@@ -1,0 +1,25 @@
+"""MNIST MLP config (ref: demo/mnist/mlp_trainer_config-style; the simplest
+end-to-end demo)."""
+
+from paddle_tpu.dsl import *
+
+is_test = get_config_arg("is_test", bool, False)
+
+define_py_data_sources2(
+    train_list="demo/mnist/train.list",
+    test_list="demo/mnist/test.list",
+    module="demo.mnist.mnist_provider",
+    obj="process")
+
+settings(
+    batch_size=128,
+    learning_rate=0.1 / 128.0,
+    learning_method=MomentumOptimizer(momentum=0.9),
+    regularization=L2Regularization(5e-4 * 128))
+
+img = data_layer(name="pixel", size=784)
+h1 = fc_layer(input=img, size=128, act=TanhActivation())
+h2 = fc_layer(input=h1, size=128, act=TanhActivation())
+predict = fc_layer(input=h2, size=10, act=SoftmaxActivation())
+label = data_layer(name="label", size=10)
+classification_cost(input=predict, label=label)

@@ -1,0 +1,57 @@
+"""MNIST data provider (ref: demo/mnist/mnist_provider.py).
+
+Reads the standard IDX-format files if present in demo/mnist/data/ (the
+reference's get_mnist_data.sh downloads them); with no dataset on disk it
+falls back to a deterministic synthetic digit-like dataset so the demo and
+benchmarks run hermetically.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.data.provider import dense_vector, integer_value, provider
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def _read_idx_images(path):
+    with open(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    return data.astype(np.float32) / 255.0
+
+
+def _read_idx_labels(path):
+    with open(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
+
+
+def _synthetic(n, seed):
+    """Digit-like blobs: each class is a fixed random 28x28 template plus
+    noise — linearly separable enough to show convergence.  Templates are
+    seeded independently of the split so train and test share classes."""
+    templates = np.random.default_rng(42).random((10, 784)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n).astype(np.int32)
+    x = 0.7 * templates[y] + 0.3 * rng.random((n, 784)).astype(np.float32)
+    return x, y
+
+
+def _load(split):
+    img = os.path.join(DATA_DIR, f"{split}-images-idx3-ubyte")
+    lbl = os.path.join(DATA_DIR, f"{split}-labels-idx1-ubyte")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _read_idx_images(img), _read_idx_labels(lbl)
+    return _synthetic(8192 if split == "train" else 1024,
+                      seed=0 if split == "train" else 1)
+
+
+@provider(input_types={"pixel": dense_vector(784), "label": integer_value(10)})
+def process(settings, filename):
+    split = "train" if "train" in filename else "t10k"
+    x, y = _load(split)
+    for i in range(len(y)):
+        yield [x[i], int(y[i])]

@@ -1,0 +1,21 @@
+"""MNIST small-VGG config (ref: demo/mnist/vgg_16_mnist.py — small_vgg over
+28x28x1 images, 10 classes)."""
+
+from paddle_tpu.dsl import *
+
+define_py_data_sources2(
+    train_list="demo/mnist/train.list",
+    test_list="demo/mnist/test.list",
+    module="demo.mnist.mnist_provider",
+    obj="process")
+
+settings(
+    batch_size=128,
+    learning_rate=0.1 / 128.0,
+    learning_method=MomentumOptimizer(momentum=0.9),
+    regularization=L2Regularization(5e-4 * 128))
+
+img = data_layer(name="pixel", size=784, height=28, width=28)
+predict = small_vgg(input_image=img, num_channels=1, num_classes=10)
+label = data_layer(name="label", size=10)
+classification_cost(input=predict, label=label)

@@ -1,0 +1,32 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of 2016-era
+PaddlePaddle (reference: /root/reference): a layer-graph model description
+built by a Python DSL, compiled into a single XLA step function, trained by
+SGD-family optimizers with data/model parallelism expressed as a
+`jax.sharding.Mesh` + collectives instead of threads and parameter servers.
+
+Layer map (bottom-up), mirroring the reference's layering (SURVEY.md §1):
+
+  utils/      flags, logging, timers/stats          (ref: paddle/utils/)
+  ops/        device op library on jnp + Pallas     (ref: paddle/cuda/ hl_*)
+  parameter/  initializers, Argument batch struct   (ref: paddle/parameter/)
+  graph/      layer registry + graph executor       (ref: paddle/gserver/)
+  optim/      optimizer/LR-schedule/regularizer zoo (ref: paddle/parameter/*Optimizer*)
+  parallel/   mesh, shardings, collectives          (ref: paddle/pserver/ + MultiGradientMachine)
+  trainer/    train/test loops, checkpoint, eval    (ref: paddle/trainer/)
+  config/     model/trainer config schema + parser  (ref: proto/, config_parser.py)
+  dsl/        user-facing layer DSL                 (ref: trainer_config_helpers/)
+  data/       data providers and feeders            (ref: gserver/dataproviders/)
+  models/     model zoo                              (ref: demo/)
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu.config.schema import (  # noqa: F401
+    LayerConfig,
+    ModelConfig,
+    OptimizationConfig,
+    ParameterConfig,
+    TrainerConfig,
+)

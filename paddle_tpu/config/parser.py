@@ -1,0 +1,63 @@
+"""Config parser: run a user config file and return its TrainerConfig.
+
+TPU-native analog of the reference's config_parser entry points
+(ref: python/paddle/trainer/config_parser.py:3349 parse_config /
+parse_config_and_serialize: executes the user config with execfile inside a
+managed namespace and returns the assembled proto).  Here the user config is a
+plain Python file importing paddle_tpu.dsl; executing it against a fresh
+ConfigContext yields the TrainerConfig dataclass tree.
+"""
+
+from __future__ import annotations
+
+import runpy
+from typing import Optional
+
+from paddle_tpu.config.schema import TrainerConfig
+from paddle_tpu.dsl.base import config_context
+
+
+def parse_config_args(config_args: str) -> dict[str, str]:
+    """'a=1,b=2' -> {'a': '1', 'b': '2'} (ref: config_parser.py:3362-3366)."""
+    out: dict[str, str] = {}
+    if not config_args:
+        return out
+    for pair in config_args.split(","):
+        if not pair:
+            continue
+        k, _, v = pair.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_config(config_file: str, config_args: str = "") -> TrainerConfig:
+    """Execute `config_file` and collect the model/optimization/data configs.
+
+    The config reads `get_config_arg(name, type, default)` for --config_args
+    passthrough, exactly like the reference.
+    """
+    args = parse_config_args(config_args)
+
+    def get_config_arg(name: str, type_=str, default=None):
+        if name in args:
+            if type_ is bool:
+                return args[name].lower() in ("1", "true", "yes")
+            return type_(args[name])
+        return default
+
+    with config_context() as ctx:
+        runpy.run_path(config_file, init_globals={"get_config_arg": get_config_arg})
+        return ctx.to_trainer_config()
+
+
+def parse_config_and_serialize(config_file: str, config_args: str = "") -> str:
+    """(ref: config_parser.py parse_config_and_serialize) — JSON instead of
+    binary proto."""
+    return parse_config(config_file, config_args).to_json()
+
+
+def parse_config_callable(fn, *fn_args, **fn_kwargs) -> TrainerConfig:
+    """Build a config by calling a Python function instead of a file."""
+    with config_context() as ctx:
+        fn(*fn_args, **fn_kwargs)
+        return ctx.to_trainer_config()

@@ -1,0 +1,442 @@
+"""Model / trainer configuration schema.
+
+This is the framework's serialized model description — the TPU-native
+equivalent of the reference's protobuf schema (ref: proto/ModelConfig.proto.m4,
+TrainerConfig.proto.m4, ParameterConfig.proto.m4, DataConfig.proto.m4).  The
+reference funnels every model through a `ModelConfig` proto built by a Python
+DSL and consumed by the C++ graph builder; here the same role is played by
+plain typed dataclasses with JSON round-tripping (the graph builder is Python
+→ XLA, so protobuf buys nothing but friction).
+
+Field names deliberately track the reference's names (type strings, size
+inference, sub-model structure) so configs translate 1:1 conceptually, while
+the *representation* is idiomatic Python.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# generic (de)serialization for the whole schema tree
+# ---------------------------------------------------------------------------
+
+def _to_dict(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj):
+        out = {"__type__": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None or v == f.default:
+                continue
+            out[f.name] = _to_dict(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _to_dict(v) for k, v in obj.items()}
+    return obj
+
+
+_SCHEMA_TYPES: dict[str, type] = {}
+
+
+def _schema(cls):
+    _SCHEMA_TYPES[cls.__name__] = cls
+    return cls
+
+
+def _from_dict(data: Any) -> Any:
+    if isinstance(data, dict) and "__type__" in data:
+        cls = _SCHEMA_TYPES[data["__type__"]]
+        kwargs = {k: _from_dict(v) for k, v in data.items() if k != "__type__"}
+        return cls(**kwargs)
+    if isinstance(data, list):
+        return [_from_dict(v) for v in data]
+    if isinstance(data, dict):
+        return {k: _from_dict(v) for k, v in data.items()}
+    return data
+
+
+class _Serializable:
+    def to_dict(self) -> dict:
+        return _to_dict(self)
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Any":
+        obj = _from_dict(data)
+        assert isinstance(obj, cls), f"expected {cls.__name__}, got {type(obj).__name__}"
+        return obj
+
+    @classmethod
+    def from_json(cls, text: str) -> "Any":
+        return cls.from_dict(json.loads(text))
+
+
+# ---------------------------------------------------------------------------
+# parameters (ref: proto/ParameterConfig.proto.m4)
+# ---------------------------------------------------------------------------
+
+@_schema
+@dataclass
+class ParameterConfig(_Serializable):
+    """Trainable parameter description (ref: ParameterConfig.proto.m4:25-80)."""
+
+    name: str = ""
+    size: int = 0
+    dims: list[int] = field(default_factory=list)
+    learning_rate: float = 1.0          # per-parameter LR multiplier
+    momentum: Optional[float] = None    # None = use global momentum
+    initial_mean: float = 0.0
+    initial_std: float = 0.01
+    # 'normal' | 'uniform' | 'zero'; with initial_smart, std is scaled 1/sqrt(fan_in)
+    # (ref: config_parser.py smart initialization; ParameterConfig initial_strategy)
+    initial_strategy: str = "normal"
+    initial_smart: bool = False
+    # None = inherit the global setting; 0.0 = explicitly disabled
+    decay_rate: Optional[float] = None       # L2 (ref: decay_rate)
+    decay_rate_l1: Optional[float] = None    # L1
+    is_static: bool = False             # frozen parameter
+    is_shared: bool = False
+    sparse_update: bool = False         # row-sparse gradient path (embeddings)
+    gradient_clipping_threshold: Optional[float] = None
+    # TPU additions: sharding spec over mesh axes, e.g. ["model", None]
+    partition_spec: Optional[list] = None
+    dtype: str = "float32"
+
+
+# ---------------------------------------------------------------------------
+# projections & operators inside mixed layers
+# (ref: ModelConfig.proto.m4 ProjectionConfig:190, OperatorConfig:212)
+# ---------------------------------------------------------------------------
+
+@_schema
+@dataclass
+class ConvConfig(_Serializable):
+    """Convolution geometry (ref: ModelConfig.proto.m4 ConvConfig)."""
+
+    filter_size: int = 3
+    filter_size_y: int = 0          # 0 → square (= filter_size)
+    channels: int = 1
+    stride: int = 1
+    stride_y: int = 0
+    padding: int = 0
+    padding_y: int = 0
+    groups: int = 1
+    img_size: int = 0               # input spatial size (square)
+    img_size_y: int = 0
+    output_x: int = 0               # inferred output spatial size
+    output_y: int = 0
+    caffe_mode: bool = True         # output-size rounding mode (ref: MathUtils.cpp outputSize)
+
+
+@_schema
+@dataclass
+class PoolConfig(_Serializable):
+    """Pooling geometry (ref: ModelConfig.proto.m4 PoolConfig)."""
+
+    pool_type: str = "max-projection"   # 'max-projection' | 'avg-projection' | ...
+    channels: int = 1
+    size_x: int = 2
+    size_y: int = 0
+    stride: int = 2
+    stride_y: int = 0
+    padding: int = 0
+    padding_y: int = 0
+    img_size: int = 0
+    img_size_y: int = 0
+    output_x: int = 0
+    output_y: int = 0
+
+
+@_schema
+@dataclass
+class NormConfig(_Serializable):
+    """Local response norm geometry (ref: ModelConfig.proto.m4 NormConfig)."""
+
+    norm_type: str = "cmrnorm-projection"
+    channels: int = 1
+    size: int = 5
+    scale: float = 0.0019531
+    pow: float = 0.75
+    img_size: int = 0
+    img_size_y: int = 0
+    output_x: int = 0
+    output_y: int = 0
+
+
+@_schema
+@dataclass
+class ProjectionConfig(_Serializable):
+    """A parameterized linear-ish map inside a mixed layer
+    (ref: ProjectionConfig types: identity, dot_mul, full_matrix, table,
+    context, trans_full_matrix, conv)."""
+
+    type: str = "fc"
+    name: str = ""
+    input_size: int = 0
+    output_size: int = 0
+    # context projection (ref: ContextProjection, hl_context_projection_*)
+    context_start: int = 0
+    context_length: int = 0
+    trainable_padding: bool = False
+    # conv projection
+    conv: Optional[ConvConfig] = None
+    num_filters: int = 0
+
+
+@_schema
+@dataclass
+class OperatorConfig(_Serializable):
+    """A parameter-free multi-input op inside a mixed layer
+    (ref: OperatorConfig: dot_mul, conv)."""
+
+    type: str = "dot_mul"
+    input_indices: list[int] = field(default_factory=list)
+    input_sizes: list[int] = field(default_factory=list)
+    output_size: int = 0
+    dotmul_scale: float = 1.0
+    conv: Optional[ConvConfig] = None
+    num_filters: int = 0
+
+
+# ---------------------------------------------------------------------------
+# layers (ref: ModelConfig.proto.m4 LayerConfig:262)
+# ---------------------------------------------------------------------------
+
+@_schema
+@dataclass
+class LayerInput(_Serializable):
+    """One input edge of a layer (ref: LayerInputConfig)."""
+
+    input_layer_name: str = ""
+    input_parameter_name: str = ""
+    proj: Optional[ProjectionConfig] = None
+
+
+@_schema
+@dataclass
+class LayerConfig(_Serializable):
+    """One node of the model graph (ref: ModelConfig.proto.m4 LayerConfig:262).
+
+    Type-specific geometry lives in the typed sub-configs (conv/pool/norm) or
+    the open `attrs` dict — mirroring the proto's optional-field sprawl
+    without freezing every layer's fields into the core schema.
+    """
+
+    name: str = ""
+    type: str = ""
+    size: int = 0
+    active_type: str = ""               # activation registry key ('' = identity)
+    inputs: list[LayerInput] = field(default_factory=list)
+    bias_parameter_name: str = ""       # '' = no bias
+    operators: list[OperatorConfig] = field(default_factory=list)
+    drop_rate: float = 0.0
+    # image layers
+    conv: Optional[ConvConfig] = None
+    pool: Optional[PoolConfig] = None
+    norm: Optional[NormConfig] = None
+    num_filters: int = 0
+    shared_biases: bool = False
+    # batch norm
+    use_global_stats: Optional[bool] = None
+    moving_average_fraction: float = 0.9
+    # cost layers
+    coeff: float = 1.0
+    num_classes: int = 0                # NCE / hsigmoid / CRF tag count
+    softmax_selfnorm_alpha: float = 0.1
+    neg_sampling_dist: Optional[list] = None
+    num_neg_samples: int = 10
+    # sequence layers
+    trans_type: str = "non-seq"         # 'seq' | 'non-seq' (expand/seqpool levels)
+    seq_pool_type: str = ""             # max/average/last/first for seqpool layers
+    average_strategy: str = "average"   # 'average'|'sum'|'squarerootn'
+    select_first: bool = False
+    stride: int = -1
+    reversed: bool = False              # recurrent direction
+    # misc
+    beam_size: int = 0
+    blank: int = 0                      # CTC blank id
+    norm_by_times: bool = False
+    add_size: int = 0
+    delimited: bool = True
+    device: int = -1
+    attrs: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# recurrent groups / generation (ref: SubModelConfig:477-503)
+# ---------------------------------------------------------------------------
+
+@_schema
+@dataclass
+class MemoryConfig(_Serializable):
+    """A recurrent memory edge: layer output fed back at t+1
+    (ref: SubModelConfig.memories; config_parser.py Memory)."""
+
+    link_name: str = ""                 # in-group layer whose output is remembered
+    layer_name: str = ""                # the agent layer that reads it at t
+    boot_layer_name: str = ""           # optional initial state source (outside group)
+    boot_bias: bool = False
+    boot_bias_active_type: str = ""
+    boot_with_const_id: Optional[int] = None
+    size: int = 0
+    is_sequence: bool = False
+
+
+@_schema
+@dataclass
+class GeneratorConfig(_Serializable):
+    """Sequence-generation settings (ref: SubModelConfig.generator)."""
+
+    max_num_frames: int = 100
+    beam_size: int = 1
+    eos_layer_name: str = ""
+    eos_id: int = 0
+    bos_id: int = 0
+    num_results_per_sample: int = 1
+    log_prob: bool = True
+    # in-group layer producing the next-token distribution (scored by search)
+    prob_layer_name: str = ""
+    # memory carrying the previously generated id (fed back each step)
+    id_memory_layer_name: str = ""
+
+
+@_schema
+@dataclass
+class SubModelConfig(_Serializable):
+    """A recurrent layer group: a sub-graph unrolled over time by the executor
+    (ref: SubModelConfig:477-503; RecurrentGradientMachine)."""
+
+    name: str = ""
+    layer_names: list[str] = field(default_factory=list)
+    input_layer_names: list[str] = field(default_factory=list)
+    output_layer_names: list[str] = field(default_factory=list)
+    # scan-carried state edges
+    memories: list[MemoryConfig] = field(default_factory=list)
+    # out-of-group → in-group data links (sequence consumed per timestep)
+    in_links: list[str] = field(default_factory=list)
+    in_link_layers: list[str] = field(default_factory=list)  # in-group alias layer per link
+    # non-sequence inputs broadcast to every timestep (ref: StaticInput)
+    static_links: list[str] = field(default_factory=list)
+    static_link_layers: list[str] = field(default_factory=list)
+    out_links: list[str] = field(default_factory=list)
+    is_recurrent_layer_group: bool = False
+    reversed: bool = False
+    generator: Optional[GeneratorConfig] = None
+
+
+@_schema
+@dataclass
+class EvaluatorConfig(_Serializable):
+    """Metric attached to the graph (ref: ModelConfig.proto.m4 EvaluatorConfig:418)."""
+
+    name: str = ""
+    type: str = "classification_error"
+    input_layer_names: list[str] = field(default_factory=list)
+    num_chunk_types: int = 0
+    chunk_scheme: str = ""
+    classification_threshold: float = 0.5
+    positive_label: int = -1
+    excluded_chunk_types: list[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+@_schema
+@dataclass
+class ModelConfig(_Serializable):
+    """The whole graph (ref: ModelConfig.proto.m4:505-531)."""
+
+    type: str = "nn"                    # 'nn' | 'recurrent_nn' (has sub-models)
+    layers: list[LayerConfig] = field(default_factory=list)
+    parameters: list[ParameterConfig] = field(default_factory=list)
+    input_layer_names: list[str] = field(default_factory=list)
+    output_layer_names: list[str] = field(default_factory=list)
+    evaluators: list[EvaluatorConfig] = field(default_factory=list)
+    sub_models: list[SubModelConfig] = field(default_factory=list)
+
+    def layer(self, name: str) -> LayerConfig:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(f"no layer named {name!r}")
+
+    def parameter(self, name: str) -> ParameterConfig:
+        for p in self.parameters:
+            if p.name == name:
+                return p
+        raise KeyError(f"no parameter named {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# optimization / trainer / data configs (ref: TrainerConfig.proto.m4:20-132)
+# ---------------------------------------------------------------------------
+
+@_schema
+@dataclass
+class OptimizationConfig(_Serializable):
+    """Optimizer + schedule settings (ref: TrainerConfig.proto.m4 OptimizationConfig)."""
+
+    batch_size: int = 1
+    algorithm: str = "sgd"              # 'sgd' (others like 'owlqn' dropped: superseded)
+    learning_method: str = "momentum"   # momentum|adagrad|adadelta|rmsprop|decayed_adagrad|adam|adamax|sparse_momentum
+    learning_rate: float = 1.0
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    learning_rate_schedule: str = "constant"  # constant|poly|caffe_poly|exp|discexp|linear|manual|pass_manual
+    learning_rate_args: str = ""
+    momentum: float = 0.0
+    ada_epsilon: float = 1e-6
+    ada_rho: float = 0.95
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    l1_weight: float = 0.0
+    l2_weight: float = 0.0
+    gradient_clipping_threshold: float = 0.0
+    average_window: float = 0.0         # ModelAverage window fraction
+    max_average_window: int = 0
+    do_average_in_cpu: bool = False
+    delta_add_rate: float = 1.0
+    num_batches_per_send_parameter: int = 1
+    num_batches_per_get_parameter: int = 1
+    shrink_parameter_value: float = 0.0
+    # TPU additions
+    dtype: str = "float32"              # param dtype
+    compute_dtype: str = ""             # '' = same as dtype; 'bfloat16' for MXU speed
+
+
+@_schema
+@dataclass
+class DataConfig(_Serializable):
+    """Data source description (ref: DataConfig.proto.m4; define_py_data_sources2)."""
+
+    type: str = "py2"                   # 'py2' (PyDataProvider2-style) | 'numpy'
+    files: str = ""                     # file-list path or glob
+    load_data_module: str = ""
+    load_data_object: str = ""
+    load_data_args: str = ""
+    async_load_data: bool = True
+    constant_slots: list[float] = field(default_factory=list)
+
+
+@_schema
+@dataclass
+class TrainerConfig(_Serializable):
+    """Top-level config (ref: TrainerConfig.proto.m4:132)."""
+
+    model_config: Optional[ModelConfig] = None
+    opt_config: Optional[OptimizationConfig] = None
+    data_config: Optional[DataConfig] = None
+    test_data_config: Optional[DataConfig] = None
+    save_dir: str = "./output"

@@ -1,0 +1,150 @@
+"""@provider decorator and input-type descriptors.
+
+Behavior-compatible analog of the reference's PyDataProvider2
+(ref: python/paddle/trainer/PyDataProvider2.py: @provider:206, input types
+:57-107 dense_vector/sparse_binary_vector/sparse_vector/integer_value ×
+{scalar, sequence}; C++ host gserver/dataproviders/PyDataProvider2.cpp).
+
+A provider is a generator function decorated with @provider(input_types=...);
+it yields one sample per iteration, each sample a list/tuple aligned with
+input_types.  The TPU DataFeeder (feeder.py) pools samples, shuffles, buckets
+sequences by length and emits padded device batches — replacing the reference's
+background loadThread + memory-pool machinery.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class SlotKind(enum.Enum):
+    DENSE = 0
+    SPARSE_BINARY = 1
+    SPARSE_VALUE = 2
+    INDEX = 3
+
+
+class SeqType(enum.Enum):
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+@dataclass
+class InputType:
+    """(ref: PyDataProvider2.py InputType)."""
+
+    dim: int
+    kind: SlotKind
+    seq_type: SeqType = SeqType.NO_SEQUENCE
+
+
+def dense_vector(dim: int) -> InputType:
+    return InputType(dim, SlotKind.DENSE)
+
+
+def sparse_binary_vector(dim: int) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_BINARY)
+
+
+def sparse_vector(dim: int) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_VALUE)
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType(value_range, SlotKind.INDEX)
+
+
+def dense_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.DENSE, SeqType.SEQUENCE)
+
+
+def sparse_binary_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_BINARY, SeqType.SEQUENCE)
+
+
+def sparse_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_VALUE, SeqType.SEQUENCE)
+
+
+def integer_value_sequence(value_range: int) -> InputType:
+    return InputType(value_range, SlotKind.INDEX, SeqType.SEQUENCE)
+
+
+def integer_value_sub_sequence(value_range: int) -> InputType:
+    return InputType(value_range, SlotKind.INDEX, SeqType.SUB_SEQUENCE)
+
+
+class CacheType(enum.Enum):
+    """(ref: PyDataProvider2.py CacheType)."""
+
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+@dataclass
+class ProviderSettings:
+    """Passed as first argument to the wrapped generator
+    (ref: PyDataProvider2 settings object)."""
+
+    input_types: list[InputType] = field(default_factory=list)
+    slots: Optional[dict[str, InputType]] = None   # name -> type when dict given
+    should_shuffle: bool = True
+    pool_size: int = -1
+    cache: CacheType = CacheType.NO_CACHE
+    calc_batch_size: Optional[Callable] = None
+    args: Any = None
+    # user extension point
+    logger: Any = None
+
+
+class DataProviderWrapper:
+    """The object produced by @provider; callable like the original function
+    but also carries the settings needed by DataFeeder."""
+
+    def __init__(self, fn: Callable, settings: ProviderSettings, init_hook: Optional[Callable]):
+        self.fn = fn
+        self.settings = settings
+        self.init_hook = init_hook
+        self.__name__ = getattr(fn, "__name__", "provider")
+
+    def initialize(self, file_list: list[str], **kwargs) -> None:
+        if self.init_hook is not None:
+            self.init_hook(self.settings, file_list=file_list, **kwargs)
+
+    def samples(self, file_list: list[str]):
+        """Iterate all samples of one pass."""
+        for f in file_list:
+            yield from self.fn(self.settings, f)
+
+    @property
+    def input_types(self) -> list[InputType]:
+        st = self.settings
+        if st.slots is not None:
+            return list(st.slots.values())
+        return list(st.input_types)
+
+    @property
+    def input_names(self) -> Optional[list[str]]:
+        if self.settings.slots is not None:
+            return list(self.settings.slots.keys())
+        return None
+
+
+def provider(input_types=None, should_shuffle: bool = True, pool_size: int = -1,
+             cache: CacheType = CacheType.NO_CACHE, init_hook: Optional[Callable] = None,
+             calc_batch_size: Optional[Callable] = None, **kwargs):
+    """(ref: PyDataProvider2.py provider:206)."""
+
+    def deco(fn):
+        st = ProviderSettings(should_shuffle=should_shuffle, pool_size=pool_size,
+                              cache=cache, calc_batch_size=calc_batch_size)
+        if isinstance(input_types, dict):
+            st.slots = dict(input_types)
+        elif input_types is not None:
+            st.input_types = list(input_types)
+        return DataProviderWrapper(fn, st, init_hook)
+
+    return deco

@@ -1,0 +1,16 @@
+"""User-facing configuration DSL.
+
+The TPU framework's equivalent of the reference's
+`trainer_config_helpers` package (ref: python/paddle/trainer_config_helpers/):
+layer constructors that assemble a ModelConfig, optimizer `settings()`,
+activation/pooling/attr descriptor classes, and composite networks.
+"""
+
+from paddle_tpu.dsl.activations import *  # noqa: F401,F403
+from paddle_tpu.dsl.attrs import ParameterAttribute, ExtraLayerAttribute  # noqa: F401
+from paddle_tpu.dsl.poolings import *  # noqa: F401,F403
+from paddle_tpu.dsl.layers import *  # noqa: F401,F403
+from paddle_tpu.dsl.optimizers import *  # noqa: F401,F403
+from paddle_tpu.dsl.networks import *  # noqa: F401,F403
+from paddle_tpu.dsl.evaluators import *  # noqa: F401,F403
+from paddle_tpu.dsl.data_sources import define_py_data_sources2  # noqa: F401

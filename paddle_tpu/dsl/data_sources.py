@@ -1,0 +1,31 @@
+"""Data source declaration (ref: trainer_config_helpers/data_sources.py
+define_py_data_sources2:173)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from paddle_tpu.config.schema import DataConfig
+from paddle_tpu.dsl.base import current_context
+
+__all__ = ["define_py_data_sources2"]
+
+
+def define_py_data_sources2(
+    train_list: Optional[str],
+    test_list: Optional[str],
+    module: str,
+    obj: str,
+    args: Any = None,
+) -> None:
+    """Declare train/test providers backed by @provider functions
+    (ref: data_sources.py:173; PyDataProvider2)."""
+    ctx = current_context()
+    import json
+    args_str = json.dumps(args) if args is not None else ""
+    if train_list is not None:
+        ctx.data = DataConfig(type="py2", files=train_list, load_data_module=module,
+                              load_data_object=obj, load_data_args=args_str)
+    if test_list is not None:
+        ctx.test_data = DataConfig(type="py2", files=test_list, load_data_module=module,
+                                   load_data_object=obj, load_data_args=args_str)

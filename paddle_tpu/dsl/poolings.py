@@ -1,0 +1,43 @@
+"""Pooling descriptors (ref: trainer_config_helpers/poolings.py)."""
+
+from __future__ import annotations
+
+__all__ = ["BasePoolingType", "MaxPooling", "AvgPooling", "SumPooling",
+           "SquareRootNPooling", "LastPooling", "FirstPooling",
+           "MaxWithIdPooling"]
+
+
+class BasePoolingType:
+    name: str = ""
+
+
+class MaxPooling(BasePoolingType):
+    name = "max"
+
+
+class MaxWithIdPooling(BasePoolingType):
+    name = "max"
+
+
+class AvgPooling(BasePoolingType):
+    name = "average"
+    strategy = "average"
+
+
+class SumPooling(BasePoolingType):
+    name = "average"
+    strategy = "sum"
+
+
+class SquareRootNPooling(BasePoolingType):
+    name = "average"
+    strategy = "squarerootn"
+
+
+class LastPooling(BasePoolingType):
+    name = "seqlastins"
+
+
+class FirstPooling(BasePoolingType):
+    name = "seqlastins"
+    select_first = True

@@ -1,0 +1,9 @@
+from paddle_tpu.graph.builder import GraphExecutor  # noqa: F401
+from paddle_tpu.graph.registry import layer_registry, register_layer  # noqa: F401
+
+# importing the implementation modules populates the registry
+from paddle_tpu.graph import layers_core  # noqa: F401
+from paddle_tpu.graph import layers_cost  # noqa: F401
+from paddle_tpu.graph import layers_seq  # noqa: F401
+from paddle_tpu.graph import layers_conv  # noqa: F401
+from paddle_tpu.graph import layers_misc  # noqa: F401

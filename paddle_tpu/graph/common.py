@@ -1,0 +1,49 @@
+"""Shared helpers for layer implementations."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import LayerConfig
+from paddle_tpu.graph.context import ForwardContext
+from paddle_tpu.ops.activations import activation
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+
+
+def apply_dropout(ctx: ForwardContext, cfg: LayerConfig, x: Array) -> Array:
+    """Classic (non-inverted) dropout, matching the reference: multiply by a
+    Bernoulli mask at train time, by (1 - drop_rate) at test time
+    (ref: paddle/gserver/layers/Layer.cpp forwardDropOut)."""
+    p = cfg.drop_rate
+    if p <= 0.0:
+        return x
+    if ctx.is_training:
+        keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - p, x.shape)
+        return x * keep.astype(x.dtype)
+    return x * (1.0 - p)
+
+
+def finish_layer(
+    ctx: ForwardContext,
+    cfg: LayerConfig,
+    value: Array,
+    like: Optional[Argument] = None,
+    lengths: Optional[Array] = None,
+) -> Argument:
+    """Apply activation + dropout and package the output Argument, inheriting
+    sequence structure from `like` (ref: Layer::forwardActivation +
+    Argument::resizeAndCopyFrom sequence info propagation)."""
+    if lengths is None and like is not None and value.ndim >= 3:
+        lengths = like.lengths
+    mask = None
+    if cfg.active_type == "sequence_softmax" and lengths is not None:
+        mask = (jnp.arange(value.shape[1])[None, :] < lengths[:, None])
+    out = activation(cfg.active_type, value, mask=mask)
+    out = apply_dropout(ctx, cfg, out)
+    sub_lengths = like.sub_lengths if like is not None else None
+    return Argument(value=out, lengths=lengths, sub_lengths=sub_lengths)

@@ -1,0 +1,66 @@
+"""ForwardContext — per-trace state threaded through layer functions.
+
+Carries what the reference spread across Layer members and globals: the mode
+(train/test/generation — ref: PassType in paddle/utils/GlobalConstants.h), the
+parameter map (ref: NeuralNetwork::parameterMap_), already-computed layer
+outputs (ref: Layer::inputLayers_ pointers), per-layer RNG for dropout and
+sampling, and mutable layer state such as batch-norm moving stats (ref:
+use_global_stats / movingMean_ in BatchNormalizationLayer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+
+from paddle_tpu.config.schema import LayerConfig, ModelConfig
+from paddle_tpu.parameter.argument import Argument
+
+TRAIN = "train"
+TEST = "test"
+GEN = "gen"
+
+
+@dataclass
+class ForwardContext:
+    model: ModelConfig
+    params: dict[str, jax.Array]
+    mode: str = TRAIN
+    rng: Optional[jax.Array] = None
+    # layer name -> computed output
+    outputs: dict[str, Argument] = field(default_factory=dict)
+    # layer name -> incoming state (e.g. BN moving stats), and collected updates
+    state_in: dict[str, Any] = field(default_factory=dict)
+    state_out: dict[str, Any] = field(default_factory=dict)
+    # accumulated per-sample costs from cost layers: name -> [B]
+    costs: dict[str, jax.Array] = field(default_factory=dict)
+    _rng_counter: int = 0
+
+    @property
+    def is_training(self) -> bool:
+        return self.mode == TRAIN
+
+    def next_rng(self) -> jax.Array:
+        assert self.rng is not None, "forward() needs an rng for stochastic layers"
+        self._rng_counter += 1
+        return jax.random.fold_in(self.rng, self._rng_counter)
+
+    def get_input(self, cfg: LayerConfig, i: int) -> Argument:
+        name = cfg.inputs[i].input_layer_name
+        try:
+            return self.outputs[name]
+        except KeyError:
+            raise KeyError(
+                f"layer {cfg.name!r} input {name!r} not computed yet — config out of topo order?")
+
+    def get_inputs(self, cfg: LayerConfig) -> list[Argument]:
+        return [self.get_input(cfg, i) for i in range(len(cfg.inputs))]
+
+    def param_of(self, cfg: LayerConfig, i: int) -> Optional[jax.Array]:
+        pname = cfg.inputs[i].input_parameter_name
+        return self.params[pname] if pname else None
+
+    def bias_of(self, cfg: LayerConfig) -> Optional[jax.Array]:
+        return self.params[cfg.bias_parameter_name] if cfg.bias_parameter_name else None

@@ -1,0 +1,382 @@
+"""Convolution / pooling / normalization layers.
+
+Covers the reference's image stack (ref: paddle/gserver/layers/
+{ExpandConvLayer,CudnnConvLayer,ConvProjection,ExpandConvTransLayer,PoolLayer,
+CudnnPoolLayer,SpatialPyramidPoolLayer,MaxOutLayer,NormProjectionLayer,
+BatchNormalizationLayer,CudnnBatchNormLayer,BilinearInterpLayer,
+BlockExpandLayer}.cpp and paddle/cuda/src/hl_cuda_cnn.cu).
+
+Re-design: images flow between layers as flat [B, C*H*W] rows exactly like the
+reference's matrix representation (so layer `size` semantics and the DSL's
+size inference carry over), and each image layer reshapes to NCHW internally.
+All convs lower to `lax.conv_general_dilated`, which XLA maps onto the MXU —
+the im2col/cuDNN split of the reference collapses into one compiler path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from typing import Optional
+
+from paddle_tpu.config.schema import ConvConfig, LayerConfig, OperatorConfig, PoolConfig, ProjectionConfig
+from paddle_tpu.graph.common import finish_layer
+from paddle_tpu.graph.context import ForwardContext
+from paddle_tpu.graph.registry import register_layer
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+
+
+def _geom(c: ConvConfig):
+    fy = c.filter_size_y or c.filter_size
+    sy = c.stride_y or c.stride
+    py = c.padding_y if c.padding_y else c.padding
+    iy = c.img_size_y or c.img_size
+    return c.filter_size, fy, c.stride, sy, c.padding, py, c.img_size, iy
+
+
+def conv_output_size(img: int, filt: int, stride: int, pad: int, caffe_mode: bool = True) -> int:
+    """(ref: paddle/math/MathUtils.cpp outputSize)."""
+    if caffe_mode:
+        return (img + 2 * pad - filt) // stride + 1
+    return (img - filt + 2 * pad + stride - 1) // stride + 1
+
+
+def _pad_amounts(img: int, filt: int, stride: int, pad: int, out: int) -> tuple[int, int]:
+    """Explicit (lo, hi) padding that reproduces the configured output size:
+    left padding is exactly `pad` (so windows align with the reference's),
+    right padding absorbs the remainder (may be negative = crop)."""
+    total = (out - 1) * stride + filt - img
+    return pad, total - pad
+
+
+def conv2d_forward(x_flat: Array, w: Array, conv: ConvConfig, num_filters: int,
+                   transpose: bool = False) -> Array:
+    """x_flat [B, C*H*W] -> [B, num_filters*OH*OW].
+
+    w layout: [num_filters, C//groups * fh * fw] matching the reference's
+    parameter shape for conv layers (ref: ExpandConvLayer weights), reshaped to
+    OIHW for the XLA conv.
+    """
+    fx, fy, sx, sy, px, py, ix, iy = _geom(conv)
+    B = x_flat.shape[0]
+    C = conv.channels
+    x = x_flat.reshape(B, C, iy, ix)
+    g = conv.groups
+
+    if not transpose:
+        oy = conv.output_y or conv_output_size(iy, fy, sy, py, conv.caffe_mode)
+        ox = conv.output_x or conv_output_size(ix, fx, sx, px, conv.caffe_mode)
+        w4 = w.reshape(num_filters, C // g, fy, fx)
+        pad_y = _pad_amounts(iy, fy, sy, py, oy)
+        pad_x = _pad_amounts(ix, fx, sx, px, ox)
+        y = lax.conv_general_dilated(
+            x, w4, window_strides=(sy, sx), padding=(pad_y, pad_x),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=g)
+        return y.reshape(B, num_filters * oy * ox)
+    else:
+        # transposed conv (ref: ExpandConvTransLayer): output spatial size is
+        # the conv-input size that would have produced this input
+        oy = conv.output_y
+        ox = conv.output_x
+        w4 = w.reshape(num_filters, C // g, fy, fx)
+        y = lax.conv_transpose(
+            x, w4, strides=(sy, sx), padding=((py, py), (px, px)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"), transpose_kernel=True)
+        # crop/pad to the configured output size
+        y = y[:, :, :oy, :ox]
+        return y.reshape(B, num_filters * oy * ox)
+
+
+def _add_conv_bias(acc: Array, b: Optional[Array], cfg: LayerConfig) -> Array:
+    """Per-channel (shared) or per-position bias (ref: ConvBaseLayer addBias);
+    DSL biases come as [1, k] rows — flatten before broadcasting."""
+    if b is None:
+        return acc
+    b = b.reshape(-1)
+    if cfg.shared_biases:
+        ohw = acc.shape[1] // cfg.num_filters
+        return (acc.reshape(acc.shape[0], cfg.num_filters, ohw)
+                + b[None, :, None]).reshape(acc.shape)
+    return acc + b
+
+
+def _conv_like_layer(ctx: ForwardContext, cfg: LayerConfig, transpose: bool) -> Argument:
+    inputs = ctx.get_inputs(cfg)
+    acc = None
+    for i, (inp, arg) in enumerate(zip(cfg.inputs, inputs)):
+        conv = inp.proj.conv if (inp.proj and inp.proj.conv) else cfg.conv
+        w = ctx.param_of(cfg, i)
+        y = conv2d_forward(arg.value, w, conv, cfg.num_filters, transpose=transpose)
+        acc = y if acc is None else acc + y
+    acc = _add_conv_bias(acc, ctx.bias_of(cfg), cfg)
+    return finish_layer(ctx, cfg, acc, like=inputs[0])
+
+
+@register_layer("exconv", "cudnn_conv")
+def conv_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Convolution layer; multiple inputs sum their conv outputs
+    (ref: ExpandConvLayer.cpp / CudnnConvLayer.cpp)."""
+    return _conv_like_layer(ctx, cfg, transpose=False)
+
+
+@register_layer("exconvt")
+def conv_trans_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Transposed convolution (ref: ExpandConvTransLayer.cpp)."""
+    return _conv_like_layer(ctx, cfg, transpose=True)
+
+
+def conv_projection_forward(proj: ProjectionConfig, arg: Argument, w: Array) -> Array:
+    """Conv as a projection inside mixed (ref: ConvProjection.cpp)."""
+    return conv2d_forward(arg.value, w, proj.conv, proj.num_filters)
+
+
+def conv_operator_forward(op: OperatorConfig, img: Argument, filt: Argument) -> Array:
+    """Conv with the *filter supplied by a layer output* — each sample has its
+    own filter (ref: ConvOperator.cpp, used by attention-style models)."""
+    conv = op.conv
+    fx, fy, sx, sy, px, py, ix, iy = _geom(conv)
+    B = img.value.shape[0]
+    C = conv.channels
+    x = img.value.reshape(B, C, iy, ix)
+    w = filt.value.reshape(B, op.num_filters, C, fy, fx)
+    oy = conv.output_y or conv_output_size(iy, fy, sy, py, conv.caffe_mode)
+    ox = conv.output_x or conv_output_size(ix, fx, sx, px, conv.caffe_mode)
+    pad_y = _pad_amounts(iy, fy, sy, py, oy)
+    pad_x = _pad_amounts(ix, fx, sx, px, ox)
+
+    def one(xi, wi):
+        return lax.conv_general_dilated(
+            xi[None], wi, (sy, sx), (pad_y, pad_x),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))[0]
+
+    y = jax.vmap(one)(x, w)
+    return y.reshape(B, op.num_filters * oy * ox)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _pool_geom(p: PoolConfig):
+    ky = p.size_y or p.size_x
+    sy = p.stride_y or p.stride
+    py = p.padding_y if p.padding_y else p.padding
+    iy = p.img_size_y or p.img_size
+    return p.size_x, ky, p.stride, sy, p.padding, py, p.img_size, iy
+
+
+def pool2d_forward(x_flat: Array, pool: PoolConfig) -> Array:
+    kx, ky, sx, sy, px, py, ix, iy = _pool_geom(pool)
+    B = x_flat.shape[0]
+    C = pool.channels
+    x = x_flat.reshape(B, C, iy, ix)
+    oy = pool.output_y or conv_output_size(iy, ky, sy, py, caffe_mode=False)
+    ox = pool.output_x or conv_output_size(ix, kx, sx, px, caffe_mode=False)
+    pad_y = _pad_amounts(iy, ky, sy, py, oy)
+    pad_x = _pad_amounts(ix, kx, sx, px, ox)
+    dims = (1, 1, ky, kx)
+    strides = (1, 1, sy, sx)
+    padding = ((0, 0), (0, 0), pad_y, pad_x)
+    if pool.pool_type.startswith("max"):
+        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+    else:
+        # average excluding padding (ref: hl_avgpool_forward divides by the
+        # clipped window size)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+        ones = jnp.ones((1, 1, iy, ix), x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+        y = s / jnp.maximum(cnt, 1.0)
+    return y.reshape(B, C * oy * ox)
+
+
+@register_layer("pool", "cudnn_pool")
+def pool_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """(ref: PoolLayer.cpp / CudnnPoolLayer.cpp)."""
+    x = ctx.get_input(cfg, 0)
+    out = pool2d_forward(x.value, cfg.pool)
+    return finish_layer(ctx, cfg, out, like=x)
+
+
+@register_layer("spp")
+def spp_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Spatial pyramid pooling: pool at pyramid levels 0..L-1 and concat
+    (ref: SpatialPyramidPoolLayer.cpp)."""
+    import dataclasses
+    x = ctx.get_input(cfg, 0)
+    p = cfg.pool
+    levels = cfg.attrs.get("pyramid_height", 1)
+    parts = []
+    ix, iy = p.img_size, (p.img_size_y or p.img_size)
+    for lvl in range(levels):
+        n = 2 ** lvl
+        kx, ky = -(-ix // n), -(-iy // n)
+        sub = dataclasses.replace(
+            p, size_x=kx, size_y=ky, stride=kx, stride_y=ky, padding=0, padding_y=0,
+            output_x=n, output_y=n)
+        parts.append(pool2d_forward(x.value, sub))
+    out = jnp.concatenate(parts, axis=-1)
+    return finish_layer(ctx, cfg, out, like=x)
+
+
+@register_layer("maxout")
+def maxout_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Max over groups of consecutive channels (ref: MaxOutLayer.cpp,
+    hl_maxout_forward: out channel o = max over in channels o*g..o*g+g-1)."""
+    x = ctx.get_input(cfg, 0)
+    groups = cfg.attrs["groups"]
+    C = cfg.conv.channels if cfg.conv else cfg.attrs["channels"]
+    B, D = x.value.shape
+    hw = D // C
+    out = jnp.max(x.value.reshape(B, C // groups, groups, hw), axis=2)
+    return finish_layer(ctx, cfg, out.reshape(B, -1), like=x)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register_layer("norm")
+def norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Cross-channel local response normalization (cmrnorm)
+    (ref: NormProjectionLayer.cpp, hl_CMRNorm_forward):
+    y = x * (1 + scale * sum_{window} x^2)^(-pow)."""
+    x = ctx.get_input(cfg, 0)
+    n = cfg.norm
+    B = x.value.shape[0]
+    C, H, W = n.channels, (n.img_size_y or n.img_size), n.img_size
+    v = x.value.reshape(B, C, H, W)
+    sq = jnp.square(v)
+    half = n.size // 2
+    padded = jnp.pad(sq, ((0, 0), (half, n.size - 1 - half), (0, 0), (0, 0)))
+    wsum = sum(padded[:, i:i + C] for i in range(n.size))
+    y = v * jnp.power(1.0 + n.scale * wsum, -n.pow)
+    return finish_layer(ctx, cfg, y.reshape(B, -1), like=x)
+
+
+@register_layer("batch_norm", "cudnn_batch_norm")
+def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Batch normalization with moving-average inference stats
+    (ref: BatchNormalizationLayer.cpp; moving stats are state, not params).
+
+    Image inputs ([B, C*H*W] with conv geometry) normalize per channel;
+    plain inputs per feature.
+    """
+    x = ctx.get_input(cfg, 0)
+    scale = ctx.param_of(cfg, 0)
+    bias = ctx.bias_of(cfg)
+    eps = 1e-5
+    v = x.value
+    img = cfg.conv is not None and cfg.conv.img_size > 0
+    if img:
+        C = cfg.conv.channels
+        B = v.shape[0]
+        v4 = v.reshape(B, C, -1)
+        axes = (0, 2)
+        stat_shape = (1, C, 1)
+    else:
+        v4 = v
+        axes = (0,)
+        stat_shape = (1, v.shape[-1])
+
+    state = ctx.state_in.get(cfg.name)
+    if state is None:
+        state = {"mean": jnp.zeros(stat_shape[1] if not img else C),
+                 "var": jnp.ones(stat_shape[1] if not img else C),
+                 "count": jnp.zeros(())}
+
+    use_global = cfg.use_global_stats
+    if use_global is None:
+        use_global = not ctx.is_training
+
+    if use_global:
+        mean = state["mean"].reshape(stat_shape)
+        var = state["var"].reshape(stat_shape)
+        new_state = state
+    else:
+        mean = jnp.mean(v4, axis=axes).reshape(stat_shape)
+        var = jnp.var(v4, axis=axes).reshape(stat_shape)
+        f = cfg.moving_average_fraction
+        new_state = {
+            "mean": f * state["mean"] + (1 - f) * mean.reshape(-1),
+            "var": f * state["var"] + (1 - f) * var.reshape(-1),
+            "count": state["count"] + 1,
+        }
+    ctx.state_out[cfg.name] = new_state
+    normed = (v4 - mean) / jnp.sqrt(var + eps)
+    normed = normed * scale.reshape(stat_shape)
+    if bias is not None:
+        normed = normed + bias.reshape(stat_shape)
+    return finish_layer(ctx, cfg, normed.reshape(v.shape), like=x)
+
+
+@register_layer("data_norm")
+def data_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Input feature normalization from precomputed stats
+    (ref: DataNormLayer.cpp; strategy z-score/min-max/decimal-scaling)."""
+    x = ctx.get_input(cfg, 0)
+    w = ctx.param_of(cfg, 0)  # [5, D]: min, max, sum, sum^2, count rows
+    strategy = cfg.attrs.get("data_norm_strategy", "z-score")
+    dmin, dmax, dsum, dsq, dcnt = (w[i] for i in range(5))
+    cnt = jnp.maximum(dcnt, 1.0)
+    mean = dsum / cnt
+    std = jnp.sqrt(jnp.maximum(dsq / cnt - jnp.square(mean), 1e-8))
+    if strategy == "min-max":
+        out = (x.value - dmin) / jnp.maximum(dmax - dmin, 1e-8)
+    elif strategy == "decimal-scaling":
+        scale = jnp.power(10.0, jnp.ceil(jnp.log10(jnp.maximum(
+            jnp.maximum(jnp.abs(dmax), jnp.abs(dmin)), 1e-8))))
+        out = x.value / scale
+    else:
+        out = (x.value - mean) / std
+    return finish_layer(ctx, cfg, out, like=x)
+
+
+@register_layer("sum_to_one_norm")
+def sum_to_one_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Row-normalize to sum 1 (ref: SumToOneNormLayer.cpp)."""
+    x = ctx.get_input(cfg, 0)
+    s = jnp.sum(x.value, axis=-1, keepdims=True)
+    return finish_layer(ctx, cfg, x.value / jnp.where(jnp.abs(s) > 1e-12, s, 1.0), like=x)
+
+
+# ---------------------------------------------------------------------------
+# resize-ish layers
+# ---------------------------------------------------------------------------
+
+@register_layer("bilinear_interp")
+def bilinear_interp_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Bilinear upsample (ref: BilinearInterpLayer.cpp, hl_bilinear_forward)."""
+    x = ctx.get_input(cfg, 0)
+    a = cfg.attrs
+    C, ih, iw = a["channels"], a["img_size_y"], a["img_size_x"]
+    oh, ow = a["out_size_y"], a["out_size_x"]
+    B = x.value.shape[0]
+    v = x.value.reshape(B, C, ih, iw)
+    out = jax.image.resize(v, (B, C, oh, ow), method="bilinear")
+    return finish_layer(ctx, cfg, out.reshape(B, -1), like=x)
+
+
+@register_layer("blockexpand")
+def block_expand_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """im2col into a sequence of patch vectors (ref: BlockExpandLayer.cpp):
+    output is a sequence with one timestep per block position."""
+    a = cfg.attrs
+    x = ctx.get_input(cfg, 0)
+    C, ih, iw = a["channels"], a["img_size_y"], a["img_size_x"]
+    bx, by = a["block_x"], a["block_y"]
+    sx, sy = a.get("stride_x", 1), a.get("stride_y", 1)
+    px, py = a.get("padding_x", 0), a.get("padding_y", 0)
+    B = x.value.shape[0]
+    v = x.value.reshape(B, C, ih, iw)
+    patches = lax.conv_general_dilated_patches(
+        v, (by, bx), (sy, sx), ((py, py), (px, px)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [B, C*by*bx, oy, ox]
+    D = C * by * bx
+    oy, ox = patches.shape[2], patches.shape[3]
+    seq = jnp.moveaxis(patches.reshape(B, D, oy * ox), 1, 2)   # [B, T, D]
+    lengths = jnp.full((B,), oy * ox, jnp.int32)
+    return finish_layer(ctx, cfg, seq, lengths=lengths)

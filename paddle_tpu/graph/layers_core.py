@@ -1,0 +1,158 @@
+"""Core layers: data, fc, mixed (projections/operators), addto, concat.
+
+Covers the reference's bread-and-butter layer types (ref:
+paddle/gserver/layers/{DataLayer,FullyConnectedLayer,MixedLayer,AddtoLayer,
+ConcatenateLayer}.cpp and the projection zoo in FullMatrixProjection.cpp,
+TableProjection.cpp, IdentityProjection.cpp, DotMulProjection.cpp,
+ContextProjection.cpp, DotMulOperator.cpp).  Every op is a jnp expression on
+the padded batch — one XLA fusion region instead of per-layer virtual calls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import LayerConfig, OperatorConfig, ProjectionConfig
+from paddle_tpu.graph.common import finish_layer
+from paddle_tpu.graph.context import ForwardContext
+from paddle_tpu.graph.registry import register_layer
+from paddle_tpu.ops import sequence as seqops
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+
+
+@register_layer("data")
+def data_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Input placeholder — the feed dict supplies its value
+    (ref: DataLayer.cpp; builder pre-populates ctx.outputs)."""
+    raise AssertionError("data layers are fed, not computed")
+
+
+def _matmul(x: Array, w: Array) -> Array:
+    """Last-dim matmul that works for [B,D] and [B,T,D]."""
+    return jnp.matmul(x, w)
+
+
+@register_layer("fc")
+def fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Fully connected: sum_i x_i @ W_i + b, then activation
+    (ref: FullyConnectedLayer.cpp forward: Matrix::mul per input + addBias)."""
+    inputs = ctx.get_inputs(cfg)
+    acc = None
+    for i, arg in enumerate(inputs):
+        w = ctx.param_of(cfg, i)
+        y = _matmul(arg.value, w)
+        acc = y if acc is None else acc + y
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        acc = acc + b
+    return finish_layer(ctx, cfg, acc, like=inputs[0])
+
+
+# ---------------------------------------------------------------------------
+# mixed layer: sum of projections + operators (ref: MixedLayer.cpp)
+# ---------------------------------------------------------------------------
+
+def _apply_projection(
+    ctx: ForwardContext, proj: ProjectionConfig, arg: Argument, w: Optional[Array]
+) -> Array:
+    t = proj.type
+    if t in ("fc", "full_matrix"):
+        return _matmul(arg.value, w)
+    if t == "trans_full_matrix":
+        return _matmul(arg.value, w.T)
+    if t == "identity":
+        return arg.data
+    if t == "dot_mul":
+        # elementwise scale by a learned vector (ref: DotMulProjection.cpp)
+        return arg.value * w
+    if t == "table":
+        # embedding lookup (ref: TableProjection.cpp, hl_matrix_select_rows)
+        return w[arg.ids]
+    if t == "context":
+        padding = None
+        if proj.trainable_padding:
+            padding = w
+        return seqops.context_projection(
+            arg.value, arg.lengths, proj.context_start, proj.context_length, padding)
+    if t == "conv":
+        from paddle_tpu.graph.layers_conv import conv_projection_forward
+        return conv_projection_forward(proj, arg, w)
+    raise NotImplementedError(f"projection type {t!r}")
+
+
+def _apply_operator(ctx: ForwardContext, op: OperatorConfig, inputs: list[Argument]) -> Array:
+    if op.type == "dot_mul":
+        a, b = (inputs[i] for i in op.input_indices[:2])
+        return op.dotmul_scale * a.value * b.value
+    if op.type == "conv":
+        from paddle_tpu.graph.layers_conv import conv_operator_forward
+        a, b = (inputs[i] for i in op.input_indices[:2])
+        return conv_operator_forward(op, a, b)
+    raise NotImplementedError(f"operator type {op.type!r}")
+
+
+@register_layer("mixed")
+def mixed_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Sum of per-input projections plus operators plus bias
+    (ref: MixedLayer.cpp forward)."""
+    inputs = ctx.get_inputs(cfg)
+    acc = None
+    like = inputs[0] if inputs else None
+    for i, (inp, arg) in enumerate(zip(cfg.inputs, inputs)):
+        if inp.proj is None:
+            continue
+        w = ctx.param_of(cfg, i)
+        y = _apply_projection(ctx, inp.proj, arg, w)
+        if arg.is_sequence and (like is None or not like.is_sequence):
+            like = arg
+        acc = y if acc is None else acc + y
+    for op in cfg.operators:
+        y = _apply_operator(ctx, op, inputs)
+        acc = y if acc is None else acc + y
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        acc = acc + b
+    # sequence structure: a table projection over id sequences yields [B,T,D]
+    lengths = like.lengths if (like is not None and acc.ndim >= 3) else None
+    return finish_layer(ctx, cfg, acc, like=like, lengths=lengths)
+
+
+@register_layer("addto")
+def addto_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Elementwise sum of all inputs + bias (ref: AddtoLayer.cpp)."""
+    inputs = ctx.get_inputs(cfg)
+    acc = inputs[0].value
+    for arg in inputs[1:]:
+        acc = acc + arg.value
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        acc = acc + b
+    return finish_layer(ctx, cfg, acc, like=inputs[0])
+
+
+@register_layer("concat")
+def concat_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Feature-dim concatenation (ref: ConcatenateLayer.cpp)."""
+    inputs = ctx.get_inputs(cfg)
+    acc = jnp.concatenate([a.value for a in inputs], axis=-1)
+    return finish_layer(ctx, cfg, acc, like=inputs[0])
+
+
+@register_layer("concat2")
+def concat2_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Concatenation of projected inputs + bias (ref: ConcatenateLayer2)."""
+    inputs = ctx.get_inputs(cfg)
+    parts = []
+    for i, (inp, arg) in enumerate(zip(cfg.inputs, inputs)):
+        w = ctx.param_of(cfg, i)
+        parts.append(_apply_projection(ctx, inp.proj, arg, w) if inp.proj else arg.value)
+    acc = jnp.concatenate(parts, axis=-1)
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        acc = acc + b
+    return finish_layer(ctx, cfg, acc, like=inputs[0])

@@ -1,0 +1,324 @@
+"""Sequence & recurrent layers.
+
+Covers the reference's sequence layer family (ref: paddle/gserver/layers/
+{SequencePoolLayer,MaxLayer,AverageLayer,SequenceLastInstanceLayer,ExpandLayer,
+SequenceConcatLayer,SequenceReshapeLayer,LstmLayer,GatedRecurrentLayer,
+RecurrentLayer,MaxIdLayer,SamplingIdLayer,EosIdCheckLayer,CRFLayer,
+CRFDecodingLayer,CTCLayer,NCELayer,HierarchicalSigmoidLayer}.cpp) on the
+padded-dense sequence representation with lax.scan recurrence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import LayerConfig
+from paddle_tpu.graph.common import finish_layer
+from paddle_tpu.graph.context import ForwardContext
+from paddle_tpu.graph.registry import register_layer
+from paddle_tpu.ops import rnn as rnnops
+from paddle_tpu.ops import sequence as seqops
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# pooling over time
+# ---------------------------------------------------------------------------
+
+@register_layer("max")
+def max_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    x = ctx.get_input(cfg, 0)
+    out = seqops.seq_pool_max(x.value, x.lengths)
+    return finish_layer(ctx, cfg, out)
+
+
+@register_layer("average")
+def average_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    x = ctx.get_input(cfg, 0)
+    out = seqops.seq_pool_avg(x.value, x.lengths, cfg.average_strategy)
+    return finish_layer(ctx, cfg, out)
+
+
+@register_layer("seqlastins")
+def seq_last_ins_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    x = ctx.get_input(cfg, 0)
+    if cfg.select_first:
+        out = seqops.seq_pool_first(x.value, x.lengths)
+    else:
+        out = seqops.seq_pool_last(x.value, x.lengths)
+    return finish_layer(ctx, cfg, out)
+
+
+@register_layer("expand")
+def expand_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Broadcast per-sequence vector across a target sequence's timesteps
+    (ref: ExpandLayer.cpp; input 1 provides the sequence layout)."""
+    x = ctx.get_input(cfg, 0)
+    like = ctx.get_input(cfg, 1)
+    out = seqops.expand_to_sequence(x.value, like.lengths, like.max_len)
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        out = out + b
+    return finish_layer(ctx, cfg, out, like=like, lengths=like.lengths)
+
+
+@register_layer("seqconcat")
+def seq_concat_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    a, b = ctx.get_input(cfg, 0), ctx.get_input(cfg, 1)
+    out, lengths = seqops.seq_concat(a.value, a.lengths, b.value, b.lengths)
+    return finish_layer(ctx, cfg, out, lengths=lengths)
+
+
+@register_layer("seqreshape")
+def seq_reshape_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    x = ctx.get_input(cfg, 0)
+    out, lengths = seqops.seq_reshape(x.value, x.lengths, cfg.size)
+    return finish_layer(ctx, cfg, out, lengths=lengths)
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+# ---------------------------------------------------------------------------
+
+@register_layer("lstmemory")
+def lstmemory_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """LSTM over a pre-projected [B,T,4D] input (ref: LstmLayer.cpp — the
+    input projection is the layer below, as in the reference DSL; recurrent
+    weight [D,4D] on the input edge; bias [4D] or [7D] with peepholes)."""
+    x = ctx.get_input(cfg, 0)
+    w = ctx.param_of(cfg, 0)
+    b = ctx.bias_of(cfg)
+    hs, _, _ = rnnops.lstm_scan(
+        x.value, x.lengths, w, b,
+        active_type=cfg.active_type or "tanh",
+        gate_active_type=cfg.attrs.get("active_gate_type", "sigmoid"),
+        state_active_type=cfg.attrs.get("active_state_type", "tanh"),
+        reverse=cfg.reversed,
+    )
+    out_cfg = _without_activation(cfg)
+    return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
+
+
+@register_layer("gated_recurrent")
+def gated_recurrent_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """GRU over a pre-projected [B,T,3D] input (ref: GatedRecurrentLayer.cpp);
+    one recurrent parameter [D,3D] split into gate [D,2D] + candidate [D,D]."""
+    x = ctx.get_input(cfg, 0)
+    w = ctx.param_of(cfg, 0)
+    b = ctx.bias_of(cfg)
+    D = cfg.size
+    hs, _ = rnnops.gru_scan(
+        x.value, x.lengths, w[:, : 2 * D], w[:, 2 * D:], b,
+        active_type=cfg.active_type or "tanh",
+        gate_active_type=cfg.attrs.get("active_gate_type", "sigmoid"),
+        reverse=cfg.reversed,
+    )
+    out_cfg = _without_activation(cfg)
+    return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
+
+
+@register_layer("recurrent")
+def recurrent_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Vanilla RNN h_t = act(x_t + h W) (ref: RecurrentLayer.cpp)."""
+    x = ctx.get_input(cfg, 0)
+    w = ctx.param_of(cfg, 0)
+    b = ctx.bias_of(cfg)
+    hs, _ = rnnops.simple_rnn_scan(
+        x.value, x.lengths, w, b,
+        active_type=cfg.active_type or "tanh", reverse=cfg.reversed)
+    out_cfg = _without_activation(cfg)
+    return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
+
+
+@register_layer("lstm_step")
+def lstm_step_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """One LSTM step on [B,4D] pre-projected input + [B,D] prev cell
+    (ref: LstmStepLayer.cpp).  Publishes the new cell under attrs['state_name']."""
+    from paddle_tpu.ops.activations import activation_registry
+    x4 = ctx.get_input(cfg, 0).value
+    c_prev = ctx.get_input(cfg, 1).value
+    b = ctx.bias_of(cfg)
+    D = cfg.size
+    act = activation_registry[cfg.active_type or "tanh"]
+    gate = activation_registry[cfg.attrs.get("active_gate_type", "sigmoid")]
+    state_act = activation_registry[cfg.attrs.get("active_state_type", "tanh")]
+    peep_i = peep_f = peep_o = None
+    if b is not None:
+        b = b.reshape(-1)
+        if b.shape[-1] == 7 * D:
+            x4 = x4 + b[: 4 * D]
+            peep_i, peep_f, peep_o = b[4 * D:5 * D], b[5 * D:6 * D], b[6 * D:]
+        else:
+            x4 = x4 + b
+    a = act(x4[:, :D])
+    zi, zf, zo = x4[:, D:2 * D], x4[:, 2 * D:3 * D], x4[:, 3 * D:]
+    if peep_i is not None:
+        zi = zi + c_prev * peep_i
+        zf = zf + c_prev * peep_f
+    i = gate(zi)
+    f = gate(zf)
+    c_new = a * i + f * c_prev
+    if peep_o is not None:
+        zo = zo + c_new * peep_o
+    o = gate(zo)
+    h = o * state_act(c_new)
+    ctx.outputs[cfg.attrs["state_name"]] = Argument(value=c_new)
+    return Argument(value=h)
+
+
+@register_layer("gru_step")
+def gru_step_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """One GRU step on [B,3D] pre-projected input + [B,D] prev hidden, with
+    its own recurrent weight [D,3D] (ref: GruStepLayer.cpp)."""
+    from paddle_tpu.ops.activations import activation_registry
+    x3 = ctx.get_input(cfg, 0).value
+    h_prev = ctx.get_input(cfg, 1).value
+    w = ctx.param_of(cfg, 0)
+    b = ctx.bias_of(cfg)
+    D = cfg.size
+    act = activation_registry[cfg.active_type or "tanh"]
+    gate = activation_registry[cfg.attrs.get("active_gate_type", "sigmoid")]
+    if b is not None:
+        x3 = x3 + b.reshape(-1)
+    zg = x3[:, : 2 * D] + h_prev @ w[:, : 2 * D]
+    u = gate(zg[:, :D])
+    r = gate(zg[:, D:])
+    c = act(x3[:, 2 * D:] + (r * h_prev) @ w[:, 2 * D:])
+    h = u * h_prev + (1.0 - u) * c
+    return Argument(value=h)
+
+
+def _without_activation(cfg: LayerConfig) -> LayerConfig:
+    """Recurrent cells apply their activations inside the scan — strip
+    active_type so finish_layer doesn't re-apply it."""
+    import dataclasses
+    return dataclasses.replace(cfg, active_type="")
+
+
+# ---------------------------------------------------------------------------
+# id/decision layers
+# ---------------------------------------------------------------------------
+
+@register_layer("maxid")
+def maxid_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Argmax ids (+ beam_size top-k ids when configured)
+    (ref: MaxIdLayer.cpp, hl_top_k)."""
+    x = ctx.get_input(cfg, 0)
+    k = max(cfg.beam_size, 1)
+    if k == 1:
+        ids = jnp.argmax(x.value, axis=-1).astype(jnp.int32)
+        return Argument(ids=ids, lengths=x.lengths)
+    vals, ids = jax.lax.top_k(x.value, k)
+    return Argument(value=vals, ids=ids.astype(jnp.int32), lengths=x.lengths)
+
+
+@register_layer("sampling_id")
+def sampling_id_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Sample an id from each row's distribution (ref: SamplingIdLayer.cpp)."""
+    x = ctx.get_input(cfg, 0)
+    logits = jnp.log(jnp.maximum(x.value, 1e-10))
+    ids = jax.random.categorical(ctx.next_rng(), logits, axis=-1).astype(jnp.int32)
+    return Argument(ids=ids, lengths=x.lengths)
+
+
+@register_layer("eos_id")
+def eos_id_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """1 where input id == eos (ref: EosIdCheckLayer.cpp)."""
+    x = ctx.get_input(cfg, 0)
+    eos = cfg.attrs.get("eos_id", 0)
+    ids = (x.ids == eos).astype(jnp.int32)
+    return Argument(ids=ids, lengths=x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# structured-output layers: CRF / CTC
+# ---------------------------------------------------------------------------
+
+@register_layer("crf")
+def crf_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Linear-chain CRF negative log-likelihood over each sequence
+    (ref: CRFLayer.cpp, LinearChainCRF.cpp)."""
+    from paddle_tpu.ops.crf import crf_nll
+    x = ctx.get_input(cfg, 0)
+    lbl = ctx.get_input(cfg, 1)
+    w = ctx.param_of(cfg, 0)
+    cost = crf_nll(x.value, lbl.ids, x.lengths, w)
+    if len(cfg.inputs) > 2:
+        wt = ctx.get_input(cfg, 2)
+        cost = cost * wt.data.reshape(cost.shape)
+    ctx.costs[cfg.name] = cfg.coeff * cost
+    return Argument(value=cost[:, None])
+
+
+@register_layer("crf_decoding")
+def crf_decoding_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Viterbi decode; with a label input, emits per-token error indicators
+    (ref: CRFDecodingLayer.cpp)."""
+    from paddle_tpu.ops.crf import crf_decode
+    x = ctx.get_input(cfg, 0)
+    w = ctx.param_of(cfg, 0)
+    path = crf_decode(x.value, x.lengths, w)
+    if len(cfg.inputs) > 1:
+        lbl = ctx.get_input(cfg, 1)
+        err = (path != lbl.ids).astype(jnp.int32) * x.mask(jnp.int32)
+        return Argument(ids=err, lengths=x.lengths)
+    return Argument(ids=path, lengths=x.lengths)
+
+
+@register_layer("ctc")
+def ctc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """CTC loss (ref: CTCLayer.cpp, LinearChainCTC.cpp)."""
+    from paddle_tpu.ops.ctc import ctc_loss
+    x = ctx.get_input(cfg, 0)
+    lbl = ctx.get_input(cfg, 1)
+    cost = ctc_loss(x.value, x.lengths, lbl.ids, lbl.lengths,
+                    blank=cfg.blank, norm_by_times=cfg.norm_by_times)
+    ctx.costs[cfg.name] = cfg.coeff * cost
+    return Argument(value=cost[:, None])
+
+
+# ---------------------------------------------------------------------------
+# sampled-softmax family
+# ---------------------------------------------------------------------------
+
+@register_layer("nce")
+def nce_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Noise-contrastive estimation cost (ref: NCELayer.cpp,
+    MultinomialSampler.cpp).  Samples num_neg_samples negatives per example
+    from neg_sampling_dist (uniform when unset)."""
+    from paddle_tpu.ops.sampling import nce_cost
+    inputs = ctx.get_inputs(cfg)
+    # inputs: feature inputs (with params), then label, then optional weight
+    n_feat = sum(1 for li in cfg.inputs if li.input_parameter_name)
+    feats = [inputs[i].value for i in range(n_feat)]
+    lbl = inputs[n_feat]
+    ws = [ctx.param_of(cfg, i) for i in range(n_feat)]
+    b = ctx.bias_of(cfg)
+    dist = None
+    if cfg.neg_sampling_dist:
+        dist = jnp.asarray(cfg.neg_sampling_dist, jnp.float32)
+    cost = nce_cost(ctx.next_rng(), feats, lbl.ids, ws, b,
+                    num_classes=cfg.num_classes,
+                    num_neg=cfg.num_neg_samples, dist=dist)
+    if len(inputs) > n_feat + 1:
+        cost = cost * inputs[n_feat + 1].data.reshape(cost.shape)
+    ctx.costs[cfg.name] = cfg.coeff * cost
+    return Argument(value=cost[:, None])
+
+
+@register_layer("hsigmoid")
+def hsigmoid_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Hierarchical sigmoid cost over a complete binary tree
+    (ref: HierarchicalSigmoidLayer.cpp, math/MatrixBitCode.cpp)."""
+    from paddle_tpu.ops.sampling import hsigmoid_cost
+    inputs = ctx.get_inputs(cfg)
+    lbl = inputs[-1]
+    feats = inputs[:-1]
+    ws = [ctx.param_of(cfg, i) for i in range(len(feats))]
+    b = ctx.bias_of(cfg)
+    cost = hsigmoid_cost([f.value for f in feats], lbl.ids, ws, b, cfg.num_classes)
+    ctx.costs[cfg.name] = cfg.coeff * cost
+    return Argument(value=cost[:, None])

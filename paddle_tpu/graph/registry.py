@@ -1,0 +1,39 @@
+"""Layer registry: LayerConfig.type string -> implementation function.
+
+TPU-native analog of the reference's REGISTER_LAYER/ClassRegistrar pattern
+(ref: paddle/gserver/layers/Layer.h:32-37, paddle/utils/ClassRegistrar.h),
+with layer *functions* instead of stateful Layer objects: a layer impl is a
+pure function (ctx, cfg, inputs) -> Argument traced under jit, and autodiff
+replaces every hand-written backward() in the reference's layer zoo.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from paddle_tpu.graph.context import ForwardContext
+    from paddle_tpu.config.schema import LayerConfig
+    from paddle_tpu.parameter.argument import Argument
+
+LayerFn = Callable[..., "Argument"]
+
+layer_registry: dict[str, LayerFn] = {}
+
+
+def register_layer(*type_names: str):
+    def deco(fn: LayerFn) -> LayerFn:
+        for name in type_names:
+            if name in layer_registry:
+                raise ValueError(f"duplicate layer type {name!r}")
+            layer_registry[name] = fn
+        return fn
+    return deco
+
+
+def get_layer_fn(type_name: str) -> LayerFn:
+    try:
+        return layer_registry[type_name]
+    except KeyError:
+        raise NotImplementedError(
+            f"layer type {type_name!r} not implemented; known: {sorted(layer_registry)}")

@@ -1,0 +1,7 @@
+"""Device op library — the TPU-native analog of the reference's hl_* kernel
+surface (ref: paddle/cuda/include/hl_*.h) re-expressed as jnp functions that
+XLA fuses, plus Pallas kernels for the few ops XLA can't schedule well.
+"""
+
+from paddle_tpu.ops.activations import activation, activation_registry  # noqa: F401
+from paddle_tpu.ops import sequence  # noqa: F401

@@ -1,0 +1,117 @@
+"""Activation registry.
+
+Mirrors the reference's activation zoo and registry-by-name
+(ref: paddle/gserver/activations/ActivationFunction.cpp:67-317): identity,
+sigmoid, softmax, sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs,
+square, exponential, log.  Forward-only pure functions — autodiff supplies
+every backward the reference hand-wrote.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+activation_registry: dict[str, Callable[..., Array]] = {}
+
+
+def _register(*names: str):
+    def deco(fn):
+        for n in names:
+            activation_registry[n] = fn
+        return fn
+    return deco
+
+
+@_register("", "linear", "identity")
+def identity(x: Array, **_) -> Array:
+    return x
+
+
+@_register("sigmoid")
+def sigmoid(x: Array, **_) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+@_register("softmax")
+def softmax(x: Array, **_) -> Array:
+    return jax.nn.softmax(x, axis=-1)
+
+
+@_register("sequence_softmax")
+def sequence_softmax(x: Array, mask: Optional[Array] = None, **_) -> Array:
+    """Softmax across the time axis of a [B, T] (or [B, T, 1]) sequence of
+    scalars, masked by validity (ref: SequenceSoftmaxActivation — softmax over
+    each variable-length sequence's scalar scores, used by attention)."""
+    squeeze = False
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+        squeeze = True
+    if mask is not None:
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=-1)
+    if mask is not None:
+        out = jnp.where(mask, out, 0.0)
+    if squeeze:
+        out = out[..., None]
+    return out
+
+
+@_register("relu")
+def relu(x: Array, **_) -> Array:
+    return jax.nn.relu(x)
+
+
+@_register("brelu")
+def brelu(x: Array, **_) -> Array:
+    # bounded relu, clip to [0, 24] (ref: BReluActivation)
+    return jnp.clip(x, 0.0, 24.0)
+
+
+@_register("tanh")
+def tanh(x: Array, **_) -> Array:
+    return jnp.tanh(x)
+
+
+@_register("stanh")
+def stanh(x: Array, **_) -> Array:
+    # scaled tanh 1.7159 * tanh(2/3 x) (ref: STanhActivation)
+    return 1.7159 * jnp.tanh(x * (2.0 / 3.0))
+
+
+@_register("softrelu")
+def softrelu(x: Array, **_) -> Array:
+    # log(1 + exp(x)), input clipped to +-40 (ref: SoftReluActivation)
+    return jnp.log1p(jnp.exp(jnp.clip(x, -40.0, 40.0)))
+
+
+@_register("abs")
+def abs_(x: Array, **_) -> Array:
+    return jnp.abs(x)
+
+
+@_register("square")
+def square(x: Array, **_) -> Array:
+    return jnp.square(x)
+
+
+@_register("exponential")
+def exponential(x: Array, **_) -> Array:
+    return jnp.exp(x)
+
+
+@_register("log")
+def log(x: Array, **_) -> Array:
+    return jnp.log(x)
+
+
+def activation(name: str, x: Array, mask: Optional[Array] = None) -> Array:
+    try:
+        fn = activation_registry[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(activation_registry)}")
+    return fn(x, mask=mask)

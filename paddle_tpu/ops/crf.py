@@ -1,0 +1,98 @@
+"""Linear-chain CRF: log-likelihood and Viterbi decoding.
+
+TPU re-design of the reference's LinearChainCRF (ref:
+paddle/gserver/layers/LinearChainCRF.{h,cpp}: parameter layout w[0]=start
+weights a, w[1]=end weights b, w[2:]=transition matrix [C,C]; forward() does
+the alpha recursion per sequence, decode() Viterbi).  Here both are masked
+`lax.scan`s over the padded time axis, batched over sequences, differentiable
+by autodiff (the reference hand-writes the gradient in backward()).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _split(w: Array):
+    a = w[0]          # start potentials [C]
+    b = w[1]          # end potentials [C]
+    trans = w[2:]     # transitions [C, C]; trans[i, j] = score(prev=i -> cur=j)
+    return a, b, trans
+
+
+def crf_log_z(x: Array, lengths: Array, w: Array) -> Array:
+    """Log partition via alpha recursion: x [B,T,C] emission scores."""
+    a, b, trans = _split(w)
+    B, T, C = x.shape
+    alpha0 = a[None, :] + x[:, 0]                     # [B, C]
+
+    def step(alpha, inp):
+        x_t, t = inp
+        # logsumexp over previous tag
+        scores = alpha[:, :, None] + trans[None, :, :]       # [B, Cprev, Ccur]
+        new = jax.nn.logsumexp(scores, axis=1) + x_t         # [B, C]
+        valid = (t < lengths)[:, None]
+        alpha = jnp.where(valid, new, alpha)
+        return alpha, None
+
+    xs = jnp.moveaxis(x, 1, 0)[1:]                    # [T-1, B, C]
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha0, (xs, ts))
+    return jax.nn.logsumexp(alpha + b[None, :], axis=-1)     # [B]
+
+
+def crf_path_score(x: Array, labels: Array, lengths: Array, w: Array) -> Array:
+    """Score of the gold path: emissions + transitions + start/end."""
+    a, b, trans = _split(w)
+    B, T, C = x.shape
+    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(x.dtype)
+    emit = jnp.take_along_axis(x, labels[..., None], axis=-1)[..., 0]   # [B,T]
+    score = jnp.sum(emit * mask, axis=1)
+    score = score + a[labels[:, 0]]
+    last = jnp.maximum(lengths - 1, 0)
+    last_lbl = jnp.take_along_axis(labels, last[:, None], axis=1)[:, 0]
+    score = score + b[last_lbl]
+    pair = trans[labels[:, :-1], labels[:, 1:]]                          # [B,T-1]
+    pair_mask = mask[:, 1:]
+    return score + jnp.sum(pair * pair_mask, axis=1)
+
+
+def crf_nll(x: Array, labels: Array, lengths: Array, w: Array) -> Array:
+    """Per-sequence negative log likelihood (ref: LinearChainCRF::forward)."""
+    return crf_log_z(x, lengths, w) - crf_path_score(x, labels, lengths, w)
+
+
+def crf_decode(x: Array, lengths: Array, w: Array) -> Array:
+    """Viterbi decode -> [B, T] int32 best path (ref: LinearChainCRF::decode)."""
+    a, b, trans = _split(w)
+    B, T, C = x.shape
+    alpha0 = a[None, :] + x[:, 0]
+
+    def fwd(alpha, inp):
+        x_t, t = inp
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)        # [B, C]
+        new = jnp.max(scores, axis=1) + x_t
+        valid = (t < lengths)[:, None]
+        alpha = jnp.where(valid, new, alpha)
+        # freeze backpointers past the end: point to self
+        best_prev = jnp.where(valid, best_prev, jnp.arange(C, dtype=jnp.int32)[None, :])
+        return alpha, best_prev
+
+    xs = jnp.moveaxis(x, 1, 0)[1:]
+    ts = jnp.arange(1, T)
+    alpha, bps = lax.scan(fwd, alpha0, (xs, ts))      # bps: [T-1, B, C]
+    last_tag = jnp.argmax(alpha + b[None, :], axis=-1).astype(jnp.int32)  # [B]
+
+    def back(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    # reverse scan over transitions: prevs[t] = tag at position t (t=0..T-2)
+    _, prevs = lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([prevs, last_tag[None, :]], axis=0)   # [T, B]
+    return jnp.moveaxis(path, 0, 1)                   # [B, T]

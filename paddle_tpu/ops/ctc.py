@@ -1,0 +1,72 @@
+"""CTC loss via the standard alpha recursion on the extended label sequence.
+
+TPU re-design of the reference's CTC (ref: paddle/gserver/layers/
+{CTCLayer,LinearChainCTC}.cpp): batched, masked `lax.scan` over time in log
+space; autodiff provides the gradient the reference derives by the beta
+recursion.  Works on padded [B, T, C] probability inputs (the layer below
+applies softmax, matching the reference's usage).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+_NEG = -1e30
+
+
+def ctc_loss(
+    probs: Array,        # [B, T, C] probabilities (softmax output)
+    input_lengths: Array,  # [B]
+    labels: Array,       # [B, L] int labels (padded)
+    label_lengths: Array,  # [B]
+    blank: int = 0,
+    norm_by_times: bool = False,
+) -> Array:
+    """Per-sequence -log p(labels | probs)."""
+    logp = jnp.log(jnp.maximum(probs, 1e-10))
+    B, T, C = logp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_lengths + 1)[:, None]
+
+    # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.full((B, 2), -1, labels.dtype), ext[:, :-2]], axis=1)
+    can_skip = (jnp.arange(S)[None, :] % 2 == 1) & (ext != ext_prev2)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t], ext, axis=1)      # [B, S]
+
+    alpha0 = jnp.full((B, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, emit(0)[:, 1], _NEG))
+
+    def step(alpha, t):
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        new = merged + emit(t)
+        new = jnp.where(ext_valid, new, _NEG)
+        valid_t = (t < input_lengths)[:, None]
+        return jnp.where(valid_t, new, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # answer: logaddexp of positions 2*len-1 (last label) and 2*len (last blank)
+    s_last = 2 * label_lengths
+    a_last_blank = jnp.take_along_axis(alpha, s_last[:, None], axis=1)[:, 0]
+    a_last_lbl = jnp.take_along_axis(
+        alpha, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0]
+    a_last_lbl = jnp.where(label_lengths > 0, a_last_lbl, _NEG)
+    ll = jnp.logaddexp(a_last_blank, a_last_lbl)
+    cost = -ll
+    if norm_by_times:
+        cost = cost / jnp.maximum(input_lengths.astype(cost.dtype), 1.0)
+    return cost

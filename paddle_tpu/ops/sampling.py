@@ -1,0 +1,99 @@
+"""Sampled output layers: NCE and hierarchical sigmoid.
+
+TPU re-design of the reference's NCELayer + MultinomialSampler and
+HierarchicalSigmoidLayer + MatrixBitCode (ref: paddle/gserver/layers/
+{NCELayer,MultinomialSampler}.cpp, paddle/math/MatrixBitCode.cpp).  Sampling
+uses jax.random.categorical (the alias-table of the reference is a CPU
+construct); the bit-code path walk is vectorized over the class-id bits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+_EPS = 1e-10
+
+
+def nce_cost(
+    rng: Array,
+    feats: list[Array],   # feature inputs, each [B, D_i] (contributions summed)
+    labels: Array,        # [B]
+    ws: list[Array],      # per-input class matrices [C, D_i]
+    b: Optional[Array],   # [C] or None
+    num_classes: int,
+    num_neg: int,
+    dist: Optional[Array] = None,   # [C] sampling distribution; None = uniform
+) -> Array:
+    """Binary-logistic NCE cost with `num_neg` shared negative samples
+    (ref: NCELayer::forward — positive + sampled negatives through sigmoid CE,
+    logits summed over all feature inputs)."""
+    B = feats[0].shape[0]
+    if dist is None:
+        logdist = jnp.zeros((num_classes,))
+        p_noise = jnp.full((num_classes,), 1.0 / num_classes)
+    else:
+        logdist = jnp.log(jnp.maximum(dist, _EPS))
+        p_noise = dist
+    neg = jax.random.categorical(rng, logdist, shape=(B, num_neg))
+    if b is not None:
+        b = b.reshape(-1)
+
+    def logit(ids):  # ids [B, K] -> [B, K]
+        z = None
+        for feat, w in zip(feats, ws):
+            wk = w[ids]                   # [B, K, D]
+            zi = jnp.einsum("bkd,bd->bk", wk, feat)
+            z = zi if z is None else z + zi
+        if b is not None:
+            z = z + b[ids]
+        return z
+
+    pos_z = logit(labels[:, None])        # [B, 1]
+    neg_z = logit(neg)                    # [B, K]
+    # NCE with noise-ratio correction: sigma(z - log(k * Pn(class)))
+    pos_corr = jnp.log(num_neg * jnp.maximum(p_noise[labels[:, None]], _EPS))
+    neg_corr = jnp.log(num_neg * jnp.maximum(p_noise[neg], _EPS))
+    pos_cost = jax.nn.softplus(-(pos_z - pos_corr))[:, 0]
+    neg_cost = jnp.sum(jax.nn.softplus(neg_z - neg_corr), axis=1)
+    return pos_cost + neg_cost
+
+
+def _bit_codes(labels: Array, num_bits: int) -> tuple[Array, Array]:
+    """Huffman-free complete-binary-tree code of class id, matching the
+    reference's SimpleCode (ref: MatrixBitCode.cpp SimpleCode: code(c)=c+1,
+    node index at depth j = code>>(j+1)-1, bit = (code>>j)&1)."""
+    code = labels + 1
+    j = jnp.arange(num_bits)
+    nodes = (code[:, None] >> (j + 1)[None, :]) - 1          # [B, nb]
+    bits = (code[:, None] >> j[None, :]) & 1                 # [B, nb]
+    valid = nodes >= 0
+    return jnp.maximum(nodes, 0), jnp.where(valid, bits, -1)
+
+
+def hsigmoid_cost(
+    feats: list[Array],    # each [B, D_i]
+    labels: Array,         # [B]
+    ws: list[Array],       # each [num_classes-1, D_i] inner-node weights
+    b: Optional[Array],    # [num_classes-1]
+    num_classes: int,
+) -> Array:
+    """sum over code bits of binary logistic cost
+    (ref: HierarchicalSigmoidLayer::forward)."""
+    num_bits = max(1, (num_classes - 1).bit_length())
+    nodes, bits = _bit_codes(labels, num_bits)     # [B, nb]
+    z = None
+    for feat, w in zip(feats, ws):
+        wn = w[nodes]                              # [B, nb, D]
+        zi = jnp.einsum("bnd,bd->bn", wn, feat)
+        z = zi if z is None else z + zi
+    if b is not None:
+        z = z + b[nodes]
+    valid = bits >= 0
+    t = jnp.maximum(bits, 0).astype(z.dtype)
+    # reference convention: bit=1 -> target sigmoid(z)=1
+    cost_bits = jax.nn.softplus(z) - t * z
+    return jnp.sum(jnp.where(valid, cost_bits, 0.0), axis=1)

@@ -1,0 +1,158 @@
+"""Variable-length sequence ops on padded [B, T, ...] tensors.
+
+The reference keeps sequences as a flat (total_tokens x dim) matrix indexed by
+sequenceStartPositions and re-buckets timesteps with SequenceToBatch so RNN
+steps are dense GEMMs (ref: paddle/parameter/Argument.h:89-98,
+paddle/gserver/layers/SequenceToBatch.h, paddle/cuda/src/hl_cuda_sequence.cu).
+On TPU the idiomatic layout is *padded dense* [batch, max_len, dim] plus a
+lengths vector: every op below is a masked dense computation that XLA tiles
+onto the MXU/VPU, and `lax.scan` replaces the timestep re-bucketing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def length_mask(lengths: Array, max_len: int, dtype=jnp.bool_) -> Array:
+    """[B] lengths -> [B, T] validity mask."""
+    return (jnp.arange(max_len)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def seq_pool_max(x: Array, lengths: Array) -> Array:
+    """Max over valid timesteps: [B,T,D],[B] -> [B,D]
+    (ref: MaxLayer / hl_max_sequence_forward)."""
+    mask = length_mask(lengths, x.shape[1])[..., None]
+    neg = jnp.finfo(x.dtype).min
+    return jnp.max(jnp.where(mask, x, neg), axis=1)
+
+
+def seq_pool_avg(x: Array, lengths: Array, strategy: str = "average") -> Array:
+    """Mean/sum/sqrt-n over valid timesteps (ref: AverageLayer,
+    hl_sequence_avg_forward; average_strategy average|sum|squarerootn)."""
+    mask = length_mask(lengths, x.shape[1], x.dtype)[..., None]
+    total = jnp.sum(x * mask, axis=1)
+    n = jnp.maximum(lengths.astype(x.dtype), 1.0)[:, None]
+    if strategy == "sum":
+        return total
+    if strategy == "squarerootn":
+        return total / jnp.sqrt(n)
+    return total / n
+
+
+def seq_pool_last(x: Array, lengths: Array) -> Array:
+    """Last valid timestep: [B,T,D],[B] -> [B,D] (ref: SequenceLastInstanceLayer)."""
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+
+
+def seq_pool_first(x: Array, lengths: Array) -> Array:
+    """First timestep (ref: SequenceLastInstanceLayer with select_first)."""
+    return x[:, 0]
+
+
+def expand_to_sequence(x: Array, lengths: Array, max_len: int) -> Array:
+    """Broadcast per-sequence vectors across timesteps: [B,D] -> [B,T,D],
+    zeroed past each length (ref: ExpandLayer)."""
+    mask = length_mask(lengths, max_len, x.dtype)[..., None]
+    return jnp.broadcast_to(x[:, None, :], (x.shape[0], max_len, x.shape[1])) * mask
+
+
+def context_projection(
+    x: Array,
+    lengths: Array,
+    context_start: int,
+    context_length: int,
+    padding: Optional[Array] = None,
+) -> Array:
+    """Concatenate a sliding window of timesteps per position:
+    [B,T,D] -> [B,T,context_length*D]
+    (ref: ContextProjection, hl_context_projection_forward).
+
+    Out-of-range positions (before 0 / after length-1) read zeros, or rows of a
+    trainable `padding` matrix [(up_pad+down_pad), D] when provided — matching
+    the reference's trainable_padding.
+    """
+    B, T, D = x.shape
+    mask = length_mask(lengths, T, x.dtype)[..., None]
+    xm = x * mask
+    cols = []
+    up_pad = max(0, -context_start)
+    for j in range(context_length):
+        offset = context_start + j
+        shifted = jnp.roll(xm, shift=-offset, axis=1)
+        t = jnp.arange(T)[None, :]
+        src = t + offset
+        valid = (src >= 0) & (src < lengths[:, None])
+        if padding is not None:
+            if offset < 0:
+                # positions src<0 read padding row (up_pad + src)
+                pad_row = padding[jnp.clip(up_pad + src, 0, padding.shape[0] - 1)]
+                fill = jnp.where((src < 0)[..., None], pad_row, 0.0)
+            elif offset > 0:
+                # positions src>=length read padding row (up_pad + (src - length))
+                over = src - lengths[:, None]
+                pad_row = padding[jnp.clip(up_pad + over, 0, padding.shape[0] - 1)]
+                fill = jnp.where((over >= 0)[..., None], pad_row, 0.0)
+            else:
+                fill = jnp.zeros_like(shifted)
+            col = jnp.where(valid[..., None], shifted, fill)
+        else:
+            col = jnp.where(valid[..., None], shifted, 0.0)
+        cols.append(col)
+    out = jnp.concatenate(cols, axis=-1)
+    return out * mask
+
+
+def seq_concat(a: Array, la: Array, b: Array, lb: Array) -> tuple[Array, Array]:
+    """Concatenate two sequence batches along time: ([B,Ta,D],[B,Tb,D]) ->
+    [B,Ta+Tb,D] with b's valid part starting right after a's
+    (ref: SequenceConcatLayer)."""
+    B, Ta, D = a.shape
+    Tb = b.shape[1]
+    T = Ta + Tb
+    out_len = la + lb
+    # scatter b at positions la..la+lb-1
+    maska = length_mask(la, T, a.dtype)[..., None]
+    padded_a = jnp.pad(a, ((0, 0), (0, Tb), (0, 0))) * maska
+    t = jnp.arange(T)[None, :]
+    src_b = t - la[:, None]
+    valid_b = (src_b >= 0) & (src_b < lb[:, None])
+    gathered_b = jnp.take_along_axis(
+        jnp.pad(b, ((0, 0), (0, Ta), (0, 0))),
+        jnp.clip(src_b, 0, T - 1)[..., None].repeat(D, axis=-1), axis=1)
+    out = padded_a + jnp.where(valid_b[..., None], gathered_b, 0.0)
+    return out, out_len
+
+
+def seq_reshape(x: Array, lengths: Array, new_dim: int) -> tuple[Array, Array]:
+    """Reshape each sequence's flat token stream to a new feature width
+    (ref: SequenceReshapeLayer): [B,T,D] -> [B, T*D//new_dim, new_dim]."""
+    B, T, D = x.shape
+    assert (T * D) % new_dim == 0
+    new_t = T * D // new_dim
+    out = x.reshape(B, new_t, new_dim)
+    new_len = lengths * D // new_dim
+    return out, new_len
+
+
+def seq_slice_first_tokens(x: Array, lengths: Array, n: int) -> tuple[Array, Array]:
+    """First n tokens of each sequence (ref: SubSequenceLayer special case)."""
+    return x[:, :n], jnp.minimum(lengths, n)
+
+
+def seq_reverse(x: Array, lengths: Array) -> Array:
+    """Reverse each sequence's valid prefix in place: [B,T,D] -> [B,T,D]
+    (used by reversed recurrent layers; ref: RecurrentLayer reversed_)."""
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = lengths[:, None] - 1 - t
+    valid = src >= 0
+    idx = jnp.where(valid, src, t)
+    out = jnp.take_along_axis(x, idx.reshape(B, T, *([1] * (x.ndim - 2))), axis=1)
+    return jnp.where(valid.reshape(B, T, *([1] * (x.ndim - 2))), out, x)

@@ -1,0 +1,2 @@
+from paddle_tpu.optim.schedulers import learning_rate_at  # noqa: F401
+from paddle_tpu.optim.updater import ParameterUpdater  # noqa: F401

@@ -1,0 +1,150 @@
+"""First-order optimizer zoo.
+
+Matches the reference's optimizer family (ref:
+paddle/parameter/FirstOrderOptimizer.{h,cpp}: SgdOptimizer,
+SparseMomentumParameterOptimizer, AdagradParameterOptimizer,
+AdaDeltaParameterOptimizer, RMSPropParameterOptimizer,
+DecayedAdagradParameterOptimizer, AdamParameterOptimizer,
+AdamaxParameterOptimizer; sgdUpdate kernel in ParameterUpdateFunctions.cpp).
+
+Each optimizer is a pair of pure functions over a single parameter tensor —
+(init_slots, update) — applied per-leaf by the ParameterUpdater.  Update rules
+follow the reference's math, e.g. momentum:
+    v <- momentum * v - lr * grad ; p <- p + v        (ref: sgdUpdate)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import OptimizationConfig
+
+Array = jax.Array
+
+# registry: name -> (init_slots(param) -> dict, update(p, g, slots, lr, opt, t) -> (p, slots))
+optimizer_registry: dict[str, tuple[Callable, Callable]] = {}
+
+
+def _register(*names: str):
+    def deco(pair):
+        for n in names:
+            optimizer_registry[n] = pair
+        return pair
+    return deco
+
+
+def _momentum_init(p: Array, opt: OptimizationConfig) -> dict:
+    return {"momentum": jnp.zeros_like(p)}
+
+
+def _momentum_update(p, g, slots, lr, opt, t, mom_override=None):
+    mom = opt.momentum if mom_override is None else mom_override
+    v = mom * slots["momentum"] - lr * g
+    return p + v, {"momentum": v}
+
+
+_register("momentum", "sgd", "sparse_momentum")((_momentum_init, _momentum_update))
+
+
+def _adagrad_init(p, opt):
+    return {"accum": jnp.zeros_like(p)}
+
+
+def _adagrad_update(p, g, slots, lr, opt, t, **_):
+    accum = slots["accum"] + jnp.square(g)
+    upd = g / (jnp.sqrt(accum) + opt.ada_epsilon)
+    return p - lr * upd, {"accum": accum}
+
+
+_register("adagrad")((_adagrad_init, _adagrad_update))
+
+
+def _decayed_adagrad_init(p, opt):
+    return {"accum": jnp.zeros_like(p)}
+
+
+def _decayed_adagrad_update(p, g, slots, lr, opt, t, **_):
+    accum = opt.ada_rho * slots["accum"] + (1.0 - opt.ada_rho) * jnp.square(g)
+    upd = g / jnp.sqrt(accum + opt.ada_epsilon)
+    return p - lr * upd, {"accum": accum}
+
+
+_register("decayed_adagrad")((_decayed_adagrad_init, _decayed_adagrad_update))
+
+
+def _adadelta_init(p, opt):
+    return {"accum": jnp.zeros_like(p), "accum_update": jnp.zeros_like(p)}
+
+
+def _adadelta_update(p, g, slots, lr, opt, t, **_):
+    rho, eps = opt.ada_rho, opt.ada_epsilon
+    accum = rho * slots["accum"] + (1.0 - rho) * jnp.square(g)
+    upd = g * jnp.sqrt((slots["accum_update"] + eps) / (accum + eps))
+    accum_update = rho * slots["accum_update"] + (1.0 - rho) * jnp.square(upd)
+    return p - lr * upd, {"accum": accum, "accum_update": accum_update}
+
+
+_register("adadelta")((_adadelta_init, _adadelta_update))
+
+
+def _rmsprop_init(p, opt):
+    return {"accum_g2": jnp.zeros_like(p), "accum_g": jnp.zeros_like(p)}
+
+
+def _rmsprop_update(p, g, slots, lr, opt, t, **_):
+    """Graves-style RMSProp with first-moment correction
+    (ref: RMSPropParameterOptimizer::update: E[g^2], E[g])."""
+    rho, eps = opt.ada_rho, opt.ada_epsilon
+    g2 = rho * slots["accum_g2"] + (1.0 - rho) * jnp.square(g)
+    g1 = rho * slots["accum_g"] + (1.0 - rho) * g
+    upd = g / jnp.sqrt(g2 - jnp.square(g1) + eps)
+    return p - lr * upd, {"accum_g2": g2, "accum_g": g1}
+
+
+_register("rmsprop")((_rmsprop_init, _rmsprop_update))
+
+
+def _adam_init(p, opt):
+    return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+
+def _adam_update(p, g, slots, lr, opt, t, **_):
+    """(ref: AdamParameterOptimizer::update)."""
+    b1, b2, eps = opt.adam_beta1, opt.adam_beta2, opt.adam_epsilon
+    m = b1 * slots["m"] + (1.0 - b1) * g
+    v = b2 * slots["v"] + (1.0 - b2) * jnp.square(g)
+    tf = t.astype(jnp.float32)
+    mhat = m / (1.0 - jnp.power(b1, tf))
+    vhat = v / (1.0 - jnp.power(b2, tf))
+    return p - lr * mhat / (jnp.sqrt(vhat) + eps), {"m": m, "v": v}
+
+
+_register("adam")((_adam_init, _adam_update))
+
+
+def _adamax_init(p, opt):
+    return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+
+def _adamax_update(p, g, slots, lr, opt, t, **_):
+    """(ref: AdamaxParameterOptimizer::update)."""
+    b1, b2 = opt.adam_beta1, opt.adam_beta2
+    m = b1 * slots["m"] + (1.0 - b1) * g
+    u = jnp.maximum(b2 * slots["u"], jnp.abs(g))
+    tf = t.astype(jnp.float32)
+    lr_t = lr / (1.0 - jnp.power(b1, tf))
+    return p - lr_t * m / (u + 1e-12), {"m": m, "u": u}
+
+
+_register("adamax")((_adamax_init, _adamax_update))
+
+
+def get_optimizer(name: str):
+    try:
+        return optimizer_registry[name]
+    except KeyError:
+        raise ValueError(f"unknown learning_method {name!r}; "
+                         f"known: {sorted(optimizer_registry)}")

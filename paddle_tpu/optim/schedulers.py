@@ -1,0 +1,56 @@
+"""Learning-rate schedules.
+
+Matches the reference's scheduler registry (ref:
+paddle/parameter/LearningRateScheduler.cpp:51-173: constant, poly, caffe_poly,
+exp, discexp, linear, manual, pass_manual), where the schedule argument is the
+number of processed *samples* (or pass id for pass_manual).  Pure jnp math so
+it runs inside the jitted update step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import OptimizationConfig
+
+
+def _parse_segments(args: str):
+    """'seg0:lr0,seg1:lr1,...' (ref: BaseLearningRateScheduler manual)."""
+    segs = []
+    for part in args.split(","):
+        if not part:
+            continue
+        a, _, b = part.partition(":")
+        segs.append((float(a), float(b)))
+    return segs
+
+
+def learning_rate_at(opt: OptimizationConfig, num_samples, pass_id=0):
+    """Global LR at this point in training; `num_samples` may be a traced
+    jnp scalar (ref: LearningRateScheduler.cpp)."""
+    lr = opt.learning_rate
+    a = opt.learning_rate_decay_a
+    b = opt.learning_rate_decay_b
+    x = jnp.asarray(num_samples, jnp.float32)
+    sched = opt.learning_rate_schedule
+    if sched == "constant":
+        return jnp.asarray(lr, jnp.float32)
+    if sched == "poly":
+        return lr * jnp.power(1.0 + a * x, -b)
+    if sched == "caffe_poly":
+        return lr * jnp.power(jnp.maximum(1.0 - x / a, 0.0), b)
+    if sched == "exp":
+        return lr * jnp.power(a, x / b)
+    if sched == "discexp":
+        return lr * jnp.power(a, jnp.floor(x / b))
+    if sched == "linear":
+        return jnp.maximum(lr - a * x, b)
+    if sched in ("manual", "pass_manual"):
+        segs = _parse_segments(opt.learning_rate_args)
+        pos = jnp.asarray(pass_id if sched == "pass_manual" else num_samples, jnp.float32)
+        rate = jnp.asarray(segs[-1][1] if segs else 1.0, jnp.float32)
+        # walk segments backwards: pick first whose boundary covers pos
+        for bound, r in reversed(segs[:-1] if segs else []):
+            rate = jnp.where(pos <= bound, r, rate)
+        return lr * rate
+    raise ValueError(f"unknown learning_rate_schedule {sched!r}")

@@ -1,0 +1,139 @@
+"""ParameterUpdater — applies optimizer + schedule + regularization.
+
+TPU-native collapse of the reference's updater family (ref:
+paddle/trainer/ParameterUpdater.h SgdLocalUpdater,
+ThreadParameterUpdater.h SgdThreadUpdater, RemoteParameterUpdater.h — local,
+thread-sharded, and parameter-server variants).  On TPU all three become one
+pure `step()` fused into the jitted train step: the optimizer math runs
+sharded next to the gradients, and data-parallel gradient reduction is an XLA
+psum (see parallel/), not a ring of threads or a remote server.
+
+Handles, per parameter (ref: parameter/ParameterConfig + OptimizationConfig):
+  - per-parameter learning-rate multipliers and momentum overrides
+  - L1/L2 weight decay (global default, per-param override)
+  - elementwise gradient clipping (global or per-param threshold)
+  - the LR schedule by processed-sample count
+  - model averaging (ref: AverageOptimizer) as an extra slot
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import ModelConfig, OptimizationConfig, ParameterConfig
+from paddle_tpu.optim.optimizers import get_optimizer
+from paddle_tpu.optim.schedulers import learning_rate_at
+
+Array = jax.Array
+
+
+class ParameterUpdater:
+    def __init__(self, model: ModelConfig, opt: OptimizationConfig):
+        self.model = model
+        self.opt = opt
+        self.param_cfgs: dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
+        self.init_slots_fn, self.update_fn = get_optimizer(opt.learning_method)
+        self.use_average = opt.average_window > 0
+
+    def init_state(self, params: dict[str, Array]) -> dict[str, Any]:
+        slots = {name: self.init_slots_fn(p, self.opt)
+                 for name, p in params.items()
+                 if not self.param_cfgs[name].is_static}
+        state: dict[str, Any] = {
+            "slots": slots,
+            "num_samples": jnp.zeros((), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
+            "num_updates": jnp.zeros((), jnp.int32),
+            "pass_id": jnp.zeros((), jnp.int32),
+        }
+        if self.use_average:
+            state["average"] = {name: jnp.array(p) for name, p in params.items()}
+            state["average_count"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def step(
+        self,
+        params: dict[str, Array],
+        grads: dict[str, Array],
+        state: dict[str, Any],
+        batch_size: int,
+    ) -> tuple[dict[str, Array], dict[str, Any]]:
+        """One optimizer application; pure, call under jit."""
+        opt = self.opt
+        num_samples = state["num_samples"] + batch_size
+        t = state["num_updates"] + 1
+        base_lr = learning_rate_at(opt, num_samples, state["pass_id"])
+
+        new_params: dict[str, Array] = {}
+        new_slots: dict[str, Any] = {}
+        for name, p in params.items():
+            cfg = self.param_cfgs[name]
+            if cfg.is_static or name not in grads:
+                new_params[name] = p
+                if name in state["slots"]:
+                    new_slots[name] = state["slots"][name]
+                continue
+            g = grads[name]
+            # gradient clipping (elementwise, ref: ParameterOptimizer clipping);
+            # per-param None inherits the global, 0.0 disables explicitly
+            thr = (cfg.gradient_clipping_threshold
+                   if cfg.gradient_clipping_threshold is not None
+                   else opt.gradient_clipping_threshold)
+            if thr:
+                g = jnp.clip(g, -thr, thr)
+            # weight decay (ref: Regularizer.cpp applied at update time)
+            l2 = cfg.decay_rate if cfg.decay_rate is not None else opt.l2_weight
+            if l2:
+                g = g + l2 * p
+            l1 = cfg.decay_rate_l1 if cfg.decay_rate_l1 is not None else opt.l1_weight
+            if l1:
+                g = g + l1 * jnp.sign(p)
+            lr = base_lr * cfg.learning_rate
+            mom_override = cfg.momentum
+            new_p, slots = self.update_fn(
+                p, g, state["slots"][name], lr, opt, t,
+                **({"mom_override": mom_override} if mom_override is not None
+                   and opt.learning_method in ("momentum", "sgd", "sparse_momentum")
+                   else {}))
+            new_params[name] = new_p
+            new_slots[name] = slots
+
+        new_state: dict[str, Any] = {
+            "slots": new_slots,
+            "num_samples": num_samples,
+            "num_updates": t,
+            "pass_id": state["pass_id"],
+        }
+        if self.use_average:
+            # cumulative average with window reset
+            # (ref: AverageOptimizer — maintains an averaged copy for eval)
+            cnt = state["average_count"] + 1
+            max_win = opt.max_average_window or 0
+            if max_win:
+                reset = cnt > max_win
+                cnt = jnp.where(reset, 1, cnt)
+            avg = {}
+            for name, p in new_params.items():
+                prev = state["average"][name]
+                if max_win:
+                    prev = jnp.where(reset, p, prev)
+                avg[name] = prev + (p - prev) / cnt.astype(p.dtype)
+            new_state["average"] = avg
+            new_state["average_count"] = cnt
+        return new_params, new_state
+
+    def start_pass(self, state):
+        return state
+
+    def finish_pass(self, state):
+        state = dict(state)
+        state["pass_id"] = state["pass_id"] + 1
+        return state
+
+    def averaged_params(self, params, state):
+        """Parameters to evaluate with (ref: AverageOptimizer::setupBeforeLoad)."""
+        if self.use_average:
+            return state["average"]
+        return params

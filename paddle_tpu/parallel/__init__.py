@@ -1,0 +1,2 @@
+from paddle_tpu.parallel.mesh import make_mesh, mesh_from_flag  # noqa: F401
+from paddle_tpu.parallel.dp import shard_batch, shard_train_objects  # noqa: F401

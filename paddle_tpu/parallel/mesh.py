@@ -1,0 +1,58 @@
+"""Device mesh construction.
+
+The TPU replacement for the reference's entire parallel topology
+configuration: `--trainer_count` worker threads + `--pservers` host lists
+(ref: paddle/trainer/TrainerMain.cpp:47-92, paddle/pserver/LightNetwork.cpp)
+collapse into one `jax.sharding.Mesh` whose axes name the parallelism kinds:
+
+  data   — batch sharding (ref: MultiGradientMachine thread DP + pserver DP)
+  model  — tensor/parameter sharding (ref: ParallelNeuralNetwork device=N)
+
+Collectives ride ICI within a slice and DCN across slices; multi-host setup
+is jax.distributed instead of a pserver fleet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(data: int = 0, model: int = 1, devices=None) -> Mesh:
+    """Build a (data, model) mesh; data=0 means 'all remaining devices'."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n = devs.size
+    if data <= 0:
+        assert n % model == 0, f"{n} devices not divisible by model={model}"
+        data = n // model
+    assert data * model == n, f"mesh {data}x{model} != {n} devices"
+    return Mesh(devs.reshape(data, model), (DATA_AXIS, MODEL_AXIS))
+
+
+def mesh_from_flag(spec: str, devices=None) -> Optional[Mesh]:
+    """Parse 'data:8' / 'data:4,model:2' (the --mesh_shape flag)."""
+    if not spec:
+        return None
+    sizes = {"data": 0, "model": 1}
+    for part in spec.split(","):
+        name, _, num = part.partition(":")
+        sizes[name.strip()] = int(num)
+    return make_mesh(sizes["data"], sizes["model"], devices)
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap (ref: the pserver fleet + --trainer_id/--pservers
+    startup protocol → jax.distributed coordinator)."""
+    kwargs = {}
+    if coordinator_address:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,2 @@
+from paddle_tpu.parameter.argument import Argument  # noqa: F401
+from paddle_tpu.parameter.init import init_parameter  # noqa: F401

@@ -1,0 +1,70 @@
+"""Argument — the inter-layer data record.
+
+TPU-native analog of the reference's `Argument` (ref:
+paddle/parameter/Argument.h:76-100: {value, ids, grad, sequenceStartPositions,
+subSequenceStartPositions, frameHeight/Width}).  Key re-design: sequences are
+*padded dense* [B, T, ...] plus a `lengths` vector instead of a flat ragged
+matrix + start positions — static shapes are what XLA wants.  Gradients don't
+live here (autodiff), and it is a registered pytree so Arguments flow through
+jit/scan directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class Argument:
+    # dense value: [B, D] for plain data, [B, T, D] for sequences
+    value: Optional[Array] = None
+    # integer ids: [B] or [B, T] (sparse/label inputs)
+    ids: Optional[Array] = None
+    # [B] valid lengths; None => not a sequence
+    lengths: Optional[Array] = None
+    # nested sequences: [B, S] per-subsequence lengths; value is [B, S, T, D]
+    sub_lengths: Optional[Array] = None
+    # per-example weight (ref: Argument.weight)
+    weight: Optional[Array] = None
+    # image geometry (static, aux data): (height, width)
+    frame_height: int = dataclasses.field(default=0, metadata=dict(static=True))
+    frame_width: int = dataclasses.field(default=0, metadata=dict(static=True))
+
+    # -- helpers ----------------------------------------------------------
+    @property
+    def is_sequence(self) -> bool:
+        return self.lengths is not None
+
+    @property
+    def data(self) -> Array:
+        """The primary payload: value if present else ids."""
+        if self.value is not None:
+            return self.value
+        assert self.ids is not None, "empty Argument"
+        return self.ids
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        assert self.is_sequence
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.bool_) -> Optional[Array]:
+        """[B, T] validity mask for sequence arguments."""
+        if self.lengths is None:
+            return None
+        return (jnp.arange(self.max_len)[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def replace(self, **kw: Any) -> "Argument":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,40 @@
+"""Parameter initialization.
+
+Matches the reference's init rules (ref: paddle/parameter/Parameter.cpp
+randomize(): normal(mean, std) by default with std = 1/sqrt(dim0) unless
+explicitly set; uniform for sparse; config_parser.py's "smart" init scales by
+fan-in) so stock configs reproduce the reference's training curves.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import ParameterConfig
+
+
+def default_std(cfg: ParameterConfig) -> float:
+    """Reference default: std = 1/sqrt(fan_in) where fan_in = dims[0]
+    (ref: config_parser.py Parameters.__init__ initial_std smart default)."""
+    if cfg.initial_smart and cfg.dims:
+        fan_in = max(cfg.dims[0], 1)
+        return 1.0 / math.sqrt(fan_in)
+    return cfg.initial_std
+
+
+def init_parameter(cfg: ParameterConfig, key: jax.Array) -> jax.Array:
+    shape = tuple(cfg.dims) if cfg.dims else (cfg.size,)
+    dtype = jnp.dtype(cfg.dtype)
+    strategy = cfg.initial_strategy
+    if cfg.initial_smart:
+        strategy = "normal"
+    if strategy == "zero":
+        return jnp.zeros(shape, dtype)
+    std = default_std(cfg)
+    if strategy == "uniform":
+        return jax.random.uniform(
+            key, shape, dtype, minval=cfg.initial_mean - std, maxval=cfg.initial_mean + std)
+    return cfg.initial_mean + std * jax.random.normal(key, shape, dtype)

@@ -1,0 +1,1 @@
+from paddle_tpu.trainer.trainer import Trainer  # noqa: F401

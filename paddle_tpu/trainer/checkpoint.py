@@ -1,0 +1,121 @@
+"""Checkpoint save/load.
+
+Matches the reference's checkpoint layout and behavior (ref:
+paddle/trainer/ParamUtil.{h,cpp}: per-pass dirs `pass-%05d`,
+saveParametersOnePass, deleteOldest; parameter/Parameter.cpp save/load header)
+re-expressed for a param pytree: each pass directory holds one `model.npz`
+with the flattened parameter/optimizer/net-state trees plus the serialized
+TrainerConfig, so a checkpoint is a self-contained deployable bundle (also
+subsuming paddle_merge_model — ref: trainer/MergeModel.cpp).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+SEP = "|"  # path separator inside npz keys
+
+
+def _flatten(tree: Any, prefix: str) -> dict[str, np.ndarray]:
+    flat = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        key = prefix + SEP + SEP.join(_path_str(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _path_str(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(p)
+
+
+def _unflatten_dicts(flat: dict[str, np.ndarray]) -> dict:
+    """Rebuild nested dicts from SEP-joined keys (trees here are nested dicts)."""
+    root: dict = {}
+    for key, arr in flat.items():
+        parts = key.split(SEP)
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root
+
+
+def pass_dir(save_dir: str, pass_id: int) -> str:
+    return os.path.join(save_dir, f"pass-{pass_id:05d}")
+
+
+def save_checkpoint(
+    save_dir: str,
+    pass_id: int,
+    params: dict,
+    opt_state: Optional[dict] = None,
+    net_state: Optional[dict] = None,
+    config_json: Optional[str] = None,
+    keep_last: int = 0,
+) -> str:
+    """Write pass-%05d/{model.npz, trainer_config.json}
+    (ref: ParamUtil::saveParametersOnePass)."""
+    d = pass_dir(save_dir, pass_id)
+    os.makedirs(d, exist_ok=True)
+    flat = _flatten(params, "params")
+    if opt_state is not None:
+        flat.update(_flatten(opt_state, "opt"))
+    if net_state is not None:
+        flat.update(_flatten(net_state, "net"))
+    np.savez(os.path.join(d, "model.npz"), **flat)
+    if config_json is not None:
+        with open(os.path.join(d, "trainer_config.json"), "w") as f:
+            f.write(config_json)
+    if keep_last > 0:
+        _delete_old(save_dir, keep_last)
+    return d
+
+
+def _delete_old(save_dir: str, keep_last: int) -> None:
+    """(ref: ParamUtil::deleteParameters keeps save_only_one / latest)."""
+    dirs = sorted(
+        (m.group(0) for m in (re.match(r"pass-\d{5}$", x) for x in os.listdir(save_dir)) if m))
+    for old in dirs[:-keep_last]:
+        shutil.rmtree(os.path.join(save_dir, old), ignore_errors=True)
+
+
+def load_checkpoint(path: str) -> dict[str, Any]:
+    """Load a checkpoint dir (or its model.npz); returns
+    {'params': ..., 'opt': ..., 'net': ..., 'config_json': ...}."""
+    npz = path if path.endswith(".npz") else os.path.join(path, "model.npz")
+    data = np.load(npz, allow_pickle=False)
+    flat = {k: data[k] for k in data.files}
+    trees: dict[str, dict] = {"params": {}, "opt": {}, "net": {}}
+    for prefix in trees:
+        sub = {k[len(prefix) + 1:]: v for k, v in flat.items()
+               if k.startswith(prefix + SEP)}
+        trees[prefix] = _unflatten_dicts(sub)
+    out: dict[str, Any] = dict(trees)
+    cfg_path = os.path.join(os.path.dirname(npz), "trainer_config.json")
+    if os.path.exists(cfg_path):
+        out["config_json"] = open(cfg_path).read()
+    return out
+
+
+def latest_pass(save_dir: str) -> int:
+    """Highest pass id present, or -1."""
+    if not os.path.isdir(save_dir):
+        return -1
+    best = -1
+    for x in os.listdir(save_dir):
+        m = re.match(r"pass-(\d{5})$", x)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best

@@ -1,0 +1,212 @@
+"""Evaluator zoo — streaming metrics over batches.
+
+TPU re-design of the reference's Evaluator framework (ref:
+paddle/gserver/evaluators/Evaluator.{h,cpp}:41-1235 — classification_error,
+sum, column_sum, auc, precision_recall, pnpair; ChunkEvaluator.cpp;
+CTCErrorEvaluator.cpp).  Each evaluator contributes per-batch partial sums
+computed *inside the jitted step* (cheap jnp reductions fused into the graph);
+the host accumulates partials across batches and finalizes — the analog of the
+reference's eval start/finish + merge protocol, without leaving the device
+during the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.config.schema import EvaluatorConfig, ModelConfig
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+
+# type -> (batch_fn(cfg, outputs, feed) -> dict partials,
+#          finalize_fn(cfg, accumulated) -> dict of floats)
+evaluator_registry: dict[str, tuple[Callable, Callable]] = {}
+
+
+def register_evaluator(*names):
+    def deco(pair):
+        for n in names:
+            evaluator_registry[n] = pair
+        return pair
+    return deco
+
+
+def _get(outputs: dict[str, Argument], name: str) -> Argument:
+    return outputs[name]
+
+
+# -- classification error ---------------------------------------------------
+
+def _cls_err_batch(cfg: EvaluatorConfig, outputs, feed):
+    out = _get(outputs, cfg.input_layer_names[0])
+    lbl = _get(outputs, cfg.input_layer_names[1])
+    pred = out.value
+    if pred.shape[-1] == 1:
+        err = (pred[..., 0] > cfg.classification_threshold).astype(jnp.float32) \
+            != lbl.ids.astype(jnp.float32)
+        err = err.astype(jnp.float32)
+    else:
+        err = (jnp.argmax(pred, axis=-1) != lbl.ids).astype(jnp.float32)
+    if out.is_sequence:
+        mask = out.mask(jnp.float32)
+        return {"err": jnp.sum(err * mask), "n": jnp.sum(mask)}
+    return {"err": jnp.sum(err), "n": jnp.asarray(err.size, jnp.float32)}
+
+
+def _cls_err_final(cfg, acc):
+    return {"classification_error": acc["err"] / max(acc["n"], 1.0)}
+
+
+register_evaluator("classification_error")((_cls_err_batch, _cls_err_final))
+
+
+# -- sums -------------------------------------------------------------------
+
+def _sum_batch(cfg, outputs, feed):
+    out = _get(outputs, cfg.input_layer_names[0])
+    v = out.data.astype(jnp.float32)
+    if out.is_sequence:
+        mask = out.mask(jnp.float32)
+        v = v * (mask[..., None] if v.ndim == 3 else mask)
+    return {"sum": jnp.sum(v), "n": jnp.asarray(v.shape[0], jnp.float32)}
+
+
+def _sum_final(cfg, acc):
+    return {"sum": acc["sum"], "mean": acc["sum"] / max(acc["n"], 1.0)}
+
+
+register_evaluator("sum")((_sum_batch, _sum_final))
+
+
+def _colsum_batch(cfg, outputs, feed):
+    out = _get(outputs, cfg.input_layer_names[0])
+    v = out.value
+    if out.is_sequence:
+        v = v * out.mask(jnp.float32)[..., None]
+        v = jnp.sum(v, axis=1)
+    return {"colsum": jnp.sum(v, axis=0), "n": jnp.asarray(v.shape[0], jnp.float32)}
+
+
+def _colsum_final(cfg, acc):
+    return {"column_sum_mean": acc["colsum"] / max(acc["n"], 1.0)}
+
+
+register_evaluator("column_sum")((_colsum_batch, _colsum_final))
+
+
+# -- AUC (histogram method, matching the reference's bucketed AUC) ----------
+
+_AUC_BINS = 1024
+
+
+def _auc_batch(cfg, outputs, feed):
+    """(ref: Evaluator.cpp AucEvaluator — 2 x kBinNum histograms)."""
+    out = _get(outputs, cfg.input_layer_names[0])
+    lbl = _get(outputs, cfg.input_layer_names[1])
+    p = out.value
+    pos_prob = p[..., 1] if p.shape[-1] == 2 else p[..., 0]
+    y = lbl.ids.astype(jnp.float32).reshape(pos_prob.shape)
+    idx = jnp.clip((pos_prob * _AUC_BINS).astype(jnp.int32), 0, _AUC_BINS - 1)
+    pos_hist = jnp.zeros((_AUC_BINS,), jnp.float32).at[idx].add(y)
+    neg_hist = jnp.zeros((_AUC_BINS,), jnp.float32).at[idx].add(1.0 - y)
+    return {"pos": pos_hist, "neg": neg_hist}
+
+
+def _auc_final(cfg, acc):
+    pos, neg = np.asarray(acc["pos"]), np.asarray(acc["neg"])
+    # integrate from the high-score end (ref: AucEvaluator::calcAuc)
+    tp = np.cumsum(pos[::-1])
+    fp = np.cumsum(neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    if tot_pos == 0 or tot_neg == 0:
+        return {"auc": 0.0}
+    tpr = np.concatenate([[0.0], tp / tot_pos])
+    fpr = np.concatenate([[0.0], fp / tot_neg])
+    auc = float(np.trapezoid(tpr, fpr))
+    return {"auc": auc}
+
+
+register_evaluator("auc", "last-column-auc")((_auc_batch, _auc_final))
+
+
+# -- precision / recall -----------------------------------------------------
+
+def _pr_batch(cfg, outputs, feed):
+    """(ref: PrecisionRecallEvaluator) — binary or per-class counts."""
+    out = _get(outputs, cfg.input_layer_names[0])
+    lbl = _get(outputs, cfg.input_layer_names[1])
+    p = out.value
+    C = p.shape[-1]
+    pred = jnp.argmax(p, axis=-1) if C > 1 else (
+        p[..., 0] > cfg.classification_threshold).astype(jnp.int32)
+    y = lbl.ids.reshape(pred.shape)
+    nC = max(C, 2)
+    onehot_p = jax.nn.one_hot(pred, nC)
+    onehot_y = jax.nn.one_hot(y, nC)
+    tp = jnp.sum(onehot_p * onehot_y, axis=tuple(range(onehot_p.ndim - 1)))
+    fp = jnp.sum(onehot_p * (1 - onehot_y), axis=tuple(range(onehot_p.ndim - 1)))
+    fn = jnp.sum((1 - onehot_p) * onehot_y, axis=tuple(range(onehot_p.ndim - 1)))
+    return {"tp": tp, "fp": fp, "fn": fn}
+
+
+def _pr_final(cfg, acc):
+    tp, fp, fn = (np.asarray(acc[k]) for k in ("tp", "fp", "fn"))
+    if cfg.positive_label >= 0:
+        tp, fp, fn = tp[cfg.positive_label], fp[cfg.positive_label], fn[cfg.positive_label]
+        prec = tp / max(tp + fp, 1.0)
+        rec = tp / max(tp + fn, 1.0)
+    else:
+        prec = float(np.mean(tp / np.maximum(tp + fp, 1.0)))
+        rec = float(np.mean(tp / np.maximum(tp + fn, 1.0)))
+    f1 = 2 * prec * rec / max(prec + rec, 1e-8)
+    return {"precision": float(prec), "recall": float(rec), "F1-score": float(f1)}
+
+
+register_evaluator("precision_recall")((_pr_batch, _pr_final))
+
+
+# -- driver -----------------------------------------------------------------
+
+class EvaluatorSet:
+    """Accumulates all configured evaluators across batches
+    (ref: Evaluator start/eval/finish + printStats protocol)."""
+
+    def __init__(self, model: ModelConfig):
+        self.configs = [e for e in model.evaluators if e.type in evaluator_registry]
+
+    def batch_partials(self, outputs, feed) -> dict[str, dict]:
+        """Called inside jit: returns {evaluator_name: partials}."""
+        res = {}
+        for cfg in self.configs:
+            batch_fn, _ = evaluator_registry[cfg.type]
+            res[cfg.name] = batch_fn(cfg, outputs, feed)
+        return res
+
+    def new_accumulator(self) -> dict:
+        return {}
+
+    def accumulate(self, acc: dict, partials: dict) -> dict:
+        for name, parts in partials.items():
+            if name not in acc:
+                acc[name] = {k: np.asarray(v, np.float64) for k, v in parts.items()}
+            else:
+                for k, v in parts.items():
+                    acc[name][k] = acc[name][k] + np.asarray(v, np.float64)
+        return acc
+
+    def finalize(self, acc: dict) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for cfg in self.configs:
+            if cfg.name not in acc:
+                continue
+            _, fin = evaluator_registry[cfg.type]
+            for k, v in fin(cfg, acc[cfg.name]).items():
+                out[f"{cfg.name}.{k}" if len(self.configs) > 1 else k] = float(
+                    np.asarray(v).reshape(-1)[0]) if np.ndim(v) == 0 or np.size(v) == 1 \
+                    else v
+        return out

@@ -1,0 +1,62 @@
+"""paddle_tpu trainer CLI — `python -m paddle_tpu.trainer_main --config=...`.
+
+TPU-native analog of the `paddle_trainer` binary (ref:
+paddle/trainer/TrainerMain.cpp:36-110: flag parsing, config load, job
+dispatch train/test/checkgrad/time).  The pserver self-hosting flags are gone
+— distribution is a mesh + jax.distributed, not a server fleet.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.parallel.mesh import mesh_from_flag
+from paddle_tpu.trainer.trainer import Trainer
+from paddle_tpu.utils import FLAGS, get_logger, parse_flags
+
+log = get_logger("main")
+
+
+def main(argv=None) -> int:
+    rest = parse_flags(argv)
+    if not FLAGS.config:
+        print("usage: python -m paddle_tpu.trainer_main --config=<config.py> "
+              "[--job=train|test|time] [--num_passes=N] [--save_dir=DIR] "
+              "[--config_args=k=v,...] [--mesh_shape=data:8]", file=sys.stderr)
+        return 2
+
+    config = parse_config(FLAGS.config, FLAGS.config_args)
+    log.info("parsed config %s: %d layers, %d parameters", FLAGS.config,
+             len(config.model_config.layers), len(config.model_config.parameters))
+    mesh = mesh_from_flag(FLAGS.mesh_shape) if FLAGS.mesh_shape else None
+    if mesh is not None:
+        log.info("mesh: %s over %d devices", dict(zip(mesh.axis_names, mesh.devices.shape)),
+                 mesh.devices.size)
+
+    trainer = Trainer(config, seed=FLAGS.seed, mesh=mesh)
+    if FLAGS.init_model_path:
+        trainer.load(FLAGS.init_model_path)
+        log.info("loaded initial model from %s", FLAGS.init_model_path)
+
+    job = FLAGS.job
+    if job == "train":
+        trainer.train(num_passes=FLAGS.num_passes, log_period=FLAGS.log_period,
+                      save_dir=FLAGS.save_dir or None)
+    elif job == "test":
+        stats = trainer.test()
+        log.info("test result: %s", stats)
+    elif job == "time":
+        stats = trainer.benchmark(trainer.train_batches())
+        log.info("benchmark: %.1f samples/sec (%d samples in %.2fs)",
+                 stats["samples_per_sec"], stats["samples"], stats["seconds"])
+    else:
+        log.error("unknown --job=%s", job)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

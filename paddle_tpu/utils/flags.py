@@ -1,0 +1,127 @@
+"""Global flag system.
+
+TPU-native analog of the reference's command-line flag tier
+(ref: paddle/utils/Flags.{h,cpp}, CommandLineParser.{h,cpp}): a process-global
+registry of typed flags with defaults, overridable from argv or
+programmatically.  Unlike the reference there is no gflags dependency — a thin
+argparse-free implementation keeps startup cheap and embeddable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    type: type
+    help: str
+
+
+class _Flags:
+    """Attribute-style access to registered flags: ``FLAGS.use_tpu``."""
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_specs", {})
+        object.__setattr__(self, "_values", {})
+
+    def define(self, name: str, default: Any, help: str = "") -> None:
+        specs = object.__getattribute__(self, "_specs")
+        if name in specs:  # re-definition keeps first registration (idempotent imports)
+            return
+        specs[name] = _FlagSpec(name, default, type(default) if default is not None else str, help)
+        object.__getattribute__(self, "_values")[name] = default
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(f"undefined flag: {name}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        values = object.__getattribute__(self, "_values")
+        if name not in values:
+            raise AttributeError(f"undefined flag: {name}; use define_flag first")
+        values[name] = value
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(object.__getattribute__(self, "_values"))
+
+    def parse(self, argv: list[str] | None = None) -> list[str]:
+        """Consume ``--name=value`` / ``--name value`` pairs; returns leftovers."""
+        specs = object.__getattribute__(self, "_specs")
+        values = object.__getattribute__(self, "_values")
+        if argv is None:
+            argv = sys.argv[1:]
+        rest: list[str] = []
+        i = 0
+        while i < len(argv):
+            arg = argv[i]
+            if not arg.startswith("--"):
+                rest.append(arg)
+                i += 1
+                continue
+            body = arg[2:]
+            if "=" in body:
+                name, raw = body.split("=", 1)
+            else:
+                name = body
+                if i + 1 < len(argv) and name in specs:
+                    raw = argv[i + 1]
+                    i += 1
+                else:
+                    raw = "true"
+            if name not in specs:
+                rest.append(arg)
+                i += 1
+                continue
+            spec = specs[name]
+            if spec.type is bool:
+                values[name] = raw.lower() in ("1", "true", "yes", "on")
+            elif spec.default is None:
+                values[name] = raw
+            else:
+                values[name] = spec.type(raw)
+            i += 1
+        return rest
+
+
+FLAGS = _Flags()
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    FLAGS.define(name, default, help)
+
+
+def parse_flags(argv: list[str] | None = None) -> list[str]:
+    return FLAGS.parse(argv)
+
+
+# Core global flags (ref: paddle/utils/Flags.cpp:19-68 — use_gpu, trainer_count,
+# log_period, saving_period, ... re-expressed for the TPU runtime).
+define_flag("use_tpu", True, "run compute on TPU devices when available")
+define_flag("seed", 1, "global RNG seed (0 = nondeterministic)")
+define_flag("log_period", 100, "log training stats every N batches")
+define_flag("dot_period", 1, "progress dot every N batches")
+define_flag("saving_period", 1, "checkpoint every N passes")
+define_flag("test_period", 0, "test every N batches (0 = every pass)")
+define_flag("num_passes", 1, "number of training passes")
+define_flag("start_pass", 0, "resume from pass N")
+define_flag("save_dir", "./output", "checkpoint directory")
+define_flag("init_model_path", "", "path to initial model checkpoint")
+define_flag("config", "", "trainer config python file")
+define_flag("config_args", "", "comma-separated key=value passed to the config")
+define_flag("job", "train", "train | test | checkgrad | time")
+define_flag("show_parameter_stats_period", 0, "dump parameter stats every N batches")
+define_flag("beam_size", 1, "beam width for sequence generation")
+define_flag("mesh_shape", "", "device mesh, e.g. 'data:8' or 'data:4,model:2'")
+define_flag("profile_dir", "", "if set, write jax profiler traces here")
+
+
+def env_flag(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)

@@ -1,0 +1,34 @@
+"""Logging facade (ref: paddle/utils/Logging.{h,cpp} — glog-or-builtin clone).
+
+One process-wide logger with a glog-style format.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(levelname).1s %(asctime)s.%(msecs)03d %(name)s] %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATEFMT))
+    root = logging.getLogger("paddle_tpu")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    _configure()
+    if name != "paddle_tpu" and not name.startswith("paddle_tpu."):
+        name = f"paddle_tpu.{name}"
+    return logging.getLogger(name)

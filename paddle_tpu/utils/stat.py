@@ -1,0 +1,80 @@
+"""Timers and stat accumulation.
+
+TPU-native analog of the reference's REGISTER_TIMER / StatSet machinery
+(ref: paddle/utils/Stat.h:130-256): named accumulating timers that the trainer
+prints and resets every log_period.  On TPU the hot path is one compiled XLA
+call, so timers wrap host-side phases (data feed, step dispatch, eval) and the
+jax profiler covers device-side detail.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Stat:
+    name: str
+    total_s: float = 0.0
+    count: int = 0
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.total_s += dt
+        self.count += 1
+        if dt > self.max_s:
+            self.max_s = dt
+
+    def reset(self) -> None:
+        self.total_s = 0.0
+        self.count = 0
+        self.max_s = 0.0
+
+    def __str__(self) -> str:
+        avg = self.total_s / max(self.count, 1)
+        return (f"{self.name}: total={self.total_s * 1e3:.1f}ms "
+                f"count={self.count} avg={avg * 1e3:.3f}ms max={self.max_s * 1e3:.3f}ms")
+
+
+@dataclass
+class StatSet:
+    """Named stat registry (ref: StatSet globalStat, Stat.h:94-128)."""
+
+    name: str = "global"
+    stats: dict[str, Stat] = field(default_factory=dict)
+
+    def get(self, name: str) -> Stat:
+        if name not in self.stats:
+            self.stats[name] = Stat(name)
+        return self.stats[name]
+
+    @contextlib.contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.get(name).add(time.perf_counter() - t0)
+
+    def print_all(self, log=None) -> str:
+        lines = ["======= StatSet: [%s] =======" % self.name]
+        for s in sorted(self.stats.values(), key=lambda s: -s.total_s):
+            lines.append("  " + str(s))
+        text = "\n".join(lines)
+        if log is not None:
+            log.info(text)
+        return text
+
+    def reset(self) -> None:
+        for s in self.stats.values():
+            s.reset()
+
+
+global_stat = StatSet()
+
+
+def timer(name: str):
+    """``with timer("forwardBackward"): ...`` accumulates into global_stat."""
+    return global_stat.time(name)

@@ -1,0 +1,46 @@
+"""Schema round-trip tests (ref test analog: config serialization goldens in
+python/paddle/trainer_config_helpers/tests)."""
+
+from paddle_tpu.config.schema import (
+    ConvConfig, LayerConfig, LayerInput, ModelConfig, OptimizationConfig,
+    ParameterConfig, ProjectionConfig, SubModelConfig, TrainerConfig,
+)
+
+
+def test_roundtrip_simple():
+    m = ModelConfig(
+        layers=[
+            LayerConfig(name="in", type="data", size=10),
+            LayerConfig(name="fc", type="fc", size=4, active_type="softmax",
+                        inputs=[LayerInput(input_layer_name="in",
+                                           input_parameter_name="_fc.w0")],
+                        bias_parameter_name="_fc.wbias"),
+        ],
+        parameters=[
+            ParameterConfig(name="_fc.w0", size=40, dims=[10, 4]),
+            ParameterConfig(name="_fc.wbias", size=4, dims=[1, 4],
+                            initial_strategy="zero"),
+        ],
+        input_layer_names=["in"],
+    )
+    tc = TrainerConfig(model_config=m, opt_config=OptimizationConfig(batch_size=32))
+    js = tc.to_json()
+    back = TrainerConfig.from_json(js)
+    assert back.model_config.layer("fc").active_type == "softmax"
+    assert back.model_config.parameter("_fc.w0").dims == [10, 4]
+    assert back.opt_config.batch_size == 32
+    assert back.to_json() == js
+
+
+def test_roundtrip_nested():
+    conv = ConvConfig(filter_size=3, channels=8, img_size=32, output_x=30)
+    lc = LayerConfig(name="c", type="exconv", size=100, conv=conv,
+                     inputs=[LayerInput(input_layer_name="in",
+                                        proj=ProjectionConfig(type="conv", conv=conv))])
+    m = ModelConfig(layers=[lc], sub_models=[
+        SubModelConfig(name="g", is_recurrent_layer_group=True,
+                       layer_names=["c"], in_links=["x"])])
+    back = ModelConfig.from_json(m.to_json())
+    assert back.layers[0].conv.filter_size == 3
+    assert back.layers[0].inputs[0].proj.conv.channels == 8
+    assert back.sub_models[0].in_links == ["x"]

@@ -1,0 +1,160 @@
+"""Finite-difference gradient checks per layer family — the analog of the
+reference's layer-grad harness (ref: paddle/gserver/tests/test_LayerGrad.cpp,
+LayerGradUtil.h testLayerGrad): build a tiny net around one layer type,
+compare autodiff grads against central differences.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.dsl import *  # noqa: F403
+from paddle_tpu.graph.builder import GraphExecutor
+from paddle_tpu.graph.context import TEST
+from paddle_tpu.parameter.argument import Argument
+
+
+def fd_check(cfg, feed, seed=0, eps=1e-5, rtol=1e-3, atol=1e-6, n_coords=6):
+    """Central-difference check in float64 (float32 FD noise would swamp the
+    comparison — the reference uses double throughout its checkers)."""
+    with jax.enable_x64():
+        ex = GraphExecutor(cfg.model_config)
+        params = ex.init_params(jax.random.PRNGKey(seed))
+        params = {k: jnp.asarray(v, jnp.float64) for k, v in params.items()}
+        feed = jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.float64)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x),
+            feed)
+        rng = jax.random.PRNGKey(seed + 1)
+
+        def loss(p):
+            return ex.loss(p, feed, mode=TEST, rng=rng)[0]
+
+        analytic = jax.grad(loss)(params)
+        rnd = np.random.default_rng(seed)
+        for name, g in analytic.items():
+            g = np.asarray(g)
+            flat_p = np.asarray(params[name]).reshape(-1)
+            idxs = rnd.choice(flat_p.size, size=min(n_coords, flat_p.size), replace=False)
+            for i in idxs:
+                pp = dict(params)
+                v = flat_p.copy()
+                v[i] += eps
+                pp[name] = jnp.asarray(v.reshape(params[name].shape))
+                up = float(loss(pp))
+                v[i] -= 2 * eps
+                pp[name] = jnp.asarray(v.reshape(params[name].shape))
+                down = float(loss(pp))
+                numeric = (up - down) / (2 * eps)
+                a = g.reshape(-1)[i]
+                assert abs(a - numeric) <= atol + rtol * max(abs(a), abs(numeric)), \
+                    f"{name}[{i}]: analytic={a} numeric={numeric}"
+
+
+def _seq_feed(rng, B=3, T=5, D=8, classes=3):
+    lengths = np.array([5, 3, 4], np.int32)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    for i in range(B):
+        x[i, lengths[i]:] = 0
+    y = rng.integers(0, classes, B).astype(np.int32)
+    return {
+        "x": Argument(value=jnp.asarray(x), lengths=jnp.asarray(lengths)),
+        "y": Argument(ids=jnp.asarray(y)),
+    }
+
+
+def test_fc_softmax_grad():
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=6)
+        h = fc_layer(input=x, size=5, act=TanhActivation())
+        out = fc_layer(input=h, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(0)
+    feed = {"x": Argument(value=jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 3, 4), jnp.int32))}
+    fd_check(cfg, feed)
+
+
+def test_lstm_grad():
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=8)
+        proj = fc_layer(input=x, size=16, act=LinearActivation(), bias_attr=False)
+        h = lstmemory(input=proj)
+        pooled = pooling_layer(input=h, pooling_type=MaxPooling())
+        out = fc_layer(input=pooled, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    feed = _seq_feed(np.random.default_rng(1))
+    fd_check(cfg, feed)
+
+
+def test_gru_grad():
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=8)
+        proj = fc_layer(input=x, size=12, act=LinearActivation(), bias_attr=False)
+        h = grumemory(input=proj, reverse=True)
+        pooled = last_seq(input=h)
+        out = fc_layer(input=pooled, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    feed = _seq_feed(np.random.default_rng(2))
+    fd_check(cfg, feed)
+
+
+def test_conv_pool_grad():
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=1 * 8 * 8)
+        c = img_conv_layer(input=x, filter_size=3, num_filters=4, num_channels=1,
+                           padding=1, act=ReluActivation())
+        p = img_pool_layer(input=c, pool_size=2, stride=2)
+        out = fc_layer(input=p, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(3)
+    feed = {"x": Argument(value=jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 3, 4), jnp.int32))}
+    fd_check(cfg, feed)
+
+
+def test_embedding_context_grad():
+    def conf():
+        settings(batch_size=3)
+        words = data_layer(name="w", size=20)
+        emb = embedding_layer(input=words, size=6)
+        with mixed_layer(size=18) as ctxp:
+            ctxp += context_projection(input=emb, context_len=3)
+        h = fc_layer(input=ctxp, size=5, act=TanhActivation())
+        pooled = pooling_layer(input=h, pooling_type=AvgPooling())
+        out = fc_layer(input=pooled, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(4)
+    lengths = np.array([5, 2, 4], np.int32)
+    ids = rng.integers(0, 20, (3, 5)).astype(np.int32)
+    feed = {"w": Argument(ids=jnp.asarray(ids), lengths=jnp.asarray(lengths)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 3, 3), jnp.int32))}
+    fd_check(cfg, feed)
+
+
+def test_crf_grad():
+    def conf():
+        settings(batch_size=3)
+        x = data_layer(name="x", size=8)
+        feats = fc_layer(input=x, size=4, act=LinearActivation())
+        crf_layer(input=feats, label=data_layer(name="t", size=4), size=4)
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(5)
+    B, T = 3, 5
+    lengths = np.array([5, 3, 4], np.int32)
+    x = rng.standard_normal((B, T, 8)).astype(np.float32)
+    tags = rng.integers(0, 4, (B, T)).astype(np.int32)
+    feed = {"x": Argument(value=jnp.asarray(x), lengths=jnp.asarray(lengths)),
+            "t": Argument(ids=jnp.asarray(tags), lengths=jnp.asarray(lengths))}
+    fd_check(cfg, feed)

@@ -1,0 +1,70 @@
+"""End-to-end slice: DSL -> ModelConfig -> GraphExecutor -> Trainer on a
+synthetic separable dataset — the v0 milestone of SURVEY.md §7.4
+(ref test analog: paddle/trainer/tests/test_TrainerOnePass.cpp)."""
+
+import numpy as np
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.data.provider import dense_vector, integer_value, provider
+from paddle_tpu.dsl import (
+    SoftmaxActivation, TanhActivation, classification_cost, data_layer,
+    fc_layer, settings, MomentumOptimizer,
+)
+from paddle_tpu.trainer.trainer import Trainer
+
+
+def mlp_config(dim=16, classes=4):
+    settings(batch_size=32, learning_rate=0.1,
+             learning_method=MomentumOptimizer(momentum=0.9))
+    img = data_layer(name="features", size=dim)
+    h = fc_layer(input=img, size=32, act=TanhActivation())
+    out = fc_layer(input=h, size=classes, act=SoftmaxActivation())
+    lbl = data_layer(name="label", size=classes)
+    classification_cost(input=out, label=lbl)
+
+
+def synth_data(n=512, dim=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, dim)) * 3.0
+    y = rng.integers(0, classes, size=n)
+    x = centers[y] + rng.standard_normal((n, dim)).astype(np.float64)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+@provider(input_types={"features": dense_vector(16), "label": integer_value(4)},
+          should_shuffle=True)
+def synth_provider(settings, fname):
+    x, y = synth_data()
+    for i in range(len(y)):
+        yield [x[i], int(y[i])]
+
+
+def test_mlp_trains_to_low_error():
+    cfg = parse_config_callable(mlp_config)
+    cfg.model_config  # built
+    tr = Trainer(cfg, seed=7)
+
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(synth_provider, ["dummy"], ["features", "label"],
+                        batch_size=32, seed=3)
+    first_stats = tr.train_one_pass(batches=feeder.batches())
+    for _ in range(4):
+        stats = tr.train_one_pass(batches=feeder.batches())
+    assert stats["cost"] < first_stats["cost"], "loss should decrease"
+    assert stats["cost"] < 0.2, f"final cost too high: {stats}"
+    assert stats["classification_error"] < 0.05, stats
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = parse_config_callable(mlp_config)
+    tr = Trainer(cfg, seed=7)
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(synth_provider, ["dummy"], ["features", "label"],
+                        batch_size=32, seed=3)
+    tr.train_one_pass(batches=feeder.batches())
+    d = tr.save(str(tmp_path))
+    tr2 = Trainer(cfg, seed=99)
+    tr2.load(d)
+    for name in tr.params:
+        np.testing.assert_allclose(np.asarray(tr.params[name]),
+                                   np.asarray(tr2.params[name]), rtol=1e-6)

@@ -1,0 +1,96 @@
+"""Sequence op tests against numpy oracles — the analog of the reference's
+CPU-vs-GPU comparison tests (ref: paddle/math/tests/test_matrixCompare.cpp,
+paddle/cuda/src/hl_cuda_sequence.cu ops)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops import sequence as seqops
+
+
+def _ragged(rng, B=5, T=7, D=3):
+    lengths = rng.integers(1, T + 1, size=B).astype(np.int32)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    for i in range(B):
+        x[i, lengths[i]:] = 0.0
+    return x, lengths
+
+
+def test_seq_pool_max_avg_last_first():
+    rng = np.random.default_rng(0)
+    x, lengths = _ragged(rng)
+    got_max = np.asarray(seqops.seq_pool_max(jnp.asarray(x), jnp.asarray(lengths)))
+    got_avg = np.asarray(seqops.seq_pool_avg(jnp.asarray(x), jnp.asarray(lengths)))
+    got_sum = np.asarray(seqops.seq_pool_avg(jnp.asarray(x), jnp.asarray(lengths), "sum"))
+    got_last = np.asarray(seqops.seq_pool_last(jnp.asarray(x), jnp.asarray(lengths)))
+    got_first = np.asarray(seqops.seq_pool_first(jnp.asarray(x), jnp.asarray(lengths)))
+    for i, L in enumerate(lengths):
+        v = x[i, :L]
+        np.testing.assert_allclose(got_max[i], v.max(0), rtol=1e-6)
+        np.testing.assert_allclose(got_avg[i], v.mean(0), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got_sum[i], v.sum(0), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got_last[i], v[-1], rtol=1e-6)
+        np.testing.assert_allclose(got_first[i], v[0], rtol=1e-6)
+
+
+def test_expand_to_sequence():
+    rng = np.random.default_rng(1)
+    B, T, D = 4, 6, 2
+    lengths = np.array([2, 6, 1, 4], np.int32)
+    v = rng.standard_normal((B, D)).astype(np.float32)
+    got = np.asarray(seqops.expand_to_sequence(jnp.asarray(v), jnp.asarray(lengths), T))
+    for i, L in enumerate(lengths):
+        for t in range(T):
+            expect = v[i] if t < L else np.zeros(D)
+            np.testing.assert_allclose(got[i, t], expect, rtol=1e-6)
+
+
+def test_context_projection_matches_naive():
+    rng = np.random.default_rng(2)
+    B, T, D = 3, 5, 2
+    lengths = np.array([5, 3, 4], np.int32)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    for i in range(B):
+        x[i, lengths[i]:] = 0.0
+    start, clen = -1, 3
+    got = np.asarray(seqops.context_projection(
+        jnp.asarray(x), jnp.asarray(lengths), start, clen))
+    # naive oracle
+    for i, L in enumerate(lengths):
+        for t in range(T):
+            if t >= L:
+                assert np.allclose(got[i, t], 0.0)
+                continue
+            cols = []
+            for j in range(clen):
+                src = t + start + j
+                cols.append(x[i, src] if 0 <= src < L else np.zeros(D))
+            np.testing.assert_allclose(got[i, t], np.concatenate(cols),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_seq_reverse():
+    rng = np.random.default_rng(3)
+    x, lengths = _ragged(rng)
+    got = np.asarray(seqops.seq_reverse(jnp.asarray(x), jnp.asarray(lengths)))
+    for i, L in enumerate(lengths):
+        np.testing.assert_allclose(got[i, :L], x[i, :L][::-1], rtol=1e-6)
+
+
+def test_seq_concat():
+    rng = np.random.default_rng(4)
+    B, Ta, Tb, D = 3, 4, 3, 2
+    la = np.array([2, 4, 1], np.int32)
+    lb = np.array([3, 1, 2], np.int32)
+    a = rng.standard_normal((B, Ta, D)).astype(np.float32)
+    b = rng.standard_normal((B, Tb, D)).astype(np.float32)
+    for i in range(B):
+        a[i, la[i]:] = 0
+        b[i, lb[i]:] = 0
+    got, lens = seqops.seq_concat(jnp.asarray(a), jnp.asarray(la),
+                                  jnp.asarray(b), jnp.asarray(lb))
+    got = np.asarray(got)
+    for i in range(B):
+        expect = np.concatenate([a[i, :la[i]], b[i, :lb[i]]], axis=0)
+        np.testing.assert_allclose(got[i, :la[i] + lb[i]], expect, rtol=1e-6)
+        assert int(lens[i]) == la[i] + lb[i]
