@@ -1,0 +1,51 @@
+"""Benchmark: small-VGG CIFAR-10 training throughput (north-star #1).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Runs on whatever backend JAX selects (real TPU under the driver).
+`vs_baseline` compares against the reference paddle's GPU-era qualitative
+target (BASELINE.json publishes no numbers, so 0.0 = unknown baseline ratio).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.data.feeder import make_batch
+    from paddle_tpu.data.provider import dense_vector, integer_value
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    batch_size = int(os.environ.get("BENCH_BATCH_SIZE", "128"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+
+    cfg = parse_config("demo/image_classification/vgg_16_cifar.py",
+                       f"batch_size={batch_size}")
+    tr = Trainer(cfg, seed=1)
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(3 + iters):
+        x = rng.random((batch_size, 3 * 32 * 32), np.float32).astype(np.float32) - 0.5
+        y = rng.integers(0, 10, batch_size).astype(np.int32)
+        batches.append({"image": Argument(value=x), "label": Argument(ids=y)})
+
+    stats = tr.benchmark(iter(batches), warmup=3, iters=iters)
+    print(json.dumps({
+        "metric": "vgg16_cifar10_train_samples_per_sec_per_chip",
+        "value": round(stats["samples_per_sec"], 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

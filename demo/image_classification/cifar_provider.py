@@ -1,0 +1,49 @@
+"""CIFAR-10 data provider (ref: demo/image_classification/image_provider.py).
+
+Loads the python-pickle CIFAR batches if present under data/cifar-10-batches-py
+(the reference's download script fetches them); otherwise falls back to a
+deterministic synthetic 32x32x3 dataset so demos/benchmarks run hermetically.
+Mean subtraction mirrors the reference's ImageTransformer preprocessing.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu.data.provider import dense_vector, integer_value, provider
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "cifar-10-batches-py")
+DIM = 3 * 32 * 32
+
+
+def _synthetic(n, seed):
+    templates = np.random.default_rng(7).random((10, DIM)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n).astype(np.int32)
+    x = 0.6 * templates[y] + 0.4 * rng.random((n, DIM)).astype(np.float32)
+    return x - 0.5, y
+
+
+def _load(split):
+    if os.path.isdir(DATA_DIR):
+        xs, ys = [], []
+        names = [f"data_batch_{i}" for i in range(1, 6)] if split == "train" \
+            else ["test_batch"]
+        for nm in names:
+            with open(os.path.join(DATA_DIR, nm), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.float32) / 255.0 - 0.5)
+            ys.append(np.asarray(d[b"labels"], np.int32))
+        return np.concatenate(xs), np.concatenate(ys)
+    return _synthetic(10240 if split == "train" else 1024,
+                      seed=0 if split == "train" else 1)
+
+
+@provider(input_types={"image": dense_vector(DIM), "label": integer_value(10)})
+def process(settings, filename):
+    split = "train" if "train" in filename else "test"
+    x, y = _load(split)
+    for i in range(len(y)):
+        yield [x[i], int(y[i])]

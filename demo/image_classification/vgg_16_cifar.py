@@ -1,0 +1,27 @@
+"""CIFAR-10 small-VGG config (ref: demo/image_classification/vgg_16_cifar.py)
+— north-star benchmark #1 (BASELINE.md)."""
+
+from paddle_tpu.dsl import *
+
+is_predict = get_config_arg("is_predict", bool, False)
+batch_size = get_config_arg("batch_size", int, 128)
+
+define_py_data_sources2(
+    train_list=None if is_predict else "demo/image_classification/train.list",
+    test_list="demo/image_classification/test.list",
+    module="demo.image_classification.cifar_provider",
+    obj="process")
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.1 / 128.0,
+    learning_method=MomentumOptimizer(momentum=0.9),
+    regularization=L2Regularization(0.0005 * 128))
+
+img = data_layer(name="image", size=3 * 32 * 32, height=32, width=32)
+predict = small_vgg(input_image=img, num_channels=3, num_classes=10)
+if not is_predict:
+    lbl = data_layer(name="label", size=10)
+    classification_cost(input=predict, label=lbl)
+else:
+    outputs(predict)
