@@ -1,0 +1,90 @@
+"""Attention seq2seq network (ref: demo/seqToseq/seqToseq_net.py:70-120 —
+bi-GRU encoder, additive-attention GRU decoder, beam-search generation).
+North-star benchmark #2 (BASELINE.md)."""
+
+from paddle_tpu.dsl import *
+
+dict_size = get_config_arg("dict_size", int, 32)
+is_generating = get_config_arg("is_generating", bool, False)
+beam_size = get_config_arg("beam_size", int, 3)
+max_length = get_config_arg("max_length", int, 12)
+
+word_vector_dim = 64
+encoder_size = 64
+decoder_size = 64
+
+define_py_data_sources2(
+    train_list=None if is_generating else "demo/seqToseq/train.list",
+    test_list="demo/seqToseq/test.list",
+    module="demo.seqToseq.seq_provider",
+    obj="process")
+
+settings(
+    batch_size=32 if not is_generating else 8,
+    learning_rate=5e-4,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(1e-4 * 32),
+    gradient_clipping_threshold=25)
+
+# ---------------- encoder ----------------
+src_word = data_layer(name="source_language_word", size=dict_size)
+src_emb = embedding_layer(input=src_word, size=word_vector_dim,
+                          param_attr=ParameterAttribute(name="_source_language_embedding"))
+src_fwd = simple_gru(input=src_emb, size=encoder_size)
+src_bwd = simple_gru(input=src_emb, size=encoder_size, reverse=True)
+encoded_vector = concat_layer(input=[src_fwd, src_bwd])
+
+with mixed_layer(size=decoder_size) as encoded_proj:
+    encoded_proj += full_matrix_projection(input=encoded_vector, size=decoder_size)
+
+backward_first = first_seq(input=src_bwd)
+with mixed_layer(size=decoder_size, act=TanhActivation()) as decoder_boot:
+    decoder_boot += full_matrix_projection(input=backward_first, size=decoder_size)
+
+
+def gru_decoder_with_attention(enc_vec, enc_proj, current_word):
+    # layers carrying parameters are explicitly named so the training and
+    # generation configs produce identical parameter names (the reference's
+    # demo does the same — shared params are matched by name)
+    decoder_mem = memory(name="gru_decoder", size=decoder_size,
+                         boot_layer=decoder_boot)
+    context = simple_attention(
+        name="attention", encoded_sequence=enc_vec, encoded_proj=enc_proj,
+        decoder_state=decoder_mem)
+    with mixed_layer(size=decoder_size * 3, name="decoder_inputs") as decoder_inputs:
+        decoder_inputs += full_matrix_projection(input=context,
+                                                 size=decoder_size * 3)
+        decoder_inputs += full_matrix_projection(input=current_word,
+                                                 size=decoder_size * 3)
+    gru_step = gru_step_layer(
+        name="gru_decoder", input=decoder_inputs, output_mem=decoder_mem,
+        size=decoder_size)
+    with mixed_layer(size=dict_size, act=SoftmaxActivation(),
+                     bias_attr=True, name="decoder_prob") as out:
+        out += full_matrix_projection(input=gru_step, size=dict_size)
+    return out
+
+
+if not is_generating:
+    trg_word = data_layer(name="target_language_word", size=dict_size)
+    trg_emb = embedding_layer(
+        input=trg_word, size=word_vector_dim,
+        param_attr=ParameterAttribute(name="_target_language_embedding"))
+    decoder = recurrent_group(
+        name="decoder_group", step=gru_decoder_with_attention,
+        input=[StaticInput(input=encoded_vector, is_seq=True),
+               StaticInput(input=encoded_proj, is_seq=True),
+               trg_emb])
+    lbl = data_layer(name="target_language_next_word", size=dict_size)
+    classification_cost(input=decoder, label=lbl)
+else:
+    gen_input = GeneratedInput(
+        size=dict_size, embedding_name="_target_language_embedding",
+        embedding_size=word_vector_dim)
+    beam_gen = beam_search(
+        name="decoder_group", step=gru_decoder_with_attention,
+        input=[StaticInput(input=encoded_vector, is_seq=True),
+               StaticInput(input=encoded_proj, is_seq=True),
+               gen_input],
+        bos_id=0, eos_id=1, beam_size=beam_size, max_length=max_length)
+    outputs(beam_gen)

@@ -1,0 +1,69 @@
+"""seq2seq data provider (ref: demo/seqToseq/dataprovider.py).
+
+Reads tokenized parallel corpora if present under data/ (the reference's
+WMT14 download layout); otherwise a synthetic sequence-reversal task with a
+small vocabulary — an exact, learnable stand-in that exercises the same
+machinery (variable lengths, attention, beam decode).
+
+Slots: src ids, trg ids (<s> + target), trg_next ids (target + <e>),
+matching the reference's three data fields.
+"""
+
+import os
+
+import numpy as np
+
+from paddle_tpu.data.provider import integer_value_sequence, provider
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+BOS = 0   # <s>
+EOS = 1   # <e>
+UNK = 2
+
+
+def make_settings_args(dict_size):
+    return {"src_dict_dim": dict_size, "trg_dict_dim": dict_size}
+
+
+def _synthetic(n, seed, vocab):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        L = int(rng.integers(3, 9))
+        src = rng.integers(3, vocab, L).tolist()
+        trg = src[::-1]
+        yield src, [BOS] + trg, trg + [EOS]
+
+
+def _file_pairs(split):
+    src_f = os.path.join(DATA_DIR, f"{split}.src")
+    trg_f = os.path.join(DATA_DIR, f"{split}.trg")
+    if not (os.path.exists(src_f) and os.path.exists(trg_f)):
+        return None
+
+    def gen():
+        with open(src_f) as fs, open(trg_f) as ft:
+            for ls, lt in zip(fs, ft):
+                src = [int(t) for t in ls.split()]
+                trg = [int(t) for t in lt.split()]
+                yield src, [BOS] + trg, trg + [EOS]
+    return gen()
+
+
+def _make(vocab):
+    @provider(input_types={
+        "source_language_word": integer_value_sequence(vocab),
+        "target_language_word": integer_value_sequence(vocab),
+        "target_language_next_word": integer_value_sequence(vocab)})
+    def process(settings, filename):
+        split = "train" if "train" in filename else "test"
+        pairs = _file_pairs(split)
+        if pairs is None:
+            pairs = _synthetic(4096 if split == "train" else 256,
+                               seed=0 if split == "train" else 1, vocab=vocab)
+        for src, trg, trg_next in pairs:
+            yield [src, trg, trg_next]
+    return process
+
+
+process = _make(int(os.environ.get("SEQ2SEQ_DICT_SIZE", "32")))

@@ -117,9 +117,16 @@ class GraphExecutor:
         for kind, item in self._plan:
             if kind == "layer":
                 cfg: LayerConfig = item
+                if any(inp.input_layer_name not in ctx.outputs for inp in cfg.inputs):
+                    # depends on a generator group's output — only produced by
+                    # generate(); skip in plain forward
+                    continue
                 ctx.outputs[cfg.name] = get_layer_fn(cfg.type)(ctx, cfg)
             else:
-                self._run_scan(ctx, item)
+                sm: SubModelConfig = item
+                if sm.generator is not None and not sm.in_links:
+                    continue  # generation-only group: run via generate()
+                self._run_scan(ctx, sm)
         return ctx.outputs, ctx.costs, ctx.state_out
 
     def loss(
@@ -140,6 +147,14 @@ class GraphExecutor:
             s = jnp.mean(c)
             total = s if total is None else total + s
         return total, (outputs, costs, new_state)
+
+    def run_group_layers(self, sm: SubModelConfig, sub: ForwardContext) -> None:
+        """Execute one timestep of a sub-model's layers; agent/alias layers
+        must already be fed into sub.outputs."""
+        for cfg in (self.layer_map[n] for n in sm.layer_names):
+            if cfg.name in sub.outputs:      # agents already fed
+                continue
+            sub.outputs[cfg.name] = get_layer_fn(cfg.type)(sub, cfg)
 
     # -- recurrent sub-model as lax.scan ---------------------------------
     def _run_scan(self, ctx: ForwardContext, sm: SubModelConfig) -> None:
@@ -210,11 +225,7 @@ class GraphExecutor:
                 sub.outputs[mem.layer_name] = (
                     Argument(ids=prev) if prev.dtype in (jnp.int32, jnp.int64)
                     else Argument(value=prev))
-            # boot bias on memory (ref: Memory boot_bias): applied once via agent
-            for cfg in group_layers:
-                if cfg.name in sub.outputs:      # agents already fed
-                    continue
-                sub.outputs[cfg.name] = get_layer_fn(cfg.type)(sub, cfg)
+            self.run_group_layers(sm, sub)
             valid = (t < lengths)
             new_carry = {}
             for mem in sm.memories:

@@ -1,0 +1,189 @@
+"""Sequence generation: greedy and beam search over a generator sub-model.
+
+TPU re-design of the reference's generation machinery (ref:
+paddle/gserver/gradientmachines/RecurrentGradientMachine.cpp:
+generateSequence :804, oneWaySearch :876, beamSearch :1211, Path struct
+RecurrentGradientMachine.h:180-250).
+
+The reference steps frame networks one timestep at a time on the host,
+expanding an explicit Path list per beam candidate.  Here the whole search is
+ONE `lax.scan` with static shapes: the beam is flattened into the batch
+dimension ([B*K] rows through the decoder step), candidate expansion is a
+top-k over K*V scores, beam-parent gathers re-index the memory carries, and
+finished beams are frozen with masks.  XLA compiles the entire search,
+including the decoder step, into a single program — no host round-trips per
+token.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import ModelConfig, SubModelConfig
+from paddle_tpu.graph.context import GEN, ForwardContext
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+_NEG_INF = -1e9
+
+
+def _tile_beam(x: Array, K: int) -> Array:
+    """[B, ...] -> [B*K, ...] repeating each row K times."""
+    return jnp.repeat(x, K, axis=0)
+
+
+def _gather_beam(x: Array, parent: Array, B: int, K: int) -> Array:
+    """Re-select beam rows after top-k: x [B*K, ...], parent [B, K] in [0,K)."""
+    xs = x.reshape((B, K) + x.shape[1:])
+    out = jnp.take_along_axis(
+        xs, parent.reshape(B, K, *([1] * (x.ndim - 1))), axis=1)
+    return out.reshape((B * K,) + x.shape[1:])
+
+
+class SequenceGenerator:
+    """Runs a generator sub-model (ref: SubModelConfig.generator).
+
+    Usage: gen = SequenceGenerator(executor, sm); ids, scores = gen(params, feed).
+    `feed` supplies the root-graph inputs (encoder side); the root layers are
+    executed first, then the search loop.
+    """
+
+    def __init__(self, executor, sm: SubModelConfig,
+                 beam_size: Optional[int] = None,
+                 max_length: Optional[int] = None):
+        assert sm.generator is not None, f"sub-model {sm.name!r} has no generator"
+        self.executor = executor
+        self.sm = sm
+        self.gen = sm.generator
+        self.beam_size = beam_size or self.gen.beam_size or 1
+        self.max_length = max_length or self.gen.max_num_frames
+
+    def __call__(self, params: dict[str, Array], feed: dict[str, Argument],
+                 rng: Optional[jax.Array] = None) -> tuple[Array, Array]:
+        """Returns (ids [B, K, L] int32 with EOS-padding, scores [B, K] log p).
+
+        Beams are sorted best-first; K = beam_size.
+        """
+        ex = self.executor
+        sm, gen = self.sm, self.gen
+        K, L = self.beam_size, self.max_length
+
+        # run the root graph (encoder) up to the group boundary
+        ctx = ForwardContext(model=ex.model, params=params, mode=GEN, rng=rng)
+        for name, arg in feed.items():
+            ctx.outputs[name] = arg
+        for kind, item in ex._plan:
+            if kind == "layer":
+                cfg = item
+                if any(i.input_layer_name not in ctx.outputs for i in cfg.inputs):
+                    continue
+                from paddle_tpu.graph.registry import get_layer_fn
+                ctx.outputs[cfg.name] = get_layer_fn(cfg.type)(ctx, cfg)
+            elif item is not sm and not (item.generator is not None and not item.in_links):
+                ex._run_scan(ctx, item)
+
+        # batch size from any static link / feed
+        static_alias = dict(zip(sm.static_links, sm.static_link_layers))
+        some = next(iter(feed.values()))
+        B = some.batch_size
+
+        # static (encoder) inputs tiled K-fold into the flattened beam batch
+        static_feeds: dict[str, Argument] = {}
+        for outer, inner in static_alias.items():
+            arg = ctx.outputs[outer]
+            static_feeds[inner] = Argument(
+                value=None if arg.value is None else _tile_beam(arg.value, K),
+                ids=None if arg.ids is None else _tile_beam(arg.ids, K),
+                lengths=None if arg.lengths is None else _tile_beam(arg.lengths, K))
+
+        # initial memory carries, tiled
+        id_mem_name = gen.id_memory_layer_name
+        carry0: dict[str, Array] = {}
+        mem_by_agent: dict[str, Any] = {}
+        for mem in sm.memories:
+            mem_by_agent[mem.layer_name] = mem
+            if mem.layer_name == id_mem_name:
+                continue  # token memory handled by the beam state
+            if mem.boot_layer_name:
+                boot = ctx.outputs[mem.boot_layer_name].data
+            else:
+                boot = jnp.zeros((B, mem.size), jnp.float32)
+            carry0[mem.layer_name] = _tile_beam(boot, K)
+
+        prob_layer = gen.prob_layer_name
+        eos = gen.eos_id
+
+        def decode_step(state, _):
+            tokens, scores, finished, carries = state
+            sub = ForwardContext(model=ex.model, params=params, mode=GEN, rng=rng)
+            sub.outputs.update(static_feeds)
+            sub.outputs[id_mem_name] = Argument(ids=tokens.reshape(B * K))
+            for agent_name, c in carries.items():
+                sub.outputs[agent_name] = Argument(value=c)
+            ex.run_group_layers(sm, sub)
+            probs = sub.outputs[prob_layer].data.reshape(B, K, -1)
+            V = probs.shape[-1]
+            logp = jnp.log(jnp.maximum(probs, 1e-12))
+            # finished beams may only emit EOS at zero cost
+            eos_only = jnp.full((V,), _NEG_INF).at[eos].set(0.0)
+            step_logp = jnp.where(finished[..., None], eos_only[None, None, :], logp)
+            total = scores[..., None] + step_logp          # [B, K, V]
+            flat = total.reshape(B, K * V)
+            new_scores, flat_idx = jax.lax.top_k(flat, K)  # [B, K]
+            parent = flat_idx // V
+            new_tok = (flat_idx % V).astype(jnp.int32)
+            # reorder state by beam parent
+            new_carries = {}
+            for agent_name in carries:
+                link = mem_by_agent[agent_name].link_name
+                out = sub.outputs[link].data
+                out = _gather_beam(out, parent, B, K)
+                prev = _gather_beam(carries[agent_name], parent, B, K)
+                fin = jnp.take_along_axis(finished, parent, axis=1).reshape(B * K)
+                new_carries[agent_name] = jnp.where(
+                    fin.reshape(B * K, *([1] * (out.ndim - 1))), prev, out)
+            new_finished = jnp.take_along_axis(finished, parent, axis=1) | (new_tok == eos)
+            return ((new_tok, new_scores, new_finished, new_carries),
+                    (new_tok, parent))
+
+        tokens0 = jnp.full((B, K), gen.bos_id, jnp.int32)
+        scores0 = jnp.tile(jnp.where(jnp.arange(K) == 0, 0.0, _NEG_INF)[None, :], (B, 1))
+        finished0 = jnp.zeros((B, K), bool)
+
+        init = (tokens0, scores0, finished0, carry0)
+        (tok_f, scores_f, fin_f, _), (toks, parents) = jax.lax.scan(
+            decode_step, init, None, length=L)
+        # toks: [L, B, K]; parents: [L, B, K] — backtrack to recover sequences
+        def back(nxt_parent, inp):
+            tok_t, par_t = inp
+            tok = jnp.take_along_axis(tok_t, nxt_parent, axis=1)
+            par = jnp.take_along_axis(par_t, nxt_parent, axis=1)
+            return par, tok
+
+        last_parent = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+        _, seq_rev = jax.lax.scan(back, last_parent, (toks, parents), reverse=True)
+        seqs = jnp.moveaxis(seq_rev, 0, 2)          # [B, K, L]
+        # pad everything after the first EOS with EOS
+        eos_seen = jnp.cumsum((seqs == eos).astype(jnp.int32), axis=-1)
+        seqs = jnp.where(eos_seen > 1, eos, seqs)
+        if gen.log_prob:
+            out_scores = scores_f
+        else:
+            lengths = jnp.sum((eos_seen == 0).astype(jnp.float32), axis=-1) + 1.0
+            out_scores = scores_f / lengths
+        return seqs, out_scores
+
+
+def generate(executor, params: dict[str, Array], feed: dict[str, Argument],
+             rng: Optional[jax.Array] = None,
+             beam_size: Optional[int] = None,
+             max_length: Optional[int] = None) -> tuple[Array, Array]:
+    """Convenience: find the generator sub-model and run the search
+    (ref: GradientMachine::generateSequence dispatch)."""
+    gens = [sm for sm in executor.model.sub_models if sm.generator is not None]
+    assert gens, "model has no generator sub-model"
+    return SequenceGenerator(executor, gens[0], beam_size, max_length)(
+        params, feed, rng)
