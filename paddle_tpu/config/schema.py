@@ -346,6 +346,10 @@ class EvaluatorConfig(_Serializable):
     classification_threshold: float = 0.5
     positive_label: int = -1
     excluded_chunk_types: list[int] = field(default_factory=list)
+    # printers (ref: EvaluatorConfig result_file/dict_file/delimited)
+    result_file: str = ""
+    dict_file: str = ""
+    delimited: bool = True
 
 
 # ---------------------------------------------------------------------------

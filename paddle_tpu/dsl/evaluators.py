@@ -11,6 +11,9 @@ __all__ = [
     "classification_error_evaluator", "auc_evaluator", "sum_evaluator",
     "column_sum_evaluator", "precision_recall_evaluator", "pnpair_evaluator",
     "chunk_evaluator", "ctc_error_evaluator", "value_printer_evaluator",
+    "rank_auc_evaluator", "seq_classification_error_evaluator",
+    "maxid_printer_evaluator", "seqtext_printer_evaluator",
+    "classification_error_printer_evaluator",
 ]
 
 
@@ -81,3 +84,38 @@ def ctc_error_evaluator(input: LayerOutput, label: LayerOutput, name=None) -> No
 
 def value_printer_evaluator(input: LayerOutput, name=None) -> None:
     _add("value_printer", [input], name)
+
+
+def rank_auc_evaluator(input: LayerOutput, label: LayerOutput, name=None,
+                       weight=None) -> None:
+    """Per-query ranking AUC over sequences (ref: RankAucEvaluator)."""
+    ins = [input, label] + ([weight] if weight else [])
+    _add("rankauc", ins, name)
+
+
+def seq_classification_error_evaluator(input: LayerOutput, label: LayerOutput,
+                                       name=None,
+                                       threshold: Optional[float] = None) -> None:
+    """Sequence-level error: wrong if any frame is wrong
+    (ref: SequenceClassificationErrorEvaluator)."""
+    _add("seq_classification_error", [input, label], name,
+         classification_threshold=threshold)
+
+
+def maxid_printer_evaluator(input: LayerOutput, name=None) -> None:
+    _add("max_id_printer", [input], name)
+
+
+def seqtext_printer_evaluator(input: LayerOutput, name=None,
+                              result_file: str = "", dict_file: str = "",
+                              delimited: bool = True) -> None:
+    """Print/write decoded id sequences (ref: SequenceTextPrinter —
+    result_file/dict_file/delimited)."""
+    _add("seq_text_printer", [input], name, result_file=result_file,
+         dict_file=dict_file, delimited=delimited)
+
+
+def classification_error_printer_evaluator(input: LayerOutput,
+                                           label: LayerOutput,
+                                           name=None) -> None:
+    _add("classification_error_printer", [input, label], name)

@@ -109,7 +109,8 @@ class Trainer:
             bsz = _batch_size(batch)
             new_params, new_opt = updater.step(params, grads, opt_state, bsz)
             partials = evaluators.batch_partials(outputs, batch)
-            return new_params, new_opt, new_net, loss, partials
+            host_out = {n: outputs[n] for n in evaluators.host_layer_names}
+            return new_params, new_opt, new_net, loss, partials, host_out
 
         return train_step
 
@@ -120,7 +121,8 @@ class Trainer:
         def test_step(params, net_state, batch, rng):
             loss, (outputs, costs, _) = executor.loss(params, batch, net_state, TEST, rng)
             partials = evaluators.batch_partials(outputs, batch)
-            return loss, partials
+            host_out = {n: outputs[n] for n in evaluators.host_layer_names}
+            return loss, partials, host_out
 
         return test_step
 
@@ -143,11 +145,15 @@ class Trainer:
             from paddle_tpu.parallel.dp import shard_batch
             batch = shard_batch(self.mesh, batch)
         self.rng, sub = jax.random.split(self.rng)
-        (self.params, self.opt_state, new_net, loss, partials) = self._train_step(
-            self.params, self.opt_state, self.net_state, batch, sub)
+        (self.params, self.opt_state, new_net, loss, partials, host_out) = \
+            self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
         if new_net:
             self.net_state = new_net
         self._acc = self.evaluators.accumulate(getattr(self, "_acc", {}), partials)
+        if self.evaluators.host_configs:
+            if not hasattr(self, "_host_acc") or self._host_acc is None:
+                self._host_acc = self.evaluators.new_host_state()
+            self.evaluators.host_update(self._host_acc, host_out)
         return float(loss)
 
     def train_one_pass(self, batches: Optional[Iterator] = None,
@@ -155,6 +161,8 @@ class Trainer:
         """(ref: Trainer::trainOnePass)."""
         t0 = time.time()
         self._acc = self.evaluators.new_accumulator()
+        self._host_acc = self.evaluators.new_host_state() if \
+            self.evaluators.host_configs else None
         total_cost, n_batches, n_samples = 0.0, 0, 0
         if batches is None:
             batches = self.train_batches()
@@ -169,6 +177,8 @@ class Trainer:
                          total_cost / n_batches, _fmt(self.evaluators.finalize(self._acc)))
         self.opt_state = self.updater.finish_pass(self.opt_state)
         stats = self.evaluators.finalize(self._acc)
+        if self._host_acc is not None:
+            stats.update(self.evaluators.finalize_host(self._host_acc))
         dt = time.time() - t0
         stats.update(cost=total_cost / max(n_batches, 1), batches=n_batches,
                      samples=n_samples, seconds=dt,
@@ -199,15 +209,22 @@ class Trainer:
             batches = self._feeder(self.config.test_data_config, False).batches()
         params = self.updater.averaged_params(self.params, self.opt_state)
         acc = self.evaluators.new_accumulator()
+        host_acc = self.evaluators.new_host_state() if \
+            self.evaluators.host_configs else None
         total, n = 0.0, 0
         self.rng, sub = jax.random.split(self.rng)
         for batch in batches:
-            loss, partials = self._test_step(params, self.net_state, batch, sub)
+            loss, partials, host_out = self._test_step(
+                params, self.net_state, batch, sub)
             bsz = _batch_size(batch)
             total += float(loss) * bsz
             n += bsz
             acc = self.evaluators.accumulate(acc, partials)
+            if host_acc is not None:
+                self.evaluators.host_update(host_acc, host_out)
         stats = self.evaluators.finalize(acc)
+        if host_acc is not None:
+            stats.update(self.evaluators.finalize_host(host_acc))
         stats["cost"] = total / max(n, 1)
         return stats
 

@@ -1,0 +1,158 @@
+"""Evaluator zoo tests — hand-computed oracles per metric
+(mirrors ref: gserver/tests/test_Evaluator.cpp strategy of feeding known
+arguments and checking the statistic)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.schema import EvaluatorConfig
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.trainer.evaluators import (
+    EvaluatorSet, host_evaluator_registry, _chunk_segments, _edit_distance,
+    _ctc_collapse, _rank_auc_one,
+)
+
+
+def run_host(type_, args, **cfg_kw):
+    cfg = EvaluatorConfig(name="e", type=type_, **cfg_kw)
+    new, batch, final = host_evaluator_registry[type_]
+    state = new()
+    batch(cfg, args, state)
+    return final(cfg, state)
+
+
+# -- chunk ------------------------------------------------------------------
+
+def test_chunk_segments_iob():
+    # IOB, 2 chunk types: labels B-0=0 I-0=1 B-1=2 I-1=3 O=4
+    labels = np.array([0, 1, 4, 2, 3, 3, 0])
+    segs = _chunk_segments(labels, "IOB", 2)
+    assert segs == [(0, 1, 0), (3, 5, 1), (6, 6, 0)]
+
+
+def test_chunk_segments_iobes():
+    # IOBES, 1 type: B=0 I=1 E=2 S=3 O=4
+    labels = np.array([0, 1, 2, 4, 3])
+    segs = _chunk_segments(labels, "IOBES", 1)
+    assert segs == [(0, 2, 0), (4, 4, 0)]
+
+
+def test_chunk_f1():
+    # one sequence: predicted has 2 segments, gold has 2, 1 correct
+    out = Argument(ids=np.array([[0, 1, 4, 0, 4]]), lengths=np.array([5]))
+    lbl = Argument(ids=np.array([[0, 1, 4, 4, 0]]), lengths=np.array([5]))
+    res = run_host("chunk", [out, lbl], chunk_scheme="IOB", num_chunk_types=2)
+    assert res["correct_chunks"] == 1
+    assert res["result_chunks"] == 2 and res["true_chunks"] == 2
+    assert res["chunk_f1"] == pytest.approx(0.5)
+
+
+# -- ctc edit distance ------------------------------------------------------
+
+def test_ctc_collapse():
+    assert _ctc_collapse([1, 1, 9, 1, 2, 9, 9, 3], blank=9) == [1, 1, 2, 3]
+
+
+def test_edit_distance():
+    assert _edit_distance([1, 2, 3], [1, 2, 3]) == 0
+    assert _edit_distance([1, 2, 3], [1, 3]) == 1
+    assert _edit_distance([], [1, 2]) == 2
+    assert _edit_distance([1, 2], [2, 1]) == 2
+
+
+def test_ctc_error_evaluator():
+    # 3 classes + blank (=3); T=4; argmax path [0,3,1,1] -> collapse [0,1]
+    acts = np.zeros((1, 4, 4), np.float32)
+    acts[0, 0, 0] = 1; acts[0, 1, 3] = 1; acts[0, 2, 1] = 1; acts[0, 3, 1] = 1
+    out = Argument(value=acts, lengths=np.array([4]))
+    lbl = Argument(ids=np.array([[0, 1]]), lengths=np.array([2]))
+    res = run_host("ctc_edit_distance", [out, lbl])
+    assert res["ctc_edit_distance"] == 0.0
+    assert res["sequence_error_rate"] == 0.0
+
+
+# -- pnpair -----------------------------------------------------------------
+
+def test_pnpair():
+    # query 0: scores (.9,l=1) (.1,l=0) -> concordant; query 1: (.2,l=1) (.8,l=0) -> discordant
+    out = Argument(value=np.array([[.9], [.1], [.2], [.8]], np.float32))
+    lbl = Argument(ids=np.array([1, 0, 1, 0]))
+    info = Argument(ids=np.array([0, 0, 1, 1]))
+    res = run_host("pnpair", [out, lbl, info])
+    assert res["pos_pairs"] == pytest.approx(1.0)
+    assert res["neg_pairs"] == pytest.approx(1.0)
+
+
+# -- rankauc ----------------------------------------------------------------
+
+def test_rank_auc_perfect():
+    scores = np.array([.9, .5, .1])
+    clicks = np.array([1.0, 0.0, 0.0])
+    pvs = np.ones(3)
+    assert _rank_auc_one(scores, clicks, pvs) == pytest.approx(1.0)
+
+
+def test_rank_auc_random():
+    # reversed ranking -> AUC 0
+    scores = np.array([.1, .5, .9])
+    clicks = np.array([1.0, 0.0, 0.0])
+    assert _rank_auc_one(scores, clicks, np.ones(3)) == pytest.approx(0.0)
+
+
+def test_rankauc_evaluator_sequences():
+    out = Argument(value=np.array([[[.9], [.1], [.5]]], np.float32),
+                   lengths=np.array([3]))
+    click = Argument(value=np.array([[[1.], [0.], [0.]]], np.float32),
+                     lengths=np.array([3]))
+    res = run_host("rankauc", [out, click])
+    assert res["rankauc"] == pytest.approx(1.0)
+
+
+# -- seq classification error ----------------------------------------------
+
+def test_seq_classification_error():
+    # seq 0 fully right, seq 1 has one wrong frame
+    pred = np.zeros((2, 3, 2), np.float32)
+    pred[0, :, 1] = 1         # predicts 1,1,1
+    pred[1, :, 0] = 1         # predicts 0,0,0
+    out = Argument(value=pred, lengths=np.array([3, 3]))
+    lbl = Argument(ids=np.array([[1, 1, 1], [0, 1, 0]]), lengths=np.array([3, 3]))
+    res = run_host("seq_classification_error", [out, lbl])
+    assert res["seq_classification_error"] == pytest.approx(0.5)
+
+
+# -- integration through the trainer ---------------------------------------
+
+def test_host_evaluator_in_trainer():
+    """chunk evaluator wired through a real jitted training step."""
+    import numpy as np
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.trainer.trainer import Trainer
+
+    def conf():
+        from paddle_tpu.dsl import (
+            AdamOptimizer, chunk_evaluator, classification_cost, data_layer,
+            fc_layer, settings, SoftmaxActivation,
+        )
+        settings(batch_size=4, learning_rate=0.01,
+                 learning_method=AdamOptimizer())
+        x = data_layer(name="x", size=8)
+        out = fc_layer(input=x, size=5, act=SoftmaxActivation())
+        lbl = data_layer(name="label", size=5)
+        classification_cost(input=out, label=lbl)
+        # chunk over maxid of out vs label (as plain scalar "sequences")
+        from paddle_tpu.dsl import maxid_layer
+        mid = maxid_layer(input=out)
+        chunk_evaluator(input=mid, label=lbl, chunk_scheme="IOB",
+                        num_chunk_types=2)
+
+    cfg = parse_config_callable(conf)
+    tr = Trainer(cfg, seed=0)
+    assert tr.evaluators.host_configs, "chunk should register as host evaluator"
+    rng = np.random.default_rng(0)
+    batch = {"x": Argument(value=rng.random((4, 8), np.float32)),
+             "label": Argument(ids=rng.integers(0, 5, 4).astype(np.int32))}
+    loss = tr.train_one_batch(batch)
+    assert np.isfinite(loss)
+    stats = tr.evaluators.finalize_host(tr._host_acc)
+    assert any("chunk" in k or "true_chunks" in k for k in stats)
