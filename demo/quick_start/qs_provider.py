@@ -1,0 +1,41 @@
+"""quick_start data providers (ref: demo/quick_start/dataprovider_bow.py and
+dataprovider_emb.py — Amazon review sentiment).
+
+Two provider objects over the same synthetic two-class text task:
+`process_bow` yields sparse bag-of-words vectors, `process` yields word-id
+sequences.
+"""
+
+import numpy as np
+
+from paddle_tpu.data.provider import (
+    integer_value, integer_value_sequence, provider, sparse_binary_vector,
+)
+
+VOCAB = 1024
+
+
+def _synthetic(n, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        label = int(rng.integers(0, 2))
+        L = int(rng.integers(4, 30))
+        lo = 0 if label == 0 else VOCAB // 3
+        hi = 2 * VOCAB // 3 if label == 0 else VOCAB
+        words = rng.integers(lo, hi, L).tolist()
+        yield words, label
+
+
+@provider(input_types={"word": sparse_binary_vector(VOCAB),
+                       "label": integer_value(2)})
+def process_bow(settings, filename):
+    seed = 0 if "train" in filename else 1
+    for words, label in _synthetic(2048 if "train" in filename else 256, seed):
+        yield sorted(set(words)), label
+
+
+@provider(input_types={"word": integer_value_sequence(VOCAB),
+                       "label": integer_value(2)})
+def process(settings, filename):
+    seed = 0 if "train" in filename else 1
+    yield from _synthetic(2048 if "train" in filename else 256, seed)

@@ -1,0 +1,95 @@
+"""Deep bidirectional LSTM for semantic role labeling (ref:
+demo/semantic_role_labeling/db_lstm.py — 6 feature embeddings with a shared
+word table, mixed fusion, depth-8 alternating-direction LSTM stack, CRF
+output)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from srl_provider import LABEL_DIM, MARK_DIM, WORD_DIM  # noqa: E402
+
+is_predict = get_config_arg("is_predict", bool, False)
+depth = get_config_arg("depth", int, 8)
+hidden_dim = get_config_arg("hidden_dim", int, 128)
+
+word_dim = 32
+mark_dim = 5
+emb_lr = 1e-2
+fc_lr = 1e-2
+lstm_lr = 2e-2
+
+define_py_data_sources2(
+    train_list="demo/semantic_role_labeling/train.list",
+    test_list="demo/semantic_role_labeling/test.list",
+    module="demo.semantic_role_labeling.srl_provider",
+    obj="process")
+
+settings(
+    batch_size=get_config_arg("batch_size", int, 150),
+    learning_method=AdamOptimizer(),
+    learning_rate=1e-3,
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25)
+
+word = data_layer(name="word_data", size=WORD_DIM)
+predicate = data_layer(name="verb_data", size=WORD_DIM)
+ctx_n1 = data_layer(name="ctx_n1_data", size=WORD_DIM)
+ctx_0 = data_layer(name="ctx_0_data", size=WORD_DIM)
+ctx_p1 = data_layer(name="ctx_p1_data", size=WORD_DIM)
+mark = data_layer(name="mark_data", size=MARK_DIM)
+target = data_layer(name="target", size=LABEL_DIM)
+
+# shared word-embedding table across the 5 word-feature inputs
+ptt = ParameterAttribute(name="src_emb", learning_rate=emb_lr)
+layer_attr = ExtraLayerAttribute(drop_rate=0.5)
+fc_para_attr = ParameterAttribute(learning_rate=fc_lr)
+lstm_para_attr = ParameterAttribute(initial_std=0., learning_rate=lstm_lr)
+para_attr = [fc_para_attr, lstm_para_attr]
+
+word_embedding = embedding_layer(size=word_dim, input=word, param_attr=ptt)
+predicate_embedding = embedding_layer(size=word_dim, input=predicate, param_attr=ptt)
+ctx_n1_embedding = embedding_layer(size=word_dim, input=ctx_n1, param_attr=ptt)
+ctx_0_embedding = embedding_layer(size=word_dim, input=ctx_0, param_attr=ptt)
+ctx_p1_embedding = embedding_layer(size=word_dim, input=ctx_p1, param_attr=ptt)
+mark_embedding = embedding_layer(size=mark_dim, input=mark)
+
+hidden_0 = mixed_layer(
+    size=hidden_dim,
+    input=[
+        full_matrix_projection(word_embedding, size=hidden_dim),
+        full_matrix_projection(predicate_embedding, size=hidden_dim),
+        full_matrix_projection(ctx_n1_embedding, size=hidden_dim),
+        full_matrix_projection(ctx_0_embedding, size=hidden_dim),
+        full_matrix_projection(ctx_p1_embedding, size=hidden_dim),
+        full_matrix_projection(mark_embedding, size=hidden_dim),
+    ])
+
+lstm_0 = lstmemory(input=hidden_0, layer_attr=layer_attr)
+
+# stack L-LSTM and R-LSTM with direct edges (ref: db_lstm.py depth loop)
+input_tmp = [hidden_0, lstm_0]
+for i in range(1, depth):
+    fc = fc_layer(input=input_tmp, size=hidden_dim, act=LinearActivation(),
+                  param_attr=para_attr)
+    lstm = lstmemory(input=fc, act=ReluActivation(), reverse=(i % 2) == 1,
+                     layer_attr=layer_attr)
+    input_tmp = [fc, lstm]
+
+feature_out = fc_layer(input=input_tmp, size=LABEL_DIM, act=LinearActivation(),
+                       param_attr=para_attr)
+
+if not is_predict:
+    crf = crf_layer(input=feature_out, label=target,
+                    param_attr=ParameterAttribute(name="crfw"))
+    crf_dec = crf_decoding_layer(size=LABEL_DIM, input=feature_out, label=target,
+                                 param_attr=ParameterAttribute(name="crfw"))
+    chunk_evaluator(name="role_f1", input=crf_dec, label=target,
+                    chunk_scheme="IOB", num_chunk_types=(LABEL_DIM - 1) // 2)
+    outputs(crf)
+else:
+    crf_dec = crf_decoding_layer(size=LABEL_DIM, input=feature_out,
+                                 param_attr=ParameterAttribute(name="crfw"))
+    outputs(crf_dec)

@@ -1,0 +1,49 @@
+"""SRL data provider (ref: demo/semantic_role_labeling/dataprovider.py —
+CoNLL-05 style: word / predicate / context words / predicate-mark token
+sequences plus a target role-label sequence).
+
+Synthetic fallback: role labels are a deterministic function of word, mark
+and distance-to-predicate, so the net can learn them; same 7 slots as the
+reference.
+"""
+
+import os
+
+import numpy as np
+
+from paddle_tpu.data.provider import integer_value_sequence, provider
+
+WORD_DIM = 1000
+LABEL_DIM = 19        # IOB over 9 role types + O
+MARK_DIM = 2
+
+
+def _synthetic(n, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        L = int(rng.integers(5, 30))
+        words = rng.integers(0, WORD_DIM, L).tolist()
+        pred_pos = int(rng.integers(0, L))
+        predicate = [words[pred_pos]] * L
+        ctx_n1 = [words[max(0, i - 1)] for i in range(L)]
+        ctx_0 = list(words)
+        ctx_p1 = [words[min(L - 1, i + 1)] for i in range(L)]
+        mark = [1 if i == pred_pos else 0 for i in range(L)]
+        labels = [((w + abs(i - pred_pos)) % (LABEL_DIM - 1)) if abs(i - pred_pos) < 3
+                  else LABEL_DIM - 1
+                  for i, w in enumerate(words)]
+        yield words, predicate, ctx_n1, ctx_0, ctx_p1, mark, labels
+
+
+@provider(input_types={
+    "word_data": integer_value_sequence(WORD_DIM),
+    "verb_data": integer_value_sequence(WORD_DIM),
+    "ctx_n1_data": integer_value_sequence(WORD_DIM),
+    "ctx_0_data": integer_value_sequence(WORD_DIM),
+    "ctx_p1_data": integer_value_sequence(WORD_DIM),
+    "mark_data": integer_value_sequence(MARK_DIM),
+    "target": integer_value_sequence(LABEL_DIM),
+})
+def process(settings, filename):
+    seed = 0 if "train" in filename else 1
+    yield from _synthetic(1024 if "train" in filename else 128, seed)

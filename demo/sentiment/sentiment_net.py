@@ -1,0 +1,63 @@
+"""Sentiment network definitions (ref: demo/sentiment/sentiment_net.py —
+bidirectional_lstm_net and stacked_lstm_net on IMDB)."""
+
+from paddle_tpu.dsl import *
+
+
+def bidirectional_lstm_net(input_dim, class_dim=2, emb_dim=128, lstm_dim=128,
+                           is_predict=False):
+    """(ref: sentiment_net.py bidirectional_lstm_net:60)."""
+    data = data_layer("word", input_dim)
+    emb = embedding_layer(input=data, size=emb_dim)
+    bi_lstm = bidirectional_lstm(input=emb, size=lstm_dim)
+    dropout = dropout_layer(input=bi_lstm, dropout_rate=0.5)
+    output = fc_layer(input=dropout, size=class_dim, act=SoftmaxActivation())
+    if not is_predict:
+        lbl = data_layer("label", class_dim)
+        outputs(classification_cost(input=output, label=lbl))
+    else:
+        outputs(output)
+    return output
+
+
+def stacked_lstm_net(input_dim, class_dim=2, emb_dim=128, hid_dim=512,
+                     stacked_num=3, is_predict=False):
+    """Stacked bidirectional LSTM per Zhou et al. 2015
+    (ref: sentiment_net.py stacked_lstm_net:77 — alternating-direction
+    lstmemory stack with parallel fc path, max-pooled)."""
+    assert stacked_num % 2 == 1
+    hid_lr = 1e-3
+    layer_attr = ExtraLayerAttribute(drop_rate=0.5)
+    fc_para_attr = ParameterAttribute(learning_rate=hid_lr)
+    lstm_para_attr = ParameterAttribute(initial_std=0., learning_rate=1.)
+    para_attr = [fc_para_attr, lstm_para_attr]
+    bias_attr = ParameterAttribute(initial_std=0., l2_rate=0.)
+    relu = ReluActivation()
+    linear = LinearActivation()
+
+    data = data_layer("word", input_dim)
+    emb = embedding_layer(input=data, size=emb_dim)
+
+    fc1 = fc_layer(input=emb, size=hid_dim, act=linear, bias_attr=bias_attr)
+    lstm1 = lstmemory(input=fc1, act=relu, bias_attr=bias_attr,
+                      layer_attr=layer_attr)
+
+    inputs_ = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fc_layer(input=inputs_, size=hid_dim, act=linear,
+                      param_attr=para_attr, bias_attr=bias_attr)
+        lstm = lstmemory(input=fc, reverse=(i % 2) == 0, act=relu,
+                         bias_attr=bias_attr, layer_attr=layer_attr)
+        inputs_ = [fc, lstm]
+
+    fc_last = pooling_layer(input=inputs_[0], pooling_type=MaxPooling())
+    lstm_last = pooling_layer(input=inputs_[1], pooling_type=MaxPooling())
+    output = fc_layer(input=[fc_last, lstm_last], size=class_dim,
+                      act=SoftmaxActivation(), bias_attr=bias_attr,
+                      param_attr=para_attr)
+    if not is_predict:
+        lbl = data_layer("label", class_dim)
+        outputs(classification_cost(input=output, label=lbl))
+    else:
+        outputs(output)
+    return output

@@ -1,0 +1,55 @@
+"""Sentiment data provider (ref: demo/sentiment/dataprovider.py).
+
+Reads `<label>\t<space-separated words>` text shards if present under data/
+(the reference's IMDB preprocess layout); with no dataset on disk, falls back
+to a synthetic two-class task: each class draws its words from a distinct
+half of the vocabulary with some overlap — learnable by an LSTM pooled over
+time, hermetic for tests/benchmarks.
+"""
+
+import os
+
+import numpy as np
+
+from paddle_tpu.data.provider import integer_value, integer_value_sequence, provider
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+VOCAB = 2000
+
+
+def _synthetic(n, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        label = int(rng.integers(0, 2))
+        L = int(rng.integers(5, 40))
+        # class 0 words ~ [0, .6*V), class 1 words ~ [.4*V, V)
+        lo = 0 if label == 0 else int(0.4 * VOCAB)
+        hi = int(0.6 * VOCAB) if label == 0 else VOCAB
+        words = rng.integers(lo, hi, L).tolist()
+        yield words, label
+
+
+def _file_samples(filename, dictionary):
+    with open(filename) as f:
+        for line in f:
+            lab, _, text = line.partition("\t")
+            words = [dictionary.get(w, 0) for w in text.split()]
+            if words:
+                yield words, int(lab)
+
+
+@provider(input_types={"word": integer_value_sequence(VOCAB),
+                       "label": integer_value(2)})
+def process(settings, filename):
+    path = os.path.join(DATA_DIR, os.path.basename(filename))
+    if os.path.exists(path):
+        dictionary = getattr(settings, "dictionary", None)
+        if not dictionary:
+            raise ValueError(
+                "real data shards found under data/ but no 'dictionary' arg "
+                "was passed to the provider (load_data_args)")
+        yield from _file_samples(path, dictionary)
+    else:
+        seed = 0 if "train" in filename else 1
+        yield from _synthetic(2048 if "train" in filename else 256, seed)

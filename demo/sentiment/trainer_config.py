@@ -1,0 +1,35 @@
+"""IMDB sentiment config (ref: demo/sentiment/trainer_config.py —
+settings + stacked_lstm_net)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from sentiment_net import stacked_lstm_net, bidirectional_lstm_net  # noqa: E402
+from sentiment_provider import VOCAB  # noqa: E402
+
+is_predict = get_config_arg("is_predict", bool, False)
+net_type = get_config_arg("net", str, "stacked")
+batch_size = get_config_arg("batch_size", int, 128)
+hid_dim = get_config_arg("hid_dim", int, 512)
+
+define_py_data_sources2(
+    train_list="demo/sentiment/train.list",
+    test_list="demo/sentiment/test.list",
+    module="demo.sentiment.sentiment_provider",
+    obj="process")
+
+settings(
+    batch_size=batch_size,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25)
+
+if net_type == "stacked":
+    stacked_lstm_net(VOCAB, class_dim=2, stacked_num=3, hid_dim=hid_dim,
+                     is_predict=is_predict)
+else:
+    bidirectional_lstm_net(VOCAB, class_dim=2, is_predict=is_predict)

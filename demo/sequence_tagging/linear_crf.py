@@ -1,0 +1,58 @@
+"""Linear-chain CRF over sparse features (ref:
+demo/sequence_tagging/linear_crf.py — single sparse fc into a CRF)."""
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from tagging_provider import FEAT_DIM, NUM_CHUNK_TYPES, NUM_LABELS, POS_DIM, WORD_DIM  # noqa: E402
+
+batch_size = get_config_arg("batch_size", int, 16)
+
+define_py_data_sources2(
+    train_list="demo/sequence_tagging/train.list",
+    test_list="demo/sequence_tagging/test.list",
+    module="demo.sequence_tagging.tagging_provider",
+    obj="process")
+
+settings(
+    learning_method=MomentumOptimizer(),
+    batch_size=batch_size,
+    regularization=L2Regularization(batch_size * 1e-4),
+    average_window=0.5,
+    learning_rate=1e-1,
+    learning_rate_decay_a=1e-5,
+    learning_rate_decay_b=0.25)
+
+
+def get_simd_size(size):
+    # (ref: linear_crf.py — label count padded for sparse_update alignment)
+    return int(math.ceil(float(size) / 8)) * 8
+
+
+num_label_types = get_simd_size(NUM_LABELS)
+
+features = data_layer(name="features", size=FEAT_DIM)
+word = data_layer(name="word", size=WORD_DIM)
+pos = data_layer(name="pos", size=POS_DIM)
+chunk = data_layer(name="chunk", size=num_label_types)
+
+crf_input = fc_layer(
+    input=features, size=num_label_types, act=LinearActivation(),
+    bias_attr=False, param_attr=ParamAttr(initial_std=0, sparse_update=True))
+
+crf = crf_layer(input=crf_input, label=chunk,
+                param_attr=ParamAttr(name="crfw", initial_std=0))
+
+crf_dec = crf_decoding_layer(size=num_label_types, input=crf_input, label=chunk,
+                             param_attr=ParamAttr(name="crfw"))
+
+sum_evaluator(name="error", input=crf_dec)
+chunk_evaluator(name="chunk_f1", input=crf_dec, label=chunk,
+                chunk_scheme="IOB", num_chunk_types=NUM_CHUNK_TYPES)
+
+inputs(word, pos, chunk, features)
+outputs(crf)

@@ -1,0 +1,91 @@
+"""RNN-CRF sequence tagging config (ref: demo/sequence_tagging/rnn_crf.py —
+embedding + mixed + bidirectional recurrent layers into a CRF, with
+crf_decoding + chunk F1 evaluation)."""
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from tagging_provider import (  # noqa: E402
+    FEAT_DIM, NUM_CHUNK_TYPES, NUM_LABELS, POS_DIM, WORD_DIM,
+)
+
+batch_size = get_config_arg("batch_size", int, 16)
+
+define_py_data_sources2(
+    train_list="demo/sequence_tagging/train.list",
+    test_list="demo/sequence_tagging/test.list",
+    module="demo.sequence_tagging.tagging_provider",
+    obj="process")
+
+settings(
+    learning_method=MomentumOptimizer(),
+    batch_size=batch_size,
+    regularization=L2Regularization(batch_size * 1e-5),
+    average_window=0.5,
+    learning_rate=2e-3,
+    learning_rate_decay_a=5e-7,
+    learning_rate_decay_b=0.5)
+
+word_dim = 128
+hidden_dim = 128
+with_rnn = True
+
+initial_std = 1 / math.sqrt(hidden_dim)
+param_attr = ParamAttr(initial_std=initial_std)
+
+features = data_layer(name="features", size=FEAT_DIM)
+word = data_layer(name="word", size=WORD_DIM)
+pos = data_layer(name="pos", size=POS_DIM)
+chunk = data_layer(name="chunk", size=NUM_LABELS)
+
+emb = embedding_layer(input=word, size=word_dim,
+                      param_attr=ParamAttr(initial_std=0))
+
+hidden1 = mixed_layer(
+    size=hidden_dim,
+    act=STanhActivation(),
+    bias_attr=True,
+    input=[full_matrix_projection(emb, size=hidden_dim),
+           table_projection(pos, size=hidden_dim, param_attr=param_attr)])
+
+if with_rnn:
+    rnn1 = recurrent_layer(act=ReluActivation(), bias_attr=True, input=hidden1,
+                           param_attr=ParamAttr(initial_std=0))
+
+hidden2 = mixed_layer(
+    size=hidden_dim,
+    act=STanhActivation(),
+    bias_attr=True,
+    input=[full_matrix_projection(hidden1, size=hidden_dim)] +
+    ([full_matrix_projection(rnn1, size=hidden_dim,
+                             param_attr=ParamAttr(initial_std=0))]
+     if with_rnn else []))
+
+if with_rnn:
+    rnn2 = recurrent_layer(reverse=True, act=ReluActivation(), bias_attr=True,
+                           input=hidden2, param_attr=ParamAttr(initial_std=0))
+
+crf_input = mixed_layer(
+    size=NUM_LABELS,
+    bias_attr=False,
+    input=[full_matrix_projection(hidden2, size=NUM_LABELS)] +
+    ([full_matrix_projection(rnn2, size=NUM_LABELS,
+                             param_attr=ParamAttr(initial_std=0))]
+     if with_rnn else []))
+
+crf = crf_layer(input=crf_input, label=chunk,
+                param_attr=ParamAttr(name="crfw", initial_std=0))
+
+crf_dec = crf_decoding_layer(size=NUM_LABELS, input=crf_input, label=chunk,
+                             param_attr=ParamAttr(name="crfw"))
+
+sum_evaluator(name="error", input=crf_dec)
+chunk_evaluator(name="chunk_f1", input=crf_dec, label=chunk,
+                chunk_scheme="IOB", num_chunk_types=NUM_CHUNK_TYPES)
+
+inputs(word, pos, chunk, features)
+outputs(crf)

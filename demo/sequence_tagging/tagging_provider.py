@@ -1,0 +1,57 @@
+"""Sequence tagging data provider (ref: demo/sequence_tagging/dataprovider.py
+— CoNLL-2000 text chunking: per-token features/word/pos and an IOB chunk
+label).
+
+Generates a synthetic chunking task with the reference's slot layout: a
+hidden segment process emits IOB labels (11 chunk types, 23 label values)
+and token features correlated with the labels — hermetic and learnable.
+"""
+
+import numpy as np
+
+from paddle_tpu.data.provider import (
+    integer_value_sequence, provider, sparse_binary_vector_sequence,
+)
+
+NUM_CHUNK_TYPES = 11
+NUM_LABELS = NUM_CHUNK_TYPES * 2 + 1      # IOB: B-x, I-x per type + O = 23
+WORD_DIM = 2000
+POS_DIM = 44
+FEAT_DIM = 1024
+
+
+def _synthetic(n, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        L = int(rng.integers(4, 24))
+        labels, words, poss, feats = [], [], [], []
+        i = 0
+        while i < L:
+            if rng.random() < 0.5:        # O run
+                run = int(rng.integers(1, 4))
+                for _ in range(min(run, L - i)):
+                    labels.append(NUM_LABELS - 1)
+                    i += 1
+            else:                          # chunk of some type
+                t = int(rng.integers(0, NUM_CHUNK_TYPES))
+                run = int(rng.integers(1, 4))
+                for k in range(min(run, L - i)):
+                    labels.append(t * 2 + (0 if k == 0 else 1))
+                    i += 1
+        for lab in labels:
+            # word/pos/features correlated with the label
+            words.append(int(rng.integers(0, 80)) + (lab * 80) % WORD_DIM)
+            poss.append(lab % POS_DIM)
+            feats.append([(lab * 37 + j) % FEAT_DIM for j in range(4)])
+        yield feats, words, poss, labels
+
+
+@provider(input_types={
+    "features": sparse_binary_vector_sequence(FEAT_DIM),
+    "word": integer_value_sequence(WORD_DIM),
+    "pos": integer_value_sequence(POS_DIM),
+    "chunk": integer_value_sequence(NUM_LABELS),
+})
+def process(settings, filename):
+    seed = 0 if "train" in filename else 1
+    yield from _synthetic(1024 if "train" in filename else 128, seed)

@@ -7,7 +7,9 @@ activation/pooling/attr descriptor classes, and composite networks.
 """
 
 from paddle_tpu.dsl.activations import *  # noqa: F401,F403
-from paddle_tpu.dsl.attrs import ParameterAttribute, ExtraLayerAttribute  # noqa: F401
+from paddle_tpu.dsl.attrs import (  # noqa: F401
+    ExtraAttr, ExtraLayerAttribute, ParamAttr, ParameterAttribute,
+)
 from paddle_tpu.dsl.poolings import *  # noqa: F401,F403
 from paddle_tpu.dsl.layers import *  # noqa: F401,F403
 from paddle_tpu.dsl.optimizers import *  # noqa: F401,F403

@@ -37,8 +37,8 @@ __all__ = [
     "data_layer", "fc_layer", "embedding_layer", "mixed_layer", "addto_layer",
     "concat_layer", "dropout_layer", "full_matrix_projection",
     "trans_full_matrix_projection", "identity_projection", "table_projection",
-    "dotmul_projection", "context_projection", "conv_projection",
-    "dotmul_operator", "conv_operator",
+    "dotmul_projection", "scaling_projection", "context_projection",
+    "conv_projection", "dotmul_operator", "conv_operator", "default_device",
     "pooling_layer", "last_seq", "first_seq", "expand_layer", "seq_concat_layer",
     "seq_reshape_layer", "repeat_layer",
     "lstmemory", "grumemory", "recurrent_layer", "lstm_step_layer", "gru_step_layer",
@@ -324,6 +324,20 @@ def dotmul_projection(input: LayerOutput,
     """(ref: DotMulProjection.cpp): out = x .* w."""
     proj = ProjectionConfig(type="dot_mul", input_size=input.size, output_size=input.size)
     return _Projection(input, proj, [1, input.size], param_attr, input.size)
+
+
+def scaling_projection(input: LayerOutput,
+                       param_attr: Optional[ParameterAttribute] = None) -> _Projection:
+    """(ref: ScalingProjection.cpp): out = w[0] * x, one learned scalar."""
+    proj = ProjectionConfig(type="scaling", input_size=input.size, output_size=input.size)
+    return _Projection(input, proj, [1, 1], param_attr, input.size)
+
+
+def default_device(device: int = 0) -> None:
+    """No-op: the reference pins layers to GPUs (ref: config_parser.py
+    default_device); here placement is mesh sharding, set via
+    ParameterAttribute.partition_spec / Trainer(mesh=...)."""
+    return None
 
 
 def context_projection(

@@ -15,16 +15,17 @@ from paddle_tpu.dsl.activations import (
 from paddle_tpu.dsl.attrs import ExtraLayerAttribute, ParameterAttribute
 from paddle_tpu.dsl.base import LayerOutput, current_context
 from paddle_tpu.dsl.layers import (
-    StaticInput, batch_norm_layer, concat_layer, dropout_layer, expand_layer,
-    fc_layer, full_matrix_projection, grumemory, img_cmrnorm_layer,
-    img_conv_layer, img_pool_layer, last_seq, lstmemory, memory, mixed_layer,
-    pooling_layer, recurrent_group, tensor_layer,
+    StaticInput, batch_norm_layer, concat_layer, context_projection,
+    dropout_layer, expand_layer, fc_layer, first_seq, full_matrix_projection,
+    grumemory, img_cmrnorm_layer, img_conv_layer, img_pool_layer, last_seq,
+    lstmemory, memory, mixed_layer, pooling_layer, recurrent_group,
+    tensor_layer,
 )
 from paddle_tpu.dsl.poolings import MaxPooling
 
 __all__ = [
     "simple_img_conv_pool", "img_conv_group", "small_vgg", "vgg_16_network",
-    "simple_lstm", "lstmemory_group", "simple_gru", "gru_group",
+    "simple_lstm", "sequence_conv_pool", "lstmemory_group", "simple_gru", "gru_group",
     "bidirectional_lstm", "simple_attention", "inputs", "outputs",
 ]
 
@@ -125,6 +126,26 @@ def vgg_16_network(input_image: LayerOutput, num_channels: int,
     return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
 
 
+def sequence_conv_pool(input: LayerOutput, context_len: int, hidden_size: int,
+                       name: Optional[str] = None,
+                       context_start: Optional[int] = None, pool_type=None,
+                       context_proj_param_attr=None, fc_param_attr=None,
+                       fc_bias_attr=None, fc_act=None,
+                       pool_bias_attr=None) -> LayerOutput:
+    """Text conv pooling: context projection -> fc -> pooling
+    (ref: networks.py sequence_conv_pool:41)."""
+    with mixed_layer(name=f"{name}_conv_proj" if name else None,
+                     size=input.size * context_len,
+                     act=LinearActivation(), bias_attr=False) as m:
+        m += context_projection(input, context_len=context_len,
+                                context_start=context_start,
+                                padding_attr=context_proj_param_attr or False)
+    fc = fc_layer(input=m, size=hidden_size, act=fc_act,
+                  param_attr=fc_param_attr, bias_attr=fc_bias_attr)
+    return pooling_layer(input=fc, pooling_type=pool_type or MaxPooling(),
+                         name=name, bias_attr=pool_bias_attr or False)
+
+
 def simple_lstm(input: LayerOutput, size: int, name: Optional[str] = None,
                 reverse: bool = False, mat_param_attr=None, bias_param_attr=None,
                 inner_param_attr=None, act=None, gate_act=None, state_act=None,
@@ -214,7 +235,9 @@ def bidirectional_lstm(input: LayerOutput, size: int, name: Optional[str] = None
     if return_seq:
         return concat_layer(input=[fwd, bwd], name=name)
     fwd_end = last_seq(input=fwd, name=f"{name}_fwd_end")
-    bwd_end = last_seq(input=bwd, name=f"{name}_bwd_end")
+    # reverse-scan outputs are position-aligned, so the backward summary
+    # (full-sequence state) sits at position 0 (ref: networks.py:1156 first_seq)
+    bwd_end = first_seq(input=bwd, name=f"{name}_bwd_end")
     return concat_layer(input=[fwd_end, bwd_end], name=name)
 
 

@@ -151,7 +151,7 @@ def settings(
     regularization=None,
     learning_rate_decay_a: float = 0.0,
     learning_rate_decay_b: float = 0.0,
-    learning_rate_schedule: str = "constant",
+    learning_rate_schedule: str = "poly",
     learning_rate_args: str = "",
     model_average=None,
     gradient_clipping_threshold=None,

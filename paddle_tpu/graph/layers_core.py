@@ -70,6 +70,9 @@ def _apply_projection(
     if t == "dot_mul":
         # elementwise scale by a learned vector (ref: DotMulProjection.cpp)
         return arg.value * w
+    if t == "scaling":
+        # one learned scalar (ref: ScalingProjection.cpp)
+        return arg.value * w.reshape(())
     if t == "table":
         # embedding lookup (ref: TableProjection.cpp, hl_matrix_select_rows)
         return w[arg.ids]

@@ -1,0 +1,62 @@
+"""Demo config train-smoke tests (mirrors ref: trainer/tests
+test_TrainerOnePass — full train-one-pass on bundled mini-data; here a few
+batches per config with loss-finite + loss-decrease checks)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.trainer.trainer import Trainer
+
+ALL_CONFIGS = [
+    "demo/sentiment/trainer_config.py",
+    "demo/sequence_tagging/rnn_crf.py",
+    "demo/sequence_tagging/linear_crf.py",
+    "demo/semantic_role_labeling/db_lstm.py",
+    "demo/quick_start/trainer_config.lr.py",
+    "demo/quick_start/trainer_config.cnn.py",
+    "demo/quick_start/trainer_config.lstm.py",
+]
+
+
+@pytest.mark.parametrize("path", ALL_CONFIGS)
+def test_demo_config_parses(path):
+    cfg = parse_config(path)
+    assert cfg.model_config.layers
+    assert cfg.model_config.parameters
+
+
+def _train_few(path, n_batches=6, config_args=""):
+    cfg = parse_config(path, config_args)
+    tr = Trainer(cfg, seed=0)
+    losses = []
+    it = tr.train_batches()
+    for _ in range(n_batches):
+        losses.append(tr.train_one_batch(next(it)))
+    assert all(np.isfinite(l) for l in losses), losses
+    return losses
+
+
+def test_quick_start_lr_trains():
+    losses = _train_few("demo/quick_start/trainer_config.lr.py",
+                        n_batches=10, config_args="batch_size=32")
+    assert losses[-1] < losses[0]
+
+
+def test_sentiment_small_trains():
+    # shrink hid_dim for test speed; stacked 3-LSTM path still exercised
+    losses = _train_few("demo/sentiment/trainer_config.py", n_batches=4,
+                        config_args="batch_size=8,hid_dim=32")
+    assert np.isfinite(losses).all()
+
+
+def test_linear_crf_trains():
+    losses = _train_few("demo/sequence_tagging/linear_crf.py", n_batches=6,
+                        config_args="batch_size=8")
+    assert losses[-1] < losses[0]
+
+
+def test_srl_db_lstm_trains():
+    losses = _train_few("demo/semantic_role_labeling/db_lstm.py", n_batches=3,
+                        config_args="batch_size=8,depth=4,hidden_dim=32")
+    assert np.isfinite(losses).all()
