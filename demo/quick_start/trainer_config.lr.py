@@ -18,7 +18,7 @@ define_py_data_sources2(
     obj="process_bow")
 
 settings(
-    batch_size=128 if not is_predict else 1,
+    batch_size=get_config_arg("batch_size", int, 128) if not is_predict else 1,
     learning_rate=2e-3,
     learning_method=AdamOptimizer(),
     regularization=L2Regularization(8e-4),

@@ -1,0 +1,58 @@
+"""MovieLens-style data provider (ref: demo/recommendation/dataprovider.py —
+movie {id, title word sequence, genres multi-hot} + user {id, gender, age,
+occupation} slots and a scaled rating regression target).
+
+Synthetic fallback: ratings come from hidden low-rank user/movie factors, so
+the embedding-fusion model can actually fit them.
+"""
+
+import numpy as np
+
+from paddle_tpu.data.provider import (
+    dense_vector, integer_value, integer_value_sequence, provider,
+    sparse_binary_vector,
+)
+
+MOVIE_DIM = 512
+USER_DIM = 512
+TITLE_VOCAB = 256
+GENRE_DIM = 18
+GENDER_DIM = 2
+AGE_DIM = 7
+OCCUPATION_DIM = 21
+
+_K = 8
+_RNG = np.random.default_rng(7)
+_MOVIE_F = _RNG.normal(size=(MOVIE_DIM, _K)).astype(np.float32)
+_USER_F = _RNG.normal(size=(USER_DIM, _K)).astype(np.float32)
+
+
+def _synthetic(n, seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        m = int(rng.integers(0, MOVIE_DIM))
+        u = int(rng.integers(0, USER_DIM))
+        title = rng.integers(0, TITLE_VOCAB, int(rng.integers(2, 8))).tolist()
+        genres = sorted(set(rng.integers(0, GENRE_DIM, 3).tolist()))
+        gender = u % GENDER_DIM
+        age = u % AGE_DIM
+        occupation = u % OCCUPATION_DIM
+        # rating in [-1, 1] from the latent factors (scaled like the
+        # reference's (rating - 3) / 2 five-star normalization)
+        r = float(np.tanh(_MOVIE_F[m] @ _USER_F[u] / np.sqrt(_K)))
+        yield m, title, genres, u, gender, age, occupation, [r]
+
+
+@provider(input_types={
+    "movie_id": integer_value(MOVIE_DIM),
+    "title": integer_value_sequence(TITLE_VOCAB),
+    "genres": sparse_binary_vector(GENRE_DIM),
+    "user_id": integer_value(USER_DIM),
+    "gender": integer_value(GENDER_DIM),
+    "age": integer_value(AGE_DIM),
+    "occupation": integer_value(OCCUPATION_DIM),
+    "rating": dense_vector(1),
+})
+def process(settings, filename):
+    seed = 0 if "train" in filename else 1
+    yield from _synthetic(4096 if "train" in filename else 512, seed)

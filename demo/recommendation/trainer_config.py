@@ -1,0 +1,64 @@
+"""MovieLens regression config (ref: demo/recommendation/trainer_config.py —
+per-feature embedding/fc fusion for movie and user, cosine similarity,
+regression cost).  Embedding tables are marked sparse_update: under a mesh
+they shard vocab-wise like pserver sparse tables (parallel/sparse.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from ml_provider import (  # noqa: E402
+    AGE_DIM, GENDER_DIM, GENRE_DIM, MOVIE_DIM, OCCUPATION_DIM, TITLE_VOCAB,
+    USER_DIM,
+)
+
+is_predict = get_config_arg("is_predict", bool, False)
+emb_size = get_config_arg("emb_size", int, 256)
+
+define_py_data_sources2(
+    train_list="demo/recommendation/train.list",
+    test_list="demo/recommendation/test.list",
+    module="demo.recommendation.ml_provider",
+    obj="process")
+
+settings(
+    batch_size=get_config_arg("batch_size", int, 1600),
+    learning_rate=get_config_arg("learning_rate", float, 1e-3),
+    learning_method=RMSPropOptimizer())
+
+def id_feature(name, dim):
+    emb = embedding_layer(input=data_layer(name, size=dim), size=emb_size,
+                          param_attr=ParamAttr(sparse_update=True))
+    return fc_layer(input=emb, size=emb_size)
+
+
+# movie features (ref: construct_feature("movie"))
+movie_id_f = id_feature("movie_id", MOVIE_DIM)
+title_emb = embedding_layer(input=data_layer("title", size=TITLE_VOCAB),
+                            size=emb_size,
+                            param_attr=ParamAttr(sparse_update=True))
+title_f = sequence_conv_pool(input=title_emb, context_len=5,
+                             hidden_size=emb_size)
+genre_f = fc_layer(input=fc_layer(input=data_layer("genres", size=GENRE_DIM),
+                                  size=emb_size), size=emb_size)
+movie_feature = fc_layer(name="movie_fusion",
+                         input=[movie_id_f, title_f, genre_f], size=emb_size)
+
+# user features (ref: construct_feature("user"))
+user_id_f = id_feature("user_id", USER_DIM)
+gender_f = id_feature("gender", GENDER_DIM)
+age_f = id_feature("age", AGE_DIM)
+occupation_f = id_feature("occupation", OCCUPATION_DIM)
+user_feature = fc_layer(name="user_fusion",
+                        input=[user_id_f, gender_f, age_f, occupation_f],
+                        size=emb_size)
+
+similarity = cos_sim(a=movie_feature, b=user_feature)
+
+if not is_predict:
+    outputs(regression_cost(input=similarity,
+                            label=data_layer("rating", size=1)))
+else:
+    outputs(similarity)

@@ -35,8 +35,18 @@ def param_sharding(mesh: Mesh, partition_spec: Optional[list]) -> NamedSharding:
 
 
 def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state: Any):
-    """Place params (+ optimizer slots) on the mesh per their partition specs."""
+    """Place params (+ optimizer slots) on the mesh per their partition specs.
+    Parameters marked sparse_update (embedding tables) default to vocab-dim
+    sharding — the pserver-shard analog (see parallel/sparse.py)."""
+    from paddle_tpu.parallel.sparse import embedding_partition_spec
     specs = {p.name: p.partition_spec for p in model.parameters}
+    emb_spec = embedding_partition_spec(mesh)
+    if emb_spec is not None:
+        axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[emb_spec[0]]
+        for p in model.parameters:
+            if p.sparse_update and not p.partition_spec \
+                    and len(p.dims) == 2 and p.dims[0] % axis_size == 0:
+                specs[p.name] = emb_spec
     out_params = {
         name: jax.device_put(v, param_sharding(mesh, specs.get(name)))
         for name, v in params.items()

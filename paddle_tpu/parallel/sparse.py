@@ -1,0 +1,84 @@
+"""Sharded embedding tables — the TPU replacement for the reference's
+sparse-parameter machinery.
+
+The reference keeps big embedding tables on parameter servers and moves only
+the touched rows: `GradientMachine::prefetch` collects the row ids a batch
+needs, `SparsePrefetchRowCpuMatrix` pulls them (ref:
+paddle/math/SparseRowMatrix.h:211; trainer/TrainerInternal.cpp:93-97), and
+`SparseRemoteParameterUpdater` pushes row-sparse gradients back over dedicated
+pserver ports (ref: trainer/RemoteParameterUpdater.h:244-335,
+--ports_num_for_sparse).
+
+TPU re-design: the table lives sharded over a mesh axis — each device owns a
+contiguous `vocab/N` row block (the analog of a pserver shard).  Lookup is a
+local gather of owned rows with zeros elsewhere, followed by one `psum` over
+the owning axis (one ICI all-reduce replaces the prefetch RPC round-trip).
+Autodiff through the psum+where gives each device a gradient touching ONLY
+its own rows — the row-sparse update economics of the reference, with the
+optimizer applying shard-locally like `ParameterServer2::blockTraverse`.
+
+Two paths:
+  * implicit — mark the parameter `sparse_update=True`; `shard_train_objects`
+    (parallel/dp.py) shards its vocab dim and XLA GSPMD partitions the gather.
+  * explicit — `sharded_embedding_lookup` inside `shard_map`, for when the
+    GSPMD choice is poor (e.g. it all-gathers the table).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+
+def embedding_partition_spec(mesh: Mesh) -> Optional[list]:
+    """Vocab-dim spec for a sharded table: prefer the model axis, fall back
+    to data (FSDP-style) — mirrors the reference striping tables over ALL
+    pserver instances (ref: ParameterClient2 sendAndReceiveParameter)."""
+    from paddle_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axes.get(MODEL_AXIS, 1) > 1:
+        return [MODEL_AXIS, None]
+    if axes.get(DATA_AXIS, 1) > 1:
+        return [DATA_AXIS, None]
+    return None
+
+
+def local_shard_lookup(table_shard: Array, ids: Array, axis_name: str) -> Array:
+    """One device's contribution to an embedding lookup, inside shard_map.
+
+    table_shard: [V/N, D] — this device's contiguous row block.
+    ids: [...] global row ids (identical on every device of `axis_name`).
+    Returns [..., D] after a psum over `axis_name`.
+    """
+    shard_rows = table_shard.shape[0]
+    shard_idx = jax.lax.axis_index(axis_name)
+    base = shard_idx * shard_rows
+    local = ids - base
+    owned = (local >= 0) & (local < shard_rows)
+    rows = jnp.take(table_shard, jnp.clip(local, 0, shard_rows - 1), axis=0)
+    rows = jnp.where(owned[..., None], rows, 0.0)
+    return jax.lax.psum(rows, axis_name)
+
+
+def sharded_embedding_lookup(mesh: Mesh, table: Array, ids: Array,
+                             axis: Optional[str] = None) -> Array:
+    """Explicit sharded lookup: shard `table` rows over `axis`, replicate
+    `ids`, one psum over ICI.  Differentiable; the table gradient is
+    computed shard-locally."""
+    from jax import shard_map
+    from paddle_tpu.parallel.mesh import MODEL_AXIS
+    axis = axis or MODEL_AXIS
+
+    fn = shard_map(
+        partial(local_shard_lookup, axis_name=axis),
+        mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+    )
+    return fn(table, ids)
