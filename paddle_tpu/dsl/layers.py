@@ -42,6 +42,7 @@ __all__ = [
     "pooling_layer", "last_seq", "first_seq", "expand_layer", "seq_concat_layer",
     "seq_reshape_layer", "repeat_layer",
     "lstmemory", "grumemory", "recurrent_layer", "lstm_step_layer", "gru_step_layer",
+    "mdlstm_layer", "sub_seq_layer",
     "img_conv_layer", "img_pool_layer", "img_cmrnorm_layer", "batch_norm_layer",
     "bilinear_interp_layer", "block_expand_layer", "maxout_layer", "spp_layer",
     "conv_shift_layer",
@@ -591,6 +592,48 @@ def grumemory(
     current_context().add_layer(cfg)
     return LayerOutput(name, "gated_recurrent", size, parents=[input],
                        seq_level=input.seq_level)
+
+
+def mdlstm_layer(
+    input: LayerOutput,
+    height: int,
+    width: int,
+    name: Optional[str] = None,
+    directions=(True, True),
+    act: Optional[BaseActivation] = None,
+    gate_act: Optional[BaseActivation] = None,
+    state_act: Optional[BaseActivation] = None,
+    bias_attr=None,
+    param_attr: Optional[ParameterAttribute] = None,
+    layer_attr: Optional[ExtraLayerAttribute] = None,
+) -> LayerOutput:
+    """2-D MDLSTM over a pre-projected 5x input grid (ref: MDLstmLayer.cpp:
+    weight [D, 5D], bias [(5+4)D] incl. peepholes)."""
+    assert input.size % 5 == 0, "mdlstm_layer input must be 5 * hidden_size"
+    size = input.size // 5
+    name = _name(name, "mdlstm")
+    cfg = LayerConfig(name=name, type="mdlstmemory", size=size,
+                      active_type=act_name(act or TanhActivation()))
+    cfg.attrs["active_gate_type"] = act_name(gate_act or SigmoidActivation())
+    cfg.attrs["active_state_type"] = act_name(state_act or TanhActivation())
+    cfg.attrs["height"] = height
+    cfg.attrs["width"] = width
+    cfg.attrs["directions"] = tuple(bool(d) for d in directions)
+    pname = _make_param(name, 0, [size, size * 5], param_attr)
+    cfg.inputs.append(LayerInput(input_layer_name=input.name, input_parameter_name=pname))
+    cfg.bias_parameter_name = _bias_name(name, bias_attr or True, [1, size * 9])
+    _layer_attr_fields(cfg, layer_attr)
+    current_context().add_layer(cfg)
+    return LayerOutput(name, "mdlstmemory", size, parents=[input],
+                       seq_level=input.seq_level)
+
+
+def sub_seq_layer(input: LayerOutput, offsets: LayerOutput, sizes: LayerOutput,
+                  name=None, bias_attr=False, layer_attr=None) -> LayerOutput:
+    """Per-sequence slice by offset/size inputs (ref: SubSequenceLayer.cpp)."""
+    return _simple_layer("subseq", [input, offsets, sizes], input.size,
+                         name=name, bias_attr=bias_attr, layer_attr=layer_attr,
+                         prefix="subseq")
 
 
 def lstm_step_layer(input: LayerOutput, state: LayerOutput, size: int,

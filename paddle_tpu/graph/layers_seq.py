@@ -65,6 +65,19 @@ def expand_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return finish_layer(ctx, cfg, out, like=like, lengths=like.lengths)
 
 
+@register_layer("subseq")
+def sub_sequence_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Per-sequence slice by offset/size id inputs (ref: SubSequenceLayer.cpp)."""
+    x = ctx.get_input(cfg, 0)
+    off = ctx.get_input(cfg, 1)
+    sz = ctx.get_input(cfg, 2)
+    out, lengths = seqops.sub_sequence(x.value, off.ids.reshape(-1), sz.ids.reshape(-1))
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        out = out + b
+    return finish_layer(ctx, cfg, out, lengths=lengths)
+
+
 @register_layer("seqconcat")
 def seq_concat_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     a, b = ctx.get_input(cfg, 0), ctx.get_input(cfg, 1)
@@ -118,6 +131,31 @@ def gated_recurrent_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     )
     out_cfg = _without_activation(cfg)
     return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
+
+
+@register_layer("mdlstmemory")
+def mdlstm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """2-D multi-dimensional LSTM over a static [H, W] grid
+    (ref: MDLstmLayer.cpp:180-486).  Input is pre-projected [B, H*W, 5D];
+    grid geometry comes from attrs['height'/'width'], scan direction per
+    dimension from attrs['directions']."""
+    from paddle_tpu.ops.mdlstm import mdlstm_2d
+    x = ctx.get_input(cfg, 0)
+    w = ctx.param_of(cfg, 0)
+    b = ctx.bias_of(cfg)
+    assert b is not None, "mdlstmemory requires its bias/peephole parameter"
+    directions = tuple(cfg.attrs.get("directions", (True, True)))
+    assert len(directions) == 2, "TPU mdlstmemory supports 2-D grids"
+    out = mdlstm_2d(
+        x.value, w, b,
+        height=cfg.attrs["height"], width=cfg.attrs["width"],
+        directions=directions,
+        active_type=cfg.active_type or "tanh",
+        gate_active_type=cfg.attrs.get("active_gate_type", "sigmoid"),
+        state_active_type=cfg.attrs.get("active_state_type", "tanh"),
+    )
+    out_cfg = _without_activation(cfg)
+    return finish_layer(ctx, out_cfg, out, like=x, lengths=x.lengths)
 
 
 @register_layer("recurrent")

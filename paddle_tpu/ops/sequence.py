@@ -146,6 +146,21 @@ def seq_slice_first_tokens(x: Array, lengths: Array, n: int) -> tuple[Array, Arr
     return x[:, :n], jnp.minimum(lengths, n)
 
 
+def sub_sequence(x: Array, offsets: Array, sizes: Array) -> tuple[Array, Array]:
+    """Take a per-sequence slice [offset, offset+size) of each sequence
+    (ref: gserver/layers/SubSequenceLayer.cpp:74-150 — inputs are the data
+    sequence plus per-sequence offset and size id vectors).  Padded-dense
+    re-design: a gather along time with an out-of-range mask."""
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = offsets[:, None] + t
+    valid = t < sizes[:, None]
+    idx = jnp.where(valid, jnp.minimum(src, T - 1), 0)
+    out = jnp.take_along_axis(x, idx.reshape(B, T, *([1] * (x.ndim - 2))), axis=1)
+    out = jnp.where(valid.reshape(B, T, *([1] * (x.ndim - 2))), out, 0)
+    return out, sizes.astype(jnp.int32)
+
+
 def seq_reverse(x: Array, lengths: Array) -> Array:
     """Reverse each sequence's valid prefix in place: [B,T,D] -> [B,T,D]
     (used by reversed recurrent layers; ref: RecurrentLayer reversed_)."""

@@ -158,3 +158,58 @@ def test_crf_grad():
     feed = {"x": Argument(value=jnp.asarray(x), lengths=jnp.asarray(lengths)),
             "t": Argument(ids=jnp.asarray(tags), lengths=jnp.asarray(lengths))}
     fd_check(cfg, feed)
+
+
+def test_mdlstm_grad():
+    H, W, D = 2, 3, 2
+
+    def conf():
+        settings(batch_size=2)
+        x = data_layer(name="x", size=5 * D)
+        h = mdlstm_layer(input=x, height=H, width=W, directions=(True, False))
+        pooled = pooling_layer(input=h, pooling_type=MaxPooling())
+        out = fc_layer(input=pooled, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(6)
+    B, T = 2, H * W
+    x = rng.standard_normal((B, T, 5 * D)).astype(np.float32)
+    lengths = np.full((B,), T, np.int32)
+    feed = {"x": Argument(value=jnp.asarray(x), lengths=jnp.asarray(lengths)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 3, B), jnp.int32))}
+    fd_check(cfg, feed)
+
+
+def test_subseq_forward_and_grad():
+    def conf():
+        settings(batch_size=3)
+        x = data_layer(name="x", size=4)
+        off = data_layer(name="off", size=1)
+        sz = data_layer(name="sz", size=1)
+        sub = sub_seq_layer(input=x, offsets=off, sizes=sz, name="subseq")
+        pooled = pooling_layer(input=sub, pooling_type=AvgPooling())
+        out = fc_layer(input=pooled, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(7)
+    B, T, D = 3, 6, 4
+    lengths = np.array([6, 4, 5], np.int32)
+    offsets = np.array([1, 0, 2], np.int32)
+    sizes = np.array([3, 2, 3], np.int32)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    feed = {"x": Argument(value=jnp.asarray(x), lengths=jnp.asarray(lengths)),
+            "off": Argument(ids=jnp.asarray(offsets)),
+            "sz": Argument(ids=jnp.asarray(sizes)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 3, B), jnp.int32))}
+
+    # forward semantics: row b, step t == x[b, offset+t] for t < size
+    ex = GraphExecutor(cfg.model_config)
+    params = ex.init_params(jax.random.PRNGKey(0))
+    outs, _, _ = ex.forward(params, feed, mode=TEST, rng=jax.random.PRNGKey(1))
+    sub = np.asarray(outs[[n for n in outs if n.startswith("subseq")][0]].value)
+    for b in range(B):
+        for t in range(sizes[b]):
+            np.testing.assert_allclose(sub[b, t], x[b, offsets[b] + t], rtol=1e-6)
+        assert np.all(sub[b, sizes[b]:] == 0)
+
+    fd_check(cfg, feed)
