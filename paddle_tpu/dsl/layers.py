@@ -621,7 +621,12 @@ def mdlstm_layer(
     cfg.attrs["directions"] = tuple(bool(d) for d in directions)
     pname = _make_param(name, 0, [size, size * 5], param_attr)
     cfg.inputs.append(LayerInput(input_layer_name=input.name, input_parameter_name=pname))
-    cfg.bias_parameter_name = _bias_name(name, bias_attr or True, [1, size * 9])
+    if bias_attr is False:
+        raise ValueError("mdlstm_layer requires a bias parameter — it carries "
+                         "the peephole weights (ref: MDLstmLayer.cpp init "
+                         "LOG(FATAL) without bias)")
+    cfg.bias_parameter_name = _bias_name(name, bias_attr if bias_attr is not None else True,
+                                         [1, size * 9])
     _layer_attr_fields(cfg, layer_attr)
     current_context().add_layer(cfg)
     return LayerOutput(name, "mdlstmemory", size, parents=[input],

@@ -71,7 +71,8 @@ def sub_sequence_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
     off = ctx.get_input(cfg, 1)
     sz = ctx.get_input(cfg, 2)
-    out, lengths = seqops.sub_sequence(x.value, off.ids.reshape(-1), sz.ids.reshape(-1))
+    out, lengths = seqops.sub_sequence(x.value, off.ids.reshape(-1),
+                                       sz.ids.reshape(-1), lengths=x.lengths)
     b = ctx.bias_of(cfg)
     if b is not None:
         out = out + b
@@ -149,7 +150,7 @@ def mdlstm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     out = mdlstm_2d(
         x.value, w, b,
         height=cfg.attrs["height"], width=cfg.attrs["width"],
-        directions=directions,
+        directions=directions, lengths=x.lengths,
         active_type=cfg.active_type or "tanh",
         gate_active_type=cfg.attrs.get("active_gate_type", "sigmoid"),
         state_active_type=cfg.attrs.get("active_state_type", "tanh"),

@@ -34,6 +34,7 @@ def mdlstm_2d(
     active_type: str = "tanh",
     gate_active_type: str = "sigmoid",
     state_active_type: str = "tanh",
+    lengths: Array | None = None,
 ) -> Array:
     """Run a 2-D MDLSTM over a pre-projected grid.
 
@@ -64,16 +65,30 @@ def mdlstm_2d(
     peep_fg1 = bias[G + 2 * D:G + 3 * D]
     peep_og = bias[G + 3 * D:]
 
+    # Padding cells (flat index >= lengths[b]) are treated as out-of-grid
+    # boundary: their h/c are forced to zero so they contribute nothing to
+    # neighbors — regardless of scan direction.  (The reference instead
+    # carries per-sequence grid dims; uniform grids + masking is the
+    # static-shape equivalent.)
+    if lengths is not None:
+        cell_valid = (jnp.arange(height * width)[None, :] < lengths[:, None])
+        cell_valid = cell_valid.reshape(B, height, width, 1).astype(x.dtype)
+    else:
+        cell_valid = jnp.ones((B, height, width, 1), x.dtype)
+
     xg = (x + local_b).reshape(B, height, width, G)
     # Normalize to forward-forward scan; flip the input (and the output back)
     # for reversed dimensions — same trick the reference's CoordIterator
     # begin()/directions_ implements with index arithmetic.
     if not directions[0]:
         xg = jnp.flip(xg, 1)
+        cell_valid = jnp.flip(cell_valid, 1)
     if not directions[1]:
         xg = jnp.flip(xg, 2)
+        cell_valid = jnp.flip(cell_valid, 2)
 
-    def cell(g: Array, h_up: Array, c_up: Array, h_left: Array, c_left: Array):
+    def cell(g: Array, h_up: Array, c_up: Array, h_left: Array, c_left: Array,
+             v: Array):
         """One MDLSTM cell on [B, ...] slices (ref: forwardGate2OutputSequence)."""
         g = g + (h_up + h_left) @ w
         a = act(g[:, :D])
@@ -86,27 +101,29 @@ def mdlstm_2d(
         c = f0 * c_up + f1 * c_left + a * i
         o = gate(g[:, 4 * D:] + c * peep_og)
         h = o * state_act(c)
-        return h, c
+        return h * v, c * v
 
     zeros = jnp.zeros((B, D), x.dtype)
 
-    def row_step(carry, x_row):
+    def row_step(carry, inp):
         # carry: previous row's (h, c) as [W, B, D]; x_row: [W, B, G]
         h_up_row, c_up_row = carry
+        x_row, v_row = inp
 
         def col_step(cc, inp):
             h_left, c_left = cc
-            g, h_up, c_up = inp
-            h, c = cell(g, h_up, c_up, h_left, c_left)
+            g, h_up, c_up, v = inp
+            h, c = cell(g, h_up, c_up, h_left, c_left, v)
             return (h, c), (h, c)
 
         (_, _), (h_row, c_row) = jax.lax.scan(
-            col_step, (zeros, zeros), (x_row, h_up_row, c_up_row))
+            col_step, (zeros, zeros), (x_row, h_up_row, c_up_row, v_row))
         return (h_row, c_row), h_row
 
     x_rows = jnp.transpose(xg, (1, 2, 0, 3))          # [H, W, B, G]
+    v_rows = jnp.transpose(cell_valid, (1, 2, 0, 3))  # [H, W, B, 1]
     init = (jnp.zeros((width, B, D), x.dtype), jnp.zeros((width, B, D), x.dtype))
-    _, h_all = jax.lax.scan(row_step, init, x_rows)   # [H, W, B, D]
+    _, h_all = jax.lax.scan(row_step, init, (x_rows, v_rows))  # [H, W, B, D]
     h = jnp.transpose(h_all, (2, 0, 1, 3))            # [B, H, W, D]
 
     if not directions[0]:

@@ -1,0 +1,482 @@
+"""Fused recurrent kernels in Pallas (TPU).
+
+TPU-native equivalent of the reference's hand-fused recurrent CUDA kernels
+(ref: paddle/cuda/src/hl_cuda_lstm.cu hl_lstm_parallel_forward/
+backward_data/backward_weight, include/hl_lstm_ops.cuh, hl_gru_ops.cuh).
+
+Design: one `pallas_call` whose grid is the time axis.  The hidden/cell
+state lives in VMEM scratch and persists across sequential grid steps, and
+the recurrent weight is loaded into VMEM once — so the whole recurrence
+runs without bouncing state through HBM, the same data-residency trick the
+reference's kernels get from shared memory.  Each step is one [B,D]x[D,kD]
+MXU matmul plus VPU gate math.  The backward pass is a second kernel
+(custom_vjp) that walks time in reverse, recomputes the gate activations
+from the stored per-step states (cheaper than storing them), and
+accumulates the weight/peephole gradients in a VMEM scratch accumulator.
+
+Variable lengths are handled branch-free: state freezes once t >= length
+(mask select), identical to the lax.scan path in ops/rnn.py, which remains
+the fallback for off-TPU backends and unaligned shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+# activation + derivative-from-output pairs usable inside kernels
+_ACTS = {
+    "sigmoid": (jax.nn.sigmoid, lambda y: y * (1.0 - y)),
+    "tanh": (jnp.tanh, lambda y: 1.0 - y * y),
+    "relu": (lambda x: jnp.maximum(x, 0.0), lambda y: (y > 0).astype(y.dtype)),
+    "linear": (lambda x: x, lambda y: jnp.ones_like(y)),
+    "": (lambda x: x, lambda y: jnp.ones_like(y)),
+}
+
+
+def supported(backend: Optional[str] = None, *acts: str) -> bool:
+    """Whether the fused kernels may be used for this configuration."""
+    if os.environ.get("PADDLE_TPU_PALLAS", "1") == "0":
+        return False
+    if any(a not in _ACTS for a in acts):
+        return False
+    backend = backend or jax.default_backend()
+    if backend == "tpu":
+        return True
+    # off-TPU the kernel only runs in (slow) interpret mode — opt-in for tests
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ===========================================================================
+# LSTM
+# ===========================================================================
+
+def _lstm_fwd_kernel(T, D, reverse, act, gate, state_act,
+                     x_ref, w_ref, peep_ref, lens_ref, h0_ref, c0_ref,
+                     hs_ref, cs_ref, h_s, c_s):
+    i = pl.program_id(0)
+    act_f, _ = _ACTS[act]
+    gate_f, _ = _ACTS[gate]
+    state_f, _ = _ACTS[state_act]
+
+    @pl.when(i == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    t = (T - 1 - i) if reverse else i
+    h = h_s[:]
+    c = c_s[:]
+    g = x_ref[0] + jnp.dot(h, w_ref[:], preferred_element_type=jnp.float32)
+    a = act_f(g[:, :D])
+    ig = gate_f(g[:, D:2 * D] + c * peep_ref[0, :])
+    fg = gate_f(g[:, 2 * D:3 * D] + c * peep_ref[1, :])
+    c_new = a * ig + fg * c
+    og = gate_f(g[:, 3 * D:] + c_new * peep_ref[2, :])
+    h_new = og * state_f(c_new)
+
+    valid = lens_ref[:] > t          # [B, 1] broadcast over D
+    h2 = jnp.where(valid, h_new, h)
+    c2 = jnp.where(valid, c_new, c)
+    h_s[:] = h2
+    c_s[:] = c2
+    hs_ref[0] = h2
+    cs_ref[0] = c2
+
+
+def _lstm_bwd_kernel(T, D, reverse, act, gate, state_act,
+                     x_ref, w_ref, peep_ref, lens_ref, h0_ref, c0_ref,
+                     hsp_ref, csp_ref, cs_ref, ghs_ref, ghl_ref, gcl_ref,
+                     dx_ref, dh0_ref, dc0_ref, dw_ref, dpeep_ref,
+                     dh_s, dc_s, dw_s, dpeep_s):
+    i = pl.program_id(0)
+    s = T - 1 - i                      # scan-order step being differentiated
+    act_f, act_d = _ACTS[act]
+    gate_f, gate_d = _ACTS[gate]
+    state_f, state_d = _ACTS[state_act]
+
+    @pl.when(i == 0)
+    def _():
+        dh_s[:] = ghl_ref[:]
+        dc_s[:] = gcl_ref[:]
+        dw_s[:] = jnp.zeros_like(dw_s)
+        dpeep_s[:] = jnp.zeros_like(dpeep_s)
+
+    first = (s == 0)
+    h_prev = jnp.where(first, h0_ref[:], hsp_ref[0])
+    c_prev = jnp.where(first, c0_ref[:], csp_ref[0])
+    c_new = cs_ref[0]
+
+    # recompute gate activations (ref: hl_lstm backward recomputes from value)
+    g = x_ref[0] + jnp.dot(h_prev, w_ref[:], preferred_element_type=jnp.float32)
+    a = act_f(g[:, :D])
+    ig = gate_f(g[:, D:2 * D] + c_prev * peep_ref[0, :])
+    fg = gate_f(g[:, 2 * D:3 * D] + c_prev * peep_ref[1, :])
+    og = gate_f(g[:, 3 * D:] + c_new * peep_ref[2, :])
+    sc = state_f(c_new)
+
+    t = (T - 1 - s) if reverse else s
+    valid = lens_ref[:] > t
+
+    dh_total = dh_s[:] + ghs_ref[0]
+    do = dh_total * sc
+    dzo = do * gate_d(og)
+    dc_in = dh_total * og * state_d(sc) + dc_s[:] + dzo * peep_ref[2, :]
+    da = dc_in * ig
+    di = dc_in * a
+    df = dc_in * c_prev
+    dza = da * act_d(a)
+    dzi = di * gate_d(ig)
+    dzf = df * gate_d(fg)
+    dc_prev = dc_in * fg + dzi * peep_ref[0, :] + dzf * peep_ref[1, :]
+
+    dx4 = jnp.concatenate([dza, dzi, dzf, dzo], axis=1)
+    dx4 = jnp.where(valid, dx4, 0.0)
+    dx_ref[0] = dx4
+    dh_prev = jnp.dot(dx4, w_ref[:].T, preferred_element_type=jnp.float32)
+    dh_s[:] = jnp.where(valid, dh_prev, dh_total)
+    dc_s[:] = jnp.where(valid, dc_prev, dc_s[:])
+    dw_s[:] = dw_s[:] + jnp.dot(h_prev.T, dx4, preferred_element_type=jnp.float32)
+    vm = valid.astype(jnp.float32)
+    dpeep_s[0, :] = dpeep_s[0, :] + jnp.sum(dzi * c_prev * vm, axis=0)
+    dpeep_s[1, :] = dpeep_s[1, :] + jnp.sum(dzf * c_prev * vm, axis=0)
+    dpeep_s[2, :] = dpeep_s[2, :] + jnp.sum(dzo * c_new * vm, axis=0)
+
+    @pl.when(i == T - 1)
+    def _():
+        dh0_ref[:] = dh_s[:]
+        dc0_ref[:] = dc_s[:]
+        dw_ref[:] = dw_s[:]
+        dpeep_ref[:] = dpeep_s[:]
+
+
+@functools.lru_cache(maxsize=None)
+def _lstm_fused_factory(reverse: bool, act: str, gate: str, state_act: str):
+    """Build the custom_vjp'd fused LSTM for one static configuration."""
+
+    def fwd_call(xs, w, peeps, lens_f, h0, c0):
+        T, B, D4 = xs.shape
+        D = D4 // 4
+        kern = functools.partial(_lstm_fwd_kernel, T, D, reverse,
+                                 act, gate, state_act)
+        hs, cs = pl.pallas_call(
+            kern,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, B, D4), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # w
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # peeps
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # lens [B,1]
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # h0
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # c0
+            ],
+            out_specs=[
+                pl.BlockSpec((1, B, D), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, B, D), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, D), jnp.float32),
+                jax.ShapeDtypeStruct((T, B, D), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((B, D), jnp.float32),
+                pltpu.VMEM((B, D), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(xs, w, peeps, lens_f, h0, c0)
+        return hs, cs
+
+    @jax.custom_vjp
+    def fused(xs, w, peeps, lens_f, h0, c0):
+        hs, cs = fwd_call(xs, w, peeps, lens_f, h0, c0)
+        return hs, hs[-1], cs[-1]
+
+    def fused_fwd(xs, w, peeps, lens_f, h0, c0):
+        hs, cs = fwd_call(xs, w, peeps, lens_f, h0, c0)
+        return (hs, hs[-1], cs[-1]), (xs, w, peeps, lens_f, h0, c0, hs, cs)
+
+    def fused_bwd(res, g):
+        xs, w, peeps, lens_f, h0, c0, hs, cs = res
+        g_hs, g_hl, g_cl = g
+        T, B, D4 = xs.shape
+        D = D4 // 4
+        kern = functools.partial(_lstm_bwd_kernel, T, D, reverse,
+                                 act, gate, state_act)
+        step = pl.BlockSpec((1, B, D), lambda i: (T - 1 - i, 0, 0),
+                            memory_space=pltpu.VMEM)
+        # predecessor state: step s-1 = T-2-i, clamped (s==0 uses h0/c0)
+        prev = pl.BlockSpec((1, B, D), lambda i: (jnp.maximum(T - 2 - i, 0), 0, 0),
+                            memory_space=pltpu.VMEM)
+        dx, dh0, dc0, dw, dpeep = pl.pallas_call(
+            kern,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, B, D4), lambda i: (T - 1 - i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # w
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # peeps
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # lens
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # h0
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # c0
+                prev,                                    # hs[s-1]
+                prev,                                    # cs[s-1]
+                step,                                    # cs[s]
+                step,                                    # g_hs[s]
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # g_h_last
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # g_c_last
+            ],
+            out_specs=[
+                pl.BlockSpec((1, B, D4), lambda i: (T - 1 - i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, D4), jnp.float32),
+                jax.ShapeDtypeStruct((B, D), jnp.float32),
+                jax.ShapeDtypeStruct((B, D), jnp.float32),
+                jax.ShapeDtypeStruct((D, D4), jnp.float32),
+                jax.ShapeDtypeStruct((3, D), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((B, D), jnp.float32),
+                pltpu.VMEM((B, D), jnp.float32),
+                pltpu.VMEM((D, D4), jnp.float32),
+                pltpu.VMEM((3, D), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(xs, w, peeps, lens_f, h0, c0, hs, cs, cs, g_hs, g_hl, g_cl)
+        return dx, dw, dpeep, jnp.zeros_like(lens_f), dh0, dc0
+
+    fused.defvjp(fused_fwd, fused_bwd)
+    return fused
+
+
+def lstm_fused(x4, lengths, w, peeps, h0, c0, *,
+               active_type, gate_active_type, state_active_type, reverse):
+    """Fused LSTM over [B, T, 4D] pre-projected (bias already added) input.
+
+    peeps: [3, D] (i, f, o) peephole vectors (zeros when the layer has none).
+    Returns (hs [B,T,D], h_last, c_last)."""
+    B, T, D4 = x4.shape
+    xs = jnp.moveaxis(x4, 1, 0).astype(jnp.float32)
+    if reverse:
+        # visit the padded tail first (scan order = reversed time); the
+        # kernel masks with t = T-1-i so state freezes over the padding
+        xs = xs[::-1]
+    lens_f = lengths.astype(jnp.float32)[:, None]
+    fused = _lstm_fused_factory(bool(reverse), active_type or "tanh",
+                                gate_active_type or "sigmoid",
+                                state_active_type or "tanh")
+    hs, h_last, c_last = fused(xs, w.astype(jnp.float32),
+                               peeps.astype(jnp.float32), lens_f,
+                               h0.astype(jnp.float32), c0.astype(jnp.float32))
+    if reverse:
+        hs = hs[::-1]
+    return jnp.moveaxis(hs, 0, 1), h_last, c_last
+
+
+# ===========================================================================
+# GRU
+# ===========================================================================
+
+def _gru_fwd_kernel(T, D, reverse, act, gate,
+                    x_ref, wg_ref, wc_ref, lens_ref, h0_ref,
+                    hs_ref, h_s):
+    i = pl.program_id(0)
+    act_f, _ = _ACTS[act]
+    gate_f, _ = _ACTS[gate]
+
+    @pl.when(i == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+
+    t = (T - 1 - i) if reverse else i
+    h = h_s[:]
+    x = x_ref[0]
+    zg = x[:, :2 * D] + jnp.dot(h, wg_ref[:], preferred_element_type=jnp.float32)
+    u = gate_f(zg[:, :D])
+    r = gate_f(zg[:, D:])
+    c = act_f(x[:, 2 * D:] + jnp.dot(r * h, wc_ref[:],
+                                     preferred_element_type=jnp.float32))
+    h_new = u * h + (1.0 - u) * c
+    valid = lens_ref[:] > t
+    h2 = jnp.where(valid, h_new, h)
+    h_s[:] = h2
+    hs_ref[0] = h2
+
+
+def _gru_bwd_kernel(T, D, reverse, act, gate,
+                    x_ref, wg_ref, wc_ref, lens_ref, h0_ref,
+                    hsp_ref, ghs_ref, ghl_ref,
+                    dx_ref, dh0_ref, dwg_ref, dwc_ref,
+                    dh_s, dwg_s, dwc_s):
+    i = pl.program_id(0)
+    s = T - 1 - i
+    act_f, act_d = _ACTS[act]
+    gate_f, gate_d = _ACTS[gate]
+
+    @pl.when(i == 0)
+    def _():
+        dh_s[:] = ghl_ref[:]
+        dwg_s[:] = jnp.zeros_like(dwg_s)
+        dwc_s[:] = jnp.zeros_like(dwc_s)
+
+    h_prev = jnp.where(s == 0, h0_ref[:], hsp_ref[0])
+    x = x_ref[0]
+    zg = x[:, :2 * D] + jnp.dot(h_prev, wg_ref[:],
+                                preferred_element_type=jnp.float32)
+    u = gate_f(zg[:, :D])
+    r = gate_f(zg[:, D:])
+    rh = r * h_prev
+    c = act_f(x[:, 2 * D:] + jnp.dot(rh, wc_ref[:],
+                                     preferred_element_type=jnp.float32))
+
+    t = (T - 1 - s) if reverse else s
+    valid = lens_ref[:] > t
+
+    dh_total = dh_s[:] + ghs_ref[0]
+    du = dh_total * (h_prev - c)
+    dc = dh_total * (1.0 - u)
+    dzc = dc * act_d(c)
+    drh = jnp.dot(dzc, wc_ref[:].T, preferred_element_type=jnp.float32)
+    dr = drh * h_prev
+    dzu = du * gate_d(u)
+    dzr = dr * gate_d(r)
+    dzg = jnp.concatenate([dzu, dzr], axis=1)
+    dh_prev = (dh_total * u + drh * r +
+               jnp.dot(dzg, wg_ref[:].T, preferred_element_type=jnp.float32))
+
+    dx3 = jnp.concatenate([dzg, dzc], axis=1)
+    dx3 = jnp.where(valid, dx3, 0.0)
+    dx_ref[0] = dx3
+    dh_s[:] = jnp.where(valid, dh_prev, dh_total)
+    vm = valid.astype(jnp.float32)
+    dwg_s[:] = dwg_s[:] + jnp.dot(h_prev.T, dzg * vm,
+                                  preferred_element_type=jnp.float32)
+    dwc_s[:] = dwc_s[:] + jnp.dot(rh.T, dzc * vm,
+                                  preferred_element_type=jnp.float32)
+
+    @pl.when(i == T - 1)
+    def _():
+        dh0_ref[:] = dh_s[:]
+        dwg_ref[:] = dwg_s[:]
+        dwc_ref[:] = dwc_s[:]
+
+
+@functools.lru_cache(maxsize=None)
+def _gru_fused_factory(reverse: bool, act: str, gate: str):
+    def fwd_call(xs, wg, wc, lens_f, h0):
+        T, B, D3 = xs.shape
+        D = D3 // 3
+        kern = functools.partial(_gru_fwd_kernel, T, D, reverse, act, gate)
+        return pl.pallas_call(
+            kern,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, B, D3), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, B, D), lambda i: (i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((T, B, D), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((B, D), jnp.float32)],
+            interpret=_interpret(),
+        )(xs, wg, wc, lens_f, h0)
+
+    @jax.custom_vjp
+    def fused(xs, wg, wc, lens_f, h0):
+        hs = fwd_call(xs, wg, wc, lens_f, h0)
+        return hs, hs[-1]
+
+    def fused_fwd(xs, wg, wc, lens_f, h0):
+        hs = fwd_call(xs, wg, wc, lens_f, h0)
+        return (hs, hs[-1]), (xs, wg, wc, lens_f, h0, hs)
+
+    def fused_bwd(res, g):
+        xs, wg, wc, lens_f, h0, hs = res
+        g_hs, g_hl = g
+        T, B, D3 = xs.shape
+        D = D3 // 3
+        kern = functools.partial(_gru_bwd_kernel, T, D, reverse, act, gate)
+        step = pl.BlockSpec((1, B, D), lambda i: (T - 1 - i, 0, 0),
+                            memory_space=pltpu.VMEM)
+        prev = pl.BlockSpec((1, B, D), lambda i: (jnp.maximum(T - 2 - i, 0), 0, 0),
+                            memory_space=pltpu.VMEM)
+        dx, dh0, dwg, dwc = pl.pallas_call(
+            kern,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, B, D3), lambda i: (T - 1 - i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                prev,
+                step,
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, B, D3), lambda i: (T - 1 - i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, B, D3), jnp.float32),
+                jax.ShapeDtypeStruct((B, D), jnp.float32),
+                jax.ShapeDtypeStruct((D, 2 * D), jnp.float32),
+                jax.ShapeDtypeStruct((D, D), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((B, D), jnp.float32),
+                pltpu.VMEM((D, 2 * D), jnp.float32),
+                pltpu.VMEM((D, D), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(xs, wg, wc, lens_f, h0, hs, g_hs, g_hl)
+        return dx, dwg, dwc, jnp.zeros_like(lens_f), dh0
+
+    fused.defvjp(fused_fwd, fused_bwd)
+    return fused
+
+
+def gru_fused(x3, lengths, w_gate, w_cand, h0, *,
+              active_type, gate_active_type, reverse):
+    """Fused GRU over [B, T, 3D] pre-projected (bias already added) input.
+    Returns (hs [B,T,D], h_last)."""
+    xs = jnp.moveaxis(x3, 1, 0).astype(jnp.float32)
+    if reverse:
+        xs = xs[::-1]
+    lens_f = lengths.astype(jnp.float32)[:, None]
+    fused = _gru_fused_factory(bool(reverse), active_type or "tanh",
+                               gate_active_type or "sigmoid")
+    hs, h_last = fused(xs, w_gate.astype(jnp.float32),
+                       w_cand.astype(jnp.float32), lens_f,
+                       h0.astype(jnp.float32))
+    if reverse:
+        hs = hs[::-1]
+    return jnp.moveaxis(hs, 0, 1), h_last

@@ -42,6 +42,17 @@ def _act(name: str):
     return activation_registry[name or "tanh"]
 
 
+def _use_fused(D: int, *acts: str) -> bool:
+    """Route to the Pallas fused kernels (ops/pallas_rnn.py) when profitable:
+    on TPU with lane-aligned hidden size, or in interpret mode for tests."""
+    from paddle_tpu.ops import pallas_rnn
+    if not pallas_rnn.supported(None, *acts):
+        return False
+    if jax.default_backend() == "tpu":
+        return D % 128 == 0
+    return True  # interpret-mode opt-in (PADDLE_TPU_PALLAS_INTERPRET=1)
+
+
 def lstm_scan(
     x4: Array,                  # [B, T, 4D] pre-projected input (order a,i,f,o)
     lengths: Array,             # [B]
@@ -74,6 +85,15 @@ def lstm_scan(
         h0 = jnp.zeros((B, D), x4.dtype)
     if c0 is None:
         c0 = jnp.zeros((B, D), x4.dtype)
+
+    if _use_fused(D, active_type, gate_active_type, state_active_type):
+        from paddle_tpu.ops import pallas_rnn
+        peeps = (jnp.stack([peep_i, peep_f, peep_o])
+                 if peep_i is not None else jnp.zeros((3, D), x4.dtype))
+        return pallas_rnn.lstm_fused(
+            x4, lengths, w_rec, peeps, h0, c0,
+            active_type=active_type, gate_active_type=gate_active_type,
+            state_active_type=state_active_type, reverse=reverse)
 
     xs = jnp.moveaxis(x4, 1, 0)  # [T, B, 4D]
     ts = jnp.arange(T)
@@ -130,6 +150,13 @@ def gru_scan(
         x3 = x3 + bias.reshape(-1)
     if h0 is None:
         h0 = jnp.zeros((B, D), x3.dtype)
+
+    if _use_fused(D, active_type, gate_active_type):
+        from paddle_tpu.ops import pallas_rnn
+        return pallas_rnn.gru_fused(
+            x3, lengths, w_gate, w_cand, h0,
+            active_type=active_type, gate_active_type=gate_active_type,
+            reverse=reverse)
 
     xs = jnp.moveaxis(x3, 1, 0)
     ts = jnp.arange(T)

@@ -146,12 +146,17 @@ def seq_slice_first_tokens(x: Array, lengths: Array, n: int) -> tuple[Array, Arr
     return x[:, :n], jnp.minimum(lengths, n)
 
 
-def sub_sequence(x: Array, offsets: Array, sizes: Array) -> tuple[Array, Array]:
+def sub_sequence(x: Array, offsets: Array, sizes: Array,
+                 lengths: Array | None = None) -> tuple[Array, Array]:
     """Take a per-sequence slice [offset, offset+size) of each sequence
     (ref: gserver/layers/SubSequenceLayer.cpp:74-150 — inputs are the data
     sequence plus per-sequence offset and size id vectors).  Padded-dense
-    re-design: a gather along time with an out-of-range mask."""
+    re-design: a gather along time with an out-of-range mask.  The reference
+    CHECK-aborts on out-of-bounds slices; under jit the slice is clamped to
+    the valid range instead (size -> max(0, min(size, length - offset)))."""
     B, T = x.shape[0], x.shape[1]
+    bound = lengths if lengths is not None else jnp.full_like(offsets, T)
+    sizes = jnp.clip(jnp.minimum(sizes, bound - offsets), 0, T)
     t = jnp.arange(T)[None, :]
     src = offsets[:, None] + t
     valid = t < sizes[:, None]

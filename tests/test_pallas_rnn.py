@@ -1,0 +1,99 @@
+"""Pallas fused RNN kernels vs the lax.scan reference — numeric oracle
+(analog of the reference's CPU-vs-GPU comparison tests for its fused LSTM
+kernels, ref: paddle/gserver/tests/test_RecurrentLayer.cpp,
+math/tests/test_matrixCompare.cpp pattern).  Runs the Pallas kernels in
+interpret mode on CPU; on real TPU the same code path compiles.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas_rnn, rnn
+
+
+def _lstm_case(rng, B, T, D, peep):
+    x4 = jnp.asarray(rng.standard_normal((B, T, 4 * D)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((D, 4 * D)) * 0.3, jnp.float32)
+    lengths = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
+    peeps = (jnp.asarray(rng.standard_normal((3, D)) * 0.2, jnp.float32)
+             if peep else jnp.zeros((3, D), jnp.float32))
+    h0 = jnp.zeros((B, D), jnp.float32)
+    c0 = jnp.zeros((B, D), jnp.float32)
+    return x4, w, lengths, peeps, h0, c0
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("peep", [False, True])
+def test_lstm_fused_matches_scan(reverse, peep):
+    rng = np.random.default_rng(0 if peep else 1)
+    B, T, D = 4, 6, 8
+    x4, w, lengths, peeps, h0, c0 = _lstm_case(rng, B, T, D, peep)
+    bias = jnp.concatenate([jnp.zeros(4 * D), peeps.reshape(-1)]) if peep else None
+
+    def ref_loss(x4, w, peeps):
+        bias = (jnp.concatenate([jnp.zeros(4 * D), peeps.reshape(-1)])
+                if peep else None)
+        hs, hl, cl = rnn.lstm_scan(x4, lengths, w, bias, reverse=reverse)
+        return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl), (hs, hl, cl)
+
+    def fused_loss(x4, w, peeps):
+        lens_f = None
+        hs, hl, cl = pallas_rnn.lstm_fused(
+            x4, lengths, w, peeps, h0, c0,
+            active_type="tanh", gate_active_type="sigmoid",
+            state_active_type="tanh", reverse=reverse)
+        return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl), (hs, hl, cl)
+
+    (ref_l, (ref_hs, ref_hl, ref_cl)) = ref_loss(x4, w, peeps)
+    (fus_l, (fus_hs, fus_hl, fus_cl)) = fused_loss(x4, w, peeps)
+    np.testing.assert_allclose(np.asarray(fus_hs), np.asarray(ref_hs),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fus_hl), np.asarray(ref_hl),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fus_cl), np.asarray(ref_cl),
+                               rtol=1e-5, atol=1e-5)
+
+    g_ref = jax.grad(lambda *a: ref_loss(*a)[0], argnums=(0, 1, 2))(x4, w, peeps)
+    g_fus = jax.grad(lambda *a: fused_loss(*a)[0], argnums=(0, 1, 2))(x4, w, peeps)
+    for gr, gf, name in zip(g_ref, g_fus, ["dx", "dw", "dpeep"]):
+        if not peep and name == "dpeep":
+            continue  # scan path has no peephole param when bias is absent
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_gru_fused_matches_scan(reverse):
+    rng = np.random.default_rng(2)
+    B, T, D = 3, 5, 8
+    x3 = jnp.asarray(rng.standard_normal((B, T, 3 * D)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((D, 2 * D)) * 0.3, jnp.float32)
+    wc = jnp.asarray(rng.standard_normal((D, D)) * 0.3, jnp.float32)
+    lengths = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
+    h0 = jnp.zeros((B, D), jnp.float32)
+
+    def ref_loss(x3, wg, wc):
+        hs, hl = rnn.gru_scan(x3, lengths, wg, wc, None, reverse=reverse)
+        return jnp.sum(hs * hs) + jnp.sum(hl), (hs, hl)
+
+    def fused_loss(x3, wg, wc):
+        hs, hl = pallas_rnn.gru_fused(
+            x3, lengths, wg, wc, h0,
+            active_type="tanh", gate_active_type="sigmoid", reverse=reverse)
+        return jnp.sum(hs * hs) + jnp.sum(hl), (hs, hl)
+
+    (_, (ref_hs, ref_hl)) = ref_loss(x3, wg, wc)
+    (_, (fus_hs, fus_hl)) = fused_loss(x3, wg, wc)
+    np.testing.assert_allclose(np.asarray(fus_hs), np.asarray(ref_hs),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fus_hl), np.asarray(ref_hl),
+                               rtol=1e-5, atol=1e-5)
+
+    g_ref = jax.grad(lambda *a: ref_loss(*a)[0], argnums=(0, 1, 2))(x3, wg, wc)
+    g_fus = jax.grad(lambda *a: fused_loss(*a)[0], argnums=(0, 1, 2))(x3, wg, wc)
+    for gr, gf, name in zip(g_ref, g_fus, ["dx", "dwg", "dwc"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
