@@ -8,7 +8,7 @@ from typing import Any, Optional
 from paddle_tpu.config.schema import DataConfig
 from paddle_tpu.dsl.base import current_context
 
-__all__ = ["define_py_data_sources2"]
+__all__ = ["define_py_data_sources2", "define_ptsh_data_sources"]
 
 
 def define_py_data_sources2(
@@ -29,3 +29,21 @@ def define_py_data_sources2(
     if test_list is not None:
         ctx.test_data = DataConfig(type="py2", files=test_list, load_data_module=module,
                                    load_data_object=obj, load_data_args=args_str)
+
+
+def define_ptsh_data_sources(
+    train: Optional[str],
+    test: Optional[str] = None,
+    names: Optional[list] = None,
+) -> None:
+    """Declare train/test sources backed by PTSH binary shards read by the
+    native C++ loader (paddle_tpu/io/).  `train`/`test` are a shard dir,
+    glob, or file-list; `names` maps shard slots to data-layer names (defaults
+    to the model's data layers in declaration order)."""
+    ctx = current_context()
+    import json
+    args_str = json.dumps({"names": names}) if names else ""
+    if train is not None:
+        ctx.data = DataConfig(type="ptsh", files=train, load_data_args=args_str)
+    if test is not None:
+        ctx.test_data = DataConfig(type="ptsh", files=test, load_data_args=args_str)

@@ -1,0 +1,11 @@
+"""Native data IO runtime: PTSH binary shards + C++ background loader.
+
+(ref equivalents: paddle/gserver/dataproviders/{ProtoDataProvider,
+PyDataProvider2}.cpp, paddle/utils/{Queue,Thread}.h — see io/csrc/ptio.cc.)
+"""
+
+from paddle_tpu.io.shards import (  # noqa: F401
+    ShardWriter, read_shard, shard_types, write_shards,
+    write_shards_from_provider,
+)
+from paddle_tpu.io.native import NativeShardLoader, available  # noqa: F401

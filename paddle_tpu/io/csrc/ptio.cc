@@ -1,0 +1,390 @@
+// ptio — native data-loader runtime for paddle_tpu.
+//
+// TPU-native equivalent of the reference's C++ data-provider machinery
+// (ref: paddle/gserver/dataproviders/DataProvider.h DoubleBuffer:260,
+// PyDataProvider2.cpp loadThread_ + memory pool :360-467,
+// ProtoDataProvider.cpp binary shards, paddle/utils/Queue.h,
+// paddle/utils/Thread.h): a background producer thread reads binary shard
+// files, maintains a streaming shuffle pool (the min_pool_size semantics of
+// PyDataProvider2), assembles padded dense batches entirely outside the
+// Python GIL, and hands them to the consumer through a bounded blocking
+// queue (the DoubleBuffer analog) so host IO overlaps device compute.
+//
+// Shard format "PTSH" v1 (written by paddle_tpu/io/shards.py):
+//   char[4] "PTSH"; u32 version; u32 nslots;
+//   per slot: u32 kind (0 dense, 1 index, 2 dense_seq, 3 index_seq); u32 dim
+//   records until EOF, each record = per-slot payload:
+//     dense:      dim * f32
+//     index:      i32
+//     dense_seq:  u32 len; len * dim * f32
+//     index_seq:  u32 len; len * i32
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -pthread ptio.cc -o libptio.so
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum SlotKind : uint32_t {
+  kDense = 0,
+  kIndex = 1,
+  kDenseSeq = 2,
+  kIndexSeq = 3,
+};
+
+struct SlotDesc {
+  uint32_t kind = 0;
+  uint32_t dim = 0;
+};
+
+// One record: raw per-slot payloads (already parsed lengths).
+struct Record {
+  // per slot: floats or ints + length (1 for non-seq)
+  std::vector<std::vector<float>> f;
+  std::vector<std::vector<int32_t>> i;
+  std::vector<int32_t> len;
+};
+
+// One assembled batch, ownership transferred to the consumer side handle.
+struct Batch {
+  int32_t batch_size = 0;       // 0 => end-of-pass marker
+  // per slot: data buffer (float32 or int32), per-row lengths, padded maxlen
+  std::vector<std::vector<float>> fdata;
+  std::vector<std::vector<int32_t>> idata;
+  std::vector<std::vector<int32_t>> lens;
+  std::vector<int32_t> maxlen;
+};
+
+struct Loader {
+  std::vector<std::string> files;
+  std::vector<SlotDesc> slots;
+  int batch_size = 1;
+  int pool_size = 1024;          // shuffle pool target fill
+  bool shuffle = true;
+  int queue_depth = 4;
+  int pad_multiple = 8;
+  int repeat = 1;                // 0 = one pass then stop
+  uint64_t seed = 0;
+
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<std::unique_ptr<Batch>> queue;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> done{false};  // producer exited
+  std::string error;
+
+  std::unique_ptr<Batch> current;  // last batch handed to the consumer
+
+  ~Loader() {
+    stop.store(true);
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    if (producer.joinable()) producer.join();
+  }
+};
+
+bool read_exact(FILE* fp, void* out, size_t n) {
+  return fread(out, 1, n, fp) == n;
+}
+
+bool read_header(FILE* fp, std::vector<SlotDesc>* slots, std::string* err) {
+  char magic[4];
+  uint32_t version = 0, nslots = 0;
+  if (!read_exact(fp, magic, 4) || memcmp(magic, "PTSH", 4) != 0) {
+    *err = "bad shard magic";
+    return false;
+  }
+  if (!read_exact(fp, &version, 4) || version != 1) {
+    *err = "unsupported shard version";
+    return false;
+  }
+  if (!read_exact(fp, &nslots, 4) || nslots == 0 || nslots > 1024) {
+    *err = "bad slot count";
+    return false;
+  }
+  slots->resize(nslots);
+  for (auto& s : *slots) {
+    if (!read_exact(fp, &s.kind, 4) || !read_exact(fp, &s.dim, 4) ||
+        s.kind > kIndexSeq) {
+      *err = "bad slot descriptor";
+      return false;
+    }
+  }
+  return true;
+}
+
+// Read one record; returns false on clean EOF, sets err on corruption.
+bool read_record(FILE* fp, const std::vector<SlotDesc>& slots, Record* rec,
+                 std::string* err) {
+  rec->f.assign(slots.size(), {});
+  rec->i.assign(slots.size(), {});
+  rec->len.assign(slots.size(), 1);
+  for (size_t s = 0; s < slots.size(); s++) {
+    const auto& d = slots[s];
+    uint32_t len = 1;
+    if (d.kind == kDenseSeq || d.kind == kIndexSeq) {
+      size_t got = fread(&len, 1, 4, fp);
+      if (got == 0 && s == 0) return false;  // clean EOF at record boundary
+      if (got != 4 || len > (1u << 24)) {
+        *err = "corrupt shard (bad seq length)";
+        return false;
+      }
+    }
+    rec->len[s] = static_cast<int32_t>(len);
+    if (d.kind == kDense || d.kind == kDenseSeq) {
+      size_t n = static_cast<size_t>(len) * d.dim;
+      rec->f[s].resize(n);
+      size_t got = fread(rec->f[s].data(), 4, n, fp);
+      if (got == 0 && s == 0 && d.kind == kDense) return false;  // EOF
+      if (got != n) {
+        *err = "corrupt shard (short dense payload)";
+        return false;
+      }
+    } else {
+      size_t n = (d.kind == kIndex) ? 1 : len;
+      rec->i[s].resize(n);
+      size_t got = fread(rec->i[s].data(), 4, n, fp);
+      if (got == 0 && s == 0 && d.kind == kIndex) return false;  // EOF
+      if (got != n) {
+        *err = "corrupt shard (short index payload)";
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+int32_t round_up(int32_t n, int32_t m) {
+  return m <= 1 ? n : ((n + m - 1) / m) * m;
+}
+
+std::unique_ptr<Batch> assemble(const std::vector<SlotDesc>& slots,
+                                std::vector<Record>&& recs, int pad_multiple) {
+  auto b = std::make_unique<Batch>();
+  const int32_t B = static_cast<int32_t>(recs.size());
+  b->batch_size = B;
+  b->fdata.resize(slots.size());
+  b->idata.resize(slots.size());
+  b->lens.resize(slots.size());
+  b->maxlen.assign(slots.size(), 1);
+  for (size_t s = 0; s < slots.size(); s++) {
+    const auto& d = slots[s];
+    bool is_seq = d.kind == kDenseSeq || d.kind == kIndexSeq;
+    int32_t maxlen = 1;
+    if (is_seq) {
+      for (auto& r : recs) maxlen = std::max(maxlen, r.len[s]);
+      maxlen = round_up(maxlen, pad_multiple);
+    }
+    b->maxlen[s] = maxlen;
+    if (is_seq) {
+      b->lens[s].resize(B);
+      for (int32_t r = 0; r < B; r++) b->lens[s][r] = recs[r].len[s];
+    }
+    if (d.kind == kDense || d.kind == kDenseSeq) {
+      b->fdata[s].assign(static_cast<size_t>(B) * maxlen * d.dim, 0.0f);
+      for (int32_t r = 0; r < B; r++) {
+        memcpy(b->fdata[s].data() + static_cast<size_t>(r) * maxlen * d.dim,
+               recs[r].f[s].data(), recs[r].f[s].size() * 4);
+      }
+    } else {
+      b->idata[s].assign(static_cast<size_t>(B) * maxlen, 0);
+      for (int32_t r = 0; r < B; r++) {
+        memcpy(b->idata[s].data() + static_cast<size_t>(r) * maxlen,
+               recs[r].i[s].data(), recs[r].i[s].size() * 4);
+      }
+    }
+  }
+  return b;
+}
+
+void push_batch(Loader* L, std::unique_ptr<Batch> b) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_push.wait(lk, [&] {
+    return L->stop.load() || static_cast<int>(L->queue.size()) < L->queue_depth;
+  });
+  if (L->stop.load()) return;
+  L->queue.push_back(std::move(b));
+  L->cv_pop.notify_one();
+}
+
+void producer_main(Loader* L) {
+  std::mt19937_64 rng(L->seed);
+  std::vector<Record> pool;
+  pool.reserve(L->pool_size + L->batch_size);
+
+  auto emit_from_pool = [&](bool flush) {
+    // Pop batch_size records once the pool is warm (pool_size extra records
+    // stay resident for shuffling quality — the min_pool_size semantics).
+    int warm = (L->shuffle ? L->pool_size : 0) + L->batch_size;
+    while (static_cast<int>(pool.size()) >= (flush ? 1 : warm)) {
+      int32_t n = std::min<int32_t>(L->batch_size,
+                                    static_cast<int32_t>(pool.size()));
+      std::vector<Record> recs;
+      recs.reserve(n);
+      if (L->shuffle) {
+        for (int32_t k = 0; k < n; k++) {
+          size_t j = rng() % pool.size();
+          recs.push_back(std::move(pool[j]));
+          pool[j] = std::move(pool.back());
+          pool.pop_back();
+        }
+      } else {
+        // preserve file order
+        recs.assign(std::make_move_iterator(pool.begin()),
+                    std::make_move_iterator(pool.begin() + n));
+        pool.erase(pool.begin(), pool.begin() + n);
+      }
+      push_batch(L, assemble(L->slots, std::move(recs), L->pad_multiple));
+      if (L->stop.load()) return;
+    }
+  };
+
+  for (int pass = 0; !L->stop.load(); pass++) {
+    std::vector<std::string> order = L->files;
+    if (L->shuffle) {
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+    for (const auto& path : order) {
+      FILE* fp = fopen(path.c_str(), "rb");
+      if (!fp) {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->error = "cannot open shard: " + path;
+        L->done.store(true);
+        L->cv_pop.notify_all();
+        return;
+      }
+      std::vector<SlotDesc> slots;
+      std::string err;
+      if (!read_header(fp, &slots, &err) || slots.size() != L->slots.size()) {
+        fclose(fp);
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->error = err.empty() ? ("shard schema mismatch: " + path)
+                               : (err + ": " + path);
+        L->done.store(true);
+        L->cv_pop.notify_all();
+        return;
+      }
+      Record rec;
+      while (!L->stop.load()) {
+        err.clear();
+        if (!read_record(fp, L->slots, &rec, &err)) {
+          if (!err.empty()) {
+            fclose(fp);
+            std::lock_guard<std::mutex> lk(L->mu);
+            L->error = err + ": " + path;
+            L->done.store(true);
+            L->cv_pop.notify_all();
+            return;
+          }
+          break;  // clean EOF
+        }
+        pool.push_back(std::move(rec));
+        emit_from_pool(false);
+      }
+      fclose(fp);
+      if (L->stop.load()) break;
+    }
+    emit_from_pool(true);  // drain the pool at pass end
+    // end-of-pass marker
+    auto eos = std::make_unique<Batch>();
+    push_batch(L, std::move(eos));
+    if (!L->repeat) break;
+  }
+  L->done.store(true);
+  L->cv_pop.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptio_open(const char** files, int nfiles, int batch_size, int pool_size,
+                int shuffle, uint64_t seed, int queue_depth, int pad_multiple,
+                int repeat) {
+  if (nfiles <= 0 || batch_size <= 0) return nullptr;
+  auto L = std::make_unique<Loader>();
+  for (int i = 0; i < nfiles; i++) L->files.emplace_back(files[i]);
+  L->batch_size = batch_size;
+  L->pool_size = std::max(0, pool_size);
+  L->shuffle = shuffle != 0;
+  L->seed = seed;
+  L->queue_depth = std::max(1, queue_depth);
+  L->pad_multiple = std::max(1, pad_multiple);
+  L->repeat = repeat;
+
+  // read the first shard's header for the schema
+  FILE* fp = fopen(L->files[0].c_str(), "rb");
+  if (!fp) return nullptr;
+  std::string err;
+  bool ok = read_header(fp, &L->slots, &err);
+  fclose(fp);
+  if (!ok) return nullptr;
+
+  Loader* raw = L.release();
+  raw->producer = std::thread(producer_main, raw);
+  return raw;
+}
+
+int ptio_nslots(void* h) {
+  return static_cast<int>(static_cast<Loader*>(h)->slots.size());
+}
+
+void ptio_slot(void* h, int i, uint32_t* kind, uint32_t* dim) {
+  auto* L = static_cast<Loader*>(h);
+  *kind = L->slots[i].kind;
+  *dim = L->slots[i].dim;
+}
+
+// Returns batch_size (>0), 0 for end-of-pass, -2 when the stream is
+// exhausted (repeat=0), -1 on error.  Buffers stay valid until the next
+// ptio_next / ptio_close call.
+long ptio_next(void* h, void** data, int32_t** lens, int32_t* maxlens) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_pop.wait(lk, [&] {
+    return !L->queue.empty() || L->done.load() || L->stop.load();
+  });
+  if (!L->error.empty()) return -1;
+  if (L->queue.empty()) return -2;  // producer finished
+  L->current = std::move(L->queue.front());
+  L->queue.pop_front();
+  L->cv_push.notify_one();
+  lk.unlock();
+
+  Batch* b = L->current.get();
+  if (b->batch_size == 0) return 0;  // end of pass
+  for (size_t s = 0; s < L->slots.size(); s++) {
+    const auto& d = L->slots[s];
+    if (d.kind == kDense || d.kind == kDenseSeq) {
+      data[s] = b->fdata[s].data();
+    } else {
+      data[s] = b->idata[s].data();
+    }
+    lens[s] = b->lens[s].empty() ? nullptr : b->lens[s].data();
+    maxlens[s] = b->maxlen[s];
+  }
+  return b->batch_size;
+}
+
+const char* ptio_error(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  std::lock_guard<std::mutex> lk(L->mu);
+  return L->error.c_str();
+}
+
+void ptio_close(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+"""ShardFeeder — DataFeeder-compatible batching from PTSH shards.
+
+Drop-in for data/feeder.DataFeeder when the data source is binary shards:
+uses the native C++ loader (io/native.py) when a toolchain is present —
+shuffle + padding + prefetch all happen off-GIL — and falls back to the
+pure-Python shard reader + make_batch otherwise.
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import os
+import random
+from typing import Iterator, Optional, Sequence
+
+from paddle_tpu.data.feeder import make_batch
+from paddle_tpu.data.provider import (
+    InputType, dense_vector, dense_vector_sequence, integer_value,
+    integer_value_sequence,
+)
+from paddle_tpu.io import native, shards
+from paddle_tpu.parameter.argument import Argument
+
+_CODE_TO_TYPE = {
+    shards.DENSE: dense_vector,
+    shards.INDEX: integer_value,
+    shards.DENSE_SEQ: dense_vector_sequence,
+    shards.INDEX_SEQ: integer_value_sequence,
+}
+
+
+def expand_files(spec: str) -> list[str]:
+    """A shard spec is a file-list file, a glob, or a directory."""
+    if os.path.isdir(spec):
+        return sorted(globmod.glob(os.path.join(spec, "*.ptsh")))
+    if os.path.isfile(spec) and not spec.endswith(".ptsh"):
+        with open(spec) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    hits = sorted(globmod.glob(spec))
+    return hits if hits else [spec]
+
+
+class ShardFeeder:
+    """Same batches()/prefetched_batches() contract as DataFeeder."""
+
+    def __init__(self, files_spec: str, input_names: Sequence[str],
+                 batch_size: int, shuffle: bool = True, seed: int = 1,
+                 drop_last: bool = True, pool_size: int = 4096,
+                 names: Optional[Sequence[str]] = None):
+        self.files = expand_files(files_spec)
+        assert self.files, f"no shard files match {files_spec!r}"
+        disk = shards.shard_types(self.files[0])
+        self.types: list[InputType] = [_CODE_TO_TYPE[k](d) for k, d in disk]
+        self.names = list(names) if names else list(input_names)
+        assert len(self.names) == len(self.types), (
+            f"{len(self.types)} shard slots but {len(self.names)} input names "
+            f"({self.names}); pass names= to match shard slot order")
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.pool_size = pool_size
+        self._loader: Optional[native.NativeShardLoader] = None
+
+    def batches(self) -> Iterator[dict[str, Argument]]:
+        if native.available():
+            if self._loader is None:
+                self._loader = native.NativeShardLoader(
+                    self.files, self.names, self.types, self.batch_size,
+                    shuffle=self.shuffle, pool_size=self.pool_size,
+                    seed=self.seed)
+            for batch in self._loader.one_pass():
+                b = next(iter(batch.values()))
+                n = (b.value if b.value is not None else b.ids).shape[0]
+                if n < self.batch_size and self.drop_last:
+                    continue
+                yield batch
+            return
+        # Python fallback: read + shuffle + pad in-process
+        samples = [s for p in self.files for s in shards.read_shard(p)]
+        if self.shuffle:
+            random.Random(self.seed).shuffle(samples)
+        for i in range(0, len(samples), self.batch_size):
+            chunk = samples[i:i + self.batch_size]
+            if len(chunk) < self.batch_size and self.drop_last:
+                continue
+            yield make_batch(chunk, self.types, self.names)
+
+    # the native loader already prefetches in its C++ thread
+    prefetched_batches = batches
+
+    def close(self) -> None:
+        if self._loader is not None:
+            self._loader.close()
+            self._loader = None

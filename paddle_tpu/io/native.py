@@ -1,0 +1,169 @@
+"""ctypes bindings for the native data-loader runtime (io/csrc/ptio.cc).
+
+Builds libptio.so with g++ on first use (cached next to the source, keyed
+by source mtime) and exposes `NativeShardLoader`, which yields padded
+batches as {layer_name: Argument} dicts — the same contract as
+data/feeder.make_batch, but with file IO, shuffling, and batch assembly
+running in a C++ background thread outside the GIL (ref equivalents:
+PyDataProvider2.cpp loadThread_, DataProvider.h DoubleBuffer).
+
+When no C++ toolchain is available, `available()` is False and callers
+fall back to the pure-Python shard reader (io/shards.read_shard).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.data.provider import InputType
+from paddle_tpu.io import shards as shard_fmt
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc", "ptio.cc")
+_LIB = os.path.join(os.path.dirname(__file__), "csrc", "libptio.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   _SRC, "-o", _LIB + ".tmp"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(_LIB + ".tmp", _LIB)
+            except (subprocess.CalledProcessError, FileNotFoundError,
+                    subprocess.TimeoutExpired) as e:
+                detail = getattr(e, "stderr", b"") or b""
+                log.warning("native loader build failed (%s); using Python "
+                            "fallback: %s", e, detail.decode()[:500])
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.ptio_open.restype = ctypes.c_void_p
+        lib.ptio_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.ptio_nslots.argtypes = [ctypes.c_void_p]
+        lib.ptio_nslots.restype = ctypes.c_int
+        lib.ptio_slot.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_uint32),
+                                  ctypes.POINTER(ctypes.c_uint32)]
+        lib.ptio_next.restype = ctypes.c_long
+        lib.ptio_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.ptio_error.argtypes = [ctypes.c_void_p]
+        lib.ptio_error.restype = ctypes.c_char_p
+        lib.ptio_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _build() is not None
+
+
+class NativeShardLoader:
+    """Batches from PTSH shards via the C++ runtime.
+
+    names/types define the Argument mapping (layer name + InputType per
+    slot, in shard slot order).  One `passes()` iteration = one epoch.
+    """
+
+    def __init__(self, files: Sequence[str], names: Sequence[str],
+                 types: Sequence[InputType], batch_size: int,
+                 shuffle: bool = True, pool_size: int = 4096,
+                 seed: int = 0, queue_depth: int = 4, pad_multiple: int = 8):
+        lib = _build()
+        assert lib is not None, "native loader unavailable (no g++?)"
+        self._lib = lib
+        self.names = list(names)
+        self.types = list(types)
+        self.files = list(files)
+        # validate schema against the shard header
+        disk = shard_fmt.shard_types(self.files[0])
+        want = [(shard_fmt.slot_code(t), t.dim) for t in self.types]
+        assert disk == want, f"shard schema {disk} != provider schema {want}"
+        arr = (ctypes.c_char_p * len(self.files))(
+            *[f.encode() for f in self.files])
+        self._h = lib.ptio_open(arr, len(self.files), batch_size,
+                                pool_size, int(shuffle), seed, queue_depth,
+                                pad_multiple, 1)
+        assert self._h, f"failed to open shards {self.files[:2]}..."
+        self._n = lib.ptio_nslots(self._h)
+        assert self._n == len(self.types)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ptio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def one_pass(self) -> Iterator[dict[str, Argument]]:
+        """Yield batches until the end-of-pass marker."""
+        n = self._n
+        data = (ctypes.c_void_p * n)()
+        lens = (ctypes.POINTER(ctypes.c_int32) * n)()
+        maxlens = (ctypes.c_int32 * n)()
+        while True:
+            got = self._lib.ptio_next(self._h, data, lens, maxlens)
+            if got == 0:
+                return  # end of pass
+            if got == -2:
+                return  # stream exhausted
+            if got < 0:
+                raise RuntimeError(
+                    f"native loader: {self._lib.ptio_error(self._h).decode()}")
+            B = int(got)
+            out: dict[str, Argument] = {}
+            for s, (name, t) in enumerate(zip(self.names, self.types)):
+                code = shard_fmt.slot_code(t)
+                T = int(maxlens[s])
+                if code == shard_fmt.DENSE:
+                    buf = np.ctypeslib.as_array(
+                        ctypes.cast(data[s], ctypes.POINTER(ctypes.c_float)),
+                        (B, t.dim))
+                    out[name] = Argument(value=buf.copy())
+                elif code == shard_fmt.INDEX:
+                    buf = np.ctypeslib.as_array(
+                        ctypes.cast(data[s], ctypes.POINTER(ctypes.c_int32)),
+                        (B,))
+                    out[name] = Argument(ids=buf.copy())
+                elif code == shard_fmt.DENSE_SEQ:
+                    buf = np.ctypeslib.as_array(
+                        ctypes.cast(data[s], ctypes.POINTER(ctypes.c_float)),
+                        (B, T, t.dim))
+                    ln = np.ctypeslib.as_array(lens[s], (B,))
+                    out[name] = Argument(value=buf.copy(), lengths=ln.copy())
+                else:
+                    buf = np.ctypeslib.as_array(
+                        ctypes.cast(data[s], ctypes.POINTER(ctypes.c_int32)),
+                        (B, T))
+                    ln = np.ctypeslib.as_array(lens[s], (B,))
+                    out[name] = Argument(ids=buf.copy(), lengths=ln.copy())
+            yield out

@@ -1,0 +1,151 @@
+"""PTSH binary shard format — writer and pure-Python reader.
+
+The on-disk format consumed by the native loader (io/csrc/ptio.cc); the
+TPU-native analog of the reference's binary proto data shards
+(ref: paddle/gserver/dataproviders/ProtoDataProvider.cpp, proto/DataFormat
+.proto.m4).  The writer converts any @provider sample stream into shards
+once, after which training reads them GIL-free through the C++ runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from paddle_tpu.data.provider import DataProviderWrapper, InputType, SeqType, SlotKind
+
+MAGIC = b"PTSH"
+VERSION = 1
+
+# slot kind codes shared with ptio.cc
+DENSE, INDEX, DENSE_SEQ, INDEX_SEQ = 0, 1, 2, 3
+
+
+def slot_code(t: InputType) -> int:
+    if t.seq_type == SeqType.NO_SEQUENCE:
+        if t.kind == SlotKind.DENSE:
+            return DENSE
+        if t.kind == SlotKind.INDEX:
+            return INDEX
+    else:
+        if t.kind == SlotKind.DENSE:
+            return DENSE_SEQ
+        if t.kind == SlotKind.INDEX:
+            return INDEX_SEQ
+    raise ValueError(
+        f"shard format v1 supports dense/index slots (got {t.kind}/{t.seq_type}); "
+        "densify sparse slots or keep them on the Python provider path")
+
+
+class ShardWriter:
+    """Stream records into one shard file."""
+
+    def __init__(self, path: str, types: Sequence[InputType]):
+        self.types = list(types)
+        self.codes = [slot_code(t) for t in self.types]
+        self.fp = open(path, "wb")
+        self.fp.write(MAGIC)
+        self.fp.write(struct.pack("<II", VERSION, len(self.types)))
+        for code, t in zip(self.codes, self.types):
+            self.fp.write(struct.pack("<II", code, t.dim))
+        self.n = 0
+
+    def write(self, sample: Sequence) -> None:
+        assert len(sample) == len(self.types), "slot count mismatch"
+        for val, code, t in zip(sample, self.codes, self.types):
+            if code == DENSE:
+                arr = np.asarray(val, np.float32).reshape(t.dim)
+                self.fp.write(arr.tobytes())
+            elif code == INDEX:
+                self.fp.write(struct.pack("<i", int(val)))
+            elif code == DENSE_SEQ:
+                arr = np.asarray(val, np.float32).reshape(-1, t.dim)
+                self.fp.write(struct.pack("<I", arr.shape[0]))
+                self.fp.write(arr.tobytes())
+            else:  # INDEX_SEQ
+                arr = np.asarray(val, np.int32).reshape(-1)
+                self.fp.write(struct.pack("<I", arr.shape[0]))
+                self.fp.write(arr.tobytes())
+        self.n += 1
+
+    def close(self) -> None:
+        self.fp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_shards(samples: Iterable[Sequence], types: Sequence[InputType],
+                 out_dir: str, prefix: str = "data",
+                 shard_size: int = 65536) -> list[str]:
+    """Split a sample stream into shard files of <= shard_size records."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths: list[str] = []
+    writer = None
+    for sample in samples:
+        if writer is None or writer.n >= shard_size:
+            if writer is not None:
+                writer.close()
+            path = os.path.join(out_dir, f"{prefix}-{len(paths):05d}.ptsh")
+            paths.append(path)
+            writer = ShardWriter(path, types)
+        writer.write(sample)
+    if writer is not None:
+        writer.close()
+    return paths
+
+
+def write_shards_from_provider(provider: DataProviderWrapper,
+                               files: list[str], out_dir: str,
+                               shard_size: int = 65536) -> list[str]:
+    """Materialize a @provider's samples as shards (offline conversion —
+    the analog of the reference's cache-to-disk provider option)."""
+    return write_shards(provider.samples(files), provider.input_types,
+                        out_dir, shard_size=shard_size)
+
+
+def read_shard(path: str) -> Iterator[tuple]:
+    """Pure-Python shard reader — fallback oracle for the native loader."""
+    with open(path, "rb") as fp:
+        assert fp.read(4) == MAGIC, f"bad shard magic in {path}"
+        version, nslots = struct.unpack("<II", fp.read(8))
+        assert version == VERSION
+        slots = [struct.unpack("<II", fp.read(8)) for _ in range(nslots)]
+        while True:
+            head = fp.read(4)
+            if not head:
+                return
+            sample = []
+            for s, (code, dim) in enumerate(slots):
+                if s > 0:
+                    head = fp.read(4)
+                if code == DENSE:
+                    buf = head + fp.read(dim * 4 - 4)
+                    sample.append(np.frombuffer(buf, np.float32).copy())
+                elif code == INDEX:
+                    sample.append(struct.unpack("<i", head)[0])
+                elif code == DENSE_SEQ:
+                    (length,) = struct.unpack("<I", head)
+                    buf = fp.read(length * dim * 4)
+                    sample.append(
+                        np.frombuffer(buf, np.float32).reshape(length, dim).copy())
+                else:
+                    (length,) = struct.unpack("<I", head)
+                    buf = fp.read(length * 4)
+                    sample.append(np.frombuffer(buf, np.int32).copy())
+            yield tuple(sample)
+
+
+def shard_types(path: str) -> list[tuple[int, int]]:
+    """Read just the (kind, dim) schema of a shard file."""
+    with open(path, "rb") as fp:
+        assert fp.read(4) == MAGIC, f"bad shard magic in {path}"
+        version, nslots = struct.unpack("<II", fp.read(8))
+        assert version == VERSION
+        return [struct.unpack("<II", fp.read(8)) for _ in range(nslots)]

@@ -127,7 +127,17 @@ class Trainer:
         return test_step
 
     # -- data -------------------------------------------------------------
-    def _feeder(self, data_cfg: DataConfig, train: bool) -> DataFeeder:
+    def _feeder(self, data_cfg: DataConfig, train: bool):
+        if data_cfg.type == "ptsh":
+            # binary shards via the native C++ loader (io/feeder.py)
+            from paddle_tpu.io.feeder import ShardFeeder
+            kwargs = (json.loads(data_cfg.load_data_args)
+                      if data_cfg.load_data_args else {})
+            return ShardFeeder(
+                data_cfg.files, input_names=self.model.input_layer_names,
+                batch_size=self.opt.batch_size, seed=self.seed,
+                drop_last=train, shuffle=train,
+                names=kwargs.get("names"))
         prov, files = load_provider(data_cfg)
         return DataFeeder(
             prov, files, input_names=self.model.input_layer_names,

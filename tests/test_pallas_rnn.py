@@ -31,7 +31,6 @@ def test_lstm_fused_matches_scan(reverse, peep):
     rng = np.random.default_rng(0 if peep else 1)
     B, T, D = 4, 6, 8
     x4, w, lengths, peeps, h0, c0 = _lstm_case(rng, B, T, D, peep)
-    bias = jnp.concatenate([jnp.zeros(4 * D), peeps.reshape(-1)]) if peep else None
 
     def ref_loss(x4, w, peeps):
         bias = (jnp.concatenate([jnp.zeros(4 * D), peeps.reshape(-1)])
@@ -40,7 +39,6 @@ def test_lstm_fused_matches_scan(reverse, peep):
         return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl), (hs, hl, cl)
 
     def fused_loss(x4, w, peeps):
-        lens_f = None
         hs, hl, cl = pallas_rnn.lstm_fused(
             x4, lengths, w, peeps, h0, c0,
             active_type="tanh", gate_active_type="sigmoid",
