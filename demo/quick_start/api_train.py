@@ -1,0 +1,74 @@
+"""Programmatic training via the paddle_tpu.api layer — no DataProvider
+config, the script owns the data and the training loop
+(ref: demo/quick_start/api_train.py using swig_paddle + DataProviderConverter).
+
+Run: python demo/quick_start/api_train.py [--num_passes N]
+"""
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu import api  # noqa: E402
+from paddle_tpu.config.parser import parse_config  # noqa: E402
+from paddle_tpu.data.provider import (  # noqa: E402
+    integer_value, integer_value_sequence,
+)
+from qs_provider import VOCAB, _synthetic  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_passes", default=3, type=int)
+    parser.add_argument("--batch_size", default=64, type=int)
+    options = parser.parse_args()
+
+    api.initPaddle()
+
+    config_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "trainer_config.lstm.py")
+    trainer_config = parse_config(config_path, "")
+    # no data provider needed — this script feeds batches itself
+    trainer_config.data_config = None
+    trainer_config.test_data_config = None
+
+    model = api.GradientMachine.createFromConfigProto(
+        trainer_config.model_config)
+    trainer = api.Trainer.create(trainer_config, model)
+
+    converter = api.DataProviderConverter(
+        [integer_value_sequence(VOCAB), integer_value(2)],
+        names=["word", "label"])
+
+    train_dataset = list(_synthetic(2048, seed=0))
+    test_dataset = list(_synthetic(256, seed=1))
+    bs = options.batch_size
+
+    trainer.startTrain()
+    for pass_id in range(options.num_passes):
+        trainer.startTrainPass()
+        random.Random(pass_id).shuffle(train_dataset)
+        for pos in range(0, len(train_dataset) - bs + 1, bs):
+            batch = train_dataset[pos:pos + bs]
+            trainer.trainOneDataBatch(len(batch), converter(batch))
+        trainer.finishTrainPass()
+
+        trainer.startTestPeriod()
+        for pos in range(0, len(test_dataset) - bs + 1, bs):
+            batch = test_dataset[pos:pos + bs]
+            trainer.testOneDataBatch(len(batch), converter(batch))
+        test_cost = trainer.finishTestPeriod()
+        print(f"pass {pass_id}: train cost {trainer.getPassCost():.4f} "
+              f"test cost {test_cost:.4f}")
+    trainer.finishTrain()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,455 @@
+"""Programmatic training/inference API — the swig_paddle equivalent.
+
+Mirrors the reference's embedded-API surface (ref: paddle/api/PaddleAPI.h:
+93-712 — Matrix/Vector/IVector, Arguments, Parameter, ParameterOptimizer,
+GradientMachine, SequenceGenerator, Trainer; driven from Python via SWIG,
+ref: paddle/api/Paddle.swig, demo/quick_start/api_train.py,
+api/test/testTrain.py).
+
+TPU-native re-design: the framework is already Python+JAX, so no FFI layer
+is needed — these classes adapt the jitted GraphExecutor/ParameterUpdater
+machinery to the reference's imperative API shape.  Two deliberate
+semantic changes:
+  * forwardBackward returns the whole gradient pytree (autodiff) instead
+    of firing per-parameter UpdateCallbacks during backward — the XLA
+    scheduler overlaps what the callback pipeline used to overlap;
+  * ParameterOptimizer.update applies one whole-tree jitted update rather
+    than per-parameter buffer mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.config.schema import (
+    ModelConfig, OptimizationConfig, TrainerConfig,
+)
+from paddle_tpu.data.feeder import make_batch
+from paddle_tpu.data.provider import InputType
+from paddle_tpu.graph.builder import GraphExecutor
+from paddle_tpu.graph.context import TEST, TRAIN
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.utils.flags import FLAGS, parse_flags
+
+__all__ = [
+    "initPaddle", "Matrix", "Vector", "IVector", "Arguments", "Parameter",
+    "ParameterOptimizer", "GradientMachine", "SequenceGenerator", "Trainer",
+    "DataProviderConverter",
+]
+
+
+def initPaddle(*args: str) -> None:
+    """(ref: PaddleAPI.h initPaddle; TrainerMain initMain).  Accepts
+    --flag=value strings and merges them into the global flag registry."""
+    parse_flags(list(args))
+
+
+# ---------------------------------------------------------------------------
+# numpy-interop value wrappers (ref: PaddleAPI.h Matrix/Vector/IVector)
+# ---------------------------------------------------------------------------
+
+class Matrix:
+    """2-D float matrix (ref: PaddleAPI.h:93 Matrix; numpy interop via
+    copyToNumpyMat/createFromNumpyMat)."""
+
+    def __init__(self, data: np.ndarray):
+        self._d = np.asarray(data, np.float32)
+        assert self._d.ndim == 2
+
+    @staticmethod
+    def createZero(height: int, width: int) -> "Matrix":
+        return Matrix(np.zeros((height, width), np.float32))
+
+    @staticmethod
+    def createDense(data: Sequence[float], height: int, width: int) -> "Matrix":
+        return Matrix(np.asarray(data, np.float32).reshape(height, width))
+
+    @staticmethod
+    def createFromNumpyMat(arr: np.ndarray) -> "Matrix":
+        return Matrix(arr)
+
+    def copyToNumpyMat(self) -> np.ndarray:
+        return self._d.copy()
+
+    def toNumpyMatInplace(self) -> np.ndarray:
+        return self._d
+
+    def getHeight(self) -> int:
+        return self._d.shape[0]
+
+    def getWidth(self) -> int:
+        return self._d.shape[1]
+
+    def get(self, i: int, j: int) -> float:
+        return float(self._d[i, j])
+
+    def set(self, i: int, j: int, v: float) -> None:
+        self._d[i, j] = v
+
+
+class Vector:
+    """1-D float vector (ref: PaddleAPI.h Vector)."""
+
+    def __init__(self, data: np.ndarray):
+        self._d = np.asarray(data, np.float32).reshape(-1)
+
+    @staticmethod
+    def create(data: Sequence[float]) -> "Vector":
+        return Vector(np.asarray(data, np.float32))
+
+    @staticmethod
+    def createZero(size: int) -> "Vector":
+        return Vector(np.zeros(size, np.float32))
+
+    @staticmethod
+    def createFromNumpyArray(arr: np.ndarray) -> "Vector":
+        return Vector(arr)
+
+    def toNumpyArrayInplace(self) -> np.ndarray:
+        return self._d
+
+    def copyToNumpyArray(self) -> np.ndarray:
+        return self._d.copy()
+
+    def getSize(self) -> int:
+        return self._d.size
+
+    def __len__(self) -> int:
+        return self._d.size
+
+
+class IVector:
+    """1-D int vector (ref: PaddleAPI.h IVector)."""
+
+    def __init__(self, data: np.ndarray):
+        self._d = np.asarray(data, np.int32).reshape(-1)
+
+    @staticmethod
+    def create(data: Sequence[int]) -> "IVector":
+        return IVector(np.asarray(data, np.int32))
+
+    @staticmethod
+    def createZero(size: int) -> "IVector":
+        return IVector(np.zeros(size, np.int32))
+
+    @staticmethod
+    def createFromNumpyArray(arr: np.ndarray) -> "IVector":
+        return IVector(arr)
+
+    def toNumpyArrayInplace(self) -> np.ndarray:
+        return self._d
+
+    def copyToNumpyArray(self) -> np.ndarray:
+        return self._d.copy()
+
+    def getSize(self) -> int:
+        return self._d.size
+
+    def __len__(self) -> int:
+        return self._d.size
+
+
+class Arguments:
+    """Ordered slot collection, convertible to the executor's feed dict
+    (ref: PaddleAPI.h Arguments: setSlotValue/getSlotValue/setSlotIds/
+    sequenceStartPositions; here a slot is one Argument)."""
+
+    def __init__(self, slots: Optional[list[Argument]] = None,
+                 names: Optional[list[str]] = None):
+        self.slots: list[Argument] = slots or []
+        self.names: Optional[list[str]] = names
+
+    @staticmethod
+    def createArguments(size: int) -> "Arguments":
+        return Arguments([Argument() for _ in range(size)])
+
+    def getSlotNum(self) -> int:
+        return len(self.slots)
+
+    def resize(self, size: int) -> None:
+        while len(self.slots) < size:
+            self.slots.append(Argument())
+        del self.slots[size:]
+
+    def setSlotValue(self, idx: int, mat: Matrix) -> None:
+        self.slots[idx] = self.slots[idx].replace(value=mat.toNumpyMatInplace())
+
+    def setSlotIds(self, idx: int, ids: IVector) -> None:
+        self.slots[idx] = self.slots[idx].replace(ids=ids.toNumpyArrayInplace())
+
+    def setSlotSequenceStartPositions(self, idx: int, lengths: IVector) -> None:
+        """Padded-dense re-design: per-sequence lengths, not start offsets."""
+        self.slots[idx] = self.slots[idx].replace(
+            lengths=lengths.toNumpyArrayInplace())
+
+    def getSlotValue(self, idx: int) -> Matrix:
+        v = np.asarray(self.slots[idx].value)
+        return Matrix(v.reshape(v.shape[0], -1))
+
+    def getSlotIds(self, idx: int) -> IVector:
+        return IVector(np.asarray(self.slots[idx].ids).reshape(-1))
+
+    def toFeed(self, input_names: Sequence[str]) -> dict[str, Argument]:
+        names = self.names or list(input_names)[: len(self.slots)]
+        return dict(zip(names, self.slots))
+
+
+class DataProviderConverter:
+    """samples -> Arguments (ref: py_paddle/dataprovider_converter.py)."""
+
+    def __init__(self, input_types: Sequence[InputType],
+                 names: Optional[Sequence[str]] = None):
+        self.types = list(input_types)
+        self.names = list(names) if names else None
+
+    def __call__(self, samples: Sequence) -> Arguments:
+        samples = list(samples)
+        names = self.names or [f"slot{i}" for i in range(len(self.types))]
+        batch = make_batch(samples, self.types, names)
+        return Arguments([batch[n] for n in names], names=self.names)
+
+
+# ---------------------------------------------------------------------------
+# parameters & optimizer
+# ---------------------------------------------------------------------------
+
+class Parameter:
+    """Handle to one named parameter inside a GradientMachine
+    (ref: PaddleAPI.h Parameter: getName/getBuf/getConfig/getID)."""
+
+    def __init__(self, machine: "GradientMachine", name: str, pid: int):
+        self._m = machine
+        self._name = name
+        self._id = pid
+
+    def getName(self) -> str:
+        return self._name
+
+    def getID(self) -> int:
+        return self._id
+
+    def getSize(self) -> int:
+        return int(np.prod(self._m.params[self._name].shape))
+
+    def getShape(self) -> tuple:
+        return tuple(self._m.params[self._name].shape)
+
+    def getValue(self) -> np.ndarray:
+        return np.asarray(self._m.params[self._name])
+
+    def setValue(self, arr: np.ndarray) -> None:
+        cur = self._m.params[self._name]
+        self._m.params[self._name] = jnp.asarray(
+            np.asarray(arr, np.float32).reshape(cur.shape))
+
+    def getConfig(self):
+        return self._m.model.parameter(self._name)
+
+
+class ParameterOptimizer:
+    """Whole-tree optimizer handle (ref: PaddleAPI.h ParameterOptimizer,
+    api/test/testTrain.py init_optimizers/update usage)."""
+
+    def __init__(self, opt_config: OptimizationConfig, model: ModelConfig):
+        from paddle_tpu.optim.updater import ParameterUpdater
+        self._updater = ParameterUpdater(model, opt_config)
+        self._state = None
+        self._step = None
+
+    @staticmethod
+    def create(opt_config: OptimizationConfig,
+               model: ModelConfig) -> "ParameterOptimizer":
+        return ParameterOptimizer(opt_config, model)
+
+    def init(self, params: dict[str, jax.Array]) -> None:
+        self._state = self._updater.init_state(params)
+
+    def startPass(self) -> None:
+        if self._state is not None:
+            self._state = self._updater.start_pass(self._state)
+
+    def finishPass(self) -> None:
+        if self._state is not None:
+            self._state = self._updater.finish_pass(self._state)
+
+    def update(self, params: dict, grads: dict, batch_size: int = 1) -> dict:
+        """Apply one optimizer step; returns the new params."""
+        assert self._state is not None, "call init() first"
+        if self._step is None:
+            self._step = jax.jit(self._updater.step,
+                                 static_argnames=("batch_size",))
+        new_params, self._state = self._step(params, grads, self._state,
+                                             batch_size=batch_size)
+        return new_params
+
+
+# ---------------------------------------------------------------------------
+# gradient machine
+# ---------------------------------------------------------------------------
+
+class GradientMachine:
+    """forward/backward executor over one ModelConfig
+    (ref: PaddleAPI.h GradientMachine:460-560, GradientMachine.cpp)."""
+
+    def __init__(self, model: ModelConfig, seed: int = 1):
+        self.model = model
+        self.executor = GraphExecutor(model)
+        self.params: dict[str, jax.Array] = {}
+        self.net_state = self.executor.init_state()
+        self._rng = jax.random.PRNGKey(seed)
+        self._fwd = None
+        self._fwdbwd = None
+        self.randParameters(seed)
+
+    @staticmethod
+    def createFromConfigProto(model: ModelConfig, seed: int = 1) -> "GradientMachine":
+        return GradientMachine(model, seed)
+
+    def randParameters(self, seed: int = 1) -> None:
+        self.params = self.executor.init_params(jax.random.PRNGKey(seed))
+
+    def getParameters(self) -> list[Parameter]:
+        return [Parameter(self, name, i)
+                for i, name in enumerate(sorted(self.params))]
+
+    def getParameter(self, name: str) -> Parameter:
+        names = sorted(self.params)
+        return Parameter(self, name, names.index(name))
+
+    def _feed(self, inArgs) -> dict[str, Argument]:
+        if isinstance(inArgs, dict):
+            return inArgs
+        return inArgs.toFeed(self.model.input_layer_names)
+
+    def forward(self, inArgs, passType: str = TEST) -> dict[str, Argument]:
+        """Returns all layer outputs by name (ref: forward + getLayerOutput)."""
+        if self._fwd is None:
+            self._fwd = jax.jit(
+                lambda p, f, s, r: self.executor.forward(p, f, s, mode=TEST, rng=r))
+        self._rng, sub = jax.random.split(self._rng)
+        outs, _, _ = self._fwd(self.params, self._feed(inArgs),
+                               self.net_state, sub)
+        return outs
+
+    def forwardTest(self, inArgs) -> dict[str, Argument]:
+        return self.forward(inArgs, TEST)
+
+    def forwardBackward(self, inArgs,
+                        callback: Optional[Callable] = None):
+        """Returns (mean cost, gradient pytree); optionally fires
+        callback(name, grad) per parameter afterwards — the sequential
+        analog of the reference's pipelined UpdateCallback."""
+        if self._fwdbwd is None:
+            def _f(p, f, s, r):
+                (loss, _), grads = jax.value_and_grad(
+                    self.executor.loss, has_aux=True)(p, f, s, TRAIN, r)
+                return loss, grads
+            self._fwdbwd = jax.jit(_f)
+        self._rng, sub = jax.random.split(self._rng)
+        loss, grads = self._fwdbwd(self.params, self._feed(inArgs),
+                                   self.net_state, sub)
+        if callback is not None:
+            for name in sorted(grads):
+                callback(name, grads[name])
+        return float(loss), grads
+
+    def getLayerOutput(self, name: str, inArgs) -> Argument:
+        return self.forward(inArgs)[name]
+
+    # -- persistence (ref: GradientMachine::saveParameters/loadParameters) --
+    def saveParameters(self, directory: str) -> None:
+        from paddle_tpu.trainer import checkpoint as ckpt
+        ckpt.save_checkpoint(directory, 0, jax.device_get(self.params),
+                             None, self.net_state,
+                             config_json=self.model.to_json())
+
+    def loadParameters(self, path: str) -> None:
+        from paddle_tpu.trainer import checkpoint as ckpt
+        data = ckpt.load_checkpoint(path)
+        for name in self.params:
+            assert name in data["params"], f"missing parameter {name!r}"
+            self.params[name] = jnp.asarray(data["params"][name])
+
+
+class SequenceGenerator:
+    """Beam-search generation handle (ref: PaddleAPI.h SequenceGenerator;
+    RecurrentGradientMachine::generateSequence)."""
+
+    def __init__(self, machine: GradientMachine, beam_size: Optional[int] = None,
+                 max_length: Optional[int] = None):
+        self._m = machine
+        self._beam = beam_size
+        self._maxlen = max_length
+
+    def generate(self, inArgs):
+        """Returns (ids [B, K, L], scores [B, K]) — beams best-first."""
+        from paddle_tpu.graph.generator import generate
+        feed = self._m._feed(inArgs)
+        self._m._rng, sub = jax.random.split(self._m._rng)
+        return generate(self._m.executor, self._m.params, feed, rng=sub,
+                        beam_size=self._beam, max_length=self._maxlen)
+
+
+class Trainer:
+    """Imperative train/test driver over the high-level trainer
+    (ref: PaddleAPI.h Trainer:640-712; api_train.py usage)."""
+
+    def __init__(self, config: TrainerConfig, machine: Optional[GradientMachine] = None,
+                 seed: int = 1):
+        from paddle_tpu.trainer.trainer import Trainer as _Trainer
+        self._t = _Trainer(config, seed=seed)
+        if machine is not None:
+            self._t.params = machine.params
+        self._machine = machine
+        self._pass_costs: list[float] = []
+
+    @staticmethod
+    def create(config: TrainerConfig,
+               machine: Optional[GradientMachine] = None) -> "Trainer":
+        return Trainer(config, machine)
+
+    def startTrain(self) -> None:
+        pass
+
+    def finishTrain(self) -> None:
+        if self._machine is not None:
+            self._machine.params = self._t.params
+
+    def startTrainPass(self) -> None:
+        self._pass_costs = []
+
+    def finishTrainPass(self) -> None:
+        if self._machine is not None:
+            self._machine.params = self._t.params
+
+    def trainOneDataBatch(self, size: int, inArgs) -> float:
+        feed = (inArgs if isinstance(inArgs, dict)
+                else inArgs.toFeed(self._t.model.input_layer_names))
+        cost = self._t.train_one_batch(feed)
+        self._pass_costs.append(cost)
+        return cost
+
+    def startTestPeriod(self) -> None:
+        self._test_costs: list[float] = []
+
+    def testOneDataBatch(self, size: int, inArgs) -> float:
+        feed = (inArgs if isinstance(inArgs, dict)
+                else inArgs.toFeed(self._t.model.input_layer_names))
+        if not hasattr(self, "_eval_fn"):
+            ex = self._t.executor
+            self._eval_fn = jax.jit(
+                lambda p, f, s, r: ex.loss(p, f, s, TEST, r)[0])
+        self._t.rng, sub = jax.random.split(self._t.rng)
+        loss = self._eval_fn(self._t.params, feed, self._t.net_state, sub)
+        self._test_costs.append(float(loss))
+        return float(loss)
+
+    def finishTestPeriod(self) -> float:
+        return float(np.mean(self._test_costs)) if self._test_costs else 0.0
+
+    def getPassCost(self) -> float:
+        return float(np.mean(self._pass_costs)) if self._pass_costs else 0.0

@@ -1,0 +1,133 @@
+"""Programmatic API tests (mirror of the reference's swig API tests —
+ref: paddle/api/test/{testMatrix,testVector,testArguments,
+testGradientMachine,testTrain,testTrainer}.py)."""
+
+import numpy as np
+
+from paddle_tpu import api
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.data.provider import dense_vector, integer_value
+
+
+def _config():
+    from paddle_tpu import dsl
+
+    def conf():
+        dsl.settings(batch_size=16, learning_rate=0.3,
+                     learning_method=dsl.MomentumOptimizer(momentum=0.9))
+        x = dsl.data_layer(name="x", size=8)
+        h = dsl.fc_layer(input=x, size=16, act=dsl.TanhActivation())
+        out = dsl.fc_layer(input=h, size=2, act=dsl.SoftmaxActivation())
+        dsl.classification_cost(input=out, label=dsl.data_layer(name="y", size=2))
+    return parse_config_callable(conf)
+
+
+def _batches(n, bs=16, seed=0):
+    rng = np.random.default_rng(seed)
+    conv = api.DataProviderConverter(
+        [dense_vector(8), integer_value(2)], names=["x", "y"])
+    out = []
+    for _ in range(n):
+        xs = rng.standard_normal((bs, 8)).astype(np.float32)
+        ys = (xs.sum(1) > 0).astype(np.int32)
+        out.append(conv(list(zip(xs, ys))))
+    return out
+
+
+def test_matrix_vector_roundtrip():
+    m = api.Matrix.createDense([1, 2, 3, 4, 5, 6], 2, 3)
+    assert m.getHeight() == 2 and m.getWidth() == 3
+    assert m.get(1, 2) == 6.0
+    m.set(0, 0, 9.0)
+    np.testing.assert_allclose(m.copyToNumpyMat()[0, 0], 9.0)
+
+    v = api.Vector.create([1.5, 2.5])
+    assert v.getSize() == 2
+    iv = api.IVector.create([3, 4, 5])
+    assert iv.copyToNumpyArray().tolist() == [3, 4, 5]
+
+
+def test_arguments_slots():
+    args = api.Arguments.createArguments(2)
+    assert args.getSlotNum() == 2
+    args.setSlotValue(0, api.Matrix.createDense([0.0] * 8, 2, 4))
+    args.setSlotIds(1, api.IVector.create([1, 0]))
+    assert args.getSlotValue(0).getWidth() == 4
+    assert args.getSlotIds(1).getSize() == 2
+
+
+def test_gradient_machine_forward_backward():
+    cfg = _config()
+    m = api.GradientMachine.createFromConfigProto(cfg.model_config)
+    params = m.getParameters()
+    assert params and all(isinstance(p, api.Parameter) for p in params)
+    # parameter get/set round-trip
+    p0 = params[0]
+    val = p0.getValue()
+    p0.setValue(np.zeros_like(val))
+    assert np.all(params[0].getValue() == 0)
+    p0.setValue(val)
+
+    batch = _batches(1)[0]
+    outs = m.forwardTest(batch)
+    out_name = [n for n in outs if n.startswith("__fc_layer_1")]
+    assert out_name, list(outs)
+
+    loss, grads = m.forwardBackward(batch)
+    assert np.isfinite(loss)
+    assert set(grads) == set(m.params)
+
+
+def test_manual_training_loop_converges():
+    """The testTrain.py pattern: GradientMachine + ParameterOptimizer."""
+    cfg = _config()
+    m = api.GradientMachine.createFromConfigProto(cfg.model_config)
+    opt = api.ParameterOptimizer.create(cfg.opt_config, cfg.model_config)
+    opt.init(m.params)
+    batches = _batches(20)
+    costs = []
+    opt.startPass()
+    for b in batches:
+        loss, grads = m.forwardBackward(b)
+        m.params = opt.update(m.params, grads, batch_size=16)
+        costs.append(loss)
+    opt.finishPass()
+    assert costs[-1] < costs[0] * 0.8, (costs[0], costs[-1])
+
+
+def test_api_trainer_loop():
+    """The api_train.py pattern: api.Trainer driving passes."""
+    cfg = _config()
+    m = api.GradientMachine.createFromConfigProto(cfg.model_config)
+    tr = api.Trainer.create(cfg, m)
+    batches = _batches(10)
+    tr.startTrain()
+    pass_costs = []
+    for _ in range(3):
+        tr.startTrainPass()
+        for b in batches:
+            tr.trainOneDataBatch(16, b)
+        tr.finishTrainPass()
+        pass_costs.append(tr.getPassCost())
+    tr.startTestPeriod()
+    for b in _batches(3, seed=9):
+        tr.testOneDataBatch(16, b)
+    test_cost = tr.finishTestPeriod()
+    tr.finishTrain()
+    assert pass_costs[-1] < pass_costs[0]
+    assert np.isfinite(test_cost)
+    # machine received the trained params back
+    assert m.params is tr._t.params
+
+
+def test_machine_save_load(tmp_path):
+    cfg = _config()
+    m = api.GradientMachine.createFromConfigProto(cfg.model_config, seed=3)
+    m.saveParameters(str(tmp_path))
+    m2 = api.GradientMachine.createFromConfigProto(cfg.model_config, seed=9)
+    import os
+    sub = [os.path.join(str(tmp_path), d) for d in os.listdir(str(tmp_path))]
+    m2.loadParameters(sub[0])
+    for name in m.params:
+        np.testing.assert_array_equal(np.asarray(m.params[name]),
+                                      np.asarray(m2.params[name]))
