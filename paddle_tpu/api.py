@@ -309,6 +309,18 @@ class GradientMachine:
     def createFromConfigProto(model: ModelConfig, seed: int = 1) -> "GradientMachine":
         return GradientMachine(model, seed)
 
+    @staticmethod
+    def createFromFile(path: str) -> "GradientMachine":
+        """Load a merged deploy bundle (tools/merge_model.py; ref:
+        GradientMachine::create(istream), GradientMachine.cpp:87-110)."""
+        from paddle_tpu.tools.merge_model import load_bundle
+        cfg, params = load_bundle(path)
+        m = GradientMachine(cfg.model_config)
+        for name in m.params:
+            assert name in params, f"bundle missing parameter {name!r}"
+            m.params[name] = jnp.asarray(params[name])
+        return m
+
     def randParameters(self, seed: int = 1) -> None:
         self.params = self.executor.init_params(jax.random.PRNGKey(seed))
 

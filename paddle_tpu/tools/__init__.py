@@ -1,0 +1,3 @@
+"""Deploy/inspection/conversion tools (ref: paddle_merge_model,
+python/paddle/utils/{dump_config,show_pb,make_model_diagram,plotcurve,
+image_util,preprocess_img,torch2paddle}.py)."""

@@ -1,0 +1,30 @@
+"""Parse a config file and dump the built TrainerConfig
+(ref: python/paddle/utils/dump_config.py — prints the protobuf text form;
+here the canonical serialization is JSON).
+
+CLI: python -m paddle_tpu.tools.dump_config CONFIG [CONFIG_ARGS]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("config")
+    p.add_argument("config_args", nargs="?", default="")
+    p.add_argument("--model_only", action="store_true",
+                   help="dump only the ModelConfig section")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.config.parser import parse_config
+    cfg = parse_config(args.config, args.config_args)
+    if args.model_only:
+        print(cfg.model_config.to_json(indent=2))
+    else:
+        print(cfg.to_json(indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Image preprocessing + train-time augmentation utilities
+(ref: python/paddle/utils/{image_util,preprocess_img}.py and the CUDA
+perturbation kernel cuda/src/hl_perturbation_util.cu — random crop /
+flip / rotate augmentation done on the host).
+
+All functions operate on numpy arrays in CHW float32 layout (the layout
+the conv layers consume after flattening) and are pure — batch-level
+augmentation composes with the native shard loader or any provider.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def to_chw(img: np.ndarray) -> np.ndarray:
+    """HWC uint8/float -> CHW float32."""
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return np.ascontiguousarray(img.transpose(2, 0, 1), np.float32)
+
+
+def center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    """CHW center crop."""
+    _, h, w = img.shape
+    top, left = (h - size) // 2, (w - size) // 2
+    return img[:, top:top + size, left:left + size]
+
+
+def random_crop(img: np.ndarray, size: int,
+                rng: np.random.Generator) -> np.ndarray:
+    _, h, w = img.shape
+    top = int(rng.integers(0, h - size + 1))
+    left = int(rng.integers(0, w - size + 1))
+    return img[:, top:top + size, left:left + size]
+
+
+def horizontal_flip(img: np.ndarray) -> np.ndarray:
+    return img[:, :, ::-1]
+
+
+def rotate_90k(img: np.ndarray, k: int) -> np.ndarray:
+    """Rotate by k*90 degrees (the perturbation kernel's cheap rotation)."""
+    return np.rot90(img, k, axes=(1, 2))
+
+
+def normalize(img: np.ndarray, mean: np.ndarray | float = 0.0,
+              scale: float = 1.0) -> np.ndarray:
+    """(img - mean) * scale; mean may be a per-channel CHW mean image."""
+    return (img - mean) * scale
+
+
+def augment(img: np.ndarray, crop_size: int, rng: np.random.Generator,
+            train: bool = True, mean: np.ndarray | float = 0.0,
+            scale: float = 1.0, flip: bool = True) -> np.ndarray:
+    """The standard train/test pipeline (ref: preprocess_img.py usage):
+    train = random crop + random flip; test = center crop."""
+    if train:
+        out = random_crop(img, crop_size, rng)
+        if flip and rng.random() < 0.5:
+            out = horizontal_flip(out)
+    else:
+        out = center_crop(img, crop_size)
+    return np.ascontiguousarray(normalize(out, mean, scale), np.float32)
+
+
+def compute_mean_image(imgs, shape: tuple[int, int, int]) -> np.ndarray:
+    """Mean CHW image over a sample iterable (ref: image_util meta file)."""
+    acc = np.zeros(shape, np.float64)
+    n = 0
+    for img in imgs:
+        acc += img
+        n += 1
+    assert n > 0, "no images"
+    return (acc / n).astype(np.float32)

@@ -1,0 +1,66 @@
+"""Emit a Graphviz dot diagram of a model config
+(ref: python/paddle/utils/make_model_diagram.py).
+
+CLI: python -m paddle_tpu.tools.make_model_diagram CONFIG [OUT.dot] [CONFIG_ARGS]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def model_to_dot(model) -> str:
+    """ModelConfig -> dot source; sub-model (recurrent group) layers are
+    clustered (the reference draws sub-graphs per submodel)."""
+    lines = ["digraph model {", "  rankdir=BT;",
+             '  node [shape=box, fontsize=10];']
+    in_group: set[str] = set()
+    for i, sm in enumerate(model.sub_models):
+        lines.append(f"  subgraph cluster_{i} {{")
+        lines.append(f'    label="{_esc(sm.name)}"; style=dashed;')
+        for name in sm.layer_names:
+            cfg = model.layer(name)
+            label = f"{cfg.name}\\n{cfg.type} [{cfg.size}]"
+            lines.append(f'    "{_esc(cfg.name)}" [label="{_esc(label)}"];')
+            in_group.add(name)
+        lines.append("  }")
+    for cfg in model.layers:
+        if cfg.name not in in_group:
+            label = f"{cfg.name}\\n{cfg.type} [{cfg.size}]"
+            shape = ", shape=ellipse" if cfg.type == "data" else ""
+            lines.append(f'  "{_esc(cfg.name)}" [label="{_esc(label)}"{shape}];')
+    for cfg in model.layers:
+        for inp in cfg.inputs:
+            attrs = ""
+            if inp.input_parameter_name:
+                attrs = f' [label="{_esc(inp.input_parameter_name)}", fontsize=8]'
+            lines.append(f'  "{_esc(inp.input_layer_name)}" -> '
+                         f'"{_esc(cfg.name)}"{attrs};')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("config")
+    p.add_argument("output", nargs="?", default=None)
+    p.add_argument("config_args", nargs="?", default="")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.config.parser import parse_config
+    cfg = parse_config(args.config, args.config_args)
+    dot = model_to_dot(cfg.model_config)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dot)
+        print(f"wrote {args.output}")
+    else:
+        print(dot)
+
+
+if __name__ == "__main__":
+    main()

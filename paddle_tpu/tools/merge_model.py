@@ -1,0 +1,71 @@
+"""Bundle a trained checkpoint + config into one deployable file
+(ref: paddle/trainer/MergeModel.cpp paddle_merge_model;
+GradientMachine::create(istream) reads the bundle back,
+GradientMachine.cpp:87-110).
+
+Bundle = single .npz whose entries are the flattened params plus a
+'__config__' JSON blob; loadable via load_bundle() or
+api.GradientMachine.createFromFile().
+
+CLI: python -m paddle_tpu.tools.merge_model --model_dir pass-00004 \\
+         [--config trainer_config.py] --output model.paddle_tpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def merge_model(model_dir: str, output: str,
+                config_path: str | None = None) -> str:
+    """model_dir: a pass-%05d checkpoint dir (trainer/checkpoint.py)."""
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    data = ckpt.load_checkpoint(model_dir)
+    entries = {f"params/{k}": np.asarray(v) for k, v in data["params"].items()}
+    if config_path is not None:
+        from paddle_tpu.config.parser import parse_config
+        cfg = parse_config(config_path, "")
+        config_json = cfg.to_json()
+    else:
+        config_json = data.get("config_json")
+        assert config_json, (
+            f"{model_dir} has no saved config; pass --config")
+    entries["__config__"] = np.frombuffer(
+        config_json.encode(), dtype=np.uint8)
+    np.savez(output, **entries)
+    if not output.endswith(".npz"):
+        # np.savez appends .npz; keep the requested name
+        os.replace(output + ".npz", output)
+    return output
+
+
+def load_bundle(path: str):
+    """Returns (TrainerConfig, {param_name: np.ndarray})."""
+    from paddle_tpu.config.schema import TrainerConfig
+
+    data = np.load(path, allow_pickle=False)
+    config_json = bytes(data["__config__"]).decode()
+    cfg = TrainerConfig.from_json(config_json)
+    params = {k[len("params/"):]: data[k] for k in data.files
+              if k.startswith("params/")}
+    return cfg, params
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_dir", required=True,
+                   help="pass-%%05d checkpoint directory")
+    p.add_argument("--config", default=None, help="config file to embed")
+    p.add_argument("--output", required=True)
+    args = p.parse_args(argv)
+    out = merge_model(args.model_dir, args.output, args.config)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Plot cost curves from trainer logs
+(ref: python/paddle/utils/plotcurve.py — reads 'cost=' lines from
+paddle_trainer output).
+
+Parses lines like
+  I 2026-... paddle_tpu.trainer] pass 3 batch 200: cost 0.1234 ...
+or any line containing 'cost <float>' / 'cost=<float>'.  Writes a PNG when
+matplotlib is importable, else renders an ASCII chart.
+
+CLI: python -m paddle_tpu.tools.plotcurve LOGFILE [OUT.png]
+     cat train.log | python -m paddle_tpu.tools.plotcurve - out.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+_PAT = re.compile(r"cost[ =]([0-9.eE+-]+)")
+
+
+def parse_costs(lines) -> list[float]:
+    out = []
+    for ln in lines:
+        m = _PAT.search(ln)
+        if m:
+            try:
+                out.append(float(m.group(1)))
+            except ValueError:
+                pass
+    return out
+
+
+def ascii_plot(ys: list[float], width: int = 72, height: int = 16) -> str:
+    if not ys:
+        return "(no cost lines found)"
+    lo, hi = min(ys), max(ys)
+    span = (hi - lo) or 1.0
+    # downsample to width columns
+    cols = []
+    n = len(ys)
+    for c in range(min(width, n)):
+        seg = ys[c * n // min(width, n):(c + 1) * n // min(width, n)] or [ys[-1]]
+        cols.append(sum(seg) / len(seg))
+    grid = [[" "] * len(cols) for _ in range(height)]
+    for c, v in enumerate(cols):
+        r = int((hi - v) / span * (height - 1))
+        grid[r][c] = "*"
+    lines = [f"{hi:10.4f} +" + "".join(grid[0])]
+    lines += ["           |" + "".join(row) for row in grid[1:-1]]
+    lines.append(f"{lo:10.4f} +" + "".join(grid[-1]))
+    lines.append(f"           {len(ys)} points, final {ys[-1]:.4f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("output", nargs="?", default=None)
+    args = p.parse_args(argv)
+
+    src = sys.stdin if args.logfile == "-" else open(args.logfile)
+    ys = parse_costs(src)
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        plt.figure(figsize=(8, 5))
+        plt.plot(ys)
+        plt.xlabel("log period")
+        plt.ylabel("cost")
+        plt.grid(True, alpha=0.3)
+        out = args.output or "cost_curve.png"
+        plt.savefig(out, dpi=120)
+        print(f"wrote {out}")
+    except ImportError:
+        print(ascii_plot(ys))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Inspect a checkpoint / merged bundle: config summary + parameter table
+(ref: python/paddle/utils/show_pb.py — prints a serialized proto).
+
+CLI: python -m paddle_tpu.tools.show_model PATH
+  PATH: a pass-%05d dir, a model.npz, or a merged bundle from merge_model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def show(path: str) -> None:
+    from paddle_tpu.config.schema import TrainerConfig
+    from paddle_tpu.tools.merge_model import load_bundle
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    cfg = None
+    if os.path.isfile(path) and not path.endswith("model.npz"):
+        cfg, params = load_bundle(path)
+    else:
+        data = ckpt.load_checkpoint(path)
+        params = data["params"]
+        if data.get("config_json"):
+            cfg = TrainerConfig.from_json(data["config_json"])
+
+    if cfg is not None and cfg.model_config is not None:
+        mc = cfg.model_config
+        print(f"model: {len(mc.layers)} layers, {len(mc.parameters)} parameters,"
+              f" {len(mc.sub_models)} sub-models")
+        for lc in mc.layers:
+            acts = f" act={lc.active_type}" if lc.active_type else ""
+            ins = ",".join(i.input_layer_name for i in lc.inputs)
+            print(f"  layer {lc.name:<32} {lc.type:<18} size={lc.size}{acts}"
+                  f"{'  <- ' + ins if ins else ''}")
+    total = 0
+    print("parameters:")
+    for name in sorted(params):
+        arr = np.asarray(params[name])
+        total += arr.size
+        print(f"  {name:<40} {str(arr.shape):<16} {arr.dtype}  "
+              f"|mean|={np.abs(arr).mean():.5f}")
+    print(f"total parameters: {total:,}")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path")
+    args = p.parse_args(argv)
+    show(args.path)
+
+
+if __name__ == "__main__":
+    main()

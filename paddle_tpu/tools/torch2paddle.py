@@ -1,0 +1,108 @@
+"""Import torch model weights into a paddle_tpu checkpoint
+(ref: python/paddle/utils/torch2paddle.py — converts legacy Torch7 nn
+model binaries into paddle parameter files; here: a torch state_dict /
+.pt file into a pass-%05d checkpoint loadable by Trainer/GradientMachine).
+
+Matching strategy: explicit name_map wins, else parameters are paired by
+shape in declaration order (torch Linear weights are [out, in] and are
+transposed to this framework's [in, out] layout).
+
+CLI: python -m paddle_tpu.tools.torch2paddle --config conf.py \\
+         --torch model.pt --output ckpt_dir
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+
+def convert_state_dict(state_dict, model_config,
+                       name_map: Optional[dict[str, str]] = None,
+                       transpose_linear: bool = True) -> dict[str, np.ndarray]:
+    """torch state_dict -> {paddle_tpu param name: np.ndarray}."""
+    import jax
+
+    from paddle_tpu.graph.builder import GraphExecutor
+
+    ex = GraphExecutor(model_config)
+    template = ex.init_params(jax.random.PRNGKey(0))
+    shapes = {k: tuple(v.shape) for k, v in template.items()}
+
+    torch_items = []
+    for k, v in state_dict.items():
+        arr = np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v,
+                         np.float32)
+        torch_items.append((k, arr))
+
+    out: dict[str, np.ndarray] = {}
+    used = set()
+    name_map = dict(name_map or {})
+    # explicit mappings first
+    for tname, pname in name_map.items():
+        arrs = dict(torch_items)
+        assert tname in arrs, f"torch key {tname!r} not found"
+        assert pname in shapes, f"param {pname!r} not in model"
+        out[pname] = _fit(arrs[tname], shapes[pname], transpose_linear)
+        used.add(tname)
+    # then shape-order pairing
+    remaining = [n for n in shapes if n not in out]
+    for tname, arr in torch_items:
+        if tname in used:
+            continue
+        for pname in remaining:
+            fitted = _try_fit(arr, shapes[pname], transpose_linear)
+            if fitted is not None:
+                out[pname] = fitted
+                remaining.remove(pname)
+                used.add(tname)
+                break
+    missing = [n for n in shapes if n not in out]
+    assert not missing, (
+        f"unmatched parameters {missing}; provide name_map entries")
+    return out
+
+
+def _try_fit(arr: np.ndarray, shape: tuple, transpose_linear: bool):
+    if tuple(arr.shape) == shape:
+        return arr
+    if transpose_linear and arr.ndim == 2 and tuple(arr.T.shape) == shape:
+        return np.ascontiguousarray(arr.T)
+    if arr.size == int(np.prod(shape)) and arr.ndim == 1:
+        return arr.reshape(shape)
+    return None
+
+
+def _fit(arr: np.ndarray, shape: tuple, transpose_linear: bool) -> np.ndarray:
+    fitted = _try_fit(arr, shape, transpose_linear)
+    assert fitted is not None, f"cannot fit {arr.shape} into {shape}"
+    return fitted
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True)
+    p.add_argument("--torch", required=True, dest="torch_path")
+    p.add_argument("--output", required=True)
+    p.add_argument("--config_args", default="")
+    args = p.parse_args(argv)
+
+    import torch
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    cfg = parse_config(args.config, args.config_args)
+    sd = torch.load(args.torch_path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    params = convert_state_dict(sd, cfg.model_config)
+    out = ckpt.save_checkpoint(args.output, 0, params,
+                               config_json=cfg.to_json())
+    print(f"wrote {out} ({len(params)} parameters)")
+
+
+if __name__ == "__main__":
+    main()

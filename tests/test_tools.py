@@ -1,0 +1,147 @@
+"""Tools suite tests: merge-model round trip, dot diagram, cost parsing,
+image augmentation, torch weight import (torch CPU is available in-image)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from paddle_tpu.config.parser import parse_config_callable
+
+
+def _config():
+    from paddle_tpu import dsl
+
+    def conf():
+        dsl.settings(batch_size=8, learning_rate=0.1)
+        x = dsl.data_layer(name="x", size=6)
+        h = dsl.fc_layer(input=x, size=5, act=dsl.TanhActivation(), name="hidden")
+        out = dsl.fc_layer(input=h, size=3, act=dsl.SoftmaxActivation(), name="out")
+        dsl.classification_cost(input=out, label=dsl.data_layer(name="y", size=3))
+    return parse_config_callable(conf)
+
+
+def test_merge_model_roundtrip(tmp_path):
+    import jax
+
+    from paddle_tpu.graph.builder import GraphExecutor
+    from paddle_tpu.tools.merge_model import load_bundle, merge_model
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    cfg = _config()
+    ex = GraphExecutor(cfg.model_config)
+    params = {k: np.asarray(v) for k, v in
+              ex.init_params(jax.random.PRNGKey(0)).items()}
+    d = ckpt.save_checkpoint(str(tmp_path / "ck"), 0, params,
+                             config_json=cfg.to_json())
+    bundle = str(tmp_path / "model.bundle")
+    merge_model(d, bundle)
+    cfg2, params2 = load_bundle(bundle)
+    assert cfg2.model_config.layer("hidden").size == 5
+    assert set(params2) == set(params)
+    for k in params:
+        np.testing.assert_array_equal(params2[k], params[k])
+
+
+def test_model_diagram():
+    from paddle_tpu.tools.make_model_diagram import model_to_dot
+
+    cfg = _config()
+    dot = model_to_dot(cfg.model_config)
+    assert dot.startswith("digraph")
+    assert '"hidden"' in dot and '"out"' in dot
+    assert '"hidden" -> "out"' in dot
+
+
+def test_plotcurve_parsing():
+    from paddle_tpu.tools.plotcurve import ascii_plot, parse_costs
+
+    lines = [
+        "I 0701 paddle_tpu.trainer] pass 0 batch 10: cost 1.5 err 0.4",
+        "noise line",
+        "I 0701 paddle_tpu.trainer] pass 0 batch 20: cost 0.75 err 0.2",
+    ]
+    ys = parse_costs(lines)
+    assert ys == [1.5, 0.75]
+    art = ascii_plot(ys)
+    assert "final 0.7500" in art
+
+
+def test_image_augmentation():
+    from paddle_tpu.tools import image_util as iu
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+    chw = iu.to_chw(img)
+    assert chw.shape == (3, 32, 32)
+    c = iu.center_crop(chw, 28)
+    assert c.shape == (3, 28, 28)
+    np.testing.assert_array_equal(c, chw[:, 2:30, 2:30])
+    r = iu.random_crop(chw, 28, rng)
+    assert r.shape == (3, 28, 28)
+    f = iu.horizontal_flip(c)
+    np.testing.assert_array_equal(f[:, :, 0], c[:, :, -1])
+    a = iu.augment(chw, 28, rng, train=True, mean=127.5, scale=1 / 127.5)
+    assert a.shape == (3, 28, 28) and a.dtype == np.float32
+    assert np.abs(a).max() <= 1.0
+
+
+def test_torch2paddle_convert():
+    import torch
+
+    from paddle_tpu.tools.torch2paddle import convert_state_dict
+
+    cfg = _config()
+    # torch Linear mirror of the model: 6->5->3 with biases
+    net = torch.nn.Sequential(
+        torch.nn.Linear(6, 5), torch.nn.Tanh(),
+        torch.nn.Linear(5, 3))
+    params = convert_state_dict(net.state_dict(), cfg.model_config)
+    # every model parameter matched, linear weights transposed
+    w_hidden = [v for k, v in params.items() if v.shape == (6, 5)]
+    assert w_hidden, {k: v.shape for k, v in params.items()}
+    np.testing.assert_allclose(
+        w_hidden[0], net[0].weight.detach().numpy().T, rtol=1e-6)
+
+
+def test_dump_config_cli(tmp_path):
+    conf_file = tmp_path / "conf.py"
+    conf_file.write_text(
+        "from paddle_tpu.dsl import *\n"
+        "settings(batch_size=4, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "out = fc_layer(input=x, size=2, act=SoftmaxActivation(), name='out')\n"
+        "classification_cost(input=out, label=data_layer(name='y', size=2))\n")
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.dump_config", str(conf_file)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert '"out"' in r.stdout
+
+
+def test_bundle_into_gradient_machine(tmp_path):
+    import jax
+
+    from paddle_tpu import api
+    from paddle_tpu.tools.merge_model import merge_model
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    cfg = _config()
+    m = api.GradientMachine.createFromConfigProto(cfg.model_config, seed=5)
+    d = ckpt.save_checkpoint(str(tmp_path / "ck"), 0,
+                             {k: np.asarray(v) for k, v in m.params.items()},
+                             config_json=cfg.to_json())
+    bundle = str(tmp_path / "model.bundle")
+    merge_model(d, bundle)
+    m2 = api.GradientMachine.createFromFile(bundle)
+    for k in m.params:
+        np.testing.assert_array_equal(np.asarray(m.params[k]),
+                                      np.asarray(m2.params[k]))
+    # deployable: forward works
+    batch = {"x": __import__("paddle_tpu.parameter.argument",
+                             fromlist=["Argument"]).Argument(
+        value=np.zeros((2, 6), np.float32))}
+    outs = m2.forwardTest(batch)
+    assert "out" in outs
