@@ -45,7 +45,7 @@ __all__ = [
     "mdlstm_layer", "sub_seq_layer",
     "img_conv_layer", "img_pool_layer", "img_cmrnorm_layer", "batch_norm_layer",
     "bilinear_interp_layer", "block_expand_layer", "maxout_layer", "spp_layer",
-    "conv_shift_layer",
+    "conv_shift_layer", "multi_head_attention_layer",
     "maxid_layer", "sampling_id_layer", "eos_layer",
     "cos_sim", "cos_sim_vecmat", "trans_layer", "resize_layer",
     "slope_intercept_layer", "scaling_layer", "interpolation_layer",
@@ -917,6 +917,63 @@ def conv_shift_layer(a: LayerOutput, b: LayerOutput, name=None) -> LayerOutput:
     (ref: ConvShiftLayer.cpp)."""
     return _simple_layer("conv_shift", [a, b], a.size, name=name,
                          prefix="conv_shift")
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def multi_head_attention_layer(
+    query: LayerOutput,
+    key: Optional[LayerOutput] = None,
+    value: Optional[LayerOutput] = None,
+    *,
+    size: int,
+    num_heads: int,
+    causal: bool = False,
+    name: Optional[str] = None,
+    param_attr: Optional[Union[ParameterAttribute, list]] = None,
+    bias_attr=False,
+    layer_attr: Optional[ExtraLayerAttribute] = None,
+) -> LayerOutput:
+    """Multi-head scaled-dot-product attention over padded sequences — NEW
+    capability (the reference's closest analog is the additive-attention
+    composite simple_attention, ref: networks.py:1257).  Self-attention when
+    key/value are omitted.  Executes dense/blockwise/ring automatically
+    (graph/layers_attn.py); with a `seq` mesh axis the sequence is context-
+    parallel via ring attention (parallel/context.py).
+
+    param_attr: one attribute applied to all four projections (q/k/v/out), or
+    a list of four.  A single NAMED attribute would tie all projections to
+    one parameter, which is never what you want — pass a list instead."""
+    key = key if key is not None else query
+    value = value if value is not None else key
+    assert size % num_heads == 0, "size must divide evenly into heads"
+    if isinstance(param_attr, ParameterAttribute):
+        assert not param_attr.name, \
+            "a single named param_attr would share ONE matrix across the " \
+            "q/k/v/out projections; pass a list of 4 ParameterAttributes"
+        attrs = [param_attr] * 4
+    else:
+        attrs = list(param_attr) if param_attr else [None] * 4
+        assert len(attrs) == 4, "param_attr list must have 4 entries (q,k,v,out)"
+    name = _name(name, "mha_layer")
+    cfg = LayerConfig(name=name, type="multi_head_attention", size=size,
+                      active_type="")
+    cfg.attrs["num_heads"] = num_heads
+    cfg.attrs["causal"] = causal
+    for i, (inp, dim_in) in enumerate(
+            [(query, query.size), (key, key.size), (value, value.size),
+             (query, size)]):
+        pname = _make_param(name, i, [dim_in, size], attrs[i])
+        cfg.inputs.append(LayerInput(input_layer_name=inp.name,
+                                     input_parameter_name=pname))
+    cfg.bias_parameter_name = _bias_name(name, bias_attr, [1, size])
+    _layer_attr_fields(cfg, layer_attr)
+    current_context().add_layer(cfg)
+    return LayerOutput(name, "multi_head_attention", size,
+                       parents=[query, key, value],
+                       seq_level=query.seq_level)
 
 
 # ---------------------------------------------------------------------------

@@ -7,3 +7,4 @@ from paddle_tpu.graph import layers_cost  # noqa: F401
 from paddle_tpu.graph import layers_seq  # noqa: F401
 from paddle_tpu.graph import layers_conv  # noqa: F401
 from paddle_tpu.graph import layers_misc  # noqa: F401
+from paddle_tpu.graph import layers_attn  # noqa: F401

@@ -50,8 +50,9 @@ def _get_output_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 class GraphExecutor:
     """Builds and runs the layer graph described by a ModelConfig."""
 
-    def __init__(self, model: ModelConfig):
+    def __init__(self, model: ModelConfig, mesh=None):
         self.model = model
+        self.mesh = mesh  # enables parallel layer paths (ring attention)
         self.layer_map: dict[str, LayerConfig] = {l.name: l for l in model.layers}
         # layers belonging to a recurrent sub-model are executed by its scan
         self._sub_of: dict[str, SubModelConfig] = {}
@@ -110,7 +111,7 @@ class GraphExecutor:
                       for k, v in params.items()}
         ctx = ForwardContext(
             model=self.model, params=params, mode=mode, rng=rng,
-            state_in=state or {},
+            state_in=state or {}, mesh=self.mesh,
         )
         for name, arg in feed.items():
             ctx.outputs[name] = arg

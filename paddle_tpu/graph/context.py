@@ -36,6 +36,8 @@ class ForwardContext:
     state_out: dict[str, Any] = field(default_factory=dict)
     # accumulated per-sample costs from cost layers: name -> [B]
     costs: dict[str, jax.Array] = field(default_factory=dict)
+    # device mesh for layers with parallel execution paths (ring attention)
+    mesh: Optional[Any] = None
     _rng_counter: int = 0
 
     @property

@@ -1,0 +1,61 @@
+"""Multi-head scaled-dot-product attention layer.
+
+NEW capability beyond the reference (whose attention story is the additive
+`simple_attention` composite of fc/expand/sequence_softmax/scaling layers,
+ref: python/paddle/trainer_config_helpers/networks.py:1257) — first-class
+long-context attention with three execution paths picked automatically:
+
+  * dense   — one fused einsum-softmax-einsum (short sequences),
+  * blockwise — online-softmax over key blocks, O(T) memory (long sequences
+    on one device; ops/attention.py:blockwise_attention),
+  * ring    — context parallelism when the executor's mesh has a `seq` axis
+    of size > 1: each device holds a sequence shard and K/V rotate around
+    the ICI ring (parallel/context.py:ring_attention_sharded).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.config.schema import LayerConfig
+from paddle_tpu.graph.common import finish_layer
+from paddle_tpu.graph.context import ForwardContext
+from paddle_tpu.graph.registry import register_layer
+from paddle_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+    multi_head_attention,
+)
+from paddle_tpu.parameter.argument import Argument
+
+# beyond this many key positions, prefer the O(T)-memory blockwise kernel
+_BLOCKWISE_MIN_KEYS = 1024
+
+
+@register_layer("multi_head_attention")
+def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """inputs: [query, key, value, (query again carrying the out-proj param)];
+    attrs: num_heads, causal, block_k."""
+    q_arg, k_arg, v_arg = (ctx.get_input(cfg, i) for i in range(3))
+    w_q, w_k, w_v, w_o = (ctx.param_of(cfg, i) for i in range(4))
+    num_heads = int(cfg.attrs["num_heads"])
+    causal = bool(cfg.attrs.get("causal", False))
+
+    q_valid = q_arg.mask()
+    k_valid = k_arg.mask()
+
+    mesh = ctx.mesh
+    from paddle_tpu.parallel.context import ring_attn_fn, seq_axis_size
+    if mesh is not None and seq_axis_size(mesh) > 1:
+        attn_fn = ring_attn_fn(mesh)
+    elif k_arg.max_len >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
+        import functools
+        attn_fn = functools.partial(
+            blockwise_attention, block_k=int(cfg.attrs.get("block_k", 512)))
+    else:
+        attn_fn = dot_product_attention
+
+    out = multi_head_attention(
+        q_arg.value, k_arg.value, v_arg.value,
+        w_q, w_k, w_v, w_o, num_heads,
+        q_valid=q_valid, k_valid=k_valid, causal=causal,
+        bias_o=ctx.bias_of(cfg), attn_fn=attn_fn)
+    return finish_layer(ctx, cfg, out, like=q_arg)

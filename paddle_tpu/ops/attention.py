@@ -1,0 +1,239 @@
+"""Scaled-dot-product attention ops: dense, blockwise (online-softmax), and
+ring attention for sequence/context parallelism.
+
+This is a NEW capability beyond the reference (which predates transformer
+attention — its closest analog is the additive-attention composite
+`simple_attention`, ref: python/paddle/trainer_config_helpers/networks.py:1257,
+and the zero-padding sequence machinery of SURVEY.md §5 "long-context").
+The TPU framework makes long-context first-class:
+
+  * `dot_product_attention` — one fused XLA einsum-softmax-einsum; masking by
+    per-sequence lengths and/or causality.
+  * `blockwise_attention` — O(T) memory online-softmax accumulation over
+    key/value blocks (the flash-attention recurrence), written with
+    `lax.scan` so XLA keeps the running (m, l, o) accumulators in registers
+    /VMEM instead of materializing the [T, T] score matrix.
+  * `ring_attention` — context parallelism over a mesh axis: each device
+    holds a sequence shard; key/value shards rotate around the ring via
+    `lax.ppermute` while every device folds each incoming block into its
+    online-softmax accumulator.  One step of compute overlaps with the next
+    ppermute.  Equivalent math to the single-device versions, differentiable
+    end-to-end (ppermute has a transpose rule, so jax.grad produces the
+    reverse ring automatically).
+
+Layouts follow TPU conventions: q/k/v are [B, T, H, Dh] (batch, time, heads,
+head_dim); scores are [B, H, Tq, Tk] so the contractions are MXU-friendly
+einsums.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _score_mask(
+    q_pos: Array,            # [Tq] global positions of the query rows
+    k_pos: Array,            # [Tk] global positions of the key rows
+    q_valid: Optional[Array],   # [B, Tq] or None
+    k_valid: Optional[Array],   # [B, Tk] or None
+    causal: bool,
+) -> Optional[Array]:
+    """Combined validity mask broadcastable to [B, 1, Tq, Tk]; None = all valid."""
+    mask = None
+    if causal:
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]    # [1,1,Tq,Tk]
+    if k_valid is not None:
+        kv = k_valid[:, None, None, :]                           # [B,1,1,Tk]
+        mask = kv if mask is None else jnp.logical_and(mask, kv)
+    if q_valid is not None:
+        qv = q_valid[:, None, :, None]                           # [B,1,Tq,1]
+        mask = qv if mask is None else jnp.logical_and(mask, qv)
+    return mask
+
+
+def dot_product_attention(
+    q: Array, k: Array, v: Array,
+    q_valid: Optional[Array] = None,
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Array:
+    """Dense reference attention. q [B,Tq,H,D], k/v [B,Tk,H,D] -> [B,Tq,H,D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = _score_mask(jnp.arange(q.shape[1]), jnp.arange(k.shape[1]),
+                       q_valid, k_valid, causal)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if mask is not None:
+        # rows with no valid key (fully masked) must output exactly 0
+        any_valid = jnp.any(mask, axis=-1, keepdims=True)
+        p = jnp.where(any_valid, p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _online_block(
+    acc: tuple[Array, Array, Array],
+    q: Array, k_blk: Array, v_blk: Array,
+    q_pos: Array, k_pos: Array,
+    q_valid: Optional[Array], k_valid_blk: Optional[Array],
+    causal: bool, scale: float,
+) -> tuple[Array, Array, Array]:
+    """Fold one key/value block into the online-softmax accumulator.
+
+    acc = (o [B,Tq,H,D] f32, m [B,H,Tq] running max, l [B,H,Tq] running sum).
+    """
+    o, m, l = acc
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale       # [B,H,Tq,Tk]
+    mask = _score_mask(q_pos, k_pos, q_valid, k_valid_blk, causal)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)                            # kill -inf rows
+    corr = jnp.exp(m - m_new)                                  # [B,H,Tq]
+    l_new = corr * l + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(p.dtype))
+    o_new = o * jnp.moveaxis(corr, 1, 2)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def _finalize(o: Array, l: Array, dtype) -> Array:
+    """o / l with fully-masked rows (l == 0) -> 0."""
+    denom = jnp.moveaxis(l, 1, 2)[..., None]                   # [B,Tq,H,1]
+    return jnp.where(denom > 0, o / jnp.maximum(denom, 1e-30), 0.0).astype(dtype)
+
+
+def _init_acc(B: int, Tq: int, H: int, D: int) -> tuple[Array, Array, Array]:
+    return (jnp.zeros((B, Tq, H, D), jnp.float32),
+            jnp.full((B, H, Tq), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32))
+
+
+def blockwise_attention(
+    q: Array, k: Array, v: Array,
+    q_valid: Optional[Array] = None,
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_k: int = 512,
+) -> Array:
+    """Online-softmax attention over key blocks — O(Tq * block_k) score memory.
+
+    Same math as `dot_product_attention`; the scan carry holds (o, m, l) so
+    the full [Tq, Tk] score matrix never exists.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    block_k = min(block_k, Tk)
+    n_blocks = -(-Tk // block_k)
+    pad = n_blocks * block_k - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kv_pad = (jnp.arange(n_blocks * block_k) < Tk)[None, :]
+        k_valid = kv_pad if k_valid is None else \
+            jnp.logical_and(jnp.pad(k_valid, ((0, 0), (0, pad))), kv_pad)
+    q_pos = jnp.arange(Tq)
+    kb = jnp.moveaxis(k.reshape(B, n_blocks, block_k, H, D), 1, 0)
+    vb = jnp.moveaxis(v.reshape(B, n_blocks, block_k, H, D), 1, 0)
+    kvalb = (None if k_valid is None else
+             jnp.moveaxis(jnp.broadcast_to(
+                 k_valid, (B, n_blocks * block_k)).reshape(B, n_blocks, block_k), 1, 0))
+
+    def body(acc, xs):
+        i = xs["i"]
+        k_pos = i * block_k + jnp.arange(block_k)
+        acc = _online_block(acc, q, xs["k"], xs["v"], q_pos, k_pos,
+                            q_valid, xs.get("kv"), causal, scale)
+        return acc, None
+
+    xs = {"i": jnp.arange(n_blocks), "k": kb, "v": vb}
+    if kvalb is not None:
+        xs["kv"] = kvalb
+    (o, m, l), _ = lax.scan(body, _init_acc(B, Tq, H, D), xs)
+    return _finalize(o, l, q.dtype)
+
+
+def ring_attention(
+    q: Array, k: Array, v: Array,
+    axis_name: str,
+    q_valid: Optional[Array] = None,
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Array:
+    """Context-parallel attention for use INSIDE `shard_map` over `axis_name`.
+
+    Every device holds its local sequence shard q/k/v [B, T_local, H, D]
+    (shard d covers global positions [d*T_local, (d+1)*T_local)).  K/V shards
+    rotate one hop per step via `lax.ppermute` while each device folds the
+    incoming block into its online-softmax accumulator; after axis_size steps
+    every query row has attended to every key.  The python loop is unrolled
+    (axis_size is static) so XLA can overlap each ppermute with the previous
+    block's einsums — the collective rides ICI behind the MXU work.
+    """
+    B, Tl, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * Tl + jnp.arange(Tl)
+    acc = _init_acc(B, Tl, H, D)
+    k_blk, v_blk, kv_blk = k, v, k_valid
+    for step in range(n):
+        src = (idx - step) % n                      # owner of the current block
+        k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+        acc = _online_block(acc, q, k_blk, v_blk, q_pos, k_pos,
+                            q_valid, kv_blk, causal, scale)
+        if step + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            if kv_blk is not None:
+                kv_blk = lax.ppermute(kv_blk, axis_name, perm)
+    o, m, l = acc
+    return _finalize(o, l, q.dtype)
+
+
+def multi_head_attention(
+    query: Array,                     # [B, Tq, Dq]
+    key: Array,                       # [B, Tk, Dk]
+    value: Array,                     # [B, Tk, Dv]
+    w_q: Array, w_k: Array, w_v: Array, w_o: Array,
+    num_heads: int,
+    q_valid: Optional[Array] = None,
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    bias_o: Optional[Array] = None,
+    attn_fn=dot_product_attention,
+) -> Array:
+    """Projected multi-head attention; attn_fn pluggable (dense / blockwise /
+    a ring closure from parallel/context.py)."""
+    B, Tq, _ = query.shape
+    Tk = key.shape[1]
+    model_dim = w_q.shape[1]
+    Dh = model_dim // num_heads
+    q = (query @ w_q).reshape(B, Tq, num_heads, Dh)
+    k = (key @ w_k).reshape(B, Tk, num_heads, Dh)
+    v = (value @ w_v).reshape(B, Tk, num_heads, Dh)
+    o = attn_fn(q, k, v, q_valid=q_valid, k_valid=k_valid, causal=causal)
+    out = o.reshape(B, Tq, model_dim) @ w_o
+    if bias_o is not None:
+        out = out + bias_o
+    return out

@@ -1,0 +1,93 @@
+"""Sequence/context parallelism — shard the TOKEN axis over the mesh.
+
+First-class long-context support, going beyond the reference (whose longest-
+sequence story is zero-padding ragged batching + SequenceToBatch re-bucketing
+on ONE device — SURVEY.md §5 "long-context"; ref: paddle/gserver/layers/
+SequenceToBatch.h:20-40).  Here a sequence too long for one chip's HBM is
+split over the `seq` mesh axis and attention runs as a ring
+(ops/attention.py:ring_attention): K/V shards rotate via `lax.ppermute`
+around ICI neighbors while each device folds incoming blocks into an
+online-softmax accumulator — compute overlaps communication, and per-device
+memory is O(T / seq_parallelism).
+
+`ring_attention_sharded` is the mesh-level entry: it shard_maps the ring
+kernel with batch on `data` and time on `seq`, usable directly or through the
+`multi_head_attention` graph layer (graph/layers_attn.py) which picks the
+ring path automatically when the executor's mesh has a seq axis > 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from paddle_tpu.ops.attention import ring_attention
+from paddle_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, axis_size
+
+Array = jax.Array
+
+
+def seq_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the seq axis, 1 if absent/no mesh."""
+    return axis_size(mesh, SEQ_AXIS)
+
+
+def _data_axis(mesh: Mesh) -> Optional[str]:
+    return DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+
+
+def shard_sequence(mesh: Mesh, x: Array) -> Array:
+    """Place [B, T, ...] with batch on `data` and time on `seq`."""
+    spec = [_data_axis(mesh), SEQ_AXIS] + [None] * (x.ndim - 2)
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: Array, k: Array, v: Array,          # [B, T, H, Dh], T % seq_axis == 0
+    q_valid: Optional[Array] = None,       # [B, T]
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Array:
+    """Context-parallel attention over the mesh: batch sharded on `data`,
+    time sharded on `seq`, ring over the seq axis.  Works under an outer
+    jit — shard_map composes with the surrounding compiled step."""
+    d = _data_axis(mesh)
+    qkv_spec = P(d, SEQ_AXIS, None, None)
+    val_spec = P(d, SEQ_AXIS)
+
+    def local(q, k, v, q_valid, k_valid):
+        return ring_attention(q, k, v, SEQ_AXIS, q_valid=q_valid,
+                              k_valid=k_valid, causal=causal, scale=scale)
+
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    args = [q, k, v]
+    # shard_map needs every arg speced; thread optional masks only if present
+    for m in (q_valid, k_valid):
+        in_specs.append(val_spec if m is not None else P())
+        args.append(m if m is not None else jnp.zeros((), q.dtype))
+
+    def wrapped(q, k, v, qm, km):
+        qv = qm if q_valid is not None else None
+        kv = km if k_valid is not None else None
+        return local(q, k, v, qv, kv)
+
+    fn = shard_map(wrapped, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=qkv_spec)
+    return fn(*args)
+
+
+def ring_attn_fn(mesh: Mesh, causal_default: bool = False):
+    """An `attn_fn` for ops.attention.multi_head_attention that routes through
+    the sharded ring. Signature matches dot_product_attention."""
+    def fn(q, k, v, q_valid=None, k_valid=None, causal=causal_default,
+           scale=None):
+        return ring_attention_sharded(mesh, q, k, v, q_valid=q_valid,
+                                      k_valid=k_valid, causal=causal,
+                                      scale=scale)
+    return fn

@@ -7,9 +7,16 @@ collapse into one `jax.sharding.Mesh` whose axes name the parallelism kinds:
 
   data   — batch sharding (ref: MultiGradientMachine thread DP + pserver DP)
   model  — tensor/parameter sharding (ref: ParallelNeuralNetwork device=N)
+  seq    — sequence/context parallelism (ring attention; parallel/context.py)
+             — NEW capability, the reference handles long sequences on one
+             device only (SURVEY.md §5 long-context)
+  pipe   — pipeline parallelism over layer stages (parallel/pipeline.py)
+             — the scaled-out analog of ParallelNeuralNetwork's per-layer
+             device= placement
 
-Collectives ride ICI within a slice and DCN across slices; multi-host setup
-is jax.distributed instead of a pserver fleet.
+Axes of size 1 are omitted so sharding specs stay clean.  Collectives ride
+ICI within a slice and DCN across slices; multi-host setup is
+jax.distributed instead of a pserver fleet.
 """
 
 from __future__ import annotations
@@ -22,28 +29,56 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS)
 
 
-def make_mesh(data: int = 0, model: int = 1, devices=None) -> Mesh:
-    """Build a (data, model) mesh; data=0 means 'all remaining devices'."""
+def make_mesh(data: int = 0, model: int = 1, seq: int = 1, pipe: int = 1,
+              devices=None) -> Mesh:
+    """Build a mesh over (data, seq, pipe, model); data=0 = 'all remaining'.
+
+    Axis order puts `model` innermost (fastest-varying devices = closest ICI
+    neighbors — tensor-parallel collectives are the most latency-sensitive)
+    and `data` outermost, matching standard TPU practice."""
     devs = np.asarray(devices if devices is not None else jax.devices())
     n = devs.size
+    rest = model * seq * pipe
     if data <= 0:
-        assert n % model == 0, f"{n} devices not divisible by model={model}"
-        data = n // model
-    assert data * model == n, f"mesh {data}x{model} != {n} devices"
-    return Mesh(devs.reshape(data, model), (DATA_AXIS, MODEL_AXIS))
+        assert n % rest == 0, f"{n} devices not divisible by {rest}"
+        data = n // rest
+    sizes = {DATA_AXIS: data, SEQ_AXIS: seq, PIPE_AXIS: pipe, MODEL_AXIS: model}
+    total = data * rest
+    assert total == n, f"mesh {sizes} = {total} devices != {n} available"
+    # `data` is always present (shard_batch and friends spec it
+    # unconditionally); other axes are omitted when trivial
+    names = (DATA_AXIS,) + tuple(
+        a for a in AXIS_ORDER if a != DATA_AXIS and sizes[a] > 1)
+    shape = tuple(sizes[a] for a in names)
+    return Mesh(devs.reshape(shape), names)
+
+
+def axis_size(mesh: Optional[Mesh], axis: str) -> int:
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
 
 
 def mesh_from_flag(spec: str, devices=None) -> Optional[Mesh]:
-    """Parse 'data:8' / 'data:4,model:2' (the --mesh_shape flag)."""
+    """Parse 'data:8' / 'data:4,model:2' / 'data:2,seq:2,model:2'
+    (the --mesh_shape flag)."""
     if not spec:
         return None
-    sizes = {"data": 0, "model": 1}
+    sizes = {"data": 0, "model": 1, "seq": 1, "pipe": 1}
     for part in spec.split(","):
         name, _, num = part.partition(":")
-        sizes[name.strip()] = int(num)
-    return make_mesh(sizes["data"], sizes["model"], devices)
+        name = name.strip()
+        assert name in sizes, \
+            f"unknown mesh axis {name!r}; valid: {sorted(sizes)}"
+        sizes[name] = int(num)
+    return make_mesh(sizes["data"], sizes["model"], sizes["seq"],
+                     sizes["pipe"], devices)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

@@ -72,7 +72,7 @@ class Trainer:
         self.config = config
         self.model = config.model_config
         self.opt = config.opt_config
-        self.executor = GraphExecutor(self.model)
+        self.executor = GraphExecutor(self.model, mesh=mesh)
         self.updater = ParameterUpdater(self.model, self.opt)
         self.evaluators = EvaluatorSet(self.model)
         self.seed = seed
