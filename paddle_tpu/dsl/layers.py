@@ -931,6 +931,8 @@ def multi_head_attention_layer(
     size: int,
     num_heads: int,
     causal: bool = False,
+    block_k: Optional[int] = None,
+    block_k_min: Optional[int] = None,
     name: Optional[str] = None,
     param_attr: Optional[Union[ParameterAttribute, list]] = None,
     bias_attr=False,
@@ -962,6 +964,10 @@ def multi_head_attention_layer(
                       active_type="")
     cfg.attrs["num_heads"] = num_heads
     cfg.attrs["causal"] = causal
+    if block_k is not None:          # key-block size for the blockwise path
+        cfg.attrs["block_k"] = block_k
+    if block_k_min is not None:      # min key length to switch to blockwise
+        cfg.attrs["block_k_min"] = block_k_min
     for i, (inp, dim_in) in enumerate(
             [(query, query.size), (key, key.size), (value, value.size),
              (query, size)]):

@@ -14,9 +14,10 @@ collapse into one `jax.sharding.Mesh` whose axes name the parallelism kinds:
              — the scaled-out analog of ParallelNeuralNetwork's per-layer
              device= placement
 
-Axes of size 1 are omitted so sharding specs stay clean.  Collectives ride
-ICI within a slice and DCN across slices; multi-host setup is
-jax.distributed instead of a pserver fleet.
+All four axes are always present (size 1 when unused) so partition specs
+naming any of them stay valid on any mesh.  Collectives ride ICI within a
+slice and DCN across slices; multi-host setup is jax.distributed instead of
+a pserver fleet.
 """
 
 from __future__ import annotations
@@ -51,12 +52,10 @@ def make_mesh(data: int = 0, model: int = 1, seq: int = 1, pipe: int = 1,
     sizes = {DATA_AXIS: data, SEQ_AXIS: seq, PIPE_AXIS: pipe, MODEL_AXIS: model}
     total = data * rest
     assert total == n, f"mesh {sizes} = {total} devices != {n} available"
-    # `data` is always present (shard_batch and friends spec it
-    # unconditionally); other axes are omitted when trivial
-    names = (DATA_AXIS,) + tuple(
-        a for a in AXIS_ORDER if a != DATA_AXIS and sizes[a] > 1)
-    shape = tuple(sizes[a] for a in names)
-    return Mesh(devs.reshape(shape), names)
+    # every axis is always present — size-1 axes cost nothing and keep
+    # partition specs naming any canonical axis valid on any mesh
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    return Mesh(devs.reshape(shape), AXIS_ORDER)
 
 
 def axis_size(mesh: Optional[Mesh], axis: str) -> int:
