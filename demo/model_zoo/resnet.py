@@ -1,0 +1,114 @@
+"""ResNet 50/101/152 model-zoo config (ref: demo/model_zoo/resnet/resnet.py) —
+bottleneck blocks with conv+bn branches and addto shortcuts, rebuilt in the
+TPU DSL.  `layer_num` picks the depth; `image_size`/`num_classes` are
+config_args so the same config serves ImageNet-scale feature extraction and
+small smoke runs (the reference fixes 224x224/1000)."""
+
+from paddle_tpu.dsl import *
+
+is_predict = get_config_arg("is_predict", bool, False)
+layer_num = get_config_arg("layer_num", int, 50)
+image_size = get_config_arg("image_size", int, 224)
+num_classes = get_config_arg("num_classes", int, 1000)
+batch_size = get_config_arg("batch_size", int, 64)
+use_data = get_config_arg("use_data", bool, True)
+
+if use_data:
+    define_py_data_sources2(
+        train_list=None if is_predict else "demo/model_zoo/train.list",
+        test_list="demo/model_zoo/test.list",
+        module="demo.model_zoo.imagenet_provider",
+        obj="process",
+        args={"image_size": image_size, "num_classes": num_classes})
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.1 / batch_size,
+    learning_method=MomentumOptimizer(momentum=0.9),
+    regularization=L2Regularization(0.0001 * batch_size),
+    learning_rate_decay_a=0.5,
+    learning_rate_decay_b=1200000 * 10,
+    learning_rate_schedule="discexp")
+
+
+def conv_bn_layer(name, input, filter_size, num_filters, stride, padding,
+                  channels=None, active_type=None):
+    """conv (no act, no bias) + batch-norm carrying the activation
+    (ref: resnet.py conv_bn_layer)."""
+    tmp = img_conv_layer(
+        name=name + "_conv", input=input, filter_size=filter_size,
+        num_channels=channels, num_filters=num_filters, stride=stride,
+        padding=padding, act=LinearActivation(), bias_attr=False)
+    return batch_norm_layer(
+        name=name + "_bn", input=tmp,
+        act=active_type if active_type is not None else ReluActivation())
+
+
+def bottleneck_block(name, input, num_filters1, num_filters2):
+    """1x1 -> 3x3 -> 1x1 bottleneck; identity shortcut; relu after the add
+    (ref: resnet.py bottleneck_block)."""
+    last = conv_bn_layer(name + "_branch2a", input, 1, num_filters1, 1, 0)
+    last = conv_bn_layer(name + "_branch2b", last, 3, num_filters1, 1, 1)
+    last = conv_bn_layer(name + "_branch2c", last, 1, num_filters2, 1, 0,
+                         active_type=LinearActivation())
+    return addto_layer(name=name + "_addto", input=[input, last],
+                       act=ReluActivation())
+
+
+def mid_projection(name, input, num_filters1, num_filters2, stride=2):
+    """Stage-entry block: strided branch1 projection shortcut + bottleneck
+    branch2 (ref: resnet.py mid_projection)."""
+    branch1 = conv_bn_layer(name + "_branch1", input, 1, num_filters2,
+                            stride, 0, active_type=LinearActivation())
+    last = conv_bn_layer(name + "_branch2a", input, 1, num_filters1, stride, 0)
+    last = conv_bn_layer(name + "_branch2b", last, 3, num_filters1, 1, 1)
+    last = conv_bn_layer(name + "_branch2c", last, 1, num_filters2, 1, 0,
+                         active_type=LinearActivation())
+    return addto_layer(name=name + "_addto", input=[branch1, last],
+                       act=ReluActivation())
+
+
+def deep_res_net(res2_num=3, res3_num=4, res4_num=6, res5_num=3):
+    """(ref: resnet.py deep_res_net) — res{2..5}_num pick 50/101/152."""
+    img = data_layer(name="image", size=image_size * image_size * 3,
+                     height=image_size, width=image_size)
+    tmp = conv_bn_layer("res_conv1", img, 7, 64, 2, 3, channels=3)
+    tmp = img_pool_layer(name="pool1", input=tmp, pool_size=3, stride=2,
+                         pool_type=MaxPooling())
+
+    tmp = mid_projection("res2_1", tmp, 64, 256, stride=1)
+    for i in range(2, res2_num + 1):
+        tmp = bottleneck_block(f"res2_{i}", tmp, 64, 256)
+
+    tmp = mid_projection("res3_1", tmp, 128, 512)
+    for i in range(2, res3_num + 1):
+        tmp = bottleneck_block(f"res3_{i}", tmp, 128, 512)
+
+    tmp = mid_projection("res4_1", tmp, 256, 1024)
+    for i in range(2, res4_num + 1):
+        tmp = bottleneck_block(f"res4_{i}", tmp, 256, 1024)
+
+    tmp = mid_projection("res5_1", tmp, 512, 2048)
+    for i in range(2, res5_num + 1):
+        tmp = bottleneck_block(f"res5_{i}", tmp, 512, 2048)
+
+    tmp = img_pool_layer(name="pool5", input=tmp,
+                         pool_size=tmp.img_size, stride=1,
+                         pool_type=AvgPooling())
+    return fc_layer(name="output", input=tmp, size=num_classes,
+                    act=SoftmaxActivation())
+
+
+depth_cfg = {
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+assert layer_num in depth_cfg, f"layer_num must be one of {sorted(depth_cfg)}"
+predict = deep_res_net(*depth_cfg[layer_num])
+
+if not is_predict:
+    lbl = data_layer(name="label", size=num_classes)
+    classification_cost(input=predict, label=lbl)
+else:
+    outputs(predict)

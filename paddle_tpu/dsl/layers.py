@@ -439,8 +439,14 @@ def addto_layer(input: Sequence[LayerOutput], act=None, name=None,
                 bias_attr=False, layer_attr=None) -> LayerOutput:
     """(ref: AddtoLayer.cpp)."""
     inputs = [input] if isinstance(input, LayerOutput) else list(input)
-    return _simple_layer("addto", inputs, inputs[0].size, name=name, act=act,
-                         bias_attr=bias_attr, layer_attr=layer_attr)
+    out = _simple_layer("addto", inputs, inputs[0].size, name=name, act=act,
+                        bias_attr=bias_attr, layer_attr=layer_attr)
+    # elementwise add preserves image geometry (residual shortcuts feed
+    # pooling/conv downstream — ref: AddtoLayer keeps the input's frame size)
+    out.num_filters = inputs[0].num_filters
+    out.img_size = inputs[0].img_size
+    out.img_size_y = inputs[0].img_size_y
+    return out
 
 
 def concat_layer(input: Sequence[LayerOutput], act=None, name=None,

@@ -60,3 +60,37 @@ def test_srl_db_lstm_trains():
     losses = _train_few("demo/semantic_role_labeling/db_lstm.py", n_batches=3,
                         config_args="batch_size=8,depth=4,hidden_dim=32")
     assert np.isfinite(losses).all()
+
+
+def test_introduction_recovers_line():
+    """The linear-regression demo must recover y = 2x + 0.3
+    (ref: demo/introduction/README quality target)."""
+    cfg = parse_config("demo/introduction/trainer_config.py")
+    tr = Trainer(cfg, seed=0)
+    for _ in range(30):
+        tr.train_one_pass(log_period=0)
+    w = float(np.asarray(tr.params["w"]).reshape(-1)[0])
+    b = float(np.asarray(tr.params["b"]).reshape(-1)[0])
+    assert abs(w - 2.0) < 0.1 and abs(b - 0.3) < 0.1, (w, b)
+
+
+@pytest.mark.parametrize("layer_num,n_layers", [(50, 128), (101, 247), (152, 366)])
+def test_model_zoo_resnet_parses(layer_num, n_layers):
+    cfg = parse_config(
+        "demo/model_zoo/resnet.py",
+        f"layer_num={layer_num},image_size=32,num_classes=4,use_data=0")
+    assert len(cfg.model_config.layers) == n_layers
+
+
+def test_model_zoo_resnet50_trains():
+    losses = _train_few(
+        "demo/model_zoo/resnet.py", n_batches=2,
+        config_args="layer_num=50,image_size=32,num_classes=4,batch_size=8")
+    assert np.isfinite(losses).all()
+
+
+def test_model_zoo_classify_runs(capsys):
+    from demo.model_zoo.classify import main as classify_main
+    classify_main([])
+    out = capsys.readouterr().out
+    assert "sample 0: label=" in out
