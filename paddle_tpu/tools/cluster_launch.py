@@ -1,0 +1,103 @@
+"""Multi-host cluster launcher.
+
+TPU-native analog of the reference's fabric/SSH cluster starter
+(ref: paddle/scripts/cluster_train/paddle.py + conf.py: copies the
+workspace to every node, then starts paddle_pserver2 fleets and
+paddle_trainer processes with --trainer_id/--pservers wiring).
+
+Re-design: there is no pserver fleet — every host runs the SAME trainer
+command under jax.distributed, with process 0 as the coordinator
+(parallel/mesh.py:init_distributed).  XLA's collectives ride ICI within a
+slice and DCN across slices; the launcher only has to start N identical
+processes with {coordinator_address, num_processes, process_id} and any
+trainer flags passed through.
+
+Usage:
+  python -m paddle_tpu.tools.cluster_launch \\
+      --hosts host0,host1,host2,host3 --port 8476 \\
+      --workspace /path/on/hosts -- \\
+      --config=demo/image_classification/vgg_16_cifar.py --num_passes=10
+
+With --dry_run the ssh commands are printed instead of executed (also how
+the unit tests exercise this hermetically).
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+
+def build_commands(hosts: list[str], port: int, workspace: str,
+                   trainer_args: list[str], python: str = "python") -> list[list[str]]:
+    """One ssh command per host; host 0 doubles as the jax.distributed
+    coordinator (ref: conf.py HOSTS + --trainer_id assignment)."""
+    coordinator = f"{hosts[0]}:{port}"
+    cmds = []
+    for pid, host in enumerate(hosts):
+        inner = (
+            f"cd {shlex.quote(workspace)} && "
+            f"{python} -m paddle_tpu.trainer_main "
+            f"--coordinator_address={coordinator} "
+            f"--num_processes={len(hosts)} --process_id={pid} "
+            + " ".join(shlex.quote(a) for a in trainer_args)
+        )
+        cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, inner])
+    return cmds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="launch one trainer process per host under jax.distributed")
+    ap.add_argument("--hosts", required=True,
+                    help="comma-separated host list; first is coordinator")
+    ap.add_argument("--port", type=int, default=8476,
+                    help="coordinator port (ref: conf.py PADDLE_PORT)")
+    ap.add_argument("--workspace", default=".",
+                    help="working directory on every host")
+    ap.add_argument("--python", default="python")
+    ap.add_argument("--dry_run", action="store_true",
+                    help="print the ssh commands without running them")
+    args, trainer_args = ap.parse_known_args(argv)
+    if trainer_args and trainer_args[0] == "--":
+        trainer_args = trainer_args[1:]
+
+    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    cmds = build_commands(hosts, args.port, args.workspace, trainer_args,
+                          args.python)
+    if args.dry_run:
+        for c in cmds:
+            print(" ".join(shlex.quote(p) for p in c))
+        return 0
+
+    # jax.distributed.initialize is a barrier over all processes: if one host
+    # dies at startup the others would block forever, so kill the survivors
+    # as soon as any process exits nonzero
+    import time
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    try:
+        while procs:
+            for p in list(procs):
+                code = p.poll()
+                if code is None:
+                    continue
+                procs.remove(p)
+                if code != 0 and rc == 0:
+                    rc = code
+                    print(f"process exited with {code}; terminating peers",
+                          file=sys.stderr)
+                    for q in procs:
+                        q.terminate()
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for q in procs:
+            q.terminate()
+        raise
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

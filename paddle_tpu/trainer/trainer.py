@@ -155,6 +155,7 @@ class Trainer:
             from paddle_tpu.parallel.dp import shard_batch
             batch = shard_batch(self.mesh, batch)
         self.rng, sub = jax.random.split(self.rng)
+        self._last_rng = sub
         (self.params, self.opt_state, new_net, loss, partials, host_out) = \
             self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
         if new_net:
@@ -164,7 +165,13 @@ class Trainer:
             if not hasattr(self, "_host_acc") or self._host_acc is None:
                 self._host_acc = self.evaluators.new_host_state()
             self.evaluators.host_update(self._host_acc, host_out)
-        return float(loss)
+        loss_f = float(loss)
+        if not np.isfinite(loss_f):
+            # layer-level localisation, the gLayerStackTrace-on-crash analog
+            # (ref: utils/CustomStackTrace.h; NeuralNetwork.cpp:280-286)
+            raise FloatingPointError(
+                f"non-finite loss {loss_f}; {self.diagnose_nonfinite(batch)}")
+        return loss_f
 
     def train_one_pass(self, batches: Optional[Iterator] = None,
                        log_period: int = 0) -> dict[str, float]:
@@ -176,6 +183,7 @@ class Trainer:
         total_cost, n_batches, n_samples = 0.0, 0, 0
         if batches is None:
             batches = self.train_batches()
+        stats_period = FLAGS.show_parameter_stats_period
         for batch in batches:
             with global_stat.time("trainOneBatch"):
                 loss = self.train_one_batch(batch)
@@ -185,6 +193,8 @@ class Trainer:
             if log_period and n_batches % log_period == 0:
                 log.info("pass %d batch %d: cost=%.5f %s", self.pass_id, n_batches,
                          total_cost / n_batches, _fmt(self.evaluators.finalize(self._acc)))
+            if stats_period and n_batches % stats_period == 0:
+                self.log_param_stats()
         self.opt_state = self.updater.finish_pass(self.opt_state)
         stats = self.evaluators.finalize(self._acc)
         if self._host_acc is not None:
@@ -237,6 +247,95 @@ class Trainer:
             stats.update(self.evaluators.finalize_host(host_acc))
         stats["cost"] = total / max(n, 1)
         return stats
+
+    # -- diagnostics ------------------------------------------------------
+    def param_stats(self) -> dict[str, dict[str, float]]:
+        """Per-parameter health dump (ref: TrainerInternal.cpp:187-217
+        showParameterStats: avg/max abs value logged every
+        show_parameter_stats_period batches)."""
+        out = {}
+        for name, v in self.params.items():
+            a = np.abs(np.asarray(jax.device_get(v)))
+            out[name] = {"shape": tuple(v.shape), "mean_abs": float(a.mean()),
+                         "max_abs": float(a.max())}
+        return out
+
+    def log_param_stats(self) -> None:
+        for name, s in self.param_stats().items():
+            log.info("param %s shape=%s mean_abs=%.3e max_abs=%.3e",
+                     name, s["shape"], s["mean_abs"], s["max_abs"])
+
+    def diagnose_nonfinite(self, batch: dict[str, Argument],
+                           rng: Optional[jax.Array] = None) -> str:
+        """Layer-level NaN/Inf localisation — the analog of the reference's
+        gLayerStackTrace dump on crash (ref: utils/CustomStackTrace.h;
+        NeuralNetwork.cpp:241,280-286): re-run forward uncompiled and report
+        the first layer whose output is non-finite.
+
+        The jitted train step donates its param buffers, so this runs on the
+        POST-update parameters — the report says which case applies."""
+        if rng is None:
+            rng = getattr(self, "_last_rng", None)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        outputs, costs, _ = self.executor.forward(
+            self.params, batch, self.net_state, TRAIN, rng)
+        for l in self.model.layers:
+            arg = outputs.get(l.name)
+            if arg is None or arg.value is None:
+                continue
+            a = np.asarray(jax.device_get(arg.value))
+            if not np.isfinite(a).all():
+                return (f"first non-finite output at layer {l.name!r} "
+                        f"(type={l.type}): nan={np.isnan(a).sum()} "
+                        f"inf={np.isinf(a).sum()} of {a.size} "
+                        f"(forward re-run with post-update parameters)")
+        for cname, c in costs.items():
+            if not np.isfinite(np.asarray(jax.device_get(c))).all():
+                return (f"non-finite cost {cname!r} with finite layer outputs "
+                        f"(forward re-run with post-update parameters)")
+        return ("forward with post-update parameters is finite — the "
+                "non-finite value arose in the gradient/optimizer update of "
+                "the failing step")
+
+    def check_gradient(self, batch: dict[str, Argument],
+                       epsilon: float = 1e-3,
+                       max_entries: int = 4) -> dict[str, float]:
+        """Finite-difference gradient check on a real batch — the --job=
+        checkgrad mode (ref: Trainer::checkGradient, Trainer.cpp:303+):
+        perturb sampled entries of every parameter, compare numeric
+        d(loss)/d(w) against the analytic gradient.  Returns per-parameter
+        max relative error."""
+        rng = jax.random.PRNGKey(7)
+        # jit once: every perturbed evaluation reuses the same executable
+        loss_fn = jax.jit(lambda p: self.executor.loss(
+            p, batch, self.net_state, TEST, rng)[0])
+        grads = jax.jit(jax.grad(lambda p: self.executor.loss(
+            p, batch, self.net_state, TEST, rng)[0]))(self.params)
+        errors: dict[str, float] = {}
+        nrng = np.random.default_rng(0)
+        for name, w in self.params.items():
+            if name in self.executor.static_param_names:
+                continue
+            flat = np.asarray(jax.device_get(w)).reshape(-1)
+            gflat = np.asarray(jax.device_get(grads[name])).reshape(-1)
+            idxs = nrng.choice(flat.size, size=min(max_entries, flat.size),
+                               replace=False)
+            worst = 0.0
+            for i in idxs:
+                sides = []
+                for sign in (+1, -1):
+                    pert = flat.copy()
+                    pert[i] += sign * epsilon
+                    p2 = dict(self.params)
+                    p2[name] = jnp.asarray(pert.reshape(w.shape))
+                    sides.append(float(loss_fn(p2)))
+                numeric = (sides[0] - sides[1]) / (2 * epsilon)
+                denom = max(abs(numeric), abs(gflat[i]), 1e-8)
+                worst = max(worst, abs(numeric - gflat[i]) / denom)
+            errors[name] = worst
+            log.info("checkgrad %s: max_rel_err=%.3e", name, worst)
+        return errors
 
     def benchmark(self, batches: Iterator, warmup: int = 3, iters: int = 30) -> dict:
         """--job=time analog (ref: TrainerBenchmark.cpp)."""

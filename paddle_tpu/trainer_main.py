@@ -24,9 +24,25 @@ def main(argv=None) -> int:
     rest = parse_flags(argv)
     if not FLAGS.config:
         print("usage: python -m paddle_tpu.trainer_main --config=<config.py> "
-              "[--job=train|test|time] [--num_passes=N] [--save_dir=DIR] "
-              "[--config_args=k=v,...] [--mesh_shape=data:8]", file=sys.stderr)
+              "[--job=train|test|checkgrad|time] [--num_passes=N] "
+              "[--save_dir=DIR] [--config_args=k=v,...] [--mesh_shape=data:8] "
+              "[--detect_nan] [--profile_dir=DIR] "
+              "[--show_parameter_stats_period=N]", file=sys.stderr)
         return 2
+
+    if FLAGS.coordinator_address:
+        from paddle_tpu.parallel.mesh import init_distributed
+        init_distributed(FLAGS.coordinator_address, FLAGS.num_processes,
+                         FLAGS.process_id)
+        log.info("joined cluster as process %d/%d (coordinator %s)",
+                 FLAGS.process_id, FLAGS.num_processes,
+                 FLAGS.coordinator_address)
+
+    if FLAGS.detect_nan:
+        # FP-anomaly trapping (ref: feenableexcept(FE_INVALID|...) at trainer
+        # start, TrainerMain.cpp:97; utils/Excepts.h): XLA re-runs the
+        # offending computation uncompiled and raises at the bad primitive
+        jax.config.update("jax_debug_nans", True)
 
     config = parse_config(FLAGS.config, FLAGS.config_args)
     log.info("parsed config %s: %d layers, %d parameters", FLAGS.config,
@@ -41,20 +57,43 @@ def main(argv=None) -> int:
         trainer.load(FLAGS.init_model_path)
         log.info("loaded initial model from %s", FLAGS.init_model_path)
 
+    if FLAGS.profile_dir:
+        # device-side tracing (ref: REGISTER_TIMER/WITH_TIMER Stat.h:130-256
+        # + hl_profiler_start/end -> jax.profiler traces viewable in
+        # tensorboard/xprof)
+        jax.profiler.start_trace(FLAGS.profile_dir)
+
     job = FLAGS.job
-    if job == "train":
-        trainer.train(num_passes=FLAGS.num_passes, log_period=FLAGS.log_period,
-                      save_dir=FLAGS.save_dir or None)
-    elif job == "test":
-        stats = trainer.test()
-        log.info("test result: %s", stats)
-    elif job == "time":
-        stats = trainer.benchmark(trainer.train_batches())
-        log.info("benchmark: %.1f samples/sec (%d samples in %.2fs)",
-                 stats["samples_per_sec"], stats["samples"], stats["seconds"])
-    else:
-        log.error("unknown --job=%s", job)
-        return 2
+    try:
+        if job == "train":
+            trainer.train(num_passes=FLAGS.num_passes, log_period=FLAGS.log_period,
+                          save_dir=FLAGS.save_dir or None)
+        elif job == "test":
+            stats = trainer.test()
+            log.info("test result: %s", stats)
+        elif job == "time":
+            stats = trainer.benchmark(trainer.train_batches())
+            log.info("benchmark: %.1f samples/sec (%d samples in %.2fs)",
+                     stats["samples_per_sec"], stats["samples"], stats["seconds"])
+        elif job == "checkgrad":
+            batch = next(iter(trainer.train_batches()), None)
+            if batch is None:
+                log.error("checkgrad: data source produced no batches")
+                return 2
+            errors = trainer.check_gradient(batch)
+            worst = max(errors.values(), default=0.0)
+            log.info("checkgrad: %d parameters, worst max_rel_err=%.3e",
+                     len(errors), worst)
+            if worst > 0.02:
+                log.error("gradient check FAILED")
+                return 1
+        else:
+            log.error("unknown --job=%s", job)
+            return 2
+    finally:
+        if FLAGS.profile_dir:
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", FLAGS.profile_dir)
     return 0
 
 

@@ -71,8 +71,12 @@ class _Flags:
                 name, raw = body.split("=", 1)
             else:
                 name = body
-                if i + 1 < len(argv) and name in specs:
-                    raw = argv[i + 1]
+                nxt = argv[i + 1] if i + 1 < len(argv) else None
+                is_bool = name in specs and specs[name].type is bool
+                # bare bool flags never consume a following flag token
+                if nxt is not None and name in specs and \
+                        not (is_bool and nxt.startswith("--")):
+                    raw = nxt
                     i += 1
                 else:
                     raw = "true"
@@ -121,6 +125,12 @@ define_flag("show_parameter_stats_period", 0, "dump parameter stats every N batc
 define_flag("beam_size", 1, "beam width for sequence generation")
 define_flag("mesh_shape", "", "device mesh, e.g. 'data:8' or 'data:4,model:2'")
 define_flag("profile_dir", "", "if set, write jax profiler traces here")
+define_flag("detect_nan", False, "trap FP anomalies (jax_debug_nans; "
+            "ref: feenableexcept at TrainerMain.cpp:97)")
+# multi-host bootstrap (ref: --trainer_id/--pservers of the pserver fleet)
+define_flag("coordinator_address", "", "jax.distributed coordinator host:port")
+define_flag("num_processes", 0, "number of cluster processes")
+define_flag("process_id", 0, "this process's id in the cluster")
 
 
 def env_flag(name: str, default: str = "") -> str:

@@ -1,0 +1,127 @@
+"""Aux-subsystem tests: checkgrad, param stats, NaN localisation, cluster
+launcher command construction, trainer CLI jobs (mirrors ref: the trainer's
+checkgrad job Trainer.cpp:303+, showParameterStats TrainerInternal.cpp:187,
+CustomStackTrace-on-crash, scripts/cluster_train/paddle.py)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.trainer.trainer import Trainer
+
+
+def _small_config(bad_log: bool = False):
+    from paddle_tpu.dsl import (
+        MomentumOptimizer, SoftmaxActivation, TanhActivation,
+        classification_cost, data_layer, fc_layer, settings,
+    )
+    from paddle_tpu.dsl.activations import LogActivation
+
+    def conf():
+        settings(batch_size=8, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(momentum=0.9))
+        x = data_layer(name="x", size=12)
+        h = fc_layer(input=x, size=16,
+                     act=LogActivation() if bad_log else TanhActivation())
+        out = fc_layer(input=h, size=4, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=4))
+
+    return parse_config_callable(conf)
+
+
+def _batch(seed=0, B=8, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": Argument(value=(rng.normal(size=(B, 12)) * scale).astype(np.float32)),
+        "y": Argument(ids=rng.integers(0, 4, B).astype(np.int32)),
+    }
+
+
+class TestCheckGrad:
+    def test_analytic_matches_numeric(self):
+        tr = Trainer(_small_config(), seed=0)
+        errors = tr.check_gradient(_batch(), epsilon=1e-3, max_entries=3)
+        assert errors, "no parameters checked"
+        worst = max(errors.values())
+        # fp32 central differences: ~1e-2 noise floor (the CLI job uses 2e-2)
+        assert worst < 2e-2, f"gradient check failed: {errors}"
+
+
+class TestParamStats:
+    def test_stats_shape(self):
+        tr = Trainer(_small_config(), seed=0)
+        stats = tr.param_stats()
+        assert set(stats) == set(tr.params)
+        for s in stats.values():
+            assert s["max_abs"] >= s["mean_abs"] >= 0.0
+
+
+class TestNanDiagnosis:
+    def test_nonfinite_loss_names_layer(self):
+        """log(negative) in layer 1 -> the error must name that layer."""
+        tr = Trainer(_small_config(bad_log=True), seed=0)
+        with pytest.raises(FloatingPointError, match="fc_layer"):
+            # large negative inputs make log() produce NaN
+            tr.train_one_batch(_batch(scale=100.0))
+
+
+class TestFlagParsing:
+    def test_bare_bool_flag_does_not_eat_next_flag(self):
+        from paddle_tpu.utils.flags import FLAGS
+        old_nan, old_passes = FLAGS.detect_nan, FLAGS.num_passes
+        try:
+            rest = FLAGS.parse(["--detect_nan", "--num_passes=5"])
+            assert rest == []
+            assert FLAGS.detect_nan is True
+            assert FLAGS.num_passes == 5
+        finally:
+            FLAGS.detect_nan, FLAGS.num_passes = old_nan, old_passes
+
+
+class TestClusterLaunch:
+    def test_build_commands(self):
+        from paddle_tpu.tools.cluster_launch import build_commands
+        cmds = build_commands(["h0", "h1", "h2"], 8476, "/ws",
+                              ["--config=c.py", "--num_passes=2"])
+        assert len(cmds) == 3
+        assert all(c[0] == "ssh" for c in cmds)
+        assert "--coordinator_address=h0:8476" in cmds[0][-1]
+        assert "--process_id=2" in cmds[2][-1]
+        assert "--num_processes=3" in cmds[1][-1]
+        assert "--config=c.py" in cmds[0][-1]
+
+    def test_dry_run_cli(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.cluster_launch",
+             "--hosts", "a,b", "--dry_run", "--", "--config=x.py"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().splitlines()
+        assert len(lines) == 2 and "ssh" in lines[0]
+
+
+class TestTrainerMainJobs:
+    def _run(self, *extra):
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.trainer_main",
+             "--config=demo/introduction/trainer_config.py", *extra],
+            capture_output=True, text=True, timeout=300, cwd=repo, env=env)
+
+    def test_checkgrad_job(self):
+        out = self._run("--job=checkgrad")
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "checkgrad" in out.stderr or "checkgrad" in out.stdout
+
+    def test_param_stats_period(self):
+        out = self._run("--job=train", "--num_passes=1", "--save_dir=",
+                        "--show_parameter_stats_period=50")
+        assert out.returncode == 0, out.stderr[-2000:]
+        blob = out.stdout + out.stderr
+        assert "mean_abs" in blob
