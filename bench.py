@@ -26,9 +26,12 @@ def main() -> None:
 
     batch_size = int(os.environ.get("BENCH_BATCH_SIZE", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "30"))
+    # bfloat16 is the TPU-native float: fp32 master params, bf16 matmuls on
+    # the MXU, fp32 softmax/BN-stats/loss (BENCH_DTYPE=float32 opts out)
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     cfg = parse_config("demo/image_classification/vgg_16_cifar.py",
-                       f"batch_size={batch_size}")
+                       f"batch_size={batch_size},compute_dtype={dtype}")
     tr = Trainer(cfg, seed=1)
 
     rng = np.random.default_rng(0)

@@ -5,6 +5,8 @@ from paddle_tpu.dsl import *
 
 is_predict = get_config_arg("is_predict", bool, False)
 batch_size = get_config_arg("batch_size", int, 128)
+# '' = fp32; 'bfloat16' = mixed precision (fp32 params, bf16 MXU matmuls)
+compute_dtype = get_config_arg("compute_dtype", str, "")
 
 define_py_data_sources2(
     train_list=None if is_predict else "demo/image_classification/train.list",
@@ -16,7 +18,8 @@ settings(
     batch_size=batch_size,
     learning_rate=0.1 / 128.0,
     learning_method=MomentumOptimizer(momentum=0.9),
-    regularization=L2Regularization(0.0005 * 128))
+    regularization=L2Regularization(0.0005 * 128),
+    compute_dtype=compute_dtype)
 
 img = data_layer(name="image", size=3 * 32 * 32, height=32, width=32)
 predict = small_vgg(input_image=img, num_channels=3, num_classes=10)

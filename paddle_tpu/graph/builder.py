@@ -50,9 +50,13 @@ def _get_output_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 class GraphExecutor:
     """Builds and runs the layer graph described by a ModelConfig."""
 
-    def __init__(self, model: ModelConfig, mesh=None):
+    def __init__(self, model: ModelConfig, mesh=None, compute_dtype: str = ""):
         self.model = model
         self.mesh = mesh  # enables parallel layer paths (ring attention)
+        # '' = run in param dtype; 'bfloat16' casts float params + inputs for
+        # MXU-speed matmuls while softmax/log/BN-stats/costs stay float32
+        # (settings(compute_dtype=...) / --compute_dtype)
+        self.compute_dtype = compute_dtype
         self.layer_map: dict[str, LayerConfig] = {l.name: l for l in model.layers}
         # layers belonging to a recurrent sub-model are executed by its scan
         self._sub_of: dict[str, SubModelConfig] = {}
@@ -109,6 +113,16 @@ class GraphExecutor:
         if static:
             params = {k: (jax.lax.stop_gradient(v) if k in static else v)
                       for k, v in params.items()}
+        if self.compute_dtype:
+            dt = jnp.dtype(self.compute_dtype)
+            params = {k: (v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating)
+                          else v) for k, v in params.items()}
+            feed = {
+                name: (arg.replace(value=arg.value.astype(dt))
+                       if arg.value is not None
+                       and jnp.issubdtype(arg.value.dtype, jnp.floating)
+                       else arg)
+                for name, arg in feed.items()}
         ctx = ForwardContext(
             model=self.model, params=params, mode=mode, rng=rng,
             state_in=state or {}, mesh=self.mesh,
@@ -143,9 +157,10 @@ class GraphExecutor:
         here the loss is per-sample mean, and the optimizer LR semantics match)."""
         outputs, costs, new_state = self.forward(params, feed, state, mode, rng)
         assert costs, "model has no cost layers"
+        from paddle_tpu.utils.dtypes import promote_compute
         total = None
         for c in costs.values():
-            s = jnp.mean(c)
+            s = jnp.mean(promote_compute(c))
             total = s if total is None else total + s
         return total, (outputs, costs, new_state)
 

@@ -297,8 +297,12 @@ def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
         var = state["var"].reshape(stat_shape)
         new_state = state
     else:
-        mean = jnp.mean(v4, axis=axes).reshape(stat_shape)
-        var = jnp.var(v4, axis=axes).reshape(stat_shape)
+        # statistics in >= float32 (promote bf16/f16 under mixed precision;
+        # keep f64 in f64 for the grad-check tests)
+        from paddle_tpu.utils.dtypes import promote_compute
+        v32 = promote_compute(v4)
+        mean = jnp.mean(v32, axis=axes).reshape(stat_shape)
+        var = jnp.var(v32, axis=axes).reshape(stat_shape)
         f = cfg.moving_average_fraction
         new_state = {
             "mean": f * state["mean"] + (1 - f) * mean.reshape(-1),
@@ -306,11 +310,12 @@ def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
             "count": state["count"] + 1,
         }
     ctx.state_out[cfg.name] = new_state
-    normed = (v4 - mean) / jnp.sqrt(var + eps)
-    normed = normed * scale.reshape(stat_shape)
+    stat_dt = mean.dtype
+    normed = (v4.astype(stat_dt) - mean) / jnp.sqrt(var + eps)
+    normed = normed * scale.reshape(stat_shape).astype(stat_dt)
     if bias is not None:
-        normed = normed + bias.reshape(stat_shape)
-    return finish_layer(ctx, cfg, normed.reshape(v.shape), like=x)
+        normed = normed + bias.reshape(stat_shape).astype(stat_dt)
+    return finish_layer(ctx, cfg, normed.reshape(v.shape).astype(v.dtype), like=x)
 
 
 @register_layer("data_norm")

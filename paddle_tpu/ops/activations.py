@@ -37,9 +37,12 @@ def sigmoid(x: Array, **_) -> Array:
     return jax.nn.sigmoid(x)
 
 
+from paddle_tpu.utils.dtypes import promote_compute as _f32
+
+
 @_register("softmax")
 def softmax(x: Array, **_) -> Array:
-    return jax.nn.softmax(x, axis=-1)
+    return jax.nn.softmax(_f32(x), axis=-1)
 
 
 @_register("sequence_softmax")
@@ -48,6 +51,7 @@ def sequence_softmax(x: Array, mask: Optional[Array] = None, **_) -> Array:
     scalars, masked by validity (ref: SequenceSoftmaxActivation — softmax over
     each variable-length sequence's scalar scores, used by attention)."""
     squeeze = False
+    x = _f32(x)
     if x.ndim == 3 and x.shape[-1] == 1:
         x = x[..., 0]
         squeeze = True
@@ -106,7 +110,7 @@ def exponential(x: Array, **_) -> Array:
 
 @_register("log")
 def log(x: Array, **_) -> Array:
-    return jnp.log(x)
+    return jnp.log(_f32(x))
 
 
 def activation(name: str, x: Array, mask: Optional[Array] = None) -> Array:

@@ -34,6 +34,8 @@ def build_commands(hosts: list[str], port: int, workspace: str,
                    trainer_args: list[str], python: str = "python") -> list[list[str]]:
     """One ssh command per host; host 0 doubles as the jax.distributed
     coordinator (ref: conf.py HOSTS + --trainer_id assignment)."""
+    if not hosts:
+        raise SystemExit("cluster_launch: no hosts given (--hosts host0,host1,...)")
     coordinator = f"{hosts[0]}:{port}"
     cmds = []
     for pid, host in enumerate(hosts):

@@ -72,7 +72,9 @@ class Trainer:
         self.config = config
         self.model = config.model_config
         self.opt = config.opt_config
-        self.executor = GraphExecutor(self.model, mesh=mesh)
+        self.executor = GraphExecutor(
+            self.model, mesh=mesh,
+            compute_dtype=FLAGS.compute_dtype or self.opt.compute_dtype)
         self.updater = ParameterUpdater(self.model, self.opt)
         self.evaluators = EvaluatorSet(self.model)
         self.seed = seed
@@ -149,8 +151,9 @@ class Trainer:
         return self._feeder(self.config.data_config, True).prefetched_batches()
 
     # -- loops ------------------------------------------------------------
-    def train_one_batch(self, batch: dict[str, Argument]) -> float:
-        """(ref: TrainerInternal::trainOneBatch)."""
+    def _dispatch_step(self, batch: dict[str, Argument]):
+        """Dispatch one compiled train step (async — no host sync); returns
+        (loss, partials, host_out) device values."""
         if self.mesh is not None:
             from paddle_tpu.parallel.dp import shard_batch
             batch = shard_batch(self.mesh, batch)
@@ -160,6 +163,11 @@ class Trainer:
             self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
         if new_net:
             self.net_state = new_net
+        return loss, partials, host_out
+
+    def train_one_batch(self, batch: dict[str, Argument]) -> float:
+        """(ref: TrainerInternal::trainOneBatch)."""
+        loss, partials, host_out = self._dispatch_step(batch)
         self._acc = self.evaluators.accumulate(getattr(self, "_acc", {}), partials)
         if self.evaluators.host_configs:
             if not hasattr(self, "_host_acc") or self._host_acc is None:
@@ -307,11 +315,23 @@ class Trainer:
         d(loss)/d(w) against the analytic gradient.  Returns per-parameter
         max relative error."""
         rng = jax.random.PRNGKey(7)
-        # jit once: every perturbed evaluation reuses the same executable
-        loss_fn = jax.jit(lambda p: self.executor.loss(
-            p, batch, self.net_state, TEST, rng)[0])
-        grads = jax.jit(jax.grad(lambda p: self.executor.loss(
-            p, batch, self.net_state, TEST, rng)[0]))(self.params)
+        # full precision: a central difference of 1e-3 is below bf16
+        # resolution, so the check must bypass any mixed-precision cast
+        saved_dtype = self.executor.compute_dtype
+        self.executor.compute_dtype = ""
+        try:
+            # jit once: every perturbed evaluation reuses the same executable
+            loss_fn = jax.jit(lambda p: self.executor.loss(
+                p, batch, self.net_state, TEST, rng)[0])
+            grads = jax.jit(jax.grad(lambda p: self.executor.loss(
+                p, batch, self.net_state, TEST, rng)[0]))(self.params)
+            return self._check_gradient_inner(loss_fn, grads, epsilon,
+                                              max_entries)
+        finally:
+            self.executor.compute_dtype = saved_dtype
+
+    def _check_gradient_inner(self, loss_fn, grads, epsilon,
+                              max_entries) -> dict[str, float]:
         errors: dict[str, float] = {}
         nrng = np.random.default_rng(0)
         for name, w in self.params.items():
@@ -349,13 +369,19 @@ class Trainer:
         for b in batch_list[:warmup]:
             self.train_one_batch(b)
         jax.block_until_ready(self.params)
+
+        # timed loop dispatches steps asynchronously — no per-step host sync
+        # (float(loss)/eval accumulation), letting XLA pipeline host dispatch
+        # with device compute; one block at the end
         t0 = time.time()
         n_samples = 0
+        loss = None
         for b in batch_list[warmup:]:
-            self.train_one_batch(b)
+            loss, _, _ = self._dispatch_step(b)
             n_samples += _batch_size(b)
         jax.block_until_ready(self.params)
         dt = time.time() - t0
+        assert loss is None or np.isfinite(float(loss)), "non-finite bench loss"
         return {"seconds": dt, "samples": n_samples,
                 "samples_per_sec": n_samples / dt if dt else 0.0,
                 "batches": len(batch_list) - warmup}

@@ -1,0 +1,17 @@
+"""Mixed-precision dtype policy helpers (compute_dtype='bfloat16')."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+
+def promote_compute(x: jax.Array) -> jax.Array:
+    """Promote low-precision compute dtypes to float32 for numerically
+    sensitive ops (softmax/log/statistics/loss accumulation); float32 and
+    float64 pass through unchanged."""
+    if x.dtype in LOW_PRECISION:
+        return x.astype(jnp.float32)
+    return x

@@ -72,10 +72,10 @@ class _Flags:
             else:
                 name = body
                 nxt = argv[i + 1] if i + 1 < len(argv) else None
-                is_bool = name in specs and specs[name].type is bool
-                # bare bool flags never consume a following flag token
+                # a bare flag never consumes a following flag token — values
+                # that genuinely start with '--' need the --name=value form
                 if nxt is not None and name in specs and \
-                        not (is_bool and nxt.startswith("--")):
+                        not nxt.startswith("--"):
                     raw = nxt
                     i += 1
                 else:
@@ -125,6 +125,8 @@ define_flag("show_parameter_stats_period", 0, "dump parameter stats every N batc
 define_flag("beam_size", 1, "beam width for sequence generation")
 define_flag("mesh_shape", "", "device mesh, e.g. 'data:8' or 'data:4,model:2'")
 define_flag("profile_dir", "", "if set, write jax profiler traces here")
+define_flag("compute_dtype", "", "override compute dtype ('bfloat16' = "
+            "mixed precision: fp32 params, bf16 matmuls on the MXU)")
 define_flag("detect_nan", False, "trap FP anomalies (jax_debug_nans; "
             "ref: feenableexcept at TrainerMain.cpp:97)")
 # multi-host bootstrap (ref: --trainer_id/--pservers of the pserver fleet)
