@@ -45,7 +45,7 @@ __all__ = [
     "mdlstm_layer", "sub_seq_layer",
     "img_conv_layer", "img_pool_layer", "img_cmrnorm_layer", "batch_norm_layer",
     "bilinear_interp_layer", "block_expand_layer", "maxout_layer", "spp_layer",
-    "conv_shift_layer", "multi_head_attention_layer",
+    "conv_shift_layer", "multi_head_attention_layer", "moe_layer",
     "maxid_layer", "sampling_id_layer", "eos_layer",
     "cos_sim", "cos_sim_vecmat", "trans_layer", "resize_layer",
     "slope_intercept_layer", "scaling_layer", "interpolation_layer",
@@ -986,6 +986,52 @@ def multi_head_attention_layer(
     return LayerOutput(name, "multi_head_attention", size,
                        parents=[query, key, value],
                        seq_level=query.seq_level)
+
+
+def moe_layer(
+    input: LayerOutput,
+    *,
+    num_experts: int,
+    expert_hidden: int,
+    size: Optional[int] = None,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    aux_weight: float = 0.01,
+    name: Optional[str] = None,
+    layer_attr: Optional[ExtraLayerAttribute] = None,
+) -> LayerOutput:
+    """Mixture-of-experts FFN block — NEW capability (parallel/moe.py):
+    top-k routed experts with capacity, load-balancing aux loss, expert
+    weights sharded over the `model` mesh axis (expert parallelism).
+    size defaults to the input width (residual-friendly)."""
+    import math as _math
+    size = size if size is not None else input.size
+    name = _name(name, "moe_layer")
+    D, E, H = input.size, num_experts, expert_hidden
+    cfg = LayerConfig(name=name, type="moe", size=size, active_type="")
+    cfg.attrs["top_k"] = top_k
+    cfg.attrs["capacity_factor"] = capacity_factor
+    cfg.attrs["aux_weight"] = aux_weight
+    espec = ["model", None, None]
+    specs = [
+        ([D, E], ParameterAttribute(initial_std=1.0 / _math.sqrt(D))),
+        ([E, D, H], ParameterAttribute(initial_std=1.0 / _math.sqrt(D),
+                                       partition_spec=espec)),
+        ([E, H], ParameterAttribute(initial_std=0.0, initial_mean=0.0,
+                                    partition_spec=espec[:2])),
+        ([E, H, size], ParameterAttribute(initial_std=1.0 / _math.sqrt(H),
+                                          partition_spec=espec)),
+        ([E, size], ParameterAttribute(initial_std=0.0, initial_mean=0.0,
+                                       partition_spec=espec[:2])),
+    ]
+    for i, (dims, attr) in enumerate(specs):
+        pname = _make_param(name, i, dims, attr)
+        cfg.inputs.append(LayerInput(input_layer_name=input.name,
+                                     input_parameter_name=pname))
+    _layer_attr_fields(cfg, layer_attr)
+    current_context().add_layer(cfg)
+    return LayerOutput(name, "moe", size, parents=[input],
+                       seq_level=input.seq_level)
 
 
 # ---------------------------------------------------------------------------

@@ -8,3 +8,4 @@ from paddle_tpu.graph import layers_seq  # noqa: F401
 from paddle_tpu.graph import layers_conv  # noqa: F401
 from paddle_tpu.graph import layers_misc  # noqa: F401
 from paddle_tpu.graph import layers_attn  # noqa: F401
+from paddle_tpu.graph import layers_moe  # noqa: F401

@@ -1,0 +1,47 @@
+"""Mixture-of-experts layer — expert-parallel FFN block.
+
+NEW capability beyond the reference (see parallel/moe.py).  The layer's
+5 parameters ride the standard input-parameter mechanism: five LayerInputs
+all referencing the single data input carry router/w1/b1/w2/b2.  The aux
+load-balancing loss registers into ctx.costs like a cost layer, scaled by
+attrs['aux_weight'].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.config.schema import LayerConfig
+from paddle_tpu.graph.common import finish_layer
+from paddle_tpu.graph.context import ForwardContext
+from paddle_tpu.graph.registry import register_layer
+from paddle_tpu.parallel.moe import moe_ffn
+from paddle_tpu.parameter.argument import Argument
+
+
+@register_layer("moe")
+def moe_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    x = ctx.get_input(cfg, 0)
+    w_router, w1, b1, w2, b2 = (ctx.param_of(cfg, i) for i in range(5))
+    top_k = int(cfg.attrs.get("top_k", 2))
+    cap = float(cfg.attrs.get("capacity_factor", 1.25))
+    aux_w = float(cfg.attrs.get("aux_weight", 0.01))
+
+    v = x.value
+    seq_shape = None
+    valid = None
+    if v.ndim == 3:                      # [B, T, D] -> route per token
+        seq_shape = v.shape[:2]
+        v = v.reshape(-1, v.shape[-1])
+        mask = x.mask()                  # padding never routed (cf. attention)
+        if mask is not None:
+            valid = mask.reshape(-1)
+    y, aux = moe_ffn(v, w_router, w1, b1, w2, b2, top_k=top_k,
+                     capacity_factor=cap, valid=valid)
+    if seq_shape is not None:
+        y = y.reshape(seq_shape + (y.shape[-1],))
+    if aux_w > 0 and ctx.is_training:
+        # per-sample broadcast so the executor's mean() leaves aux_w * aux
+        ctx.costs[f"{cfg.name}.aux"] = jnp.broadcast_to(
+            aux_w * aux, (x.batch_size,))
+    return finish_layer(ctx, cfg, y, like=x)
