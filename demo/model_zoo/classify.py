@@ -41,7 +41,8 @@ def main(argv=None):
         tr.params, {"image": Argument(value=x)}, None, TEST,
         jax.random.PRNGKey(0))
     if args.feature_layer:
-        feats = np.asarray(outputs[args.feature_layer].value)
+        # features are saved in the reference's flat C-major row layout
+        feats = np.asarray(outputs[args.feature_layer].flatten_image().value)
         print(f"{args.feature_layer}: shape={feats.shape}")
         np.save("features.npy", feats)
     else:

@@ -345,7 +345,8 @@ class GradientMachine:
         self._rng, sub = jax.random.split(self._rng)
         outs, _, _ = self._fwd(self.params, self._feed(inArgs),
                                self.net_state, sub)
-        return outs
+        return {k: v.flatten_image() if isinstance(v, Argument) else v
+                for k, v in outs.items()}
 
     def forwardTest(self, inArgs) -> dict[str, Argument]:
         return self.forward(inArgs, TEST)

@@ -34,11 +34,19 @@ def finish_layer(
     value: Array,
     like: Optional[Argument] = None,
     lengths: Optional[Array] = None,
+    nhwc: bool = False,
 ) -> Argument:
     """Apply activation + dropout and package the output Argument, inheriting
     sequence structure from `like` (ref: Layer::forwardActivation +
-    Argument::resizeAndCopyFrom sequence info propagation)."""
-    if lengths is None and like is not None and value.ndim >= 3:
+    Argument::resizeAndCopyFrom sequence info propagation).  `nhwc` marks a
+    [B, H, W, C] image output (stays channels-last for the next image layer;
+    flattened lazily at the flat-row boundary)."""
+    if nhwc and cfg.active_type in ("softmax", "sequence_softmax"):
+        # whole-row activations are defined on the flat layout
+        B, H, W, C = value.shape
+        value = value.transpose(0, 3, 1, 2).reshape(B, C * H * W)
+        nhwc = False
+    if lengths is None and like is not None and not nhwc and value.ndim >= 3:
         lengths = like.lengths
     mask = None
     if cfg.active_type == "sequence_softmax" and lengths is not None:
@@ -46,4 +54,4 @@ def finish_layer(
     out = activation(cfg.active_type, value, mask=mask)
     out = apply_dropout(ctx, cfg, out)
     sub_lengths = like.sub_lengths if like is not None else None
-    return Argument(value=out, lengths=lengths, sub_lengths=sub_lengths)
+    return Argument(value=out, lengths=lengths, sub_lengths=sub_lengths, nhwc=nhwc)

@@ -50,12 +50,37 @@ class ForwardContext:
         return jax.random.fold_in(self.rng, self._rng_counter)
 
     def get_input(self, cfg: LayerConfig, i: int) -> Argument:
+        """Input i in the reference's flat row layout (NHWC image outputs are
+        flattened lazily here — image layers use get_image_input instead, so
+        tensors stay channels-last between image layers)."""
+        return self.get_raw_input(cfg, i).flatten_image()
+
+    def get_raw_input(self, cfg: LayerConfig, i: int) -> Argument:
         name = cfg.inputs[i].input_layer_name
         try:
             return self.outputs[name]
         except KeyError:
             raise KeyError(
                 f"layer {cfg.name!r} input {name!r} not computed yet — config out of topo order?")
+
+    def get_image_input(self, cfg: LayerConfig, i: int,
+                        channels: int, height: int, width: int) -> Argument:
+        """Input i as a [B, H, W, C] channels-last image Argument (the TPU's
+        preferred conv layout; XLA keeps it resident without per-layer
+        transposes).  Flat-row inputs are unpacked from the reference's
+        C-major layout once at entry into the image pipeline."""
+        arg = self.get_raw_input(cfg, i)
+        if arg.nhwc:
+            if arg.value.shape[1:] != (height, width, channels):
+                # the consumer's config reinterprets the producer's geometry
+                # (e.g. same element count, different C/H/W split) — the flat
+                # C-major row layout is the common currency for that
+                arg = arg.flatten_image()
+            else:
+                return arg
+        B = arg.value.shape[0]
+        v = arg.value.reshape(B, channels, height, width).transpose(0, 2, 3, 1)
+        return arg.replace(value=v, nhwc=True)
 
     def get_inputs(self, cfg: LayerConfig) -> list[Argument]:
         return [self.get_input(cfg, i) for i in range(len(cfg.inputs))]

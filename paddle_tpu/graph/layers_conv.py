@@ -6,11 +6,15 @@ CudnnPoolLayer,SpatialPyramidPoolLayer,MaxOutLayer,NormProjectionLayer,
 BatchNormalizationLayer,CudnnBatchNormLayer,BilinearInterpLayer,
 BlockExpandLayer}.cpp and paddle/cuda/src/hl_cuda_cnn.cu).
 
-Re-design: images flow between layers as flat [B, C*H*W] rows exactly like the
-reference's matrix representation (so layer `size` semantics and the DSL's
-size inference carry over), and each image layer reshapes to NCHW internally.
-All convs lower to `lax.conv_general_dilated`, which XLA maps onto the MXU —
-the im2col/cuDNN split of the reference collapses into one compiler path.
+Re-design: images flow between image layers as channels-last [B, H, W, C]
+tensors — the TPU-native conv layout — and are converted to/from the
+reference's flat C-major [B, C*H*W] rows only at the image-pipeline boundary
+(ForwardContext.get_input flattens lazily; get_image_input unpacks once on
+entry).  Layer `size` semantics and the DSL's size inference carry over
+unchanged because every flat view is C-major.  All convs lower to
+`lax.conv_general_dilated` with NHWC/HWIO dimension numbers, which XLA maps
+onto the MXU without per-layer transposes — the im2col/cuDNN split of the
+reference collapses into one compiler path.
 """
 
 from __future__ import annotations
@@ -53,67 +57,75 @@ def _pad_amounts(img: int, filt: int, stride: int, pad: int, out: int) -> tuple[
     return pad, total - pad
 
 
-def conv2d_forward(x_flat: Array, w: Array, conv: ConvConfig, num_filters: int,
-                   transpose: bool = False) -> Array:
-    """x_flat [B, C*H*W] -> [B, num_filters*OH*OW].
+def conv2d_forward_nhwc(x: Array, w: Array, conv: ConvConfig, num_filters: int,
+                        transpose: bool = False) -> Array:
+    """x [B, H, W, C] -> [B, OH, OW, num_filters] (channels-last throughout).
 
     w layout: [num_filters, C//groups * fh * fw] matching the reference's
-    parameter shape for conv layers (ref: ExpandConvLayer weights), reshaped to
-    OIHW for the XLA conv.
+    parameter shape for conv layers (ref: ExpandConvLayer weights), laid out
+    as HWIO for the XLA conv (same kernel tensor, TPU-preferred spec).
     """
     fx, fy, sx, sy, px, py, ix, iy = _geom(conv)
-    B = x_flat.shape[0]
     C = conv.channels
-    x = x_flat.reshape(B, C, iy, ix)
     g = conv.groups
+    w4 = w.reshape(num_filters, C // g, fy, fx).transpose(2, 3, 1, 0)
 
     if not transpose:
         oy = conv.output_y or conv_output_size(iy, fy, sy, py, conv.caffe_mode)
         ox = conv.output_x or conv_output_size(ix, fx, sx, px, conv.caffe_mode)
-        w4 = w.reshape(num_filters, C // g, fy, fx)
         pad_y = _pad_amounts(iy, fy, sy, py, oy)
         pad_x = _pad_amounts(ix, fx, sx, px, ox)
-        y = lax.conv_general_dilated(
+        return lax.conv_general_dilated(
             x, w4, window_strides=(sy, sx), padding=(pad_y, pad_x),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=g)
-        return y.reshape(B, num_filters * oy * ox)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=g)
     else:
         # transposed conv (ref: ExpandConvTransLayer): output spatial size is
         # the conv-input size that would have produced this input
-        oy = conv.output_y
-        ox = conv.output_x
-        w4 = w.reshape(num_filters, C // g, fy, fx)
         y = lax.conv_transpose(
             x, w4, strides=(sy, sx), padding=((py, py), (px, px)),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"), transpose_kernel=True)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), transpose_kernel=True)
         # crop/pad to the configured output size
-        y = y[:, :, :oy, :ox]
-        return y.reshape(B, num_filters * oy * ox)
+        return y[:, :conv.output_y, :conv.output_x, :]
 
 
-def _add_conv_bias(acc: Array, b: Optional[Array], cfg: LayerConfig) -> Array:
-    """Per-channel (shared) or per-position bias (ref: ConvBaseLayer addBias);
-    DSL biases come as [1, k] rows — flatten before broadcasting."""
+def conv2d_forward(x_flat: Array, w: Array, conv: ConvConfig, num_filters: int,
+                   transpose: bool = False) -> Array:
+    """Flat-row wrapper: [B, C*H*W] -> [B, num_filters*OH*OW] (used by conv
+    projections/operators inside mixed layers, which live in row space)."""
+    _, fy, _, sy, _, py, ix, iy = _geom(conv)
+    B = x_flat.shape[0]
+    C = conv.channels
+    x = x_flat.reshape(B, C, iy, ix).transpose(0, 2, 3, 1)
+    y = conv2d_forward_nhwc(x, w, conv, num_filters, transpose=transpose)
+    oy, ox = y.shape[1], y.shape[2]
+    return y.transpose(0, 3, 1, 2).reshape(B, num_filters * oy * ox)
+
+
+def _add_conv_bias_nhwc(acc: Array, b: Optional[Array], cfg: LayerConfig) -> Array:
+    """Per-channel (shared) or per-position bias on a [B, OH, OW, F] tensor
+    (ref: ConvBaseLayer addBias); DSL biases come as [1, k] rows — flatten
+    before broadcasting.  Per-position biases are stored flat C-major."""
     if b is None:
         return acc
     b = b.reshape(-1)
     if cfg.shared_biases:
-        ohw = acc.shape[1] // cfg.num_filters
-        return (acc.reshape(acc.shape[0], cfg.num_filters, ohw)
-                + b[None, :, None]).reshape(acc.shape)
-    return acc + b
+        return acc + b          # [F] broadcasts over the channels-last axis
+    _, oy, ox, F = acc.shape
+    return acc + b.reshape(F, oy, ox).transpose(1, 2, 0)
 
 
 def _conv_like_layer(ctx: ForwardContext, cfg: LayerConfig, transpose: bool) -> Argument:
-    inputs = ctx.get_inputs(cfg)
     acc = None
-    for i, (inp, arg) in enumerate(zip(cfg.inputs, inputs)):
+    for i, inp in enumerate(cfg.inputs):
         conv = inp.proj.conv if (inp.proj and inp.proj.conv) else cfg.conv
+        iy = conv.img_size_y or conv.img_size
+        arg = ctx.get_image_input(cfg, i, conv.channels, iy, conv.img_size)
         w = ctx.param_of(cfg, i)
-        y = conv2d_forward(arg.value, w, conv, cfg.num_filters, transpose=transpose)
+        y = conv2d_forward_nhwc(arg.value, w, conv, cfg.num_filters,
+                                transpose=transpose)
         acc = y if acc is None else acc + y
-    acc = _add_conv_bias(acc, ctx.bias_of(cfg), cfg)
-    return finish_layer(ctx, cfg, acc, like=inputs[0])
+    acc = _add_conv_bias_nhwc(acc, ctx.bias_of(cfg), cfg)
+    return finish_layer(ctx, cfg, acc, nhwc=True)
 
 
 @register_layer("exconv", "cudnn_conv")
@@ -169,36 +181,44 @@ def _pool_geom(p: PoolConfig):
     return p.size_x, ky, p.stride, sy, p.padding, py, p.img_size, iy
 
 
-def pool2d_forward(x_flat: Array, pool: PoolConfig) -> Array:
+def pool2d_forward_nhwc(x: Array, pool: PoolConfig) -> Array:
+    """[B, H, W, C] -> [B, OH, OW, C] max/avg pooling."""
     kx, ky, sx, sy, px, py, ix, iy = _pool_geom(pool)
-    B = x_flat.shape[0]
-    C = pool.channels
-    x = x_flat.reshape(B, C, iy, ix)
     oy = pool.output_y or conv_output_size(iy, ky, sy, py, caffe_mode=False)
     ox = pool.output_x or conv_output_size(ix, kx, sx, px, caffe_mode=False)
     pad_y = _pad_amounts(iy, ky, sy, py, oy)
     pad_x = _pad_amounts(ix, kx, sx, px, ox)
-    dims = (1, 1, ky, kx)
-    strides = (1, 1, sy, sx)
-    padding = ((0, 0), (0, 0), pad_y, pad_x)
+    dims = (1, ky, kx, 1)
+    strides = (1, sy, sx, 1)
+    padding = ((0, 0), pad_y, pad_x, (0, 0))
     if pool.pool_type.startswith("max"):
-        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
-    else:
-        # average excluding padding (ref: hl_avgpool_forward divides by the
-        # clipped window size)
-        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
-        ones = jnp.ones((1, 1, iy, ix), x.dtype)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
-        y = s / jnp.maximum(cnt, 1.0)
-    return y.reshape(B, C * oy * ox)
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+    # average excluding padding (ref: hl_avgpool_forward divides by the
+    # clipped window size)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    ones = jnp.ones((1, iy, ix, 1), x.dtype)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+    return s / jnp.maximum(cnt, 1.0)
+
+
+def pool2d_forward(x_flat: Array, pool: PoolConfig) -> Array:
+    """Flat-row wrapper: [B, C*H*W] -> [B, C*OH*OW] (pool projections)."""
+    _, _, _, _, _, _, ix, iy = _pool_geom(pool)
+    B = x_flat.shape[0]
+    C = pool.channels
+    x = x_flat.reshape(B, C, iy, ix).transpose(0, 2, 3, 1)
+    y = pool2d_forward_nhwc(x, pool)
+    return y.transpose(0, 3, 1, 2).reshape(B, -1)
 
 
 @register_layer("pool", "cudnn_pool")
 def pool_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """(ref: PoolLayer.cpp / CudnnPoolLayer.cpp)."""
-    x = ctx.get_input(cfg, 0)
-    out = pool2d_forward(x.value, cfg.pool)
-    return finish_layer(ctx, cfg, out, like=x)
+    p = cfg.pool
+    x = ctx.get_image_input(cfg, 0, p.channels,
+                            p.img_size_y or p.img_size, p.img_size)
+    out = pool2d_forward_nhwc(x.value, p)
+    return finish_layer(ctx, cfg, out, nhwc=True)
 
 
 @register_layer("spp")
@@ -206,29 +226,35 @@ def spp_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Spatial pyramid pooling: pool at pyramid levels 0..L-1 and concat
     (ref: SpatialPyramidPoolLayer.cpp)."""
     import dataclasses
-    x = ctx.get_input(cfg, 0)
     p = cfg.pool
-    levels = cfg.attrs.get("pyramid_height", 1)
-    parts = []
     ix, iy = p.img_size, (p.img_size_y or p.img_size)
+    x = ctx.get_image_input(cfg, 0, p.channels, iy, ix)
+    levels = cfg.attrs.get("pyramid_height", 1)
+    B = x.value.shape[0]
+    parts = []
     for lvl in range(levels):
         n = 2 ** lvl
         kx, ky = -(-ix // n), -(-iy // n)
         sub = dataclasses.replace(
             p, size_x=kx, size_y=ky, stride=kx, stride_y=ky, padding=0, padding_y=0,
             output_x=n, output_y=n)
-        parts.append(pool2d_forward(x.value, sub))
+        pooled = pool2d_forward_nhwc(x.value, sub)          # [B, n, n, C]
+        parts.append(pooled.transpose(0, 3, 1, 2).reshape(B, -1))
     out = jnp.concatenate(parts, axis=-1)
-    return finish_layer(ctx, cfg, out, like=x)
+    return finish_layer(ctx, cfg, out)
 
 
 @register_layer("maxout")
 def maxout_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Max over groups of consecutive channels (ref: MaxOutLayer.cpp,
     hl_maxout_forward: out channel o = max over in channels o*g..o*g+g-1)."""
-    x = ctx.get_input(cfg, 0)
+    x = ctx.get_raw_input(cfg, 0)
     groups = cfg.attrs["groups"]
     C = cfg.conv.channels if cfg.conv else cfg.attrs["channels"]
+    if x.nhwc:
+        B, H, W, _ = x.value.shape
+        out = jnp.max(x.value.reshape(B, H, W, C // groups, groups), axis=-1)
+        return finish_layer(ctx, cfg, out, nhwc=True)
     B, D = x.value.shape
     hw = D // C
     out = jnp.max(x.value.reshape(B, C // groups, groups, hw), axis=2)
@@ -244,17 +270,16 @@ def norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Cross-channel local response normalization (cmrnorm)
     (ref: NormProjectionLayer.cpp, hl_CMRNorm_forward):
     y = x * (1 + scale * sum_{window} x^2)^(-pow)."""
-    x = ctx.get_input(cfg, 0)
     n = cfg.norm
-    B = x.value.shape[0]
     C, H, W = n.channels, (n.img_size_y or n.img_size), n.img_size
-    v = x.value.reshape(B, C, H, W)
+    x = ctx.get_image_input(cfg, 0, C, H, W)
+    v = x.value                                             # [B, H, W, C]
     sq = jnp.square(v)
     half = n.size // 2
-    padded = jnp.pad(sq, ((0, 0), (half, n.size - 1 - half), (0, 0), (0, 0)))
-    wsum = sum(padded[:, i:i + C] for i in range(n.size))
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, n.size - 1 - half)))
+    wsum = sum(padded[..., i:i + C] for i in range(n.size))
     y = v * jnp.power(1.0 + n.scale * wsum, -n.pow)
-    return finish_layer(ctx, cfg, y.reshape(B, -1), like=x)
+    return finish_layer(ctx, cfg, y, nhwc=True)
 
 
 @register_layer("batch_norm", "cudnn_batch_norm")
@@ -265,22 +290,25 @@ def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     Image inputs ([B, C*H*W] with conv geometry) normalize per channel;
     plain inputs per feature.
     """
-    x = ctx.get_input(cfg, 0)
-    scale = ctx.param_of(cfg, 0)
-    bias = ctx.bias_of(cfg)
-    eps = 1e-5
-    v = x.value
     img = cfg.conv is not None and cfg.conv.img_size > 0
     if img:
         C = cfg.conv.channels
-        B = v.shape[0]
-        v4 = v.reshape(B, C, -1)
-        axes = (0, 2)
-        stat_shape = (1, C, 1)
+        x = ctx.get_image_input(cfg, 0, C,
+                                cfg.conv.img_size_y or cfg.conv.img_size,
+                                cfg.conv.img_size)
+        v = x.value                      # [B, H, W, C]
+        v4 = v
+        axes = (0, 1, 2)
+        stat_shape = (1, 1, 1, C)
     else:
+        x = ctx.get_input(cfg, 0)
+        v = x.value
         v4 = v
         axes = (0,)
         stat_shape = (1, v.shape[-1])
+    scale = ctx.param_of(cfg, 0)
+    bias = ctx.bias_of(cfg)
+    eps = 1e-5
 
     state = ctx.state_in.get(cfg.name)
     if state is None:
@@ -315,7 +343,8 @@ def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     normed = normed * scale.reshape(stat_shape).astype(stat_dt)
     if bias is not None:
         normed = normed + bias.reshape(stat_shape).astype(stat_dt)
-    return finish_layer(ctx, cfg, normed.reshape(v.shape).astype(v.dtype), like=x)
+    return finish_layer(ctx, cfg, normed.reshape(v.shape).astype(v.dtype),
+                        like=x, nhwc=img)
 
 
 @register_layer("data_norm")
@@ -355,14 +384,13 @@ def sum_to_one_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 @register_layer("bilinear_interp")
 def bilinear_interp_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Bilinear upsample (ref: BilinearInterpLayer.cpp, hl_bilinear_forward)."""
-    x = ctx.get_input(cfg, 0)
     a = cfg.attrs
     C, ih, iw = a["channels"], a["img_size_y"], a["img_size_x"]
     oh, ow = a["out_size_y"], a["out_size_x"]
+    x = ctx.get_image_input(cfg, 0, C, ih, iw)
     B = x.value.shape[0]
-    v = x.value.reshape(B, C, ih, iw)
-    out = jax.image.resize(v, (B, C, oh, ow), method="bilinear")
-    return finish_layer(ctx, cfg, out.reshape(B, -1), like=x)
+    out = jax.image.resize(x.value, (B, oh, ow, C), method="bilinear")
+    return finish_layer(ctx, cfg, out, nhwc=True)
 
 
 @register_layer("blockexpand")

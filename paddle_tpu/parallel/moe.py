@@ -57,6 +57,10 @@ def moe_routing(
         idx = jnp.argmax(remaining, axis=-1)                  # [B]
         onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [B, E]
         onehot = onehot * vmask[:, None]      # pads take no expert slot
+        # a round with no probability mass left (softmax underflow, or
+        # top_k > num_experts) must not dispatch: argmax would re-pick
+        # expert 0 with zero gate weight and burn one of its capacity slots
+        onehot = onehot * (jnp.sum(remaining, -1, keepdims=True) > 0)
         picks.append(onehot)
         gate = jnp.sum(probs * onehot, axis=-1)               # [B]
         # position of each token within its expert's buffer this round

@@ -37,6 +37,11 @@ class Argument:
     # image geometry (static, aux data): (height, width)
     frame_height: int = dataclasses.field(default=0, metadata=dict(static=True))
     frame_width: int = dataclasses.field(default=0, metadata=dict(static=True))
+    # True => value is a [B, H, W, C] image tensor (TPU-native channels-last
+    # layout kept between image layers); False => the reference's flat
+    # C-major [B, C*H*W] row layout.  Conversion happens lazily at the
+    # flat-row API boundary (ForwardContext.get_input / flatten_image).
+    nhwc: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
     # -- helpers ----------------------------------------------------------
     @property
@@ -68,3 +73,13 @@ class Argument:
 
     def replace(self, **kw: Any) -> "Argument":
         return dataclasses.replace(self, **kw)
+
+    def flatten_image(self) -> "Argument":
+        """NHWC image -> the reference's flat C-major [B, C*H*W] rows
+        (identity for non-image arguments)."""
+        if not self.nhwc:
+            return self
+        B, H, W, C = self.value.shape
+        flat = self.value.transpose(0, 3, 1, 2).reshape(B, C * H * W)
+        return self.replace(value=flat, nhwc=False,
+                            frame_height=H, frame_width=W)

@@ -37,7 +37,7 @@ def register_evaluator(*names):
 
 
 def _get(outputs: dict[str, Argument], name: str) -> Argument:
-    return outputs[name]
+    return outputs[name].flatten_image()
 
 
 # -- classification error ---------------------------------------------------

@@ -129,6 +129,9 @@ define_flag("compute_dtype", "", "override compute dtype ('bfloat16' = "
             "mixed precision: fp32 params, bf16 matmuls on the MXU)")
 define_flag("detect_nan", False, "trap FP anomalies (jax_debug_nans; "
             "ref: feenableexcept at TrainerMain.cpp:97)")
+define_flag("nonfinite_check_period", 100, "without --detect_nan, losses "
+            "buffer on device and are bulk-checked every N batches (keeps "
+            "dispatch pipelined — no per-batch host sync)")
 # multi-host bootstrap (ref: --trainer_id/--pservers of the pserver fleet)
 define_flag("coordinator_address", "", "jax.distributed coordinator host:port")
 define_flag("num_processes", 0, "number of cluster processes")

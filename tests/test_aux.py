@@ -62,11 +62,33 @@ class TestParamStats:
 
 class TestNanDiagnosis:
     def test_nonfinite_loss_names_layer(self):
-        """log(negative) in layer 1 -> the error must name that layer."""
+        """Under --detect_nan (the reference's opt-in feenableexcept analog),
+        log(negative) in layer 1 -> the error must name that layer."""
+        from paddle_tpu.utils.flags import FLAGS
         tr = Trainer(_small_config(bad_log=True), seed=0)
-        with pytest.raises(FloatingPointError, match="fc_layer"):
-            # large negative inputs make log() produce NaN
-            tr.train_one_batch(_batch(scale=100.0))
+        old = FLAGS.detect_nan
+        FLAGS.detect_nan = True
+        try:
+            with pytest.raises(FloatingPointError, match="fc_layer"):
+                # large negative inputs make log() produce NaN
+                tr.train_one_batch(_batch(scale=100.0))
+        finally:
+            FLAGS.detect_nan = old
+
+    def test_nonfinite_caught_by_periodic_bulk_check(self):
+        """Without --detect_nan, losses buffer on device (no per-batch host
+        sync) and the bulk check still raises within
+        nonfinite_check_period batches."""
+        from paddle_tpu.utils.flags import FLAGS
+        tr = Trainer(_small_config(bad_log=True), seed=0)
+        old = FLAGS.nonfinite_check_period
+        FLAGS.nonfinite_check_period = 4
+        try:
+            with pytest.raises(FloatingPointError, match="non-finite loss"):
+                for _ in range(4):
+                    tr.train_one_batch(_batch(scale=100.0))
+        finally:
+            FLAGS.nonfinite_check_period = old
 
 
 class TestFlagParsing:
