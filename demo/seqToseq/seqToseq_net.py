@@ -8,10 +8,14 @@ dict_size = get_config_arg("dict_size", int, 32)
 is_generating = get_config_arg("is_generating", bool, False)
 beam_size = get_config_arg("beam_size", int, 3)
 max_length = get_config_arg("max_length", int, 12)
+batch_size = get_config_arg("batch_size", int, 0)
+compute_dtype = get_config_arg("compute_dtype", str, "")
 
-word_vector_dim = 64
-encoder_size = 64
-decoder_size = 64
+# reference-scale dims are 512 (ref: seqToseq_net.py:72-74); the default here
+# is small for fast tests — the bench passes hidden_dim=512
+word_vector_dim = get_config_arg("hidden_dim", int, 64)
+encoder_size = word_vector_dim
+decoder_size = word_vector_dim
 
 define_py_data_sources2(
     train_list=None if is_generating else "demo/seqToseq/train.list",
@@ -20,11 +24,12 @@ define_py_data_sources2(
     obj="process")
 
 settings(
-    batch_size=32 if not is_generating else 8,
+    batch_size=batch_size or (32 if not is_generating else 8),
     learning_rate=5e-4,
     learning_method=AdamOptimizer(),
     regularization=L2Regularization(1e-4 * 32),
-    gradient_clipping_threshold=25)
+    gradient_clipping_threshold=25,
+    compute_dtype=compute_dtype)
 
 # ---------------- encoder ----------------
 src_word = data_layer(name="source_language_word", size=dict_size)

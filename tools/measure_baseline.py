@@ -1,0 +1,200 @@
+"""Measure the reference-framework baseline for BASELINE.json.
+
+Why a torch-CPU proxy: the reference (PaddlePaddle v0.9.0, C++/CUDA) hard-
+requires Python 2.7 + SWIG + period libraries at build time
+(ref: CMakeLists.txt:14-18 `find_package(PythonLibs 2.7 REQUIRED)`), none of
+which exist in this image and none of which can be installed (zero egress).
+No GPU is present either, so the "Paddle-GPU" target cannot be measured
+directly.  What CAN be measured on this host is the same training math —
+layer-for-layer reimplementations of the two north-star configs
+(ref: demo/image_classification/vgg_16_cifar.py — small_vgg;
+demo/seqToseq/seqToseq_net.py:70-120 — bi-GRU + attention GRU decoder) in
+torch CPU, whose MKL/oneDNN kernels are a generous stand-in for the
+reference's CPU path (SSE/AVX hand kernels + CBLAS, README.md:30-47).
+
+Writes the measured numbers + full provenance into BASELINE.json
+`published`.  Usage: python tools/measure_baseline.py [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import time
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cpu_name() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.startswith("model name"):
+                    return ln.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    return platform.processor() or "unknown"
+
+
+class SmallVGG(nn.Module):
+    """small_vgg of the reference demos (ref: trainer_config_helpers/
+    networks.py:418 — conv groups [64x2,128x2,256x3,512x3] with BN, 8x8 pool,
+    dropout, fc 512 + BN, softmax 10)."""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        chans = [(3, 64), (64, 64), (64, 128), (128, 128), (128, 256),
+                 (256, 256), (256, 256), (256, 512), (512, 512), (512, 512)]
+        pool_after = {1, 3, 6, 9}
+        layers: list[nn.Module] = []
+        for i, (ci, co) in enumerate(chans):
+            layers += [nn.Conv2d(ci, co, 3, padding=1),
+                       nn.BatchNorm2d(co), nn.ReLU()]
+            if i in pool_after:
+                layers.append(nn.MaxPool2d(2, 2))
+        layers.append(nn.MaxPool2d(2, 2))  # img_pool 8x8/8 on the 2x2 map -> 1x1
+        self.features = nn.Sequential(*layers)
+        self.drop = nn.Dropout(0.5)
+        self.fc1 = nn.Linear(512, 512)
+        self.bn1 = nn.BatchNorm1d(512)
+        self.drop1 = nn.Dropout(0.5)
+        self.fc2 = nn.Linear(512, num_classes)
+
+    def forward(self, x):
+        h = self.features(x).flatten(1)
+        h = self.bn1(self.fc1(self.drop(h))).relu()
+        return self.fc2(self.drop1(h))
+
+
+def bench_vgg(steps: int, batch: int = 128) -> float:
+    torch.manual_seed(0)
+    model = SmallVGG()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1 / 128,
+                          momentum=0.9, weight_decay=0.0005 * 128)
+    x = torch.randn(batch, 3, 32, 32)
+    y = torch.randint(0, 10, (batch,))
+    # warmup
+    loss = F.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+class AttnSeq2Seq(nn.Module):
+    """The reference's gru_encoder_decoder (ref: demo/seqToseq/
+    seqToseq_net.py:70-120): embedding 512, bi-GRU encoder 512/dir,
+    additive attention, GRU decoder 512, softmax over the target dict."""
+
+    def __init__(self, vocab: int = 30000, dim: int = 512):
+        super().__init__()
+        self.src_emb = nn.Embedding(vocab, dim)
+        self.trg_emb = nn.Embedding(vocab, dim)
+        self.enc_f = nn.GRU(dim, dim, batch_first=True)
+        self.enc_b = nn.GRU(dim, dim, batch_first=True)
+        self.enc_proj = nn.Linear(2 * dim, dim, bias=False)
+        self.boot = nn.Linear(dim, dim)
+        self.att_dec = nn.Linear(dim, dim, bias=False)
+        self.att_v = nn.Linear(dim, 1, bias=False)
+        self.dec_in = nn.Linear(2 * dim + dim, 3 * dim, bias=False)
+        self.cell = nn.GRUCell(3 * dim, dim)
+        self.out = nn.Linear(dim, vocab)
+
+    def forward(self, src, trg_in):
+        es = self.src_emb(src)
+        hf, _ = self.enc_f(es)
+        hb, _ = self.enc_b(es.flip(1))
+        hb = hb.flip(1)
+        enc = torch.cat([hf, hb], -1)            # [B,T,2D]
+        proj = self.enc_proj(enc)                # [B,T,D]
+        state = torch.tanh(self.boot(hb[:, 0]))  # [B,D]
+        et = self.trg_emb(trg_in)
+        logits = []
+        for t in range(trg_in.shape[1]):
+            scores = self.att_v(torch.tanh(proj + self.att_dec(state)[:, None]))
+            alpha = scores.softmax(1)            # [B,T,1]
+            ctx = (alpha * enc).sum(1)           # [B,2D]
+            inp = self.dec_in(torch.cat([ctx, et[:, t]], -1))
+            state = self.cell(inp, state)
+            logits.append(self.out(state))
+        return torch.stack(logits, 1)
+
+
+def bench_seq2seq(steps: int, batch: int = 64, srclen: int = 30,
+                  trglen: int = 30, vocab: int = 30000) -> float:
+    torch.manual_seed(0)
+    model = AttnSeq2Seq(vocab=vocab)
+    opt = torch.optim.Adam(model.parameters(), lr=5e-4, weight_decay=1e-4)
+    src = torch.randint(0, vocab, (batch, srclen))
+    trg_in = torch.randint(0, vocab, (batch, trglen))
+    trg_out = torch.randint(0, vocab, (batch, trglen))
+    loss = F.cross_entropy(model(src, trg_in).flatten(0, 1), trg_out.flatten())
+    loss.backward()
+    opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = F.cross_entropy(model(src, trg_in).flatten(0, 1),
+                               trg_out.flatten())
+        loss.backward()
+        opt.step()
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--out", default=os.path.join(ROOT, "BASELINE.json"))
+    args = ap.parse_args()
+
+    hw = {"cpu": _cpu_name(), "cores": os.cpu_count(),
+          "torch": torch.__version__, "threads": torch.get_num_threads()}
+    print(f"host: {hw}")
+
+    vgg = bench_vgg(args.steps)
+    print(f"vgg16_cifar10 (torch-CPU, batch 128): {vgg:.2f} samples/sec")
+    s2s = bench_seq2seq(args.steps)
+    print(f"wmt14_seq2seq (torch-CPU, batch 64, T=30, vocab 30k): "
+          f"{s2s:.2f} samples/sec")
+
+    with open(args.out) as f:
+        base = json.load(f)
+    base["published"] = {
+        "vgg16_cifar10": {
+            "samples_per_sec": round(vgg, 2),
+            "config": "small_vgg CIFAR-10, batch 128, SGD momentum 0.9 + L2",
+            "how": "torch-CPU reimplementation of the reference model "
+                   "(see tools/measure_baseline.py docstring: the v0.9.0 "
+                   "C++ build requires Python 2.7 — unbuildable here; no "
+                   "GPU present for the Paddle-GPU target)",
+            "hardware": hw,
+        },
+        "wmt14_seq2seq": {
+            "samples_per_sec": round(s2s, 2),
+            "config": "bi-GRU 512 encoder + attention GRU 512 decoder, "
+                      "vocab 30000, batch 64, src/trg len 30, Adam",
+            "how": "torch-CPU reimplementation (same caveats)",
+            "hardware": hw,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(base, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
