@@ -1,7 +1,12 @@
-"""Benchmark: small-VGG CIFAR-10 training throughput (north-star #1).
+"""Benchmark: the two north-star configs (BASELINE.md).
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
-Runs on whatever backend JAX selects (real TPU under the driver).
+#1 small-VGG CIFAR-10 training throughput (samples/sec/chip + MFU)
+#2 WMT14-style attention seq2seq: training samples/sec + beam-decode tokens/sec
+
+Prints ONE JSON line: the primary (VGG) metric at the top level, with the
+seq2seq numbers nested under "seq2seq" — both carry `vs_baseline` ratios
+against the measured reference numbers in BASELINE.json (see
+tools/measure_baseline.py for how those were measured).
 
 Measurement shape: batches are staged in device HBM and the full per-batch
 training step (loss + backward + optimizer, identical to Trainer.train)
@@ -10,9 +15,6 @@ pipeline, where an async host pipeline keeps data resident ahead of
 compute (ref: the reference's DoubleBuffer prefetch,
 gserver/dataproviders/DataProvider.h:260).  MFU is reported from XLA's own
 flop count for the compiled step against the chip's peak.
-
-`vs_baseline` compares against the measured reference baseline recorded in
-BASELINE.json (reference paddle_trainer --job=time; see BASELINE.md).
 """
 
 from __future__ import annotations
@@ -50,7 +52,23 @@ def _baseline_ratio(value: float, key: str) -> float:
         return 0.0
 
 
-def main() -> None:
+def _step_mfu(tr, batch, samples_per_sec: float, batch_size: int,
+              dtype: str) -> float:
+    """MFU from XLA's own flop count of the compiled per-batch step."""
+    try:
+        import jax
+        ca = tr._train_step.lower(
+            tr.params, tr.opt_state, tr.net_state, batch,
+            jax.random.PRNGKey(0)).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        step_flops = float(ca.get("flops", 0.0))
+        achieved = step_flops * (samples_per_sec / batch_size)  # flops/sec
+        return achieved / (_chip_peak_tflops(dtype) * 1e12)
+    except Exception:
+        return 0.0
+
+
+def bench_vgg(dtype: str) -> dict:
     import numpy as np
 
     from paddle_tpu.config.parser import parse_config
@@ -59,9 +77,6 @@ def main() -> None:
 
     batch_size = int(os.environ.get("BENCH_BATCH_SIZE", "128"))
     iters = int(os.environ.get("BENCH_ITERS", "200"))
-    # bfloat16 is the TPU-native float: fp32 master params, bf16 matmuls on
-    # the MXU, fp32 softmax/BN-stats/loss (BENCH_DTYPE=float32 opts out)
-    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     cfg = parse_config("demo/image_classification/vgg_16_cifar.py",
                        f"batch_size={batch_size},compute_dtype={dtype}")
@@ -76,28 +91,97 @@ def main() -> None:
 
     stats = tr.benchmark(iter(batches), warmup=2, iters=iters, scan=True)
     value = stats["samples_per_sec"]
-
-    # MFU from XLA's flop count of the compiled per-batch step
-    mfu = 0.0
-    try:
-        import jax
-        ca = tr._train_step.lower(
-            tr.params, tr.opt_state, tr.net_state, batches[0],
-            jax.random.PRNGKey(0)).compile().cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
-        step_flops = float(ca.get("flops", 0.0))
-        achieved = step_flops * (value / batch_size)  # flops/sec
-        mfu = achieved / (_chip_peak_tflops(dtype) * 1e12)
-    except Exception:
-        pass
-
-    print(json.dumps({
+    return {
         "metric": "vgg16_cifar10_train_samples_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": _baseline_ratio(value, "vgg16_cifar10"),
-        "mfu": round(mfu, 4),
-    }))
+        "mfu": round(_step_mfu(tr, batches[0], value, batch_size, dtype), 4),
+    }
+
+
+def bench_seq2seq(dtype: str) -> dict:
+    """North-star #2 (ref: demo/seqToseq/seqToseq_net.py:70-120): bi-GRU 512
+    encoder + additive-attention GRU 512 decoder, vocab 30k — the WMT14
+    training shape on synthetic ids (throughput does not depend on token
+    values), plus compiled beam-search decode tokens/sec."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.graph.builder import GraphExecutor
+    from paddle_tpu.graph.generator import generate
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    vocab = int(os.environ.get("BENCH_S2S_VOCAB", "30000"))
+    hidden = int(os.environ.get("BENCH_S2S_HIDDEN", "512"))
+    batch_size = int(os.environ.get("BENCH_S2S_BATCH", "64"))
+    seqlen = int(os.environ.get("BENCH_S2S_LEN", "30"))
+    iters = int(os.environ.get("BENCH_S2S_ITERS", "50"))
+
+    cfg = parse_config(
+        "demo/seqToseq/seqToseq_net.py",
+        f"dict_size={vocab},hidden_dim={hidden},batch_size={batch_size},"
+        f"compute_dtype={dtype}")
+    tr = Trainer(cfg, seed=1)
+
+    rng = np.random.default_rng(0)
+    full = np.full((batch_size,), seqlen, np.int32)
+    batches = []
+    for _ in range(2 + iters):
+        src = rng.integers(3, vocab, (batch_size, seqlen)).astype(np.int32)
+        trg = rng.integers(3, vocab, (batch_size, seqlen)).astype(np.int32)
+        batches.append({
+            "source_language_word": Argument(ids=src, lengths=full),
+            "target_language_word": Argument(ids=trg, lengths=full),
+            "target_language_next_word": Argument(ids=trg, lengths=full),
+        })
+    stats = tr.benchmark(iter(batches), warmup=2, iters=iters, scan=True)
+    train_sps = stats["samples_per_sec"]
+
+    # beam decode tokens/sec: compiled beam search over the trained params
+    beam = int(os.environ.get("BENCH_S2S_BEAM", "3"))
+    max_len = int(os.environ.get("BENCH_S2S_MAXLEN", "30"))
+    gcfg = parse_config(
+        "demo/seqToseq/seqToseq_net.py",
+        f"dict_size={vocab},hidden_dim={hidden},is_generating=1,"
+        f"beam_size={beam},max_length={max_len},compute_dtype={dtype}")
+    gex = GraphExecutor(gcfg.model_config)
+    gparams = {p.name: tr.params[p.name]
+               for p in gcfg.model_config.parameters}
+    feed = {"source_language_word":
+            Argument(ids=batches[0]["source_language_word"].ids,
+                     lengths=full)}
+    seqs, _ = generate(gex, gparams, feed)          # compile + warmup
+    np.asarray(seqs)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        seqs, _ = generate(gex, gparams, feed)
+    n_tokens = int(np.asarray(seqs).shape[0]) * max_len * reps
+    decode_tps = n_tokens / (time.perf_counter() - t0)
+
+    return {
+        "metric": "wmt14_seq2seq_train_samples_per_sec_per_chip",
+        "value": round(train_sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": _baseline_ratio(train_sps, "wmt14_seq2seq"),
+        "beam_decode_tokens_per_sec": round(decode_tps, 2),
+    }
+
+
+def main() -> None:
+    # bfloat16 is the TPU-native float: fp32 master params, bf16 matmuls on
+    # the MXU, fp32 softmax/BN-stats/loss (BENCH_DTYPE=float32 opts out)
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    vgg = bench_vgg(dtype)
+    out = dict(vgg)
+    if os.environ.get("BENCH_SKIP_S2S", "0") != "1":
+        out["seq2seq"] = bench_seq2seq(dtype)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -247,7 +247,10 @@ class GraphExecutor:
             for mem in sm.memories:
                 out = sub.outputs[mem.link_name].data
                 v = valid.reshape((B,) + (1,) * (out.ndim - 1))
-                new_carry[mem.link_name] = jnp.where(v, out, carry[mem.link_name])
+                prev = carry[mem.link_name]
+                # keep the carry dtype fixed across steps (a stray fp32 op in
+                # the step body must not flip a bf16 memory to fp32 mid-scan)
+                new_carry[mem.link_name] = jnp.where(v, out, prev).astype(prev.dtype)
             emitted = {name: sub.outputs[name].data for name in sm.output_layer_names}
             return new_carry, emitted
 

@@ -42,7 +42,9 @@ from paddle_tpu.utils.dtypes import promote_compute as _f32
 
 @_register("softmax")
 def softmax(x: Array, **_) -> Array:
-    return jax.nn.softmax(_f32(x), axis=-1)
+    # fp32 exponentials/sum for stability, result back in the compute dtype
+    # so bf16 doesn't silently leak to fp32 downstream (cost layers re-promote)
+    return jax.nn.softmax(_f32(x), axis=-1).astype(x.dtype)
 
 
 @_register("sequence_softmax")
@@ -51,6 +53,7 @@ def sequence_softmax(x: Array, mask: Optional[Array] = None, **_) -> Array:
     scalars, masked by validity (ref: SequenceSoftmaxActivation — softmax over
     each variable-length sequence's scalar scores, used by attention)."""
     squeeze = False
+    in_dtype = x.dtype
     x = _f32(x)
     if x.ndim == 3 and x.shape[-1] == 1:
         x = x[..., 0]
@@ -62,7 +65,7 @@ def sequence_softmax(x: Array, mask: Optional[Array] = None, **_) -> Array:
         out = jnp.where(mask, out, 0.0)
     if squeeze:
         out = out[..., None]
-    return out
+    return out.astype(in_dtype)
 
 
 @_register("relu")
