@@ -331,6 +331,10 @@ class SubModelConfig(_Serializable):
     is_recurrent_layer_group: bool = False
     reversed: bool = False
     generator: Optional[GeneratorConfig] = None
+    # enclosing recurrent group ('' = top level).  A nested group runs inside
+    # its parent's scan step (ref: RecurrentGradientMachine.cpp:626-699 —
+    # hierarchical RNN over sub-sequences)
+    parent: str = ""
 
 
 @_schema

@@ -52,6 +52,34 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                     for j, v in pairs:
                         arr[i, j] = v
                 out[name] = Argument(value=arr)
+        elif t.seq_type == SeqType.SUB_SEQUENCE:
+            # nested sequence: sample = list of subsequences.  Packed as
+            # [B, S, T(, dim)] + lengths [B] (#subsequences) + sub_lengths
+            # [B, S] (tokens per subsequence)
+            n_sub = np.asarray([len(v) for v in vals], np.int32)
+            # bucket the subsequence axis too — exact per-batch maxima would
+            # recompile the jitted step for every distinct document shape
+            S = _bucket_len(max(int(n_sub.max()) if n_sub.size else 1, 1),
+                            bucket_sizes=(2, 4, 8, 16, 32, 64, 128))
+            sub_l = np.zeros((B, S), np.int32)
+            for i, subs in enumerate(vals):
+                for j, ss in enumerate(subs):
+                    sub_l[i, j] = len(ss)
+            T = pad_len or _bucket_len(max(int(sub_l.max()), 1))
+            if t.kind == SlotKind.INDEX:
+                arr = np.zeros((B, S, T), np.int32)
+                for i, subs in enumerate(vals):
+                    for j, ss in enumerate(subs):
+                        arr[i, j, :len(ss)] = np.asarray(ss, np.int32)
+                out[name] = Argument(ids=arr, lengths=n_sub, sub_lengths=sub_l)
+            elif t.kind == SlotKind.DENSE:
+                arr = np.zeros((B, S, T, t.dim), np.float32)
+                for i, subs in enumerate(vals):
+                    for j, ss in enumerate(subs):
+                        arr[i, j, :len(ss)] = np.asarray(ss, np.float32)
+                out[name] = Argument(value=arr, lengths=n_sub, sub_lengths=sub_l)
+            else:
+                raise NotImplementedError("sparse sub-sequence slots")
         else:
             lengths = np.asarray([len(v) for v in vals], np.int32)
             T = pad_len or _bucket_len(int(lengths.max()) if B else 1)

@@ -56,7 +56,8 @@ __all__ = [
     "multi_binary_label_cross_entropy", "rank_cost", "lambda_cost",
     "huber_cost", "sum_cost",
     "crf_layer", "crf_decoding_layer", "ctc_layer", "nce_layer", "hsigmoid",
-    "recurrent_group", "memory", "StaticInput", "GeneratedInput", "beam_search",
+    "recurrent_group", "memory", "StaticInput", "SubsequenceInput",
+    "GeneratedInput", "beam_search",
     "get_output_layer",
     "LayerOutput",
 ]
@@ -1369,6 +1370,16 @@ class StaticInput:
         self.size = size or input.size
 
 
+class SubsequenceInput:
+    """Marks a recurrent_group in-link as a nested (level-2) sequence: the
+    group steps over SUB-SEQUENCES, feeding each step a full [B, T, ...]
+    sequence — the hierarchical-RNN form (ref: layers.py SubsequenceInput;
+    RecurrentGradientMachine.cpp:626-699)."""
+
+    def __init__(self, input: LayerOutput):
+        self.input = input
+
+
 class GeneratedInput:
     """Feedback input for generation: embedding of the previously generated
     token (ref: layers.py GeneratedInput)."""
@@ -1416,13 +1427,26 @@ def recurrent_group(step, input, reverse: bool = False,
     inputs = input if isinstance(input, (list, tuple)) else [input]
 
     sm = SubModelConfig(name=name, is_recurrent_layer_group=True, reversed=reverse)
+    if ctx.group_stack:
+        # nested group: executed inside the enclosing group's scan step
+        sm.parent = ctx.group_stack[-1].name
     ctx.model.sub_models.append(sm)
     ctx.group_stack.append(sm)
     try:
         step_args = []
         gen_inputs = []
         for inp in inputs:
-            if isinstance(inp, LayerOutput):
+            if isinstance(inp, SubsequenceInput):
+                # nested in-link: each step receives one whole subsequence
+                src = inp.input
+                alias = ctx.unique_name(f"inlink_{src.name}")
+                ctx.add_layer(LayerConfig(name=alias, type="scatter_agent",
+                                          size=src.size))
+                sm.in_links.append(src.name)
+                sm.in_link_layers.append(alias)
+                step_args.append(LayerOutput(alias, "scatter_agent", src.size,
+                                             seq_level=1))
+            elif isinstance(inp, LayerOutput):
                 # sequence in-link -> in-group alias (per-step slice)
                 alias = ctx.unique_name(f"inlink_{inp.name}")
                 ctx.add_layer(LayerConfig(name=alias, type="scatter_agent", size=inp.size))

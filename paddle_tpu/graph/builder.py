@@ -59,17 +59,26 @@ class GraphExecutor:
         self.compute_dtype = compute_dtype
         self.layer_map: dict[str, LayerConfig] = {l.name: l for l in model.layers}
         # layers belonging to a recurrent sub-model are executed by its scan
+        # (layer_names holds only the INNERMOST group's layers, so _sub_of
+        # maps each layer to the group whose step body runs it)
         self._sub_of: dict[str, SubModelConfig] = {}
+        self._sub_by_name: dict[str, SubModelConfig] = {}
         for sm in model.sub_models:
             if sm.is_recurrent_layer_group:
+                self._sub_by_name[sm.name] = sm
                 for ln in sm.layer_names:
                     self._sub_of[ln] = sm
+        # per-group execution plans (nested groups appear as ('scan', child)
+        # items inside their parent's plan)
+        self._sub_plan: dict[str, list[tuple[str, Any]]] = {}
         self._plan = self._build_plan()
 
     # -- planning ---------------------------------------------------------
     def _build_plan(self) -> list[tuple[str, Any]]:
-        """Execution plan: ('layer', cfg) and ('scan', sub_model) items in
-        config order (the DSL emits layers topologically, like config_parser)."""
+        """Execution plans: ('layer', cfg) and ('scan', sub_model) items in
+        config order (the DSL emits layers topologically, like config_parser).
+        The top-level plan holds root groups; each group's own plan
+        (self._sub_plan) interleaves its layers with nested child scans."""
         plan: list[tuple[str, Any]] = []
         seen_subs: set[str] = set()
         for l in self.model.layers:
@@ -78,9 +87,19 @@ class GraphExecutor:
                 if l.type != "data":
                     plan.append(("layer", l))
                 continue
-            if sm.name not in seen_subs:
-                seen_subs.add(sm.name)
-                plan.append(("scan", sm))
+            self._sub_plan.setdefault(sm.name, []).append(("layer", l))
+            # first appearance of a group (or of any of its descendants)
+            # emits a ('scan', group) item into its parent's plan
+            child = sm
+            while child is not None and child.name not in seen_subs:
+                seen_subs.add(child.name)
+                if child.parent:
+                    self._sub_plan.setdefault(child.parent, []).append(
+                        ("scan", child))
+                    child = self._sub_by_name[child.parent]
+                else:
+                    plan.append(("scan", child))
+                    child = None
         return plan
 
     # -- parameters -------------------------------------------------------
@@ -166,8 +185,13 @@ class GraphExecutor:
 
     def run_group_layers(self, sm: SubModelConfig, sub: ForwardContext) -> None:
         """Execute one timestep of a sub-model's layers; agent/alias layers
-        must already be fed into sub.outputs."""
-        for cfg in (self.layer_map[n] for n in sm.layer_names):
+        must already be fed into sub.outputs.  Nested child groups run as
+        inner scans at their position in the plan."""
+        for kind, item in self._sub_plan.get(sm.name, []):
+            if kind == "scan":
+                self._run_scan(sub, item)
+                continue
+            cfg: LayerConfig = item
             if cfg.name in sub.outputs:      # agents already fed
                 continue
             sub.outputs[cfg.name] = get_layer_fn(cfg.type)(sub, cfg)
@@ -186,14 +210,28 @@ class GraphExecutor:
         in_link_alias = dict(zip(sm.in_links, sm.in_link_layers))
         static_alias = dict(zip(sm.static_links, sm.static_link_layers))
 
-        # outside sequence inputs: [B, T, D] -> time-major [T, B, D]
+        # outside sequence inputs: [B, T, D] -> time-major [T, B, D].
+        # A nested (level-2) in_link [B, S, T, ...] + sub_lengths instead
+        # iterates over the SUBSEQUENCE axis: each step feeds one whole
+        # [B, T, ...] sequence with that subsequence's lengths
+        # (ref: RecurrentGradientMachine.cpp:626-699 hierarchical forward)
         xs = {}
         lengths = None
+        sub_lens_src = None          # [B, S] of the nested in_link(s)
         T = None
         for outer in sm.in_links:
             arg = ctx.outputs[outer]
             assert arg.is_sequence, f"in_link {outer!r} must be a sequence"
             seq = arg.data
+            if arg.sub_lengths is not None:
+                assert not sm.reversed, \
+                    "reverse=True on a nested recurrent group is not supported"
+                xs[outer] = jnp.moveaxis(seq, 1, 0)              # [S, B, T, ..]
+                xs["__sublen__" + outer] = jnp.moveaxis(arg.sub_lengths, 1, 0)
+                sub_lens_src = arg.sub_lengths
+                lengths = arg.lengths if lengths is None else jnp.maximum(lengths, arg.lengths)
+                T = seq.shape[1] if T is None else max(T, seq.shape[1])
+                continue
             if sm.reversed:
                 from paddle_tpu.ops.sequence import seq_reverse
                 seq = seq_reverse(seq, arg.lengths)
@@ -219,19 +257,24 @@ class GraphExecutor:
         params = ctx.params
         model = self.model
 
+        out_is_seq: dict[str, bool] = {}   # filled once during scan tracing
+
         def step(carry, inp):
             t = inp["__t__"]
             sub = ForwardContext(model=model, params=params, mode=mode,
                                  rng=(jax.random.fold_in(rng, t) if rng is not None else None))
             # feed sliced in_links through their in-group alias layers,
             # preserving ids-vs-value payload kind (an integer id sequence
-            # must stay an ids Argument so table projections index correctly)
+            # must stay an ids Argument so table projections index correctly);
+            # a nested link's slice is itself a sequence with this
+            # subsequence's lengths
             for outer, inner in in_link_alias.items():
                 sl = inp[outer]
+                sub_len = inp.get("__sublen__" + outer)
                 if jnp.issubdtype(sl.dtype, jnp.integer):
-                    sub.outputs[inner] = Argument(ids=sl)
+                    sub.outputs[inner] = Argument(ids=sl, lengths=sub_len)
                 else:
-                    sub.outputs[inner] = Argument(value=sl)
+                    sub.outputs[inner] = Argument(value=sl, lengths=sub_len)
             # feed static links: same value every step (ref: StaticInput)
             for outer, inner in static_alias.items():
                 sub.outputs[inner] = ctx.outputs[outer]
@@ -251,17 +294,27 @@ class GraphExecutor:
                 # keep the carry dtype fixed across steps (a stray fp32 op in
                 # the step body must not flip a bf16 memory to fp32 mid-scan)
                 new_carry[mem.link_name] = jnp.where(v, out, prev).astype(prev.dtype)
-            emitted = {name: sub.outputs[name].data for name in sm.output_layer_names}
+            emitted = {}
+            for name in sm.output_layer_names:
+                o = sub.outputs[name]
+                out_is_seq[name] = o.lengths is not None
+                emitted[name] = o.data
             return new_carry, emitted
 
         inp_seq = {"__t__": jnp.arange(T)}
         inp_seq.update(xs)
         _, stacked = jax.lax.scan(step, carry0, inp_seq)
 
-        # publish out_links as [B, T, D] sequences
+        # publish out_links as [B, T, D] sequences; a nested group whose step
+        # emitted per-subsequence sequences publishes [B, S, T, D] with the
+        # in_link's subsequence structure
         for name in sm.output_layer_names:
             seq = jnp.moveaxis(stacked[name], 0, 1)
             if sm.reversed:
                 from paddle_tpu.ops.sequence import seq_reverse
                 seq = seq_reverse(seq, lengths)
-            ctx.outputs[name] = Argument(value=seq, lengths=lengths)
+            if sub_lens_src is not None and out_is_seq.get(name):
+                ctx.outputs[name] = Argument(value=seq, lengths=lengths,
+                                             sub_lengths=sub_lens_src)
+            else:
+                ctx.outputs[name] = Argument(value=seq, lengths=lengths)

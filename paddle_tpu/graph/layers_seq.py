@@ -31,21 +31,32 @@ Array = jax.Array
 @register_layer("max")
 def max_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
-    out = seqops.seq_pool_max(x.value, x.lengths)
+    if x.sub_lengths is not None:
+        out = seqops.nested_pool_max(x.value, x.lengths, x.sub_lengths)
+    else:
+        out = seqops.seq_pool_max(x.value, x.lengths)
     return finish_layer(ctx, cfg, out)
 
 
 @register_layer("average")
 def average_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
-    out = seqops.seq_pool_avg(x.value, x.lengths, cfg.average_strategy)
+    if x.sub_lengths is not None:
+        out = seqops.nested_pool_avg(x.value, x.lengths, x.sub_lengths,
+                                     cfg.average_strategy)
+    else:
+        out = seqops.seq_pool_avg(x.value, x.lengths, cfg.average_strategy)
     return finish_layer(ctx, cfg, out)
 
 
 @register_layer("seqlastins")
 def seq_last_ins_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
-    if cfg.select_first:
+    if x.sub_lengths is not None:
+        pool = (seqops.nested_pool_first if cfg.select_first
+                else seqops.nested_pool_last)
+        out = pool(x.value, x.lengths, x.sub_lengths)
+    elif cfg.select_first:
         out = seqops.seq_pool_first(x.value, x.lengths)
     else:
         out = seqops.seq_pool_last(x.value, x.lengths)

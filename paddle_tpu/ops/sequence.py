@@ -56,6 +56,59 @@ def seq_pool_first(x: Array, lengths: Array) -> Array:
     return x[:, 0]
 
 
+def nested_mask(lengths: Array, sub_lengths: Array, T: int,
+                dtype=bool) -> Array:
+    """Validity mask for a nested sequence [B, S, T]: position (b, s, t) is
+    valid iff s < lengths[b] and t < sub_lengths[b, s]."""
+    B, S = sub_lengths.shape
+    s_valid = jnp.arange(S)[None, :] < lengths[:, None]               # [B,S]
+    t_valid = jnp.arange(T)[None, None, :] < sub_lengths[:, :, None]  # [B,S,T]
+    return (s_valid[:, :, None] & t_valid).astype(dtype)
+
+
+def nested_pool_max(x: Array, lengths: Array, sub_lengths: Array) -> Array:
+    """Max over all valid tokens of a nested sequence: [B,S,T,D] -> [B,D]."""
+    mask = nested_mask(lengths, sub_lengths, x.shape[2])[..., None]
+    neg = jnp.finfo(x.dtype).min
+    return jnp.max(jnp.where(mask, x, neg), axis=(1, 2))
+
+
+def nested_pool_avg(x: Array, lengths: Array, sub_lengths: Array,
+                    strategy: str = "average") -> Array:
+    """Mean/sum/sqrt-n over all valid tokens: [B,S,T,D] -> [B,D]."""
+    mask = nested_mask(lengths, sub_lengths, x.shape[2], x.dtype)[..., None]
+    total = jnp.sum(x * mask, axis=(1, 2))
+    n = jnp.maximum(jnp.sum(mask, axis=(1, 2)), 1.0)
+    if strategy == "sum":
+        return total
+    if strategy == "squarerootn":
+        return total / jnp.sqrt(n)
+    return total / n
+
+
+def nested_pool_last(x: Array, lengths: Array, sub_lengths: Array) -> Array:
+    """Last valid token overall: [B,S,T,D] -> [B,D] (ref:
+    SequenceLastInstanceLayer on nested input).  Robust to empty
+    subsequences anywhere in the valid region."""
+    B, S, T = x.shape[:3]
+    mask = nested_mask(lengths, sub_lengths, T).reshape(B, S * T)
+    idx = (S * T - 1) - jnp.argmax(mask[:, ::-1], axis=1)  # 0-pad if none valid
+    flat = x.reshape((B, S * T) + x.shape[3:])
+    expand = idx.reshape((B, 1) + (1,) * (flat.ndim - 2))
+    return jnp.take_along_axis(flat, expand, axis=1)[:, 0]
+
+
+def nested_pool_first(x: Array, lengths: Array, sub_lengths: Array) -> Array:
+    """First valid token overall: [B,S,T,D] -> [B,D].  Robust to empty
+    subsequences anywhere in the valid region."""
+    B, S, T = x.shape[:3]
+    mask = nested_mask(lengths, sub_lengths, T).reshape(B, S * T)
+    idx = jnp.argmax(mask, axis=1)
+    flat = x.reshape((B, S * T) + x.shape[3:])
+    expand = idx.reshape((B, 1) + (1,) * (flat.ndim - 2))
+    return jnp.take_along_axis(flat, expand, axis=1)[:, 0]
+
+
 def expand_to_sequence(x: Array, lengths: Array, max_len: int) -> Array:
     """Broadcast per-sequence vectors across timesteps: [B,D] -> [B,T,D],
     zeroed past each length (ref: ExpandLayer)."""

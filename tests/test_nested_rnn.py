@@ -1,0 +1,112 @@
+"""Nested-vs-flat recurrent group equivalence — the reference's hierarchical
+RNN oracle (ref: paddle/gserver/tests/test_RecurrentGradientMachine.cpp
+test_reversed_grnn / CalCost over sequence_nest_rnn.conf vs sequence_rnn.conf;
+RecurrentGradientMachine.cpp:626-699): a hierarchical RNN whose inner memory
+boots from the outer carry must compute exactly what the flat RNN computes on
+the concatenated token stream — same cost, same gradients."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.data.feeder import make_batch
+from paddle_tpu.data.provider import (integer_value,
+                                      integer_value_sequence,
+                                      integer_value_sub_sequence)
+from paddle_tpu.graph.builder import GraphExecutor
+
+NEST_CFG = os.path.join(REPO, "tests/configs/sequence_nest_rnn.py")
+FLAT_CFG = os.path.join(REPO, "tests/configs/sequence_rnn.py")
+
+# the reference's rnn_data_provider data: (subsequences, label)
+DATA = [
+    [[[1, 3, 2], [4, 5, 2]], 0],
+    [[[0, 2], [2, 5], [0, 1, 2]], 1],
+]
+
+
+def _nested_batch():
+    samples = [(d[0], d[1]) for d in DATA]
+    return make_batch(samples,
+                      [integer_value_sub_sequence(10), integer_value(3)],
+                      ["word", "label"])
+
+
+def _flat_batch():
+    samples = [([t for ss in d[0] for t in ss], d[1]) for d in DATA]
+    return make_batch(samples,
+                      [integer_value_sequence(10), integer_value(3)],
+                      ["word", "label"])
+
+
+def _loss_and_grads(cfg_path, batch):
+    cfg = parse_config(cfg_path, "")
+    ex = GraphExecutor(cfg.model_config)
+    params = ex.init_params(jax.random.PRNGKey(7))
+
+    def loss_fn(p):
+        loss, _ = ex.loss(p, batch)
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return float(loss), params, grads
+
+
+def test_nested_matches_flat():
+    nl, nparams, ngrads = _loss_and_grads(NEST_CFG, _nested_batch())
+    fl, fparams, fgrads = _loss_and_grads(FLAT_CFG, _flat_batch())
+
+    # identical parameter sets: same shapes in the same declaration order,
+    # same seed => same values (names differ: inner_rnn_state vs rnn_state)
+    nkeys, fkeys = list(nparams), list(fparams)
+    assert len(nkeys) == len(fkeys)
+    for nk, fk in zip(nkeys, fkeys):
+        np.testing.assert_array_equal(np.asarray(nparams[nk]),
+                                      np.asarray(fparams[fk]))
+
+    assert abs(nl - fl) < 1e-5, (nl, fl)
+    for nk, fk in zip(nkeys, fkeys):
+        np.testing.assert_allclose(np.asarray(ngrads[nk]),
+                                   np.asarray(fgrads[fk]),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"{nk} vs {fk}")
+
+
+def test_nested_pooling_ops():
+    """Nested pooling equals flat pooling over the concatenated tokens."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import sequence as seqops
+
+    rng = np.random.default_rng(0)
+    B, S, T, D = 2, 3, 4, 5
+    x = rng.normal(size=(B, S, T, D)).astype(np.float32)
+    # sample 0 ends with an EMPTY valid subsequence (last/first must skip it)
+    lengths = np.asarray([3, 3], np.int32)            # valid subseqs
+    sub_lengths = np.asarray([[3, 2, 0], [1, 4, 2]], np.int32)
+
+    def flat(b):
+        toks = [x[b, s, t] for s in range(lengths[b])
+                for t in range(sub_lengths[b, s])]
+        return np.stack(toks)
+
+    got_last = np.asarray(seqops.nested_pool_last(
+        jnp.asarray(x), jnp.asarray(lengths), jnp.asarray(sub_lengths)))
+    got_first = np.asarray(seqops.nested_pool_first(
+        jnp.asarray(x), jnp.asarray(lengths), jnp.asarray(sub_lengths)))
+    got_max = np.asarray(seqops.nested_pool_max(
+        jnp.asarray(x), jnp.asarray(lengths), jnp.asarray(sub_lengths)))
+    got_avg = np.asarray(seqops.nested_pool_avg(
+        jnp.asarray(x), jnp.asarray(lengths), jnp.asarray(sub_lengths)))
+    for b in range(B):
+        f = flat(b)
+        np.testing.assert_allclose(got_last[b], f[-1], rtol=1e-6)
+        np.testing.assert_allclose(got_first[b], f[0], rtol=1e-6)
+        np.testing.assert_allclose(got_max[b], f.max(0), rtol=1e-6)
+        np.testing.assert_allclose(got_avg[b], f.mean(0), rtol=1e-5)
