@@ -42,16 +42,26 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
             elif t.kind == SlotKind.INDEX:
                 out[name] = Argument(ids=np.asarray(vals, np.int32).reshape(B))
             elif t.kind == SlotKind.SPARSE_BINARY:
-                arr = np.zeros((B, t.dim), np.float32)
-                for i, ids in enumerate(vals):
-                    arr[i, np.asarray(ids, np.int64)] = 1.0
-                out[name] = Argument(value=arr)
+                # sparse row representation: padded [B, K] nonzero ids + a
+                # validity mask — memory ∝ nnz, never ∝ dim (ref:
+                # SparseRowMatrix.h; PyDataProvider2 sparse_binary_vector)
+                K = _bucket_len(max((len(v) for v in vals), default=1) or 1)
+                ids = np.zeros((B, K), np.int32)
+                w = np.zeros((B, K), np.float32)
+                for i, row in enumerate(vals):
+                    n = len(row)
+                    ids[i, :n] = np.asarray(row, np.int32)
+                    w[i, :n] = 1.0
+                out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim)
             elif t.kind == SlotKind.SPARSE_VALUE:
-                arr = np.zeros((B, t.dim), np.float32)
+                K = _bucket_len(max((len(p) for p in vals), default=1) or 1)
+                ids = np.zeros((B, K), np.int32)
+                w = np.zeros((B, K), np.float32)
                 for i, pairs in enumerate(vals):
-                    for j, v in pairs:
-                        arr[i, j] = v
-                out[name] = Argument(value=arr)
+                    for k, (j, v) in enumerate(pairs):
+                        ids[i, k] = j
+                        w[i, k] = v
+                out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim)
         elif t.seq_type == SeqType.SUB_SEQUENCE:
             # nested sequence: sample = list of subsequences.  Packed as
             # [B, S, T(, dim)] + lengths [B] (#subsequences) + sub_lengths
@@ -94,11 +104,19 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                     arr[i, :len(seq)] = np.asarray(seq, np.float32)
                 out[name] = Argument(value=arr, lengths=lengths)
             elif t.kind == SlotKind.SPARSE_BINARY:
-                arr = np.zeros((B, T, t.dim), np.float32)
+                # per-timestep sparse rows: [B, T, K] ids + validity — same
+                # nnz-proportional representation as the non-sequence slot
+                K = _bucket_len(max((len(ids) for seq in vals for ids in seq),
+                                    default=1) or 1)
+                ids = np.zeros((B, T, K), np.int32)
+                w = np.zeros((B, T, K), np.float32)
                 for i, seq in enumerate(vals):
-                    for j, ids in enumerate(seq):
-                        arr[i, j, np.asarray(ids, np.int64)] = 1.0
-                out[name] = Argument(value=arr, lengths=lengths)
+                    for j, row in enumerate(seq):
+                        n = len(row)
+                        ids[i, j, :n] = np.asarray(row, np.int32)
+                        w[i, j, :n] = 1.0
+                out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim,
+                                     lengths=lengths)
             else:
                 raise NotImplementedError("sparse_value sequences")
     return out

@@ -136,12 +136,14 @@ class GraphExecutor:
             dt = jnp.dtype(self.compute_dtype)
             params = {k: (v.astype(dt) if jnp.issubdtype(v.dtype, jnp.floating)
                           else v) for k, v in params.items()}
-            feed = {
-                name: (arg.replace(value=arg.value.astype(dt))
-                       if arg.value is not None
-                       and jnp.issubdtype(arg.value.dtype, jnp.floating)
-                       else arg)
-                for name, arg in feed.items()}
+            def _cast(arg):
+                if (arg.value is not None
+                        and jnp.issubdtype(arg.value.dtype, jnp.floating)):
+                    arg = arg.replace(value=arg.value.astype(dt))
+                if arg.sparse_vals is not None:
+                    arg = arg.replace(sparse_vals=arg.sparse_vals.astype(dt))
+                return arg
+            feed = {name: _cast(arg) for name, arg in feed.items()}
         ctx = ForwardContext(
             model=self.model, params=params, mode=mode, rng=rng,
             state_in=state or {}, mesh=self.mesh,
@@ -206,7 +208,6 @@ class GraphExecutor:
         out_links are stacked; variable lengths freeze the carry and mask
         outputs — no sorting, no cloning, one compiled scan.
         """
-        group_layers = [self.layer_map[n] for n in sm.layer_names]
         in_link_alias = dict(zip(sm.in_links, sm.in_link_layers))
         static_alias = dict(zip(sm.static_links, sm.static_link_layers))
 
@@ -218,11 +219,22 @@ class GraphExecutor:
         xs = {}
         lengths = None
         sub_lens_src = None          # [B, S] of the nested in_link(s)
+        sparse_links: dict[str, int] = {}   # in_link -> sparse_dim
         T = None
+        nest_levels = {ctx.outputs[o].sub_lengths is not None
+                       for o in sm.in_links}
+        assert len(nest_levels) <= 1, (
+            f"recurrent group {sm.name!r} mixes nested (SubsequenceInput) and "
+            f"flat sequence in_links — all in_links must share one nesting "
+            f"level (the step counts differ)")
         for outer in sm.in_links:
             arg = ctx.outputs[outer]
             assert arg.is_sequence, f"in_link {outer!r} must be a sequence"
             seq = arg.data
+            if arg.sparse_dim:
+                # keep the sparse-row structure through per-step slicing
+                sparse_links[outer] = arg.sparse_dim
+                xs["__spvals__" + outer] = jnp.moveaxis(arg.sparse_vals, 1, 0)
             if arg.sub_lengths is not None:
                 assert not sm.reversed, \
                     "reverse=True on a nested recurrent group is not supported"
@@ -271,7 +283,11 @@ class GraphExecutor:
             for outer, inner in in_link_alias.items():
                 sl = inp[outer]
                 sub_len = inp.get("__sublen__" + outer)
-                if jnp.issubdtype(sl.dtype, jnp.integer):
+                if outer in sparse_links:
+                    sub.outputs[inner] = Argument(
+                        ids=sl, sparse_vals=inp["__spvals__" + outer],
+                        sparse_dim=sparse_links[outer], lengths=sub_len)
+                elif jnp.issubdtype(sl.dtype, jnp.integer):
                     sub.outputs[inner] = Argument(ids=sl, lengths=sub_len)
                 else:
                     sub.outputs[inner] = Argument(value=sl, lengths=sub_len)

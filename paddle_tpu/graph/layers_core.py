@@ -37,6 +37,18 @@ def _matmul(x: Array, w: Array) -> Array:
     return jnp.matmul(x, w)
 
 
+def _input_matmul(arg: Argument, w: Array) -> Array:
+    """x @ W where x may be a sparse-row argument: gather the K touched
+    parameter rows and weight-sum them — compute and memory ∝ nnz, and the
+    backward pass is a scatter-add into only those rows (ref: the reference's
+    SparseRowMatrix / hl_matrix_dense_mul_csr path)."""
+    if arg.sparse_dim:
+        rows = w[arg.ids]                                  # [..., K, Dout]
+        return jnp.sum(rows * arg.sparse_vals[..., None].astype(rows.dtype),
+                       axis=-2)
+    return _matmul(arg.value, w)
+
+
 @register_layer("fc")
 def fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Fully connected: sum_i x_i @ W_i + b, then activation
@@ -45,7 +57,7 @@ def fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     acc = None
     for i, arg in enumerate(inputs):
         w = ctx.param_of(cfg, i)
-        y = _matmul(arg.value, w)
+        y = _input_matmul(arg, w)
         acc = y if acc is None else acc + y
     b = ctx.bias_of(cfg)
     if b is not None:
@@ -62,10 +74,14 @@ def _apply_projection(
 ) -> Array:
     t = proj.type
     if t in ("fc", "full_matrix"):
-        return _matmul(arg.value, w)
+        return _input_matmul(arg, w)
     if t == "trans_full_matrix":
         return _matmul(arg.value, w.T)
     if t == "identity":
+        assert not arg.sparse_dim, (
+            "identity projection over a sparse-row input would expose raw "
+            "column indices as activations — use a full_matrix projection "
+            "(gather path) or Argument.to_dense()")
         return arg.data
     if t == "dot_mul":
         # elementwise scale by a learned vector (ref: DotMulProjection.cpp)
@@ -75,6 +91,10 @@ def _apply_projection(
         return arg.value * w.reshape(())
     if t == "table":
         # embedding lookup (ref: TableProjection.cpp, hl_matrix_select_rows)
+        assert not arg.sparse_dim, (
+            "table projection expects token ids, not sparse-row column "
+            "indices (padding slots would embed id 0) — a sparse slot wants "
+            "a full_matrix projection, which gathers+sums the touched rows")
         return w[arg.ids]
     if t == "context":
         padding = None

@@ -34,6 +34,15 @@ class Argument:
     sub_lengths: Optional[Array] = None
     # per-example weight (ref: Argument.weight)
     weight: Optional[Array] = None
+    # sparse row representation (ref: SparseRowMatrix.h:31-301, the reference's
+    # sparse_binary_vector / sparse_vector slots): `ids` holds [..., K] nonzero
+    # column indices, `sparse_vals` the matching [..., K] values (1/0 validity
+    # mask for binary slots), and sparse_dim the logical row width.  Memory is
+    # proportional to nnz, not dim; consuming layers gather parameter rows
+    # instead of densifying.  `value` stays None so unsupported layers fail
+    # loudly rather than silently mixing representations.
+    sparse_vals: Optional[Array] = None
+    sparse_dim: int = dataclasses.field(default=0, metadata=dict(static=True))
     # image geometry (static, aux data): (height, width)
     frame_height: int = dataclasses.field(default=0, metadata=dict(static=True))
     frame_width: int = dataclasses.field(default=0, metadata=dict(static=True))
@@ -73,6 +82,18 @@ class Argument:
 
     def replace(self, **kw: Any) -> "Argument":
         return dataclasses.replace(self, **kw)
+
+    def to_dense(self) -> "Argument":
+        """Materialize a sparse-row argument as a dense [..., dim] value —
+        an explicit (memory ∝ dim) escape hatch for layers/tools that need
+        the full row; the training path should never call this."""
+        if not self.sparse_dim:
+            return self
+        onehot = jax.nn.one_hot(self.ids, self.sparse_dim,
+                                dtype=self.sparse_vals.dtype)
+        dense = jnp.einsum("...k,...kd->...d", self.sparse_vals, onehot)
+        return Argument(value=dense, lengths=self.lengths,
+                        sub_lengths=self.sub_lengths, weight=self.weight)
 
     def flatten_image(self) -> "Argument":
         """NHWC image -> the reference's flat C-major [B, C*H*W] rows

@@ -1,0 +1,181 @@
+"""Sparse-row input path (ref: paddle/math/SparseRowMatrix.h:31-301;
+python/paddle/trainer/PyDataProvider2.py:57-107 sparse_binary_vector /
+sparse_vector): sparse slots are packed as (ids, vals) with memory ∝ nnz,
+and fc/mixed gather parameter rows instead of densifying — the reference's
+whole point for these types is 100k+-dim vocabularies."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.data.feeder import make_batch
+from paddle_tpu.data.provider import (integer_value, sparse_binary_vector,
+                                      sparse_vector)
+from paddle_tpu.parameter.argument import Argument
+
+
+def test_packing_memory_prop_nnz():
+    """A 200k-dim slot with <=6 nonzeros packs to K=8 columns, not 200k."""
+    dim = 200_000
+    samples = [([5, 17, 199_999], 0), ([2, 3, 4, 5, 6, 7], 1)]
+    b = make_batch(samples, [sparse_binary_vector(dim), integer_value(2)],
+                   ["word", "label"])
+    arg = b["word"]
+    assert arg.sparse_dim == dim
+    assert arg.ids.shape == (2, 8)          # bucketed nnz, NOT dim
+    assert arg.sparse_vals.shape == (2, 8)
+    assert arg.value is None                # never densified
+    np.testing.assert_array_equal(arg.sparse_vals[0, :3], 1.0)
+    np.testing.assert_array_equal(arg.sparse_vals[0, 3:], 0.0)
+
+
+def test_sparse_fc_matches_dense():
+    """fc over the sparse representation == fc over the dense multi-hot."""
+    from paddle_tpu.graph.layers_core import _input_matmul
+
+    rng = np.random.default_rng(0)
+    dim, dout, B = 64, 5, 3
+    w = rng.normal(size=(dim, dout)).astype(np.float32)
+    rows = [[1, 7, 63], [0], [10, 11]]
+    samples = [(r, 0) for r in rows]
+    arg = make_batch(samples, [sparse_binary_vector(dim), integer_value(2)],
+                     ["word", "label"])["word"]
+
+    dense = np.zeros((B, dim), np.float32)
+    for i, r in enumerate(rows):
+        dense[i, r] = 1.0
+
+    got = np.asarray(_input_matmul(arg, w))
+    np.testing.assert_allclose(got, dense @ w, rtol=1e-5, atol=1e-6)
+
+    # weighted (sparse_vector) variant
+    pairs = [[(1, 0.5), (7, -2.0)], [(0, 3.0)], [(10, 1.0), (11, 1.0)]]
+    argv = make_batch([(p, 0) for p in pairs],
+                      [sparse_vector(dim), integer_value(2)],
+                      ["word", "label"])["word"]
+    densev = np.zeros((B, dim), np.float32)
+    for i, ps in enumerate(pairs):
+        for j, v in ps:
+            densev[i, j] = v
+    np.testing.assert_allclose(np.asarray(_input_matmul(argv, w)),
+                               densev @ w, rtol=1e-5, atol=1e-6)
+
+    # to_dense escape hatch round-trips
+    np.testing.assert_allclose(np.asarray(argv.to_dense().value), densev,
+                               rtol=1e-6)
+
+
+def test_sparse_grad_touches_only_gathered_rows():
+    """Backward through the gather is a scatter-add into the nnz rows only."""
+    from paddle_tpu.graph.layers_core import _input_matmul
+
+    dim, dout = 1000, 4
+    w = np.ones((dim, dout), np.float32)
+    arg = make_batch([([3, 900], 0)],
+                     [sparse_binary_vector(dim), integer_value(2)],
+                     ["word", "label"])["word"]
+
+    g = jax.grad(lambda p: _input_matmul(arg, p).sum())(w)
+    g = np.asarray(g)
+    touched = set(np.flatnonzero(np.abs(g).sum(-1)).tolist())
+    assert touched == {3, 900}   # padding slots are zero-weighted: no grad
+    np.testing.assert_array_equal(g[0], 0.0)
+
+
+def test_sparse_sequence_through_recurrent_group():
+    """A sparse_binary_vector_sequence in_link keeps its sparse-row
+    structure through recurrent_group per-step slicing (fc in the step
+    gathers rows; padding slots contribute nothing)."""
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.graph.builder import GraphExecutor
+    from paddle_tpu.data.provider import sparse_binary_vector_sequence
+
+    dim = 512
+    cfg_src = f"""
+from paddle_tpu.dsl import *
+settings(batch_size=2, learning_rate=0.1)
+feats = data_layer(name="feats", size={dim})
+def step(y):
+    mem = memory(name="state", size=8)
+    return fc_layer(input=[y, mem], size=8, act=TanhActivation(),
+                    bias_attr=True, name="state")
+out = recurrent_group(name="rg", step=step, input=feats)
+rep = last_seq(input=out)
+prob = fc_layer(size=2, input=rep, act=SoftmaxActivation(), bias_attr=True)
+classification_cost(input=prob, label=data_layer(name="label", size=2))
+"""
+    path = os.path.join(REPO, "tests", "_sparse_seq_rg.py")
+    with open(path, "w") as f:
+        f.write(cfg_src)
+    try:
+        cfg = parse_config(path, "")
+        ex = GraphExecutor(cfg.model_config)
+        params = ex.init_params(jax.random.PRNGKey(0))
+        seqs = [[[1, 5], [7], [2, 3, 8]], [[0], [dim - 1, 4]]]
+        batch = make_batch([(s, 0) for s in seqs],
+                           [sparse_binary_vector_sequence(dim),
+                            integer_value(2)],
+                           ["feats", "label"])
+        loss, _ = ex.loss(params, batch)
+        assert np.isfinite(float(loss))
+
+        # oracle: dense multi-hot feed produces the identical loss
+        T = batch["feats"].ids.shape[1]
+        dense = np.zeros((2, T, dim), np.float32)
+        for i, s in enumerate(seqs):
+            for j, row in enumerate(s):
+                dense[i, j, row] = 1.0
+        dense_batch = dict(batch)
+        dense_batch["feats"] = Argument(value=dense,
+                                        lengths=batch["feats"].lengths)
+        dloss, _ = ex.loss(params, dense_batch)
+        np.testing.assert_allclose(float(loss), float(dloss), rtol=1e-5)
+    finally:
+        os.remove(path)
+
+
+def test_quick_start_lr_at_100k_vocab():
+    """The quick_start LR shape trains at dict_dim=200k: memory ∝ nnz."""
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.trainer.trainer import Trainer
+
+    dim = 200_000
+    cfg_src = f"""
+from paddle_tpu.dsl import *
+settings(batch_size=8, learning_rate=2e-3, learning_method=AdamOptimizer())
+data = data_layer(name="word", size={dim})
+output = fc_layer(input=data, size=2, act=SoftmaxActivation())
+classification_cost(input=output, label=data_layer(name="label", size=2))
+"""
+    path = os.path.join(REPO, "tests", "_qs_lr_100k.py")
+    with open(path, "w") as f:
+        f.write(cfg_src)
+    try:
+        cfg = parse_config(path, "")
+        tr = Trainer(cfg, seed=0)
+        rng = np.random.default_rng(0)
+
+        def batches():
+            for _ in range(8):
+                samples = []
+                for _ in range(8):
+                    label = int(rng.integers(0, 2))
+                    lo, hi = (0, dim // 2) if label == 0 else (dim // 2, dim)
+                    words = sorted(set(rng.integers(lo, hi, 20).tolist()))
+                    samples.append((words, label))
+                yield make_batch(
+                    samples, [sparse_binary_vector(dim), integer_value(2)],
+                    ["word", "label"])
+
+        c0 = tr.train_one_pass(batches=batches(), log_period=0)["cost"]
+        st = c0
+        for _ in range(4):
+            st = tr.train_one_pass(batches=batches(), log_period=0)["cost"]
+        assert st < c0, (c0, st)
+    finally:
+        os.remove(path)
