@@ -28,6 +28,17 @@ def _bucket_len(n: int, bucket_sizes=(8, 16, 32, 48, 64, 96, 128, 192, 256, 384,
     return ((n + 127) // 128) * 128
 
 
+def _check_sparse_ids(ids: np.ndarray, dim: int, name: str) -> None:
+    """Out-of-range feature ids must fail at batch assembly — on device the
+    gather would silently clamp to dim-1 and train on the wrong row."""
+    hi = int(ids.max()) if ids.size else 0
+    lo = int(ids.min()) if ids.size else 0
+    if hi >= dim or lo < 0:
+        raise ValueError(
+            f"sparse slot {name!r}: feature id {hi if hi >= dim else lo} "
+            f"out of range for dim={dim}")
+
+
 def make_batch(samples: list, types: list[InputType], names: list[str],
                pad_len: Optional[int] = None) -> dict[str, Argument]:
     """Assemble one padded batch: sample tuples -> {layer_name: Argument}."""
@@ -52,6 +63,7 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                     n = len(row)
                     ids[i, :n] = np.asarray(row, np.int32)
                     w[i, :n] = 1.0
+                _check_sparse_ids(ids, t.dim, name)
                 out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim)
             elif t.kind == SlotKind.SPARSE_VALUE:
                 K = _bucket_len(max((len(p) for p in vals), default=1) or 1)
@@ -61,6 +73,7 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                     for k, (j, v) in enumerate(pairs):
                         ids[i, k] = j
                         w[i, k] = v
+                _check_sparse_ids(ids, t.dim, name)
                 out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim)
         elif t.seq_type == SeqType.SUB_SEQUENCE:
             # nested sequence: sample = list of subsequences.  Packed as
@@ -115,6 +128,7 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                         n = len(row)
                         ids[i, j, :n] = np.asarray(row, np.int32)
                         w[i, j, :n] = 1.0
+                _check_sparse_ids(ids, t.dim, name)
                 out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim,
                                      lengths=lengths)
             else:

@@ -233,8 +233,13 @@ class GraphExecutor:
             seq = arg.data
             if arg.sparse_dim:
                 # keep the sparse-row structure through per-step slicing
+                # (values reversed in lockstep with the ids below)
                 sparse_links[outer] = arg.sparse_dim
-                xs["__spvals__" + outer] = jnp.moveaxis(arg.sparse_vals, 1, 0)
+                spvals = arg.sparse_vals
+                if sm.reversed and arg.sub_lengths is None:
+                    from paddle_tpu.ops.sequence import seq_reverse
+                    spvals = seq_reverse(spvals, arg.lengths)
+                xs["__spvals__" + outer] = jnp.moveaxis(spvals, 1, 0)
             if arg.sub_lengths is not None:
                 assert not sm.reversed, \
                     "reverse=True on a nested recurrent group is not supported"

@@ -89,10 +89,18 @@ class Argument:
         the full row; the training path should never call this."""
         if not self.sparse_dim:
             return self
-        onehot = jax.nn.one_hot(self.ids, self.sparse_dim,
-                                dtype=self.sparse_vals.dtype)
-        dense = jnp.einsum("...k,...kd->...d", self.sparse_vals, onehot)
-        return Argument(value=dense, lengths=self.lengths,
+        # scatter-add keeps peak memory at the [..., dim] result itself
+        # (a one_hot intermediate would be K x larger)
+        lead = self.ids.shape[:-1]
+        K = self.ids.shape[-1]
+        flat_ids = self.ids.reshape(-1, K)
+        flat_vals = self.sparse_vals.reshape(-1, K)
+        rows = jnp.arange(flat_ids.shape[0])[:, None]
+        dense = jnp.zeros((flat_ids.shape[0], self.sparse_dim),
+                          self.sparse_vals.dtype)
+        dense = dense.at[rows, flat_ids].add(flat_vals)
+        return Argument(value=dense.reshape(lead + (self.sparse_dim,)),
+                        lengths=self.lengths,
                         sub_lengths=self.sub_lengths, weight=self.weight)
 
     def flatten_image(self) -> "Argument":
