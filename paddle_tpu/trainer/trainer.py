@@ -100,6 +100,11 @@ class Trainer:
         self._loss_buf: list[jax.Array] = []
         self._drained_cost = 0.0
         self._last_batch: Optional[dict[str, Argument]] = None
+        # BarrierStat analog: per-step dispatch/sync timing + straggler skew,
+        # logged every log_period on mesh runs (ref: utils/BarrierStat.h:
+        # 198-389, REGISTER_BARRIER_TIMER_SERVER)
+        from paddle_tpu.parallel.barrier_stat import BarrierTimer
+        self.barrier_stat = BarrierTimer()
 
     # -- compiled steps ---------------------------------------------------
     def _build_train_step_fn(self):
@@ -168,8 +173,16 @@ class Trainer:
             batch = shard_batch(self.mesh, batch)
         self.rng, sub = jax.random.split(self.rng)
         self._last_rng = sub
-        (self.params, self.opt_state, new_net, loss, partials, host_out) = \
-            self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
+        if getattr(self, "_dispatched_once", False):
+            with self.barrier_stat.time_dispatch():
+                (self.params, self.opt_state, new_net, loss, partials, host_out) = \
+                    self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
+        else:
+            # first dispatch carries XLA trace+compile time — seconds, not
+            # queue backpressure; keep it out of the barrier windows
+            self._dispatched_once = True
+            (self.params, self.opt_state, new_net, loss, partials, host_out) = \
+                self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
         if new_net:
             self.net_state = new_net
         return loss, partials, host_out
@@ -210,7 +223,8 @@ class Trainer:
         check + their sum (for cost accounting)."""
         if not self._loss_buf:
             return 0.0
-        losses = np.asarray(jax.device_get(jnp.stack(self._loss_buf)))
+        with self.barrier_stat.time_sync():
+            losses = np.asarray(jax.device_get(jnp.stack(self._loss_buf)))
         n = len(self._loss_buf)
         self._loss_buf.clear()
         if not np.isfinite(losses).all():
@@ -245,6 +259,8 @@ class Trainer:
                 log.info("pass %d batch %d: cost=%.5f %s", self.pass_id, n_batches,
                          self._drained_cost / n_batches,
                          _fmt(self.evaluators.finalize(self._acc)))
+                if self.mesh is not None:
+                    log.info("barrier: %s", self.barrier_stat.render())
             if stats_period and n_batches % stats_period == 0:
                 self.log_param_stats()
         self._drained_cost += self._drain_losses()

@@ -1,0 +1,76 @@
+"""BarrierStat analog (ref: paddle/utils/BarrierStat.h:198-389): per-step
+dispatch/sync timing windows and the straggler report on mesh runs."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.parallel.barrier_stat import BarrierTimer
+
+
+def test_percentiles_and_render():
+    bt = BarrierTimer(window=100)
+    for ms in (1, 2, 3, 100):
+        bt.dispatch_s.append(ms / 1e3)
+    bt.sync_s.append(0.005)
+    s = bt.local_summary()
+    assert 1.0 <= s["dispatch"]["p50"] <= 3.0
+    assert abs(s["dispatch"]["max"] - 100.0) < 1e-6
+    assert abs(s["sync"]["p50"] - 5.0) < 1e-6
+    line = bt.render()
+    assert "dispatch" in line and "sync" in line
+    # single process: no straggler table
+    assert bt.straggler_summary() is None
+
+
+def test_timed_context_managers():
+    bt = BarrierTimer()
+    with bt.time_dispatch():
+        time.sleep(0.01)
+    with bt.time_sync():
+        time.sleep(0.005)
+    assert bt.dispatch_s[0] >= 0.009
+    assert bt.sync_s[0] >= 0.004
+
+
+def test_trainer_logs_barrier_on_mesh():
+    """Mesh training populates the windows and renders a summary line."""
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg_src = """
+from paddle_tpu.dsl import *
+settings(batch_size=16, learning_rate=0.1)
+x = data_layer(name="x", size=8)
+h = fc_layer(input=x, size=8, act=TanhActivation())
+out = fc_layer(input=h, size=2, act=SoftmaxActivation())
+classification_cost(input=out, label=data_layer(name="label", size=2))
+"""
+    path = os.path.join(REPO, "tests", "_barrier_cfg.py")
+    with open(path, "w") as f:
+        f.write(cfg_src)
+    try:
+        cfg = parse_config(path, "")
+        tr = Trainer(cfg, seed=0, mesh=make_mesh())
+        rng = np.random.default_rng(0)
+
+        def batches():
+            for _ in range(6):
+                x = rng.normal(size=(16, 8)).astype(np.float32)
+                y = (x.sum(-1) > 0).astype(np.int32)
+                yield {"x": Argument(value=x), "label": Argument(ids=y)}
+
+        tr.train_one_pass(batches=batches(), log_period=2)
+        # first dispatch (compile) is excluded from the window
+        assert len(tr.barrier_stat.dispatch_s) == 5
+        assert len(tr.barrier_stat.sync_s) >= 1
+        assert "dispatch" in tr.barrier_stat.render()
+    finally:
+        os.remove(path)

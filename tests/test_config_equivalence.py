@@ -84,6 +84,10 @@ def test_mixed_layer_matches_fc_layer():
 
         (la, ga, fa), (lb, gb, fb) = results
         assert abs(la - lb) < 1e-6, (la, lb)
+        # params pair positionally (names legitimately differ between the
+        # two expressions); the counts must match or the oracle is void
+        assert len(ga) == len(gb), (sorted(ga), sorted(gb))
+        assert len(fa) == len(fb), (sorted(fa), sorted(fb))
         for ka, kb in zip(ga, gb):
             np.testing.assert_allclose(np.asarray(ga[ka]), np.asarray(gb[kb]),
                                        rtol=1e-5, atol=1e-6,
