@@ -1,0 +1,56 @@
+"""Deep bidirectional LSTM stack: 8 alternating-direction lstmemory layers
+(ref: demo/quick_start/trainer_config.db-lstm.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from qs_provider import VOCAB  # noqa: E402
+
+is_predict = get_config_arg("is_predict", bool, False)
+# the reference stacks 8; depth is an arg so tests can use a shallow stack
+depth = get_config_arg("depth", int, 8)
+
+define_py_data_sources2(
+    train_list="demo/quick_start/train.list",
+    test_list="demo/quick_start/test.list",
+    module="demo.quick_start.qs_provider",
+    obj="process")
+
+settings(
+    batch_size=get_config_arg("batch_size", int, 128) if not is_predict else 1,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25)
+
+bias_attr = ParamAttr(initial_std=0.0, l2_rate=0.0)
+
+data = data_layer(name="word", size=VOCAB)
+emb = embedding_layer(input=data, size=128)
+
+hidden_0 = mixed_layer(size=128, input=[full_matrix_projection(input=emb)])
+lstm_0 = lstmemory(input=hidden_0, layer_attr=ExtraAttr(drop_rate=0.1))
+
+input_layers = [hidden_0, lstm_0]
+
+lstm = lstm_0
+for i in range(1, depth):
+    fc = fc_layer(input=input_layers, size=128)
+    lstm = lstmemory(input=fc, layer_attr=ExtraAttr(drop_rate=0.1),
+                     reverse=(i % 2) == 1)
+    input_layers = [fc, lstm]
+
+lstm_last = pooling_layer(input=lstm, pooling_type=MaxPooling())
+
+output = fc_layer(input=lstm_last, size=2, bias_attr=bias_attr,
+                  act=SoftmaxActivation())
+
+if is_predict:
+    maxid = maxid_layer(output)
+    outputs(maxid, output)
+else:
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))

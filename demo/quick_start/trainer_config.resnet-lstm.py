@@ -1,0 +1,57 @@
+"""Residual-connection LSTM stack: each layer's input is the sum of the
+previous layer's input and hidden state
+(ref: demo/quick_start/trainer_config.resnet-lstm.py — a stacked
+single-direction variant of the ResNet-LSTM architecture)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.dsl import *  # noqa: E402
+from qs_provider import VOCAB  # noqa: E402
+
+is_predict = get_config_arg("is_predict", bool, False)
+depth = get_config_arg("depth", int, 3)
+
+define_py_data_sources2(
+    train_list="demo/quick_start/train.list",
+    test_list="demo/quick_start/test.list",
+    module="demo.quick_start.qs_provider",
+    obj="process")
+
+settings(
+    batch_size=get_config_arg("batch_size", int, 128) if not is_predict else 1,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25)
+
+bias_attr = ParamAttr(initial_std=0.0, l2_rate=0.0)
+
+data = data_layer(name="word", size=VOCAB)
+emb = embedding_layer(input=data, size=128)
+lstm = simple_lstm(input=emb, size=128,
+                   lstm_cell_attr=ExtraAttr(drop_rate=0.1))
+
+previous_input, previous_hidden_state = emb, lstm
+
+for i in range(depth):
+    # current layer's input = previous layer's input + its hidden state
+    current_input = addto_layer(input=[previous_input, previous_hidden_state])
+    hidden_state = simple_lstm(input=current_input, size=128,
+                               lstm_cell_attr=ExtraAttr(drop_rate=0.1))
+    previous_input, previous_hidden_state = current_input, hidden_state
+
+lstm = previous_hidden_state
+
+lstm_last = pooling_layer(input=lstm, pooling_type=MaxPooling())
+output = fc_layer(input=lstm_last, size=2, bias_attr=bias_attr,
+                  act=SoftmaxActivation())
+
+if is_predict:
+    maxid = maxid_layer(output)
+    outputs(maxid, output)
+else:
+    label = data_layer(name="label", size=2)
+    outputs(classification_cost(input=output, label=label))

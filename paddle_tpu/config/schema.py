@@ -110,6 +110,10 @@ class ParameterConfig(_Serializable):
     # TPU additions: sharding spec over mesh axes, e.g. ["model", None]
     partition_spec: Optional[list] = None
     dtype: str = "float32"
+    # updater hooks (ref: ParameterUpdaterHook.cpp:32,167 StaticPruningHook):
+    # e.g. [{"type": "pruning", "sparsity_ratio": 0.6}] or
+    # [{"type": "pruning", "mask_filename": "mask.npy"}]
+    update_hooks: list = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -429,13 +433,17 @@ class OptimizationConfig(_Serializable):
 class DataConfig(_Serializable):
     """Data source description (ref: DataConfig.proto.m4; define_py_data_sources2)."""
 
-    type: str = "py2"                   # 'py2' (PyDataProvider2-style) | 'numpy'
+    type: str = "py2"                   # 'py2' | 'ptsh' | 'multi'
     files: str = ""                     # file-list path or glob
     load_data_module: str = ""
     load_data_object: str = ""
     load_data_args: str = ""
     async_load_data: bool = True
     constant_slots: list[float] = field(default_factory=list)
+    # type='multi' (ref: MultiDataProvider.{h,cpp}): sub-sources mixed by
+    # data ratio into one stream
+    sub_configs: list["DataConfig"] = field(default_factory=list)
+    data_ratios: list[int] = field(default_factory=list)
 
 
 @_schema

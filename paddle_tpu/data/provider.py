@@ -133,6 +133,60 @@ class DataProviderWrapper:
         return None
 
 
+class MultiProviderWrapper:
+    """Mixes several sub-providers into one sample stream by data ratio
+    (ref: gserver/dataproviders/MultiDataProvider.{h,cpp}: each batch draws
+    size*ratio_i/total samples from sub-provider i; in test mode every
+    sub-provider contributes all of its data).
+
+    All sub-providers must declare the same slot schema.  Presents the
+    DataProviderWrapper interface so DataFeeder needs no special casing.
+    """
+
+    def __init__(self, subs: list, sub_files: list[list[str]],
+                 ratios: Optional[list[int]] = None, is_test: bool = False):
+        assert subs, "MultiProviderWrapper needs at least one sub-provider"
+        self.subs = subs
+        self.sub_files = sub_files
+        self.ratios = list(ratios) if ratios else [1] * len(subs)
+        assert len(self.ratios) == len(subs)
+        self.is_test = is_test
+        self.settings = subs[0].settings
+        t0 = [type(t).__name__ for t in subs[0].input_types]
+        for s in subs[1:]:
+            assert [type(t).__name__ for t in s.input_types] == t0, \
+                "MultiDataProvider sub-providers must share one slot schema"
+
+    def samples(self, file_list: list[str]):
+        """Ratio-weighted round-robin over the sub-provider streams.  The
+        TRAIN stream ends when the first sub-provider drains, so the pass's
+        overall composition honors the ratios even after the feeder's
+        global shuffle (the reference draws size*ratio_i/total per batch —
+        same steady-state mixture).  Test mode ignores ratios and
+        concatenates everything."""
+        if self.is_test:
+            for s, files in zip(self.subs, self.sub_files):
+                yield from s.samples(files)
+            return
+        its = [iter(s.samples(files))
+               for s, files in zip(self.subs, self.sub_files)]
+        while True:
+            for i, it in enumerate(its):
+                for _ in range(self.ratios[i]):
+                    try:
+                        yield next(it)
+                    except StopIteration:
+                        return
+
+    @property
+    def input_types(self):
+        return self.subs[0].input_types
+
+    @property
+    def input_names(self):
+        return self.subs[0].input_names
+
+
 def provider(input_types=None, should_shuffle: bool = True, pool_size: int = -1,
              cache: CacheType = CacheType.NO_CACHE, init_hook: Optional[Callable] = None,
              calc_batch_size: Optional[Callable] = None, **kwargs):

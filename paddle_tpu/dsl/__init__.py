@@ -16,5 +16,6 @@ from paddle_tpu.dsl.optimizers import *  # noqa: F401,F403
 from paddle_tpu.dsl.networks import *  # noqa: F401,F403
 from paddle_tpu.dsl.evaluators import *  # noqa: F401,F403
 from paddle_tpu.dsl.data_sources import (  # noqa: F401
-    define_ptsh_data_sources, define_py_data_sources2,
+    define_multi_py_data_sources2, define_ptsh_data_sources,
+    define_py_data_sources2,
 )

@@ -28,6 +28,7 @@ class ParameterAttribute:
         sparse_update: bool = False,
         gradient_clipping_threshold: Optional[float] = None,
         partition_spec: Optional[list] = None,
+        update_hooks: Optional[list] = None,
     ):
         self.name = name
         self.is_static = is_static
@@ -42,6 +43,7 @@ class ParameterAttribute:
         self.sparse_update = sparse_update
         self.gradient_clipping_threshold = gradient_clipping_threshold
         self.partition_spec = partition_spec
+        self.update_hooks = update_hooks
 
     def apply(self, cfg: ParameterConfig) -> ParameterConfig:
         if self.name:
@@ -73,6 +75,8 @@ class ParameterAttribute:
             cfg.gradient_clipping_threshold = self.gradient_clipping_threshold
         if self.partition_spec is not None:
             cfg.partition_spec = list(self.partition_spec)
+        if self.update_hooks is not None:
+            cfg.update_hooks = [dict(h) for h in self.update_hooks]
         return cfg
 
 

@@ -8,7 +8,8 @@ from typing import Any, Optional
 from paddle_tpu.config.schema import DataConfig
 from paddle_tpu.dsl.base import current_context
 
-__all__ = ["define_py_data_sources2", "define_ptsh_data_sources"]
+__all__ = ["define_py_data_sources2", "define_multi_py_data_sources2",
+           "define_ptsh_data_sources"]
 
 
 def define_py_data_sources2(
@@ -29,6 +30,40 @@ def define_py_data_sources2(
     if test_list is not None:
         ctx.test_data = DataConfig(type="py2", files=test_list, load_data_module=module,
                                    load_data_object=obj, load_data_args=args_str)
+
+
+def define_multi_py_data_sources2(
+    train_sources: Optional[list] = None,
+    test_sources: Optional[list] = None,
+    ratios: Optional[list] = None,
+) -> None:
+    """Declare a multi-source provider that mixes several @provider streams
+    by data ratio into one training stream (ref:
+    gserver/dataproviders/MultiDataProvider.{h,cpp}).
+
+    Each source is a dict: {"files": ..., "module": ..., "obj": ...,
+    "args": optional}; all sources must share one slot schema.  `ratios`
+    weights how many samples each source contributes per mixing round
+    (default: equal).  Test sources are concatenated, not mixed.
+    """
+    import json as _json
+
+    ctx = current_context()
+
+    def _sub(src) -> DataConfig:
+        return DataConfig(
+            type="py2", files=src["files"], load_data_module=src["module"],
+            load_data_object=src["obj"],
+            load_data_args=(_json.dumps(src["args"]) if src.get("args")
+                            is not None else ""))
+
+    if train_sources:
+        ctx.data = DataConfig(type="multi",
+                              sub_configs=[_sub(s) for s in train_sources],
+                              data_ratios=list(ratios or []))
+    if test_sources:
+        ctx.test_data = DataConfig(type="multi",
+                                   sub_configs=[_sub(s) for s in test_sources])
 
 
 def define_ptsh_data_sources(

@@ -57,7 +57,7 @@ __all__ = [
     "huber_cost", "sum_cost",
     "crf_layer", "crf_decoding_layer", "ctc_layer", "nce_layer", "hsigmoid",
     "recurrent_group", "memory", "StaticInput", "SubsequenceInput",
-    "GeneratedInput", "beam_search",
+    "GeneratedInput", "beam_search", "sub_network",
     "get_output_layer",
     "LayerOutput",
 ]
@@ -249,6 +249,15 @@ class MixedLayer(LayerOutput):
         for i, p in enumerate(self._projs):
             if not p.proj.output_size:
                 p.proj.output_size = self.size
+            if p.param_dims is None:
+                # projection declared without an explicit size (the
+                # reference allows e.g. full_matrix_projection(input=x)
+                # inside mixed_layer(size=N)): dims resolve against the
+                # mixed layer's size at finalize time
+                if p.proj.type in ("fc", "full_matrix", "table"):
+                    p.param_dims = [p.proj.input_size, self.size]
+                elif p.proj.type == "trans_full_matrix":
+                    p.param_dims = [self.size, p.proj.input_size]
             pname = ""
             if p.param_dims is not None:
                 pname = _make_param(self.name, i, p.param_dims, p.param_attr)
@@ -1401,8 +1410,10 @@ def memory(name: Optional[str], size: int, is_seq: bool = False,
     layer fed by the scan carry; registers a MemoryConfig on the group.
     """
     ctx = current_context()
-    assert ctx.group_stack, "memory() must be used inside recurrent_group"
-    sm = ctx.group_stack[-1]
+    recurrent = [g for g in ctx.group_stack if g.is_recurrent_layer_group]
+    assert recurrent, ("memory() must be used inside recurrent_group "
+                       "(a sub_network scope is not a recurrent group)")
+    sm = recurrent[-1]
     agent_name = ctx.unique_name(f"memory_{name or 'anon'}")
     cfg = LayerConfig(name=agent_name, type="agent", size=size)
     ctx.add_layer(cfg)
@@ -1427,9 +1438,12 @@ def recurrent_group(step, input, reverse: bool = False,
     inputs = input if isinstance(input, (list, tuple)) else [input]
 
     sm = SubModelConfig(name=name, is_recurrent_layer_group=True, reversed=reverse)
-    if ctx.group_stack:
+    recurrent_ancestors = [g for g in ctx.group_stack
+                           if g.is_recurrent_layer_group]
+    if recurrent_ancestors:
         # nested group: executed inside the enclosing group's scan step
-        sm.parent = ctx.group_stack[-1].name
+        # (a non-recurrent sub_network scope is bookkeeping, not execution)
+        sm.parent = recurrent_ancestors[-1].name
     ctx.model.sub_models.append(sm)
     ctx.group_stack.append(sm)
     try:
@@ -1486,6 +1500,37 @@ def recurrent_group(step, input, reverse: bool = False,
     results = [LayerOutput(o.name, o.layer_type, o.size, seq_level=1)
                for o in out_list]
     return results if isinstance(outs, (list, tuple)) else results[0]
+
+
+class sub_network:
+    """Scope layers into a named sub-network — the MultiNetwork / multi_nn
+    analog (ref: gserver/gradientmachines/MultiNetwork.h:25-62).
+
+    The reference runs each sub-network's forward/backward separately and
+    sums the costs; here all sub-networks compile into the ONE jitted
+    program (XLA schedules independent subgraphs concurrently — the correct
+    TPU collapse of the sub-machine loop), so this scope is structural
+    metadata: it groups layers in the config for tooling
+    (dump_config/show_model) and marks the model type multi_nn.  Use one
+    `with sub_network("task_a"): ...` block per task; costs from every
+    block train jointly.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        ctx = current_context()
+        sm = SubModelConfig(name=self.name, is_recurrent_layer_group=False)
+        ctx.model.sub_models.append(sm)
+        ctx.group_stack.append(sm)
+        ctx.model.type = "multi_nn"
+        self.sm = sm
+        return self
+
+    def __exit__(self, *exc):
+        current_context().group_stack.pop()
+        return False
 
 
 def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,

@@ -17,7 +17,8 @@ token.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import dataclasses
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +29,39 @@ from paddle_tpu.parameter.argument import Argument
 
 Array = jax.Array
 _NEG_INF = -1e9
+
+
+@dataclasses.dataclass
+class BeamSearchControls:
+    """User control hooks for beam search — the TPU re-design of
+    registerBeamSearchControlCallbacks / registerBeamSearchStatisticsCallbacks
+    (ref: RecurrentGradientMachine.h:86-170).
+
+    The reference's hooks are host-side std::functions invoked per step;
+    that shape would force a host round-trip every token.  Here each hook
+    is a JAX-TRACEABLE function compiled straight into the search scan, so
+    constrained decoding runs at full device speed:
+
+    - adjust_logp(step, tokens, logp) -> logp': reshape next-token
+      log-probabilities [B, K, V] before candidate expansion (the
+      BeamSearchCandidatesAdjustCallback analog — ban words, force
+      prefixes, add lexical bonuses).  `tokens` is the previous step's
+      [B, K] choices.
+    - stop_path(step, tokens, scores) -> [B, K] bool: force-finish paths
+      (the DropCallback analog; a stopped path is frozen exactly like one
+      that emitted EOS).
+    - norm_path(scores, lengths) -> scores': final path-score
+      normalization, replacing the default length normalization (the
+      NormOrDropNodeCallback analog).
+    - on_step(step): host-side statistics hook dispatched via
+      jax.debug.callback (the EachStepCallback analog; async, diagnostic
+      only).
+    """
+
+    adjust_logp: Optional[Callable[[Array, Array, Array], Array]] = None
+    stop_path: Optional[Callable[[Array, Array, Array], Array]] = None
+    norm_path: Optional[Callable[[Array, Array], Array]] = None
+    on_step: Optional[Callable[[Any], None]] = None
 
 
 def _tile_beam(x: Array, K: int) -> Array:
@@ -53,13 +87,15 @@ class SequenceGenerator:
 
     def __init__(self, executor, sm: SubModelConfig,
                  beam_size: Optional[int] = None,
-                 max_length: Optional[int] = None):
+                 max_length: Optional[int] = None,
+                 controls: Optional[BeamSearchControls] = None):
         assert sm.generator is not None, f"sub-model {sm.name!r} has no generator"
         self.executor = executor
         self.sm = sm
         self.gen = sm.generator
         self.beam_size = beam_size or self.gen.beam_size or 1
         self.max_length = max_length or self.gen.max_num_frames
+        self.controls = controls or BeamSearchControls()
 
     def __call__(self, params: dict[str, Array], feed: dict[str, Argument],
                  rng: Optional[jax.Array] = None) -> tuple[Array, Array]:
@@ -116,8 +152,12 @@ class SequenceGenerator:
         prob_layer = gen.prob_layer_name
         eos = gen.eos_id
 
-        def decode_step(state, _):
+        ctl = self.controls
+
+        def decode_step(state, t):
             tokens, scores, finished, carries = state
+            if ctl.on_step is not None:
+                jax.debug.callback(ctl.on_step, t)
             sub = ForwardContext(model=ex.model, params=params, mode=GEN, rng=rng)
             sub.outputs.update(static_feeds)
             sub.outputs[id_mem_name] = Argument(ids=tokens.reshape(B * K))
@@ -127,6 +167,10 @@ class SequenceGenerator:
             probs = sub.outputs[prob_layer].data.reshape(B, K, -1)
             V = probs.shape[-1]
             logp = jnp.log(jnp.maximum(probs, 1e-12))
+            if ctl.adjust_logp is not None:
+                logp = ctl.adjust_logp(t, tokens, logp)
+            if ctl.stop_path is not None:
+                finished = finished | ctl.stop_path(t, tokens, scores)
             # finished beams may only emit EOS at zero cost
             eos_only = jnp.full((V,), _NEG_INF).at[eos].set(0.0)
             step_logp = jnp.where(finished[..., None], eos_only[None, None, :], logp)
@@ -155,7 +199,7 @@ class SequenceGenerator:
 
         init = (tokens0, scores0, finished0, carry0)
         (tok_f, scores_f, fin_f, _), (toks, parents) = jax.lax.scan(
-            decode_step, init, None, length=L)
+            decode_step, init, jnp.arange(L))
         # toks: [L, B, K]; parents: [L, B, K] — backtrack to recover sequences
         def back(nxt_parent, inp):
             tok_t, par_t = inp
@@ -169,7 +213,10 @@ class SequenceGenerator:
         # pad everything after the first EOS with EOS
         eos_seen = jnp.cumsum((seqs == eos).astype(jnp.int32), axis=-1)
         seqs = jnp.where(eos_seen > 1, eos, seqs)
-        if gen.log_prob:
+        if ctl.norm_path is not None:
+            lengths = jnp.sum((eos_seen == 0).astype(jnp.float32), axis=-1) + 1.0
+            out_scores = ctl.norm_path(scores_f, lengths)
+        elif gen.log_prob:
             out_scores = scores_f
         else:
             lengths = jnp.sum((eos_seen == 0).astype(jnp.float32), axis=-1) + 1.0
@@ -180,10 +227,11 @@ class SequenceGenerator:
 def generate(executor, params: dict[str, Array], feed: dict[str, Argument],
              rng: Optional[jax.Array] = None,
              beam_size: Optional[int] = None,
-             max_length: Optional[int] = None) -> tuple[Array, Array]:
+             max_length: Optional[int] = None,
+             controls: Optional[BeamSearchControls] = None) -> tuple[Array, Array]:
     """Convenience: find the generator sub-model and run the search
     (ref: GradientMachine::generateSequence dispatch)."""
     gens = [sm for sm in executor.model.sub_models if sm.generator is not None]
     assert gens, "model has no generator sub-model"
-    return SequenceGenerator(executor, gens[0], beam_size, max_length)(
-        params, feed, rng)
+    return SequenceGenerator(executor, gens[0], beam_size, max_length,
+                             controls)(params, feed, rng)

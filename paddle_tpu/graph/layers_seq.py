@@ -20,8 +20,35 @@ from paddle_tpu.graph.registry import register_layer
 from paddle_tpu.ops import rnn as rnnops
 from paddle_tpu.ops import sequence as seqops
 from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.utils.flags import FLAGS
 
 Array = jax.Array
+
+
+def _prev_state(ctx: ForwardContext, cfg: LayerConfig, B: int,
+                names: tuple[str, ...]) -> list:
+    """Truncated-BPTT continuation (ref: RecurrentLayer.cpp prevOutput_;
+    --prev_batch_state): under the flag, a forward recurrent layer boots
+    from the previous batch's final state, carried through the net_state
+    channel (the same jit-friendly path as batch-norm moving stats).
+    Returns one initial state per name (None = zeros).  The state is
+    stop_gradiented — BPTT truncates at the batch boundary — and ignored
+    when the batch size changes (stream restart)."""
+    if not FLAGS.prev_batch_state or cfg.reversed:
+        return [None] * len(names)
+    out = []
+    for n in names:
+        s = ctx.state_in.get(f"{cfg.name}:{n}")
+        out.append(jax.lax.stop_gradient(s)
+                   if s is not None and s.shape[0] == B else None)
+    return out
+
+
+def _save_state(ctx: ForwardContext, cfg: LayerConfig, **states) -> None:
+    if not FLAGS.prev_batch_state or cfg.reversed:
+        return
+    for n, v in states.items():
+        ctx.state_out[f"{cfg.name}:{n}"] = v
 
 
 # ---------------------------------------------------------------------------
@@ -116,13 +143,15 @@ def lstmemory_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
     w = ctx.param_of(cfg, 0)
     b = ctx.bias_of(cfg)
-    hs, _, _ = rnnops.lstm_scan(
-        x.value, x.lengths, w, b,
+    h0, c0 = _prev_state(ctx, cfg, x.value.shape[0], ("h", "c"))
+    hs, last_h, last_c = rnnops.lstm_scan(
+        x.value, x.lengths, w, b, h0=h0, c0=c0,
         active_type=cfg.active_type or "tanh",
         gate_active_type=cfg.attrs.get("active_gate_type", "sigmoid"),
         state_active_type=cfg.attrs.get("active_state_type", "tanh"),
         reverse=cfg.reversed,
     )
+    _save_state(ctx, cfg, h=last_h, c=last_c)
     out_cfg = _without_activation(cfg)
     return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
 
@@ -135,12 +164,14 @@ def gated_recurrent_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     w = ctx.param_of(cfg, 0)
     b = ctx.bias_of(cfg)
     D = cfg.size
-    hs, _ = rnnops.gru_scan(
-        x.value, x.lengths, w[:, : 2 * D], w[:, 2 * D:], b,
+    (h0,) = _prev_state(ctx, cfg, x.value.shape[0], ("h",))
+    hs, last_h = rnnops.gru_scan(
+        x.value, x.lengths, w[:, : 2 * D], w[:, 2 * D:], b, h0=h0,
         active_type=cfg.active_type or "tanh",
         gate_active_type=cfg.attrs.get("active_gate_type", "sigmoid"),
         reverse=cfg.reversed,
     )
+    _save_state(ctx, cfg, h=last_h)
     out_cfg = _without_activation(cfg)
     return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
 
@@ -176,9 +207,11 @@ def recurrent_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
     w = ctx.param_of(cfg, 0)
     b = ctx.bias_of(cfg)
-    hs, _ = rnnops.simple_rnn_scan(
-        x.value, x.lengths, w, b,
+    (h0,) = _prev_state(ctx, cfg, x.value.shape[0], ("h",))
+    hs, last_h = rnnops.simple_rnn_scan(
+        x.value, x.lengths, w, b, h0=h0,
         active_type=cfg.active_type or "tanh", reverse=cfg.reversed)
+    _save_state(ctx, cfg, h=last_h)
     out_cfg = _without_activation(cfg)
     return finish_layer(ctx, out_cfg, hs, like=x, lengths=x.lengths)
 

@@ -37,6 +37,41 @@ class ParameterUpdater:
         self.param_cfgs: dict[str, ParameterConfig] = {p.name: p for p in model.parameters}
         self.init_slots_fn, self.update_fn = get_optimizer(opt.learning_method)
         self.use_average = opt.average_window > 0
+        self._masks: dict[str, Array] = {}   # built by apply_init_hooks
+
+    # -- updater hooks (ref: ParameterUpdaterHook.cpp:32,167) --------------
+    def apply_init_hooks(self, params: dict[str, Array]) -> dict[str, Array]:
+        """Build pruning masks and apply them to the initial values — the
+        StaticPruningHook's init() (mask the parameter) + the per-update
+        gradient masking happens in step().  Mask sources:
+          - sparsity_ratio r: zero the r-fraction smallest-|w| entries of
+            the initial value (the magnitude criterion later Paddle uses);
+          - mask_filename: a .npy 0/1 array of the parameter's shape (the
+            re-design of the reference's packed-bit mask file format)."""
+        import numpy as np
+
+        out = dict(params)
+        for name, cfg in self.param_cfgs.items():
+            for hook in cfg.update_hooks:
+                if hook.get("type") != "pruning":
+                    raise ValueError(f"unknown updater hook {hook!r}")
+                p = np.asarray(out[name])
+                if "mask_filename" in hook:
+                    mask = np.load(hook["mask_filename"]).astype(p.dtype)
+                    assert mask.shape == p.shape, (
+                        f"mask {mask.shape} vs param {p.shape}")
+                else:
+                    r = float(hook.get("sparsity_ratio", 0.0))
+                    k = int(r * p.size)
+                    mask = np.ones(p.size, p.dtype)
+                    if k > 0:
+                        order = np.argsort(np.abs(p.reshape(-1)),
+                                           kind="stable")
+                        mask[order[:k]] = 0.0
+                    mask = mask.reshape(p.shape)
+                self._masks[name] = jnp.asarray(mask)
+                out[name] = jnp.asarray(p * mask)
+        return out
 
     def init_state(self, params: dict[str, Array]) -> dict[str, Any]:
         slots = {name: self.init_slots_fn(p, self.opt)
@@ -48,6 +83,12 @@ class ParameterUpdater:
             "num_updates": jnp.zeros((), jnp.int32),
             "pass_id": jnp.zeros((), jnp.int32),
         }
+        if self._masks:
+            # masks travel INSIDE the optimizer state so a mask rebuilt
+            # after checkpoint load reaches the already-compiled train step
+            # (a closure read would bake the first trace's values in as
+            # constants)
+            state["masks"] = dict(self._masks)
         if self.use_average:
             state["average"] = {name: jnp.array(p) for name, p in params.items()}
             state["average_count"] = jnp.zeros((), jnp.int32)
@@ -76,6 +117,12 @@ class ParameterUpdater:
                     new_slots[name] = state["slots"][name]
                 continue
             g = grads[name]
+            # pruning-mask hook: masked entries receive no gradient and the
+            # value is re-masked after the update (ref: StaticPruningHook::
+            # update — grad dotMul mask)
+            mask = state.get("masks", {}).get(name)
+            if mask is not None:
+                g = g * mask.astype(g.dtype)
             # gradient clipping (elementwise, ref: ParameterOptimizer clipping);
             # per-param None inherits the global, 0.0 disables explicitly
             thr = (cfg.gradient_clipping_threshold
@@ -97,6 +144,9 @@ class ParameterUpdater:
                 **({"mom_override": mom_override} if mom_override is not None
                    and opt.learning_method in ("momentum", "sgd", "sparse_momentum")
                    else {}))
+            if mask is not None:
+                # weight decay / averaging must not resurrect pruned weights
+                new_p = new_p * mask.astype(new_p.dtype)
             new_params[name] = new_p
             new_slots[name] = slots
 
@@ -106,6 +156,8 @@ class ParameterUpdater:
             "num_updates": t,
             "pass_id": state["pass_id"],
         }
+        if "masks" in state:
+            new_state["masks"] = state["masks"]
         if self.use_average:
             # cumulative average with window reset
             # (ref: AverageOptimizer — maintains an averaged copy for eval)

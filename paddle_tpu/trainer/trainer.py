@@ -37,13 +37,22 @@ from paddle_tpu.utils import FLAGS, get_logger, global_stat
 log = get_logger("trainer")
 
 
-def load_provider(data_cfg: DataConfig):
+def load_provider(data_cfg: DataConfig, fresh: bool = False):
     """Instantiate a @provider from a DataConfig
-    (ref: gserver/dataproviders/PyDataProvider2.cpp createPyDataProvider)."""
+    (ref: gserver/dataproviders/PyDataProvider2.cpp createPyDataProvider).
+
+    fresh=True clones the module-level wrapper and its settings before
+    initialize() — required when several sub-sources reference the same
+    @provider object with different args (the init_hook mutates settings,
+    which would otherwise be shared)."""
     import importlib
 
     mod = importlib.import_module(data_cfg.load_data_module)
     prov = getattr(mod, data_cfg.load_data_object)
+    if fresh:
+        import copy
+        prov = copy.copy(prov)
+        prov.settings = copy.deepcopy(prov.settings)
     files: list[str] = []
     if data_cfg.files:
         if os.path.exists(data_cfg.files):
@@ -82,6 +91,9 @@ class Trainer:
         self.rng = jax.random.PRNGKey(seed)
 
         self.params = self.executor.init_params(jax.random.PRNGKey(seed))
+        # updater hooks (pruning masks) bind to the initial values
+        # (ref: ParameterUpdaterHook.cpp StaticPruningHook::init)
+        self.params = self.updater.apply_init_hooks(self.params)
         self.opt_state = self.updater.init_state(self.params)
         self.net_state: dict[str, Any] = {}
         self.pass_id = 0
@@ -154,7 +166,20 @@ class Trainer:
                 batch_size=self.opt.batch_size, seed=self.seed,
                 drop_last=train, shuffle=train,
                 names=kwargs.get("names"))
-        prov, files = load_provider(data_cfg)
+        if data_cfg.type == "multi":
+            # ratio-mixed sub-providers (ref: MultiDataProvider.{h,cpp})
+            from paddle_tpu.data.provider import MultiProviderWrapper
+            subs, sub_files = [], []
+            for sub_cfg in data_cfg.sub_configs:
+                p, f = load_provider(sub_cfg, fresh=True)
+                subs.append(p)
+                sub_files.append(f)
+            prov = MultiProviderWrapper(subs, sub_files,
+                                        ratios=data_cfg.data_ratios or None,
+                                        is_test=not train)
+            files: list[str] = []
+        else:
+            prov, files = load_provider(data_cfg)
         return DataFeeder(
             prov, files, input_names=self.model.input_layer_names,
             batch_size=self.opt.batch_size, seed=self.seed,
@@ -173,14 +198,22 @@ class Trainer:
             batch = shard_batch(self.mesh, batch)
         self.rng, sub = jax.random.split(self.rng)
         self._last_rng = sub
-        if getattr(self, "_dispatched_once", False):
+        # any UNSEEN (batch-shape, net_state-structure) signature likely
+        # retraces+recompiles — seconds of XLA work, not queue backpressure;
+        # keep those dispatches out of the barrier timing windows (this
+        # covers the first batch, every new length bucket, and the
+        # net_state pytree change after batch 1)
+        sig = (str(jax.tree.map(lambda a: (jnp.shape(a), str(jnp.result_type(a))), batch)),
+               str(jax.tree_util.tree_structure(self.net_state)))
+        seen = getattr(self, "_dispatch_sigs", None)
+        if seen is None:
+            seen = self._dispatch_sigs = set()
+        if sig in seen:
             with self.barrier_stat.time_dispatch():
                 (self.params, self.opt_state, new_net, loss, partials, host_out) = \
                     self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
         else:
-            # first dispatch carries XLA trace+compile time — seconds, not
-            # queue backpressure; keep it out of the barrier windows
-            self._dispatched_once = True
+            seen.add(sig)
             (self.params, self.opt_state, new_net, loss, partials, host_out) = \
                 self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
         if new_net:
@@ -553,6 +586,9 @@ class Trainer:
             assert name in loaded, f"checkpoint missing parameter {name!r}"
             self.params = dict(self.params)
             self.params[name] = jnp.asarray(loaded[name])
+        # rebuild pruning masks from the loaded magnitudes (the reference
+        # reloads its mask file on --init_model_path too)
+        self.params = self.updater.apply_init_hooks(self.params)
         if data.get("opt"):
             # rebuild optimizer state with loaded leaves where shapes match
             tmpl = self.updater.init_state(self.params)

@@ -132,6 +132,10 @@ define_flag("detect_nan", False, "trap FP anomalies (jax_debug_nans; "
 define_flag("nonfinite_check_period", 100, "without --detect_nan, losses "
             "buffer on device and are bulk-checked every N batches (keeps "
             "dispatch pipelined — no per-batch host sync)")
+define_flag("prev_batch_state", False, "truncated-BPTT continuation: "
+            "forward recurrent layers start from the previous batch's final "
+            "hidden state instead of zeros (ref: RecurrentLayer.cpp "
+            "prevOutput_; feed consecutive chunks of long streams in order)")
 # multi-host bootstrap (ref: --trainer_id/--pservers of the pserver fleet)
 define_flag("coordinator_address", "", "jax.distributed coordinator host:port")
 define_flag("num_processes", 0, "number of cluster processes")

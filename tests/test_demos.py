@@ -16,6 +16,10 @@ ALL_CONFIGS = [
     "demo/quick_start/trainer_config.lr.py",
     "demo/quick_start/trainer_config.cnn.py",
     "demo/quick_start/trainer_config.lstm.py",
+    "demo/quick_start/trainer_config.emb.py",
+    "demo/quick_start/trainer_config.bidi-lstm.py",
+    "demo/quick_start/trainer_config.db-lstm.py",
+    "demo/quick_start/trainer_config.resnet-lstm.py",
 ]
 
 
@@ -41,6 +45,20 @@ def test_quick_start_lr_trains():
     losses = _train_few("demo/quick_start/trainer_config.lr.py",
                         n_batches=10, config_args="batch_size=32")
     assert losses[-1] < losses[0]
+
+
+def test_quick_start_emb_trains():
+    losses = _train_few("demo/quick_start/trainer_config.emb.py",
+                        n_batches=10, config_args="batch_size=32")
+    assert losses[-1] < losses[0]
+
+
+def test_quick_start_deep_stacks_train():
+    # shallow variants of the db-lstm / resnet-lstm stacks for speed
+    _train_few("demo/quick_start/trainer_config.db-lstm.py",
+               n_batches=3, config_args="batch_size=16,depth=2")
+    _train_few("demo/quick_start/trainer_config.resnet-lstm.py",
+               n_batches=3, config_args="batch_size=16,depth=1")
 
 
 def test_sentiment_small_trains():
