@@ -21,8 +21,16 @@ import numpy as np
 
 def convert_state_dict(state_dict, model_config,
                        name_map: Optional[dict[str, str]] = None,
-                       transpose_linear: bool = True) -> dict[str, np.ndarray]:
-    """torch state_dict -> {paddle_tpu param name: np.ndarray}."""
+                       transpose_linear: bool = True,
+                       conv_transpose_keys: tuple = ()) -> dict[str, np.ndarray]:
+    """torch state_dict -> {paddle_tpu param name: np.ndarray}.
+
+    `conv_transpose_keys`: state_dict keys holding nn.ConvTranspose2d
+    weights, whose torch layout is [in, out/g, kH, kW] — the OPPOSITE
+    first-two-axis order of a regular Conv2d.  They must be named
+    explicitly because the array alone cannot reveal which layout it is
+    (a square in==out transposed kernel would otherwise be silently
+    scrambled by the [O, I, kh, kw] reshape rule)."""
     import jax
 
     from paddle_tpu.graph.builder import GraphExecutor
@@ -33,8 +41,15 @@ def convert_state_dict(state_dict, model_config,
 
     torch_items = []
     for k, v in state_dict.items():
-        arr = np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v,
-                         np.float32)
+        # np.array(copy=True): tensor.numpy() ALIASES torch's live storage,
+        # and jax's CPU backend can zero-copy numpy buffers — without the
+        # copy, later in-place torch updates would mutate the "converted"
+        # parameters
+        arr = np.array(v.detach().cpu().numpy() if hasattr(v, "detach") else v,
+                       dtype=np.float32)
+        if k in conv_transpose_keys:
+            assert arr.ndim == 4, f"{k} is not a 4-D conv kernel"
+            arr = np.ascontiguousarray(arr.transpose(1, 0, 2, 3))
         torch_items.append((k, arr))
 
     out: dict[str, np.ndarray] = {}
@@ -71,6 +86,11 @@ def _try_fit(arr: np.ndarray, shape: tuple, transpose_linear: bool):
     if transpose_linear and arr.ndim == 2 and tuple(arr.T.shape) == shape:
         return np.ascontiguousarray(arr.T)
     if arr.size == int(np.prod(shape)) and arr.ndim == 1:
+        return arr.reshape(shape)
+    # conv kernels: torch [O, I, kh, kw] -> this framework's [O, I*kh*kw]
+    # (same element order — C-major within each output filter)
+    if (arr.ndim == 4 and len(shape) == 2 and arr.shape[0] == shape[0]
+            and arr.size == int(np.prod(shape))):
         return arr.reshape(shape)
     return None
 

@@ -1,0 +1,162 @@
+"""Loss parity against the reference training math (BASELINE.md:
+"samples/sec/chip + loss parity").
+
+No public dataset is reachable from this machine (zero egress), so parity
+is asserted in its strongest falsifiable form: the SAME VGG-style network,
+initialized with the SAME weights (transferred via tools/torch2paddle),
+trained on the SAME batches with the SAME optimizer (SGD momentum + L2)
+must produce the SAME per-step loss curve as torch-CPU — the
+implementation used to measure the reference baseline numbers in
+BASELINE.json.  This checks conv/BN/pool/fc forward, their backward
+passes, and the updater math end to end; a single wrong gradient or a
+mismatched BN/momentum/L2 convention diverges the curve within steps.
+(ref: trainer/tests/test_CompareTwoNets.cpp — step-wise parameter/cost
+comparison between two implementations of one network.)
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+torch = pytest.importorskip("torch")
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.tools.torch2paddle import convert_state_dict
+from paddle_tpu.trainer.trainer import Trainer
+
+LR = 0.002
+MOM = 0.9
+L2 = 5e-4
+BATCH = 16
+STEPS = 8
+
+CFG = """
+from paddle_tpu.dsl import *
+settings(batch_size=16, learning_rate=0.002,
+         learning_method=MomentumOptimizer(momentum=0.9),
+         regularization=L2Regularization(5e-4))
+img = data_layer(name="image", size=3*32*32, height=32, width=32)
+c1 = img_conv_layer(input=img, filter_size=3, num_filters=32, padding=1,
+                    stride=1, act=LinearActivation(), bias_attr=False,
+                    num_channels=3)
+b1 = batch_norm_layer(input=c1, act=ReluActivation())
+p1 = img_pool_layer(input=b1, pool_size=2, stride=2, pool_type=MaxPooling())
+c2 = img_conv_layer(input=p1, filter_size=3, num_filters=64, padding=1,
+                    stride=1, act=LinearActivation(), bias_attr=False)
+b2 = batch_norm_layer(input=c2, act=ReluActivation())
+p2 = img_pool_layer(input=b2, pool_size=2, stride=2, pool_type=MaxPooling())
+h = fc_layer(input=p2, size=128, act=ReluActivation(), bias_attr=True)
+out = fc_layer(input=h, size=10, act=SoftmaxActivation(), bias_attr=True)
+classification_cost(input=out, label=data_layer(name="label", size=10))
+"""
+
+
+@pytest.mark.skipif(not os.environ.get("PADDLE_TPU_SLOW_TESTS"),
+                    reason="slow quality run; set PADDLE_TPU_SLOW_TESTS=1")
+def test_vgg_cifar_quality():
+    """Train the demo small_vgg to a reported accuracy (ref:
+    demo/image_classification/train.sh quality expectation).  On real
+    CIFAR-10 (drop the pickle batches under
+    demo/image_classification/data/cifar-10-batches-py) this trains the
+    real task; hermetically it trains the provider's deterministic
+    template-class dataset (2x40 batches of 64, test error bar < 0.15,
+    ~5 min on one CPU core)."""
+    import itertools
+
+    cfg = parse_config("demo/image_classification/vgg_16_cifar.py",
+                       "batch_size=64")
+    tr = Trainer(cfg, seed=0)
+    for _ in range(2):
+        tr.train_one_pass(batches=itertools.islice(tr.train_batches(), 40),
+                          log_period=0)
+    stats = tr.test()
+    assert stats["classification_error"] < 0.15, stats
+
+
+class TorchTwin(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.c1 = torch.nn.Conv2d(3, 32, 3, padding=1, bias=False)
+        self.b1 = torch.nn.BatchNorm2d(32)
+        self.c2 = torch.nn.Conv2d(32, 64, 3, padding=1, bias=False)
+        self.b2 = torch.nn.BatchNorm2d(64)
+        self.fc1 = torch.nn.Linear(64 * 8 * 8, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.b1(self.c1(x)))
+        x = torch.max_pool2d(x, 2, 2)
+        x = torch.relu(self.b2(self.c2(x)))
+        x = torch.max_pool2d(x, 2, 2)
+        x = torch.relu(self.fc1(x.flatten(1)))
+        return self.fc2(x)
+
+
+def test_vgg_loss_curve_matches_torch():
+    path = os.path.join(REPO, "tests", "_parity_cfg.py")
+    with open(path, "w") as f:
+        f.write(CFG)
+    try:
+        torch.manual_seed(0)
+        tm = TorchTwin()
+        tm.train()
+
+        cfg = parse_config(path, "")
+        tr = Trainer(cfg, seed=0)
+        sd = {k: v for k, v in tm.state_dict().items()
+              if "running_" not in k and "num_batches" not in k}
+        converted = convert_state_dict(sd, cfg.model_config)
+        assert set(converted) == set(tr.params), (
+            sorted(converted), sorted(tr.params))
+        import jax.numpy as jnp
+        tr.params = {k: jnp.asarray(v) for k, v in converted.items()}
+        tr.opt_state = tr.updater.init_state(tr.params)
+
+        rng = np.random.default_rng(0)
+        # cycle 2 fixed batches so memorization drives the curve DOWN —
+        # parity on a rising noise-fit curve would still pass allclose, but
+        # a descending curve also catches sign errors in the update
+        xs_pool = rng.normal(size=(2, BATCH, 3, 32, 32)).astype(np.float32)
+        W = rng.normal(size=(3 * 32 * 32, 10)).astype(np.float32)
+        ys_pool = np.argmax(xs_pool.reshape(2, BATCH, -1) @ W, -1).astype(np.int64)
+        xs = xs_pool[np.arange(STEPS) % 2]
+        ys = ys_pool[np.arange(STEPS) % 2]
+
+        # torch side: plain SGD momentum + coupled L2 (same math as the
+        # updater: g += l2*p, v = m*v - lr*g, p += v under constant lr)
+        opt = torch.optim.SGD(tm.parameters(), lr=LR, momentum=MOM,
+                              weight_decay=L2)
+        t_losses = []
+        for s in range(STEPS):
+            opt.zero_grad()
+            logits = tm(torch.from_numpy(xs[s]))
+            loss = torch.nn.functional.cross_entropy(
+                logits, torch.from_numpy(ys[s]))
+            loss.backward()
+            opt.step()
+            t_losses.append(float(loss))
+
+        p_losses = []
+        for s in range(STEPS):
+            flat = xs[s].reshape(BATCH, -1)   # C-major rows == torch layout
+            loss = tr.train_one_batch(
+                {"image": Argument(value=flat),
+                 "label": Argument(ids=ys[s].astype(np.int32))})
+            p_losses.append(float(loss))
+        tr._drain_losses()
+
+        t_losses = np.asarray(t_losses)
+        p_losses = np.asarray(p_losses)
+        # identical math in fp32: per-step agreement to ~1e-3 relative
+        np.testing.assert_allclose(p_losses, t_losses, rtol=5e-3, atol=5e-4,
+                                   err_msg=f"torch={t_losses} ours={p_losses}")
+        # and the curve actually moved (parity of a flat line proves nothing)
+        assert t_losses[-1] < t_losses[0]
+    finally:
+        os.remove(path)
