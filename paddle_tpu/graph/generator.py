@@ -96,9 +96,16 @@ class SequenceGenerator:
         self.beam_size = beam_size or self.gen.beam_size or 1
         self.max_length = max_length or self.gen.max_num_frames
         self.controls = controls or BeamSearchControls()
+        # the WHOLE search — encoder + scan — compiles once per feed shape;
+        # repeat decodes with the same shapes skip tracing entirely
+        self._jitted = jax.jit(self._search)
 
     def __call__(self, params: dict[str, Array], feed: dict[str, Argument],
                  rng: Optional[jax.Array] = None) -> tuple[Array, Array]:
+        return self._jitted(params, feed, rng)
+
+    def _search(self, params: dict[str, Array], feed: dict[str, Argument],
+                rng: Optional[jax.Array] = None) -> tuple[Array, Array]:
         """Returns (ids [B, K, L] int32 with EOS-padding, scores [B, K] log p).
 
         Beams are sorted best-first; K = beam_size.
@@ -233,5 +240,21 @@ def generate(executor, params: dict[str, Array], feed: dict[str, Argument],
     (ref: GradientMachine::generateSequence dispatch)."""
     gens = [sm for sm in executor.model.sub_models if sm.generator is not None]
     assert gens, "model has no generator sub-model"
-    return SequenceGenerator(executor, gens[0], beam_size, max_length,
-                             controls)(params, feed, rng)
+    ctl = controls or BeamSearchControls()
+    # memoize generators on the executor so repeat generate() calls reuse
+    # the compiled search instead of re-tracing.  Keyed on hook IDENTITY —
+    # reuse one long-lived BeamSearchControls per constraint set; a fresh
+    # lambda every call recompiles every call.  LRU-bounded so per-call
+    # closures degrade to recompiles, not unbounded memory growth.
+    from collections import OrderedDict
+    cache = executor.__dict__.setdefault("_generator_cache", OrderedDict())
+    key = (gens[0].name, beam_size, max_length, ctl.adjust_logp,
+           ctl.stop_path, ctl.norm_path, ctl.on_step)
+    if key in cache:
+        cache.move_to_end(key)
+    else:
+        cache[key] = SequenceGenerator(executor, gens[0], beam_size,
+                                       max_length, ctl)
+        while len(cache) > 8:
+            cache.popitem(last=False)
+    return cache[key](params, feed, rng)

@@ -22,7 +22,7 @@ import numpy as np
 def convert_state_dict(state_dict, model_config,
                        name_map: Optional[dict[str, str]] = None,
                        transpose_linear: bool = True,
-                       conv_transpose_keys: tuple = ()) -> dict[str, np.ndarray]:
+                       conv_transpose_keys=()) -> dict[str, np.ndarray]:
     """torch state_dict -> {paddle_tpu param name: np.ndarray}.
 
     `conv_transpose_keys`: state_dict keys holding nn.ConvTranspose2d
@@ -30,7 +30,8 @@ def convert_state_dict(state_dict, model_config,
     first-two-axis order of a regular Conv2d.  They must be named
     explicitly because the array alone cannot reveal which layout it is
     (a square in==out transposed kernel would otherwise be silently
-    scrambled by the [O, I, kh, kw] reshape rule)."""
+    scrambled by the [O, I, kh, kw] reshape rule).  Pass a tuple/list of
+    keys for groups=1 layers, or a {key: groups} dict for grouped ones."""
     import jax
 
     from paddle_tpu.graph.builder import GraphExecutor
@@ -49,15 +50,23 @@ def convert_state_dict(state_dict, model_config,
                        dtype=np.float32)
         if k in conv_transpose_keys:
             assert arr.ndim == 4, f"{k} is not a 4-D conv kernel"
-            arr = np.ascontiguousarray(arr.transpose(1, 0, 2, 3))
+            g = (conv_transpose_keys[k]
+                 if isinstance(conv_transpose_keys, dict) else 1)
+            i, og, kh, kw = arr.shape
+            assert i % g == 0, f"{k}: in_channels {i} not divisible by groups {g}"
+            # [in, out/g, kh, kw] -> [out, in/g, kh, kw], group-block aware
+            arr = np.ascontiguousarray(
+                arr.reshape(g, i // g, og, kh, kw)
+                   .transpose(0, 2, 1, 3, 4)
+                   .reshape(g * og, i // g, kh, kw))
         torch_items.append((k, arr))
 
     out: dict[str, np.ndarray] = {}
     used = set()
     name_map = dict(name_map or {})
+    arrs = dict(torch_items)
     # explicit mappings first
     for tname, pname in name_map.items():
-        arrs = dict(torch_items)
         assert tname in arrs, f"torch key {tname!r} not found"
         assert pname in shapes, f"param {pname!r} not in model"
         out[pname] = _fit(arrs[tname], shapes[pname], transpose_linear)

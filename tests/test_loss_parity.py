@@ -69,8 +69,9 @@ def test_vgg_cifar_quality():
     ~5 min on one CPU core)."""
     import itertools
 
-    cfg = parse_config("demo/image_classification/vgg_16_cifar.py",
-                       "batch_size=64")
+    cfg = parse_config(
+        os.path.join(REPO, "demo/image_classification/vgg_16_cifar.py"),
+        "batch_size=64")
     tr = Trainer(cfg, seed=0)
     for _ in range(2):
         tr.train_one_pass(batches=itertools.islice(tr.train_batches(), 40),
@@ -98,8 +99,8 @@ class TorchTwin(torch.nn.Module):
         return self.fc2(x)
 
 
-def test_vgg_loss_curve_matches_torch():
-    path = os.path.join(REPO, "tests", "_parity_cfg.py")
+def test_vgg_loss_curve_matches_torch(tmp_path):
+    path = str(tmp_path / "parity_cfg.py")
     with open(path, "w") as f:
         f.write(CFG)
     try:
