@@ -19,3 +19,7 @@ from paddle_tpu.dsl.data_sources import (  # noqa: F401
     define_multi_py_data_sources2, define_ptsh_data_sources,
     define_py_data_sources2,
 )
+# legacy recurrent building blocks: use as
+# `from paddle_tpu.dsl import recurrent_units` (the reference's
+# `import trainer.recurrent_units` form)
+from paddle_tpu.dsl import recurrent_units  # noqa: F401

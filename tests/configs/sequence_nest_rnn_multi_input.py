@@ -1,0 +1,41 @@
+"""Hierarchical RNN with TWO nested in-links (raw ids + embeddings), the
+inner step embedding its id slice — equivalent to the flat twin
+(ref: gserver/tests/sequence_nest_rnn_multi_input.conf)."""
+
+from paddle_tpu.dsl import *
+
+settings(batch_size=2, learning_rate=0.01)
+
+dict_dim = 10
+word_dim = 8
+hidden_dim = 8
+label_dim = 3
+
+data = data_layer(name="word", size=dict_dim)
+emb = embedding_layer(input=data, size=word_dim)
+
+
+def outer_step(wid, x):
+    outer_mem = memory(name="outer_rnn_state", size=hidden_dim)
+
+    def inner_step(y, wid):
+        z = embedding_layer(input=wid, size=word_dim)
+        inner_mem = memory(name="inner_rnn_state", size=hidden_dim,
+                           boot_layer=outer_mem)
+        return fc_layer(input=[y, z, inner_mem], size=hidden_dim,
+                        act=TanhActivation(), bias_attr=True,
+                        name="inner_rnn_state")
+
+    inner_rnn_output = recurrent_group(
+        step=inner_step, name="inner", input=[x, wid])
+    last_seq(input=inner_rnn_output, name="outer_rnn_state")
+    return inner_rnn_output
+
+
+out = recurrent_group(name="outer", step=outer_step,
+                      input=[SubsequenceInput(data), SubsequenceInput(emb)])
+
+rep = last_seq(input=out)
+prob = fc_layer(size=label_dim, input=rep, act=SoftmaxActivation(),
+                bias_attr=True)
+classification_cost(input=prob, label=data_layer(name="label", size=label_dim))

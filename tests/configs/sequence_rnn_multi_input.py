@@ -1,0 +1,29 @@
+"""Flat twin of sequence_nest_rnn_multi_input.py
+(ref: gserver/tests/sequence_rnn_multi_input.conf)."""
+
+from paddle_tpu.dsl import *
+
+settings(batch_size=2, learning_rate=0.01)
+
+dict_dim = 10
+word_dim = 8
+hidden_dim = 8
+label_dim = 3
+
+data = data_layer(name="word", size=dict_dim)
+emb = embedding_layer(input=data, size=word_dim)
+
+
+def step(y, wid):
+    z = embedding_layer(input=wid, size=word_dim)
+    mem = memory(name="rnn_state", size=hidden_dim)
+    return fc_layer(input=[y, z, mem], size=hidden_dim,
+                    act=TanhActivation(), bias_attr=True, name="rnn_state")
+
+
+out = recurrent_group(name="rnn", step=step, input=[emb, data])
+
+rep = last_seq(input=out)
+prob = fc_layer(size=label_dim, input=rep, act=SoftmaxActivation(),
+                bias_attr=True)
+classification_cost(input=prob, label=data_layer(name="label", size=label_dim))

@@ -58,24 +58,37 @@ def _loss_and_grads(cfg_path, batch):
     return float(loss), params, grads
 
 
-def test_nested_matches_flat():
-    nl, nparams, ngrads = _loss_and_grads(NEST_CFG, _nested_batch())
-    fl, fparams, fgrads = _loss_and_grads(FLAT_CFG, _flat_batch())
+def _assert_nested_matches_flat(nested_cfg, flat_cfg):
+    """The equivalence oracle: identical parameter sets (same shapes, same
+    declaration order, same seed => same values; names legitimately
+    differ), identical loss, identical gradients."""
+    nl, nparams, ngrads = _loss_and_grads(nested_cfg, _nested_batch())
+    fl, fparams, fgrads = _loss_and_grads(flat_cfg, _flat_batch())
 
-    # identical parameter sets: same shapes in the same declaration order,
-    # same seed => same values (names differ: inner_rnn_state vs rnn_state)
     nkeys, fkeys = list(nparams), list(fparams)
     assert len(nkeys) == len(fkeys)
     for nk, fk in zip(nkeys, fkeys):
         np.testing.assert_array_equal(np.asarray(nparams[nk]),
                                       np.asarray(fparams[fk]))
-
     assert abs(nl - fl) < 1e-5, (nl, fl)
     for nk, fk in zip(nkeys, fkeys):
         np.testing.assert_allclose(np.asarray(ngrads[nk]),
                                    np.asarray(fgrads[fk]),
                                    rtol=1e-4, atol=1e-5,
                                    err_msg=f"{nk} vs {fk}")
+
+
+def test_nested_matches_flat():
+    _assert_nested_matches_flat(NEST_CFG, FLAT_CFG)
+
+
+def test_nested_multi_input_matches_flat():
+    """Two nested in-links (ids + embeddings), inner step embeds its id
+    slice (ref: sequence_nest_rnn_multi_input.conf vs
+    sequence_rnn_multi_input.conf)."""
+    _assert_nested_matches_flat(
+        os.path.join(REPO, "tests/configs/sequence_nest_rnn_multi_input.py"),
+        os.path.join(REPO, "tests/configs/sequence_rnn_multi_input.py"))
 
 
 def test_nested_pooling_ops():

@@ -1,0 +1,75 @@
+"""Legacy recurrent_units building blocks (ref: python/paddle/trainer/
+recurrent_units.py): LSTM/GRU units + layer groups with para_prefix
+parameter sharing."""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.data.feeder import make_batch
+from paddle_tpu.data.provider import integer_value, integer_value_sequence
+from paddle_tpu.trainer.trainer import Trainer
+
+CFG = """
+from paddle_tpu.dsl import *
+from paddle_tpu.dsl.recurrent_units import (
+    GatedRecurrentLayerGroup, LstmRecurrentLayerGroup)
+
+settings(batch_size=4, learning_rate=0.5)
+data = data_layer(name="word", size=10)
+emb = embedding_layer(input=data, size=8)
+lstm = LstmRecurrentLayerGroup(name="lstm_u", size=8,
+                               inputs=[full_matrix_projection(input=emb)])
+gru = GatedRecurrentLayerGroup(name="gru_u", size=8,
+                               inputs=[full_matrix_projection(input=emb)])
+# a second GRU group SHARING the first's parameters via para_prefix
+gru2 = GatedRecurrentLayerGroup(name="gru_u2", size=8, para_prefix="gru_u",
+                                inputs=[full_matrix_projection(input=emb)])
+rep = concat_layer(input=[last_seq(input=lstm), last_seq(input=gru),
+                          last_seq(input=gru2)])
+out = fc_layer(input=rep, size=3, act=SoftmaxActivation())
+classification_cost(input=out, label=data_layer(name="label", size=3))
+"""
+
+
+def test_units_train_and_share_parameters():
+    path = os.path.join(REPO, "tests", "_runits_cfg.py")
+    with open(path, "w") as f:
+        f.write(CFG)
+    try:
+        cfg = parse_config(path, "")
+        pnames = [p.name for p in cfg.model_config.parameters]
+        # para_prefix sharing: the recurrent weight/bias exist ONCE
+        assert pnames.count("gru_u_gate_recurrent.w") == 1
+        assert pnames.count("gru_u_input_proj.b") == 1
+        assert not any("gru_u2_gate" in n for n in pnames), pnames
+        assert "lstm_u_input_recurrent.w" in pnames
+        assert "lstm_u_check.b" in pnames
+
+        tr = Trainer(cfg, seed=0)
+        rng = np.random.default_rng(0)
+        dataset = []
+        for _ in range(12):
+            L = int(rng.integers(2, 6))
+            seq = rng.integers(0, 10, L).tolist()
+            dataset.append((seq, seq[0] % 3))
+
+        def batches():
+            for i in range(0, 12, 4):
+                yield make_batch(
+                    dataset[i:i + 4],
+                    [integer_value_sequence(10), integer_value(3)],
+                    ["word", "label"])
+
+        c0 = tr.train_one_pass(batches=batches(), log_period=0)["cost"]
+        last = c0
+        for _ in range(30):
+            last = tr.train_one_pass(batches=batches(), log_period=0)["cost"]
+        assert last < c0 * 0.8, (c0, last)
+    finally:
+        os.remove(path)
