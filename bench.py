@@ -25,17 +25,21 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# bf16 peak TFLOP/s per chip by TPU generation (v5 lite = v5e)
-_PEAK_TFLOPS = {"v4": 275.0, "v5 lite": 197.0, "v5": 459.0, "v6": 918.0}
-
-
 def _chip_peak_tflops(dtype: str) -> float:
     import jax
     kind = jax.devices()[0].device_kind.lower()
-    peak = 197.0  # assume v5e when unknown
-    for k, v in _PEAK_TFLOPS.items():
-        if k in kind:
-            peak = v
+    # most specific first: 'v5 lite'/'v5e' must not fall through to the
+    # bare 'v5' (v5p) entry — that bug under-reported MFU 2.3x
+    if "v5 lite" in kind or "v5e" in kind:
+        peak = 197.0
+    elif "v5" in kind:
+        peak = 459.0
+    elif "v6" in kind:
+        peak = 918.0
+    elif "v4" in kind:
+        peak = 275.0
+    else:
+        peak = 197.0  # assume v5e when unknown
     # fp32 peak is half the bf16 peak on TPU
     return peak if dtype == "bfloat16" else peak / 2.0
 

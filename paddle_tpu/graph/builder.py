@@ -72,6 +72,8 @@ class GraphExecutor:
         # items inside their parent's plan)
         self._sub_plan: dict[str, list[tuple[str, Any]]] = {}
         self._plan = self._build_plan()
+        # per-group suffix-deferral splits (see _split_deferred), lazy
+        self._defer_cache: dict[str, Optional[dict]] = {}
 
     # -- planning ---------------------------------------------------------
     def _build_plan(self) -> list[tuple[str, Any]]:
@@ -185,10 +187,12 @@ class GraphExecutor:
             total = s if total is None else total + s
         return total, (outputs, costs, new_state)
 
-    def run_group_layers(self, sm: SubModelConfig, sub: ForwardContext) -> None:
+    def run_group_layers(self, sm: SubModelConfig, sub: ForwardContext,
+                         skip: Optional[set] = None) -> None:
         """Execute one timestep of a sub-model's layers; agent/alias layers
         must already be fed into sub.outputs.  Nested child groups run as
-        inner scans at their position in the plan."""
+        inner scans at their position in the plan.  `skip` holds layer
+        names deferred to post-scan batched execution."""
         for kind, item in self._sub_plan.get(sm.name, []):
             if kind == "scan":
                 self._run_scan(sub, item)
@@ -196,7 +200,86 @@ class GraphExecutor:
             cfg: LayerConfig = item
             if cfg.name in sub.outputs:      # agents already fed
                 continue
+            if skip and cfg.name in skip:
+                continue
             sub.outputs[cfg.name] = get_layer_fn(cfg.type)(sub, cfg)
+
+    # -- suffix-layer deferral --------------------------------------------
+    _DEFER_PROJS = {"fc", "full_matrix", "trans_full_matrix", "table",
+                    "identity", "dot_mul", "scaling"}
+
+    def _split_deferred(self, sm: SubModelConfig) -> Optional[dict]:
+        """Layers of a recurrent group OUTSIDE the carry-dependency closure
+        need not run inside the sequential scan: they can execute ONCE on
+        the stacked [B, T, ...] sequence afterwards, turning T small
+        per-step matmuls into one large MXU-shaped one.  The classic case
+        is an attention decoder's vocabulary softmax projection — the
+        dominant matmul of the step, feeding only the cost, never the
+        recurrence.
+
+        Returns {deferred, cfgs, emit} or None when nothing defers.  Only
+        batch-agnostic layer types (last-dim ops) are eligible; a deferred
+        layer may read scan-internal values (emitted per step) or in_link
+        aliases (reconstructed as full sequences) but not static links
+        (their [B, D] shape would not broadcast against [B, T, D])."""
+        plan = self._sub_plan.get(sm.name, [])
+        if sm.generator is not None or any(k == "scan" for k, _ in plan):
+            return None
+        layer_cfgs = {item.name: item for k, item in plan if k == "layer"}
+        alias = set(sm.in_link_layers)
+        statics = set(sm.static_link_layers)
+        agents = {m.layer_name for m in sm.memories}
+
+        # carry closure: memory-linked layers + their transitive inputs
+        needed: set = set()
+        stack = [m.link_name for m in sm.memories]
+        while stack:
+            n = stack.pop()
+            if n in needed or n not in layer_cfgs:
+                continue
+            needed.add(n)
+            for inp in layer_cfgs[n].inputs:
+                stack.append(inp.input_layer_name)
+
+        def safe(cfg: LayerConfig) -> bool:
+            if any(i.input_layer_name in statics for i in cfg.inputs):
+                return False
+            if cfg.type in ("fc", "addto"):
+                return True
+            if cfg.type == "mixed":
+                return (all(i.proj is None or i.proj.type in self._DEFER_PROJS
+                            for i in cfg.inputs)
+                        and all(op.type == "dot_mul" for op in cfg.operators))
+            return False
+
+        deferred = {item.name for k, item in plan if k == "layer"
+                    and item.name not in needed and item.name not in alias
+                    and item.name not in agents and safe(item)}
+        # fixpoint: an inside layer consuming a deferred output pulls the
+        # producer back inside
+        changed = True
+        while changed:
+            changed = False
+            for k, item in plan:
+                if k != "layer" or item.name in deferred:
+                    continue
+                for inp in item.inputs:
+                    if inp.input_layer_name in deferred:
+                        deferred.discard(inp.input_layer_name)
+                        changed = True
+        if not deferred:
+            return None
+        cfgs = [item for k, item in plan
+                if k == "layer" and item.name in deferred]
+        emit: set = set()
+        for cfg in cfgs:
+            for inp in cfg.inputs:
+                n = inp.input_layer_name
+                if n in deferred or n in alias:
+                    continue
+                if n in layer_cfgs or n in agents:
+                    emit.add(n)
+        return {"deferred": deferred, "cfgs": cfgs, "emit": emit}
 
     # -- recurrent sub-model as lax.scan ---------------------------------
     def _run_scan(self, ctx: ForwardContext, sm: SubModelConfig) -> None:
@@ -274,6 +357,17 @@ class GraphExecutor:
         params = ctx.params
         model = self.model
 
+        # suffix layers outside the carry closure run post-scan, batched
+        # over all timesteps (computed once per group, cached)
+        if sm.name not in self._defer_cache:
+            self._defer_cache[sm.name] = self._split_deferred(sm)
+        spec = self._defer_cache[sm.name]
+        defer_active = spec is not None and sub_lens_src is None
+        skip = spec["deferred"] if defer_active else None
+        emit_names = (sorted((set(sm.output_layer_names) - spec["deferred"])
+                             | spec["emit"])
+                      if defer_active else list(sm.output_layer_names))
+
         out_is_seq: dict[str, bool] = {}   # filled once during scan tracing
 
         def step(carry, inp):
@@ -305,7 +399,7 @@ class GraphExecutor:
                 sub.outputs[mem.layer_name] = (
                     Argument(ids=prev) if prev.dtype in (jnp.int32, jnp.int64)
                     else Argument(value=prev))
-            self.run_group_layers(sm, sub)
+            self.run_group_layers(sm, sub, skip=skip)
             valid = (t < lengths)
             new_carry = {}
             for mem in sm.memories:
@@ -316,7 +410,7 @@ class GraphExecutor:
                 # the step body must not flip a bf16 memory to fp32 mid-scan)
                 new_carry[mem.link_name] = jnp.where(v, out, prev).astype(prev.dtype)
             emitted = {}
-            for name in sm.output_layer_names:
+            for name in emit_names:
                 o = sub.outputs[name]
                 out_is_seq[name] = o.lengths is not None
                 emitted[name] = o.data
@@ -329,7 +423,10 @@ class GraphExecutor:
         # publish out_links as [B, T, D] sequences; a nested group whose step
         # emitted per-subsequence sequences publishes [B, S, T, D] with the
         # in_link's subsequence structure
+        deferred_names = spec["deferred"] if defer_active else set()
         for name in sm.output_layer_names:
+            if name in deferred_names:
+                continue  # produced by the deferred batched execution below
             seq = jnp.moveaxis(stacked[name], 0, 1)
             if sm.reversed:
                 from paddle_tpu.ops.sequence import seq_reverse
@@ -338,4 +435,44 @@ class GraphExecutor:
                 ctx.outputs[name] = Argument(value=seq, lengths=lengths,
                                              sub_lengths=sub_lens_src)
             else:
+                ctx.outputs[name] = Argument(value=seq, lengths=lengths)
+
+        if defer_active:
+            # run the suffix layers ONCE over the stacked sequences: one
+            # [B*T, D] matmul instead of T [B, D] ones inside the scan.
+            # rng folded with a large per-group constant so deferred dropout
+            # masks are independent of the root context's key sequence and
+            # of other groups' (the scan body folds small t values)
+            drng = None
+            if rng is not None:
+                gid = [s.name for s in model.sub_models].index(sm.name)
+                drng = jax.random.fold_in(rng, 2**31 - 1 - gid)
+            dctx = ForwardContext(model=model, params=params, mode=mode,
+                                  rng=drng)
+            for outer, inner in in_link_alias.items():
+                full = jnp.moveaxis(xs[outer], 0, 1)   # scan orientation
+                if outer in sparse_links:
+                    dctx.outputs[inner] = Argument(
+                        ids=full,
+                        sparse_vals=jnp.moveaxis(xs["__spvals__" + outer], 0, 1),
+                        sparse_dim=sparse_links[outer], lengths=lengths)
+                elif jnp.issubdtype(full.dtype, jnp.integer):
+                    dctx.outputs[inner] = Argument(ids=full, lengths=lengths)
+                else:
+                    dctx.outputs[inner] = Argument(value=full, lengths=lengths)
+            for name in spec["emit"]:
+                v = jnp.moveaxis(stacked[name], 0, 1)
+                if jnp.issubdtype(v.dtype, jnp.integer):
+                    dctx.outputs[name] = Argument(ids=v, lengths=lengths)
+                else:
+                    dctx.outputs[name] = Argument(value=v, lengths=lengths)
+            for cfg in spec["cfgs"]:
+                dctx.outputs[cfg.name] = get_layer_fn(cfg.type)(dctx, cfg)
+            for name in sm.output_layer_names:
+                if name not in deferred_names:
+                    continue
+                seq = dctx.outputs[name].data
+                if sm.reversed:
+                    from paddle_tpu.ops.sequence import seq_reverse
+                    seq = seq_reverse(seq, lengths)
                 ctx.outputs[name] = Argument(value=seq, lengths=lengths)
