@@ -194,8 +194,10 @@ def print_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 def selective_fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Selective FC (ref: SelectiveFullyConnectedLayer.cpp): full output here —
     the selection mask is an inference-time sparsity optimization that XLA's
-    dense matmul makes unnecessary; with a selection input, non-selected
-    outputs are masked to -inf-ish zero."""
+    dense matmul makes unnecessary.  With a selection input, non-selected
+    logits are pushed to a large negative value BEFORE the (softmax)
+    activation so unselected classes get ~zero probability — the reference
+    computes softmax over only the selected columns."""
     inputs = ctx.get_inputs(cfg)
     has_sel = cfg.attrs.get("has_selected_colums", False)
     feat_inputs = inputs[:-1] if has_sel else inputs
@@ -209,5 +211,8 @@ def selective_fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
         acc = acc + b
     if has_sel:
         sel = inputs[-1]
-        acc = acc * sel.value
+        if cfg.active_type == "softmax":
+            acc = jnp.where(sel.value > 0, acc, -1e9)
+        else:
+            acc = acc * sel.value
     return finish_layer(ctx, cfg, acc, like=feat_inputs[0])

@@ -78,7 +78,7 @@ def hsigmoid_cost(
     feats: list[Array],    # each [B, D_i]
     labels: Array,         # [B]
     ws: list[Array],       # each [num_classes-1, D_i] inner-node weights
-    b: Optional[Array],    # [num_classes-1]
+    b: Optional[Array],    # [1, num_classes-1] (the bias-parameter layout)
     num_classes: int,
 ) -> Array:
     """sum over code bits of binary logistic cost
@@ -91,7 +91,7 @@ def hsigmoid_cost(
         zi = jnp.einsum("bnd,bd->bn", wn, feat)
         z = zi if z is None else z + zi
     if b is not None:
-        z = z + b[nodes]
+        z = z + b.reshape(-1)[nodes]   # bias params arrive [1, C-1]
     valid = bits >= 0
     t = jnp.maximum(bits, 0).astype(z.dtype)
     # reference convention: bit=1 -> target sigmoid(z)=1

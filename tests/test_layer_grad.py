@@ -213,3 +213,85 @@ def test_subseq_forward_and_grad():
         assert np.all(sub[b, sizes[b]:] == 0)
 
     fd_check(cfg, feed)
+
+
+def test_nce_grad():
+    """NCE cost gradients (ref: test_LayerGrad.cpp testNceLayer analog):
+    with a fixed rng the sampled negatives are deterministic, so central
+    differences see the same loss surface as autodiff."""
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=6)
+        h = fc_layer(input=x, size=8, act=TanhActivation())
+        nce_layer(input=h, label=data_layer(name="y", size=12),
+                  num_classes=12, num_neg_samples=5, bias_attr=True)
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(11)
+    feed = {"x": Argument(value=jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 12, 4), jnp.int32))}
+    fd_check(cfg, feed)
+
+
+def test_hsigmoid_grad():
+    """Hierarchical sigmoid cost gradients (ref: test_LayerGrad.cpp
+    testHsigmoidLayer analog)."""
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=6)
+        h = fc_layer(input=x, size=8, act=TanhActivation())
+        hsigmoid(input=h, label=data_layer(name="y", size=10),
+                 num_classes=10, bias_attr=True)
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(12)
+    feed = {"x": Argument(value=jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 10, 4), jnp.int32))}
+    fd_check(cfg, feed)
+
+
+def test_selective_fc_grad():
+    """Selective FC gradients, with and without a selection input
+    (ref: test_LayerGrad.cpp testSelectiveFcLayer analog).  With selection,
+    unselected classes must carry ~zero probability (the reference's
+    selected-columns-only softmax)."""
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=6)
+        sel = data_layer(name="sel", size=5)
+        h = selective_fc_layer(input=x, select=sel, size=5,
+                               act=SoftmaxActivation(), bias_attr=True)
+        classification_cost(input=h, label=data_layer(name="y", size=5))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(13)
+    sel = np.zeros((4, 5), np.float32)
+    for b in range(4):
+        sel[b, rng.choice(5, 3, replace=False)] = 1.0
+    # labels must be among the selected columns (unselected prob ~ 0)
+    y = np.asarray([int(np.flatnonzero(sel[b])[0]) for b in range(4)], np.int32)
+    feed = {"x": Argument(value=jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)),
+            "sel": Argument(value=jnp.asarray(sel)),
+            "y": Argument(ids=jnp.asarray(y))}
+
+    ex = GraphExecutor(cfg.model_config)
+    params = ex.init_params(jax.random.PRNGKey(0))
+    outs, _, _ = ex.forward(params, feed, mode=TEST, rng=jax.random.PRNGKey(1))
+    probs = np.asarray(
+        outs[[n for n in outs if "selective" in n][0]].value, np.float64)
+    assert np.abs(probs[sel == 0]).max() < 1e-6, "unselected prob must be ~0"
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
+
+    fd_check(cfg, feed)
+
+
+def test_selective_fc_no_selection_grad():
+    """Without a selection input selective_fc is a plain FC."""
+    def conf():
+        settings(batch_size=4)
+        x = data_layer(name="x", size=6)
+        h = selective_fc_layer(input=x, select=None, size=5,
+                               act=SoftmaxActivation(), bias_attr=True)
+        classification_cost(input=h, label=data_layer(name="y", size=5))
+    cfg = parse_config_callable(conf)
+    rng = np.random.default_rng(14)
+    feed = {"x": Argument(value=jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)),
+            "y": Argument(ids=jnp.asarray(rng.integers(0, 5, 4), jnp.int32))}
+    fd_check(cfg, feed)
