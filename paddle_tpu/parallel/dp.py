@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.config.schema import ModelConfig
@@ -32,6 +33,18 @@ def param_sharding(mesh: Mesh, partition_spec: Optional[list]) -> NamedSharding:
     if not partition_spec:
         return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(*[a if a else None for a in partition_spec]))
+
+
+def _global_put(x, sharding: NamedSharding):
+    """device_put that also works on multi-process meshes: every process
+    holds the same full host value (deterministic seeded init / loaded
+    checkpoint) and materializes only its addressable shards — device_put
+    cannot target non-addressable devices."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
 
 
 def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state: Any):
@@ -48,13 +61,13 @@ def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state:
                     and len(p.dims) == 2 and p.dims[0] % axis_size == 0:
                 specs[p.name] = emb_spec
     out_params = {
-        name: jax.device_put(v, param_sharding(mesh, specs.get(name)))
+        name: _global_put(v, param_sharding(mesh, specs.get(name)))
         for name, v in params.items()
     }
 
     def place_slots(slots_for_param, name):
         sh = param_sharding(mesh, specs.get(name))
-        return jax.tree.map(lambda x: jax.device_put(x, sh), slots_for_param)
+        return jax.tree.map(lambda x: _global_put(x, sh), slots_for_param)
 
     opt_state = dict(opt_state)
     if "slots" in opt_state:
@@ -62,17 +75,29 @@ def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state:
             name: place_slots(s, name) for name, s in opt_state["slots"].items()}
     if "average" in opt_state:
         opt_state["average"] = {
-            name: jax.device_put(v, param_sharding(mesh, specs.get(name)))
+            name: _global_put(v, param_sharding(mesh, specs.get(name)))
             for name, v in opt_state["average"].items()}
     return out_params, opt_state
 
 
 def shard_batch(mesh: Mesh, batch: dict[str, Argument]) -> dict[str, Argument]:
     """Shard every array's leading (batch) dim over the data axis — the analog
-    of MultiGradientMachine slicing inArgs per thread (ref: .h:330-340)."""
+    of MultiGradientMachine slicing inArgs per thread (ref: .h:330-340).
+
+    Single-process: a plain device_put.  Multi-process (jax.distributed):
+    each process feeds its OWN local batch — the per-host data-parallel
+    input pipeline, like each trainer of the pserver fleet reading its own
+    file shard — and the local batches concatenate along the batch dim
+    into the global array (device_put cannot target non-addressable
+    devices)."""
     sh = NamedSharding(mesh, P(DATA_AXIS))
+    multiproc = jax.process_count() > 1
 
     def place(x):
-        return jax.device_put(x, sh) if hasattr(x, "ndim") and x.ndim >= 1 else x
+        if not (hasattr(x, "ndim") and x.ndim >= 1):
+            return x
+        if multiproc:
+            return jax.make_array_from_process_local_data(sh, np.asarray(x))
+        return jax.device_put(x, sh)
 
     return jax.tree.map(place, batch)

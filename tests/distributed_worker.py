@@ -1,0 +1,83 @@
+"""Worker for the real multi-process jax.distributed test (launched by
+tests/test_multiprocess.py, one subprocess per simulated host).
+
+Each process boots via init_distributed (the pserver-fleet bootstrap
+analog), builds the SAME model from the same seed, feeds its OWN local
+batch shard (per-host data-parallel input, like each trainer reading its
+own file list), trains a few steps over a data-parallel mesh, and prints
+the per-step losses — which must agree bit-for-bit across processes since
+the loss is computed from the global batch.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# force the CPU backend BEFORE jax import (the axon plugin must not latch)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    coord = sys.argv[1]
+    num_procs = int(sys.argv[2])
+    pid = int(sys.argv[3])
+
+    from paddle_tpu.parallel.mesh import init_distributed, make_mesh
+    init_distributed(coord, num_procs, pid)
+    assert jax.process_count() == num_procs, jax.process_count()
+
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    def conf():
+        from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
+                                    TanhActivation, classification_cost,
+                                    data_layer, fc_layer, settings)
+        settings(batch_size=8 * num_procs, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(momentum=0.9))
+        x = data_layer(name="x", size=16)
+        h = fc_layer(input=x, size=32, act=TanhActivation())
+        out = fc_layer(input=h, size=4, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=4))
+
+    cfg = parse_config_callable(conf)
+    mesh = make_mesh()          # data axis spans both processes' devices
+    tr = Trainer(cfg, seed=7, mesh=mesh)
+
+    # per-process data: DIFFERENT shards (seeded by process id), global
+    # batch = concatenation over processes
+    rng = np.random.default_rng(100 + pid)
+    W = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    losses = []
+    for _ in range(4):
+        x = rng.normal(size=(8, 16)).astype(np.float32)
+        y = np.argmax(x @ W, -1).astype(np.int32)
+        loss = tr.train_one_batch({"x": Argument(value=x),
+                                   "y": Argument(ids=y)})
+        # the step loss is computed from the GLOBAL batch and fully
+        # replicated, so float() is legal multi-process and every process
+        # must see the same value
+        losses.append(float(loss))
+    tr._drain_losses()
+    print("RESULT pid={} losses={}".format(
+        pid, ",".join(f"{l:.10f}" for l in losses)), flush=True)
+
+    # barrier stats straggler table exercises process_allgather
+    from paddle_tpu.parallel.barrier_stat import BarrierTimer
+    bt = tr.barrier_stat
+    strag = bt.straggler_summary()
+    assert strag is not None and strag["skew"] >= 1.0, strag
+    print(f"RESULT pid={pid} straggler_ok skew={strag['skew']:.3f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
