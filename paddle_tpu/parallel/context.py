@@ -41,9 +41,11 @@ def _data_axis(mesh: Mesh) -> Optional[str]:
 
 
 def shard_sequence(mesh: Mesh, x: Array) -> Array:
-    """Place [B, T, ...] with batch on `data` and time on `seq`."""
+    """Place [B, T, ...] with batch on `data` and time on `seq`.  Works on
+    multi-process meshes too (each process holds the full host copy)."""
+    from paddle_tpu.parallel.dp import global_put
     spec = [_data_axis(mesh), SEQ_AXIS] + [None] * (x.ndim - 2)
-    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return global_put(x, NamedSharding(mesh, P(*spec)))
 
 
 def ring_attention_sharded(

@@ -35,16 +35,21 @@ def param_sharding(mesh: Mesh, partition_spec: Optional[list]) -> NamedSharding:
     return NamedSharding(mesh, P(*[a if a else None for a in partition_spec]))
 
 
-def _global_put(x, sharding: NamedSharding):
+def global_put(x, sharding: NamedSharding):
     """device_put that also works on multi-process meshes: every process
     holds the same full host value (deterministic seeded init / loaded
     checkpoint) and materializes only its addressable shards — device_put
-    cannot target non-addressable devices."""
+    cannot target non-addressable devices.  Use for REPLICATED host data
+    (params, slots, identical copies); per-process-distinct data goes
+    through jax.make_array_from_process_local_data instead."""
     if jax.process_count() == 1:
         return jax.device_put(x, sharding)
     arr = np.asarray(x)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])
+
+
+_global_put = global_put
 
 
 def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state: Any):

@@ -48,8 +48,9 @@ def stage_param_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def place_stage_params(mesh: Mesh, stacked: Any) -> Any:
+    from paddle_tpu.parallel.dp import global_put
     sh = stage_param_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
+    return jax.tree.map(lambda x: global_put(x, sh), stacked)
 
 
 def pipeline_apply(

@@ -1,0 +1,148 @@
+"""Pretrained-embedding utilities (ref: demo/model_zoo/embedding/
+{extract_para.py, paraconvert.py}).
+
+The reference ships two scripts around its binary parameter files: extract
+the rows of a big pretrained embedding that match a user dictionary, and
+convert parameter files binary<->text.  Here the parameter container is
+this framework's checkpoint .npz / plain .npy, and the text form is the
+word2vec-style "word v1 v2 ... vD" per line, so embeddings interchange
+with the wider ecosystem.
+
+CLI:
+    python -m paddle_tpu.tools.embedding_zoo extract \
+        --pre_model emb.npy --pre_dict big.dict \
+        --usr_model out.npy --usr_dict small.dict
+    python -m paddle_tpu.tools.embedding_zoo to_text \
+        --model emb.npy --dict words.dict --output emb.txt
+    python -m paddle_tpu.tools.embedding_zoo from_text \
+        --input emb.txt --model out.npy --dict out.dict
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def _read_dict(path: str) -> list[str]:
+    with open(path) as f:
+        return [ln.rstrip("\n") for ln in f if ln.rstrip("\n")]
+
+
+def extract_rows(pre_emb: np.ndarray, pre_words: list[str],
+                 usr_words: list[str],
+                 unk_token: str = "<unk>") -> np.ndarray:
+    """Rows of `pre_emb` for `usr_words` (ref: extract_para.py
+    get_row_index + extract_parameters_by_usrDict).  A user word missing
+    from the pretrained dictionary falls back to the `<unk>` row when the
+    pretrained dict has one, else to the pretrained mean vector."""
+    index = {w: i for i, w in enumerate(pre_words)}
+    assert len(pre_words) == pre_emb.shape[0], \
+        f"dict has {len(pre_words)} words, embedding {pre_emb.shape[0]} rows"
+    if unk_token in index:
+        fallback = pre_emb[index[unk_token]]
+    else:
+        fallback = pre_emb.mean(axis=0)
+    out = np.empty((len(usr_words), pre_emb.shape[1]), pre_emb.dtype)
+    misses = 0
+    for r, w in enumerate(usr_words):
+        i = index.get(w)
+        if i is None:
+            out[r] = fallback
+            misses += 1
+        else:
+            out[r] = pre_emb[i]
+    if misses:
+        print(f"{misses}/{len(usr_words)} user words not in the pretrained "
+              f"dictionary (filled with "
+              f"{'<unk> row' if unk_token in index else 'mean vector'})")
+    return out
+
+
+def to_text(emb: np.ndarray, words: list[str], path: str) -> None:
+    """word2vec-style text (ref: paraconvert.py --b2t; the first line
+    carries the shape header like the reference's count:dim line)."""
+    assert len(words) == emb.shape[0]
+    with open(path, "w") as f:
+        f.write(f"{emb.shape[0]} {emb.shape[1]}\n")
+        for w, row in zip(words, emb):
+            f.write(w + " " + " ".join(f"{v:.6g}" for v in row) + "\n")
+
+
+def from_text(path: str) -> tuple[np.ndarray, list[str]]:
+    """(ref: paraconvert.py --t2b)."""
+    with open(path) as f:
+        n, d = (int(t) for t in f.readline().split())
+        words, rows = [], []
+        for ln in f:
+            parts = ln.split()   # tolerate double spaces / trailing blanks
+            if not parts:
+                continue
+            words.append(parts[0])
+            rows.append(np.asarray(parts[1:], np.float32))
+    emb = np.stack(rows)
+    assert emb.shape == (n, d), f"header {(n, d)} vs data {emb.shape}"
+    return emb, words
+
+
+def _load_emb(path: str, key: str = "") -> np.ndarray:
+    if path.endswith(".npz"):
+        data = np.load(path)
+        if key:
+            assert key in data.files, \
+                f"--key {key!r} not in archive; available: {sorted(data.files)}"
+            return np.asarray(data[key], np.float32)
+        names = [k for k in data.files if "embedding" in k]
+        if len(names) != 1:
+            raise SystemExit(
+                f"cannot identify the embedding array in {path} "
+                f"(matches: {names or 'none'}); pass --key, available keys: "
+                f"{sorted(data.files)}")
+        return np.asarray(data[names[0]], np.float32)
+    return np.asarray(np.load(path), np.float32)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    e = sub.add_parser("extract")
+    e.add_argument("--pre_model", required=True)
+    e.add_argument("--pre_dict", required=True)
+    e.add_argument("--usr_model", required=True)
+    e.add_argument("--usr_dict", required=True)
+    e.add_argument("--key", default="", help=".npz array name if ambiguous")
+
+    t = sub.add_parser("to_text")
+    t.add_argument("--model", required=True)
+    t.add_argument("--dict", dest="dict_path", required=True)
+    t.add_argument("--output", required=True)
+    t.add_argument("--key", default="", help=".npz array name if ambiguous")
+
+    ft = sub.add_parser("from_text")
+    ft.add_argument("--input", required=True)
+    ft.add_argument("--model", required=True)
+    ft.add_argument("--dict", dest="dict_path", required=True)
+
+    args = p.parse_args(argv)
+    if args.cmd == "extract":
+        emb = extract_rows(_load_emb(args.pre_model, args.key),
+                           _read_dict(args.pre_dict),
+                           _read_dict(args.usr_dict))
+        np.save(args.usr_model, emb)
+        print(f"wrote {args.usr_model}: {emb.shape}")
+    elif args.cmd == "to_text":
+        to_text(_load_emb(args.model, args.key),
+                _read_dict(args.dict_path), args.output)
+        print(f"wrote {args.output}")
+    else:
+        emb, words = from_text(args.input)
+        np.save(args.model, emb)
+        with open(args.dict_path, "w") as f:
+            f.write("\n".join(words) + "\n")
+        print(f"wrote {args.model}: {emb.shape} and {args.dict_path}")
+
+
+if __name__ == "__main__":
+    main()

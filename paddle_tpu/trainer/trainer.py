@@ -515,10 +515,17 @@ class Trainer:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from paddle_tpu.parallel.dp import DATA_AXIS
             sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+            multiproc = jax.process_count() > 1
 
             def place(x):
-                return (jax.device_put(x, sh)
-                        if hasattr(x, "ndim") and x.ndim >= 2 else x)
+                if not (hasattr(x, "ndim") and x.ndim >= 2):
+                    return x
+                if multiproc:
+                    # each process stages its OWN batches; the global
+                    # staged array concatenates them along the batch dim
+                    return jax.make_array_from_process_local_data(
+                        sh, np.asarray(x))
+                return jax.device_put(x, sh)
             stacked = jax.tree.map(place, stacked)
         else:
             stacked = jax.device_put(stacked)

@@ -71,7 +71,6 @@ def main() -> int:
         pid, ",".join(f"{l:.10f}" for l in losses)), flush=True)
 
     # barrier stats straggler table exercises process_allgather
-    from paddle_tpu.parallel.barrier_stat import BarrierTimer
     bt = tr.barrier_stat
     strag = bt.straggler_summary()
     assert strag is not None and strag["skew"] >= 1.0, strag

@@ -145,3 +145,43 @@ def test_bundle_into_gradient_machine(tmp_path):
         value=np.zeros((2, 6), np.float32))}
     outs = m2.forwardTest(batch)
     assert "out" in outs
+
+
+def test_embedding_zoo_roundtrip(tmp_path):
+    """extract/to_text/from_text (ref: demo/model_zoo/embedding/
+    extract_para.py, paraconvert.py)."""
+    import numpy as np
+
+    from paddle_tpu.tools import embedding_zoo as ez
+
+    rng = np.random.default_rng(0)
+    pre = rng.normal(size=(6, 4)).astype(np.float32)
+    pre_words = ["<unk>", "the", "cat", "sat", "mat", "dog"]
+    usr_words = ["cat", "unicorn", "dog"]
+
+    out = ez.extract_rows(pre, pre_words, usr_words)
+    np.testing.assert_array_equal(out[0], pre[2])     # cat
+    np.testing.assert_array_equal(out[1], pre[0])     # OOV -> <unk> row
+    np.testing.assert_array_equal(out[2], pre[5])     # dog
+
+    # without an <unk> row, OOV falls back to the mean vector
+    out2 = ez.extract_rows(pre[1:], pre_words[1:], ["unicorn"])
+    np.testing.assert_allclose(out2[0], pre[1:].mean(0), rtol=1e-6)
+
+    txt = tmp_path / "emb.txt"
+    ez.to_text(out, usr_words, str(txt))
+    back, words = ez.from_text(str(txt))
+    assert words == usr_words
+    np.testing.assert_allclose(back, out, rtol=1e-5, atol=1e-6)
+
+    # CLI end to end
+    pre_npy = tmp_path / "pre.npy"
+    np.save(pre_npy, pre)
+    (tmp_path / "pre.dict").write_text("\n".join(pre_words) + "\n")
+    (tmp_path / "usr.dict").write_text("\n".join(usr_words) + "\n")
+    usr_npy = tmp_path / "usr.npy"
+    ez.main(["extract", "--pre_model", str(pre_npy),
+             "--pre_dict", str(tmp_path / "pre.dict"),
+             "--usr_model", str(usr_npy),
+             "--usr_dict", str(tmp_path / "usr.dict")])
+    np.testing.assert_array_equal(np.load(usr_npy), out)
