@@ -94,7 +94,16 @@ def _delete_old(save_dir: str, keep_last: int) -> None:
 def load_checkpoint(path: str) -> dict[str, Any]:
     """Load a checkpoint dir (or its model.npz); returns
     {'params': ..., 'opt': ..., 'net': ..., 'config_json': ...}."""
-    npz = path if path.endswith(".npz") else os.path.join(path, "model.npz")
+    if path.endswith(".npz"):
+        npz = path
+    else:
+        npz = os.path.join(path, "model.npz")
+        if not os.path.exists(npz):
+            lp = latest_pass(path)
+            if lp >= 0:
+                # given the save_dir root, resume from its newest pass
+                # (ref: ParamUtil --start_pass resume semantics)
+                npz = os.path.join(path, f"pass-{lp:05d}", "model.npz")
     data = np.load(npz, allow_pickle=False)
     flat = {k: data[k] for k in data.files}
     trees: dict[str, dict] = {"params": {}, "opt": {}, "net": {}}

@@ -581,7 +581,7 @@ class Trainer:
         if jax.process_index() != 0:
             return ""
         return ckpt.save_checkpoint(
-            save_dir, self.pass_id - 1, jax.device_get(self.params),
+            save_dir, max(self.pass_id - 1, 0), jax.device_get(self.params),
             jax.device_get(self.opt_state), jax.device_get(self.net_state),
             config_json=self.config.to_json(), keep_last=keep_last)
 
