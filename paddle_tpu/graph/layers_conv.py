@@ -181,8 +181,10 @@ def _pool_geom(p: PoolConfig):
     return p.size_x, ky, p.stride, sy, p.padding, py, p.img_size, iy
 
 
-def pool2d_forward_nhwc(x: Array, pool: PoolConfig) -> Array:
-    """[B, H, W, C] -> [B, OH, OW, C] max/avg pooling."""
+def pool2d_reduce_window(x: Array, pool: PoolConfig) -> Array:
+    """Generic [B, H, W, C] pooling via `lax.reduce_window` — the reference
+    semantics all fast paths must match (and the oracle the fast-path test
+    compares against)."""
     kx, ky, sx, sy, px, py, ix, iy = _pool_geom(pool)
     oy = pool.output_y or conv_output_size(iy, ky, sy, py, caffe_mode=False)
     ox = pool.output_x or conv_output_size(ix, kx, sx, px, caffe_mode=False)
@@ -191,6 +193,23 @@ def pool2d_forward_nhwc(x: Array, pool: PoolConfig) -> Array:
     dims = (1, ky, kx, 1)
     strides = (1, sy, sx, 1)
     padding = ((0, 0), pad_y, pad_x, (0, 0))
+    if pool.pool_type.startswith("max"):
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+    # average excluding padding (ref: hl_avgpool_forward divides by the
+    # clipped window size)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    ones = jnp.ones((1, iy, ix, 1), x.dtype)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+    return s / jnp.maximum(cnt, 1.0)
+
+
+def pool2d_forward_nhwc(x: Array, pool: PoolConfig) -> Array:
+    """[B, H, W, C] -> [B, OH, OW, C] max/avg pooling."""
+    kx, ky, sx, sy, px, py, ix, iy = _pool_geom(pool)
+    oy = pool.output_y or conv_output_size(iy, ky, sy, py, caffe_mode=False)
+    ox = pool.output_x or conv_output_size(ix, kx, sx, px, caffe_mode=False)
+    pad_y = _pad_amounts(iy, ky, sy, py, oy)
+    pad_x = _pad_amounts(ix, kx, sx, px, ox)
     # Non-overlapping windows that tile the image exactly (the VGG 2x2/s2
     # case) pool via reshape+reduce: the gradient is then an elementwise
     # mask/broadcast fusion instead of TPU's slow select-and-scatter
@@ -211,14 +230,7 @@ def pool2d_forward_nhwc(x: Array, pool: PoolConfig) -> Array:
         if pool.pool_type.startswith("max"):
             return jnp.max(x, axis=(1, 2), keepdims=True)
         return jnp.mean(x, axis=(1, 2), keepdims=True)
-    if pool.pool_type.startswith("max"):
-        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
-    # average excluding padding (ref: hl_avgpool_forward divides by the
-    # clipped window size)
-    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
-    ones = jnp.ones((1, iy, ix, 1), x.dtype)
-    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
-    return s / jnp.maximum(cnt, 1.0)
+    return pool2d_reduce_window(x, pool)
 
 
 def pool2d_forward(x_flat: Array, pool: PoolConfig) -> Array:

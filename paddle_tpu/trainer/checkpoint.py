@@ -112,6 +112,11 @@ def load_checkpoint(path: str) -> dict[str, Any]:
                if k.startswith(prefix + SEP)}
         trees[prefix] = _unflatten_dicts(sub)
     out: dict[str, Any] = dict(trees)
+    m = re.match(r"pass-(\d{5})$", os.path.basename(os.path.dirname(npz)))
+    if m:
+        # which pass this snapshot belongs to, so a resumed Trainer can
+        # continue the numbering instead of re-saving from pass-00000
+        out["pass_id"] = int(m.group(1))
     cfg_path = os.path.join(os.path.dirname(npz), "trainer_config.json")
     if os.path.exists(cfg_path):
         out["config_json"] = open(cfg_path).read()

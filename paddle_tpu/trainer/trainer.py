@@ -602,6 +602,11 @@ class Trainer:
             self.opt_state = _merge_state(tmpl, data["opt"])
         if data.get("net"):
             self.net_state = jax.tree.map(jnp.asarray, data["net"])
+        if "pass_id" in data:
+            # continue the pass numbering: the snapshot is named after its
+            # last completed pass, so the resumed run trains (and next
+            # saves) pass N+1 instead of colliding with pass-00000
+            self.pass_id = data["pass_id"] + 1
 
 
 def _merge_state(template, loaded):

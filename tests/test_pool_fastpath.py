@@ -1,0 +1,64 @@
+"""Pooling fast-path oracle: the reshape-based tiled and global pooling
+paths must match the generic `reduce_window` implementation exactly, in
+forward AND gradient (the fast paths exist because reduce_window's
+max-pool backward lowers to TPU's slow select-and-scatter; ref:
+paddle/cuda/src/hl_cuda_cnn.cu hl_maxpool_forward/backward semantics).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.config.schema import PoolConfig
+from paddle_tpu.graph.layers_conv import (
+    pool2d_forward_nhwc, pool2d_reduce_window)
+
+
+def _pool_cfg(ptype, size, stride, img, pad=0):
+    return PoolConfig(pool_type=ptype, channels=3, size_x=size, stride=stride,
+                      padding=pad, img_size=img, img_size_y=img)
+
+
+@pytest.mark.parametrize("ptype", ["max-projection", "avg-projection"])
+@pytest.mark.parametrize("size,stride,img", [
+    (2, 2, 8),      # tiled 2x2/s2 (the VGG case)
+    (4, 4, 8),      # tiled 4x4/s4
+    (8, 8, 4),      # window > image: global pooling
+])
+def test_fastpath_matches_reduce_window(ptype, size, stride, img):
+    p = _pool_cfg(ptype, size, stride, img)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, img, img, 3)),
+                    jnp.float32)
+    ref_fn = lambda a: pool2d_reduce_window(a, p)
+
+    got = pool2d_forward_nhwc(x, p)
+    want = ref_fn(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+    # gradients: for avg they must match exactly; for max they may differ
+    # only at tied window maxima (measure-zero for continuous data) — this
+    # random input has no ties, so exact agreement is required there too
+    g_got = jax.grad(lambda a: jnp.sum(jnp.square(pool2d_forward_nhwc(a, p))))(x)
+    g_want = jax.grad(lambda a: jnp.sum(jnp.square(ref_fn(a))))(x)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_overlapping_window_still_generic():
+    """3x3/s2 (overlapping) must keep the exact reduce_window semantics."""
+    p = _pool_cfg("max-projection", 3, 2, 8)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 8, 3)),
+                    jnp.float32)
+    got = pool2d_forward_nhwc(x, p)
+    assert got.shape == (2, 4, 4, 3)
+    # fast paths would produce a different shape/semantics; the generic
+    # path's output equals a hand-rolled window max
+    man = np.full((2, 4, 4, 3), -np.inf, np.float32)
+    xn = np.asarray(x)
+    for oy in range(4):
+        for ox in range(4):
+            ys, xs = oy * 2, ox * 2
+            man[:, oy, ox] = xn[:, ys:min(ys + 3, 8), xs:min(xs + 3, 8)].max((1, 2))
+    np.testing.assert_allclose(np.asarray(got), man, rtol=1e-6)
