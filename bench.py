@@ -1,12 +1,15 @@
-"""Benchmark: the two north-star configs (BASELINE.md).
+"""Benchmark: all five BASELINE.md configs.
 
-#1 small-VGG CIFAR-10 training throughput (samples/sec/chip + MFU)
-#2 WMT14-style attention seq2seq: training samples/sec + beam-decode tokens/sec
+#1 small-VGG CIFAR-10 training throughput (samples/sec/chip + MFU) — north star
+#2 WMT14-style attention seq2seq: training samples/sec + beam-decode
+   tokens/sec — north star
+#3-5 (BENCH_EXTENDED=0 skips): MNIST small_vgg, IMDB stacked-LSTM
+   sentiment, MovieLens embedding-fusion recommendation
 
 Prints ONE JSON line: the primary (VGG) metric at the top level, with the
-seq2seq numbers nested under "seq2seq" — both carry `vs_baseline` ratios
-against the measured reference numbers in BASELINE.json (see
-tools/measure_baseline.py for how those were measured).
+others nested under "seq2seq"/"mnist"/"sentiment"/"recommendation" — all
+carry `vs_baseline` ratios against the measured reference numbers in
+BASELINE.json (see tools/measure_baseline.py for how those were measured).
 
 Measurement shape: batches are staged in device HBM and the full per-batch
 training step (loss + backward + optimizer, identical to Trainer.train)
@@ -166,7 +169,6 @@ def bench_seq2seq(dtype: str) -> dict:
     t0 = time.perf_counter()
     for _ in range(reps):
         seqs, _ = generate(gex, gparams, feed)
-    np.asarray(seqs)
     n_tokens = int(np.asarray(seqs).shape[0]) * max_len * reps
     decode_tps = n_tokens / (time.perf_counter() - t0)
 
@@ -179,6 +181,109 @@ def bench_seq2seq(dtype: str) -> dict:
     }
 
 
+def bench_mnist(dtype: str) -> dict:
+    """small_vgg on MNIST 1x28x28 (ref: demo/mnist/vgg_16_mnist.py)."""
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    batch = int(os.environ.get("BENCH_MNIST_BATCH", "128"))
+    iters = int(os.environ.get("BENCH_MNIST_ITERS", "50"))
+    cfg = parse_config("demo/mnist/vgg_16_mnist.py",
+                       f"compute_dtype={dtype}")
+    tr = Trainer(cfg, seed=1)
+    rng = np.random.default_rng(0)
+    batches = [{"pixel": Argument(value=(rng.random((batch, 784), np.float32)
+                                         .astype(np.float32) - 0.5)),
+                "label": Argument(ids=rng.integers(0, 10, batch).astype(np.int32))}
+               for _ in range(2 + iters)]
+    stats = tr.benchmark(iter(batches), warmup=2, iters=iters, scan=True)
+    v = stats["samples_per_sec"]
+    return {"metric": "mnist_vgg_train_samples_per_sec_per_chip",
+            "value": round(v, 2), "unit": "samples/sec/chip",
+            "vs_baseline": _baseline_ratio(v, "mnist_vgg")}
+
+
+def bench_sentiment(dtype: str) -> dict:
+    """stacked_lstm_net on IMDB-shaped data (ref: demo/sentiment/
+    trainer_config.py — emb 128, 3 alternating fc+lstm pairs hid 512)."""
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    vocab = int(os.environ.get("BENCH_SENT_VOCAB", "30000"))
+    batch = int(os.environ.get("BENCH_SENT_BATCH", "128"))
+    seqlen = int(os.environ.get("BENCH_SENT_LEN", "100"))
+    iters = int(os.environ.get("BENCH_SENT_ITERS", "30"))
+    cfg = parse_config(
+        "demo/sentiment/trainer_config.py",
+        f"dict_dim={vocab},batch_size={batch},compute_dtype={dtype}")
+    tr = Trainer(cfg, seed=1)
+    rng = np.random.default_rng(0)
+    full = np.full((batch,), seqlen, np.int32)
+    batches = [{"word": Argument(ids=rng.integers(0, vocab, (batch, seqlen))
+                                 .astype(np.int32), lengths=full),
+                "label": Argument(ids=rng.integers(0, 2, batch).astype(np.int32))}
+               for _ in range(2 + iters)]
+    stats = tr.benchmark(iter(batches), warmup=2, iters=iters, scan=True)
+    v = stats["samples_per_sec"]
+    return {"metric": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
+            "value": round(v, 2), "unit": "samples/sec/chip",
+            "vs_baseline": _baseline_ratio(v, "imdb_sentiment_lstm")}
+
+
+def bench_recommendation(dtype: str) -> dict:
+    """MovieLens embedding-fusion regression at 1M dims (ref:
+    demo/recommendation/trainer_config.py; movie 3952, user 6040,
+    title vocab 5100, batch 1600)."""
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    batch = int(os.environ.get("BENCH_REC_BATCH", "1600"))
+    iters = int(os.environ.get("BENCH_REC_ITERS", "30"))
+    title_len = 15
+    cfg = parse_config(
+        "demo/recommendation/trainer_config.py",
+        f"batch_size={batch},movie_dim=3952,user_dim=6040,title_vocab=5100,"
+        f"compute_dtype={dtype}")
+    tr = Trainer(cfg, seed=1)
+    rng = np.random.default_rng(0)
+
+    def one():
+        ids = lambda n: rng.integers(0, n, batch).astype(np.int32)
+        # genres: sparse-row slot — 3 multi-hot ids per sample
+        gen = rng.integers(0, 18, (batch, 3)).astype(np.int32)
+        return {
+            "movie_id": Argument(ids=ids(3952)),
+            "title": Argument(ids=rng.integers(0, 5100, (batch, title_len))
+                              .astype(np.int32),
+                              lengths=np.full((batch,), title_len, np.int32)),
+            "genres": Argument(ids=gen,
+                               sparse_vals=np.ones((batch, 3), np.float32),
+                               sparse_dim=18),
+            "user_id": Argument(ids=ids(6040)),
+            "gender": Argument(ids=ids(2)),
+            "age": Argument(ids=ids(7)),
+            "occupation": Argument(ids=ids(21)),
+            "rating": Argument(value=(rng.random((batch, 1), np.float32)
+                                      .astype(np.float32) * 2 - 1)),
+        }
+
+    batches = [one() for _ in range(2 + iters)]
+    stats = tr.benchmark(iter(batches), warmup=2, iters=iters, scan=True)
+    v = stats["samples_per_sec"]
+    return {"metric": "movielens_recsys_train_samples_per_sec_per_chip",
+            "value": round(v, 2), "unit": "samples/sec/chip",
+            "vs_baseline": _baseline_ratio(v, "movielens_recsys")}
+
+
 def main() -> None:
     # bfloat16 is the TPU-native float: fp32 master params, bf16 matmuls on
     # the MXU, fp32 softmax/BN-stats/loss (BENCH_DTYPE=float32 opts out)
@@ -188,6 +293,11 @@ def main() -> None:
     out = dict(vgg)
     if os.environ.get("BENCH_SKIP_S2S", "0") != "1":
         out["seq2seq"] = bench_seq2seq(dtype)
+    if os.environ.get("BENCH_EXTENDED", "1") != "0":
+        # the three remaining BASELINE.md configs (BENCH_EXTENDED=0 skips)
+        out["mnist"] = bench_mnist(dtype)
+        out["sentiment"] = bench_sentiment(dtype)
+        out["recommendation"] = bench_recommendation(dtype)
     print(json.dumps(out))
 
 

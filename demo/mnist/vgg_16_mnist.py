@@ -10,10 +10,11 @@ define_py_data_sources2(
     obj="process")
 
 settings(
-    batch_size=128,
+    batch_size=get_config_arg("batch_size", int, 128),
     learning_rate=0.1 / 128.0,
     learning_method=MomentumOptimizer(momentum=0.9),
-    regularization=L2Regularization(5e-4 * 128))
+    regularization=L2Regularization(5e-4 * 128),
+    compute_dtype=get_config_arg("compute_dtype", str, ""))
 
 img = data_layer(name="pixel", size=784, height=28, width=28)
 predict = small_vgg(input_image=img, num_channels=1, num_classes=10)

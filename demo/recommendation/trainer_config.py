@@ -16,6 +16,11 @@ from ml_provider import (  # noqa: E402
 
 is_predict = get_config_arg("is_predict", bool, False)
 emb_size = get_config_arg("emb_size", int, 256)
+# bench overrides: real MovieLens-1M dims are larger than the synthetic
+# provider's (movie 3952, user 6040, title vocab ~5100)
+MOVIE_DIM = get_config_arg("movie_dim", int, MOVIE_DIM)
+USER_DIM = get_config_arg("user_dim", int, USER_DIM)
+TITLE_VOCAB = get_config_arg("title_vocab", int, TITLE_VOCAB)
 
 define_py_data_sources2(
     train_list="demo/recommendation/train.list",
@@ -26,7 +31,8 @@ define_py_data_sources2(
 settings(
     batch_size=get_config_arg("batch_size", int, 1600),
     learning_rate=get_config_arg("learning_rate", float, 1e-3),
-    learning_method=RMSPropOptimizer())
+    learning_method=RMSPropOptimizer(),
+    compute_dtype=get_config_arg("compute_dtype", str, ""))
 
 def id_feature(name, dim):
     emb = embedding_layer(input=data_layer(name, size=dim), size=emb_size,

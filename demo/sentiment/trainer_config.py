@@ -14,6 +14,9 @@ is_predict = get_config_arg("is_predict", bool, False)
 net_type = get_config_arg("net", str, "stacked")
 batch_size = get_config_arg("batch_size", int, 128)
 hid_dim = get_config_arg("hid_dim", int, 512)
+# bench override: the real pre-IMDB dictionary is ~100k+ words; the
+# synthetic provider's is VOCAB
+dict_dim = get_config_arg("dict_dim", int, VOCAB)
 
 define_py_data_sources2(
     train_list="demo/sentiment/train.list",
@@ -26,10 +29,11 @@ settings(
     learning_rate=2e-3,
     learning_method=AdamOptimizer(),
     regularization=L2Regularization(8e-4),
-    gradient_clipping_threshold=25)
+    gradient_clipping_threshold=25,
+    compute_dtype=get_config_arg("compute_dtype", str, ""))
 
 if net_type == "stacked":
-    stacked_lstm_net(VOCAB, class_dim=2, stacked_num=3, hid_dim=hid_dim,
+    stacked_lstm_net(dict_dim, class_dim=2, stacked_num=3, hid_dim=hid_dim,
                      is_predict=is_predict)
 else:
-    bidirectional_lstm_net(VOCAB, class_dim=2, is_predict=is_predict)
+    bidirectional_lstm_net(dict_dim, class_dim=2, is_predict=is_predict)

@@ -47,9 +47,9 @@ class SmallVGG(nn.Module):
     networks.py:418 — conv groups [64x2,128x2,256x3,512x3] with BN, 8x8 pool,
     dropout, fc 512 + BN, softmax 10)."""
 
-    def __init__(self, num_classes: int = 10):
+    def __init__(self, num_classes: int = 10, in_ch: int = 3):
         super().__init__()
-        chans = [(3, 64), (64, 64), (64, 128), (128, 128), (128, 256),
+        chans = [(in_ch, 64), (64, 64), (64, 128), (128, 128), (128, 256),
                  (256, 256), (256, 256), (256, 512), (512, 512), (512, 512)]
         pool_after = {1, 3, 6, 9}
         layers: list[nn.Module] = []
@@ -57,8 +57,12 @@ class SmallVGG(nn.Module):
             layers += [nn.Conv2d(ci, co, 3, padding=1),
                        nn.BatchNorm2d(co), nn.ReLU()]
             if i in pool_after:
-                layers.append(nn.MaxPool2d(2, 2))
-        layers.append(nn.MaxPool2d(2, 2))  # img_pool 8x8/8 on the 2x2 map -> 1x1
+                # ceil_mode matches the framework's caffe_mode=False pooling
+                # geometry on non-divisible sizes (MNIST 28x28)
+                layers.append(nn.MaxPool2d(2, 2, ceil_mode=True))
+        # img_pool 8x8/8: global over whatever spatial size remains
+        # (2x2 from CIFAR 32x32, 2x2 from MNIST 28x28 under ceil pooling)
+        layers.append(nn.AdaptiveMaxPool2d(1))
         self.features = nn.Sequential(*layers)
         self.drop = nn.Dropout(0.5)
         self.fc1 = nn.Linear(512, 512)
@@ -154,6 +158,145 @@ def bench_seq2seq(steps: int, batch: int = 64, srclen: int = 30,
     return steps * batch / dt
 
 
+def bench_mnist(steps: int, batch: int = 128) -> float:
+    """MNIST small_vgg (ref: demo/mnist/vgg_16_mnist.py — same net as the
+    CIFAR config, 1x28x28 input)."""
+    torch.manual_seed(0)
+    model = SmallVGG(in_ch=1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1 / 128,
+                          momentum=0.9, weight_decay=0.0005 * 128)
+    x = torch.randn(batch, 1, 28, 28)
+    y = torch.randint(0, 10, (batch,))
+    loss = F.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+    return steps * batch / (time.perf_counter() - t0)
+
+
+class StackedLSTM(nn.Module):
+    """The sentiment demo's stacked_lstm_net (ref: demo/sentiment/
+    sentiment_net.py stacked_lstm_net:77 — emb 128, alternating-direction
+    fc+lstm pairs at hid 512, max-pool over time of the last pair, fc 2)."""
+
+    def __init__(self, vocab: int, emb: int = 128, hid: int = 512,
+                 stacked: int = 3):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, emb)
+        self.fc = nn.ModuleList()
+        self.lstm = nn.ModuleList()
+        self.reverse = []
+        in_dim = emb
+        for i in range(1, stacked + 1):
+            self.fc.append(nn.Linear(in_dim, hid))
+            self.lstm.append(nn.LSTM(hid, hid, batch_first=True))
+            self.reverse.append(i % 2 == 0)
+            in_dim = 2 * hid
+        self.out = nn.Linear(2 * hid, 2)
+
+    def forward(self, w):
+        h = self.emb(w)
+        fc_o = lstm_o = None
+        for fc, lstm, rev in zip(self.fc, self.lstm, self.reverse):
+            fc_o = fc(h)
+            x = fc_o.flip(1) if rev else fc_o
+            lstm_o, _ = lstm(x)
+            if rev:
+                lstm_o = lstm_o.flip(1)
+            lstm_o = lstm_o.relu()
+            h = torch.cat([fc_o, lstm_o], -1)
+        pooled = torch.cat([fc_o.max(1).values, lstm_o.max(1).values], -1)
+        return self.out(pooled)
+
+
+def bench_sentiment(steps: int, batch: int = 128, seqlen: int = 100,
+                    vocab: int = 30000) -> float:
+    torch.manual_seed(0)
+    model = StackedLSTM(vocab)
+    opt = torch.optim.Adam(model.parameters(), lr=2e-3, weight_decay=8e-4)
+    w = torch.randint(0, vocab, (batch, seqlen))
+    y = torch.randint(0, 2, (batch,))
+    loss = F.cross_entropy(model(w), y)
+    loss.backward()
+    opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = F.cross_entropy(model(w), y)
+        loss.backward()
+        opt.step()
+    return steps * batch / (time.perf_counter() - t0)
+
+
+class Recommender(nn.Module):
+    """The MovieLens demo (ref: demo/recommendation/trainer_config.py —
+    per-feature embedding/fc 256 fusion for movie and user, title text
+    conv-pool context 5, cosine similarity regression)."""
+
+    def __init__(self, movie: int = 3952, user: int = 6040,
+                 title_vocab: int = 5100, genre: int = 18, emb: int = 256):
+        super().__init__()
+        def id_feat(n):
+            return nn.ModuleDict({"emb": nn.Embedding(n, emb),
+                                  "fc": nn.Linear(emb, emb)})
+        self.movie_id = id_feat(movie)
+        self.title_emb = nn.Embedding(title_vocab, emb)
+        self.title_conv = nn.Conv1d(emb, emb, 5, padding=2)
+        self.genre_fc1 = nn.Linear(genre, emb)
+        self.genre_fc2 = nn.Linear(emb, emb)
+        self.movie_fusion = nn.Linear(3 * emb, emb)
+        self.user_id = id_feat(user)
+        self.gender = id_feat(2)
+        self.age = id_feat(7)
+        self.occupation = id_feat(21)
+        self.user_fusion = nn.Linear(4 * emb, emb)
+
+    @staticmethod
+    def _id(f, ids):
+        return f["fc"](f["emb"](ids))
+
+    def forward(self, movie_id, title, genres, user_id, gender, age, occ):
+        t = self.title_emb(title).transpose(1, 2)          # [B, E, T]
+        title_f = self.title_conv(t).max(-1).values        # [B, E]
+        m = self.movie_fusion(torch.cat(
+            [self._id(self.movie_id, movie_id), title_f,
+             self.genre_fc2(self.genre_fc1(genres))], -1))
+        u = self.user_fusion(torch.cat(
+            [self._id(self.user_id, user_id), self._id(self.gender, gender),
+             self._id(self.age, age), self._id(self.occupation, occ)], -1))
+        return F.cosine_similarity(m, u, dim=-1)
+
+
+def bench_recommendation(steps: int, batch: int = 1600,
+                         title_len: int = 15) -> float:
+    torch.manual_seed(0)
+    model = Recommender()
+    opt = torch.optim.RMSprop(model.parameters(), lr=1e-3)
+    feed = (torch.randint(0, 3952, (batch,)),
+            torch.randint(0, 5100, (batch, title_len)),
+            torch.rand(batch, 18),
+            torch.randint(0, 6040, (batch,)),
+            torch.randint(0, 2, (batch,)),
+            torch.randint(0, 7, (batch,)),
+            torch.randint(0, 21, (batch,)))
+    rating = torch.rand(batch)
+    loss = F.mse_loss(model(*feed), rating)
+    loss.backward()
+    opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        loss = F.mse_loss(model(*feed), rating)
+        loss.backward()
+        opt.step()
+    return steps * batch / (time.perf_counter() - t0)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
@@ -169,23 +312,52 @@ def main() -> None:
     s2s = bench_seq2seq(args.steps)
     print(f"wmt14_seq2seq (torch-CPU, batch 64, T=30, vocab 30k): "
           f"{s2s:.2f} samples/sec")
+    mnist = bench_mnist(args.steps)
+    print(f"mnist_vgg (torch-CPU, batch 128): {mnist:.2f} samples/sec")
+    sent = bench_sentiment(args.steps)
+    print(f"imdb_sentiment_lstm (torch-CPU, batch 128, T=100, vocab 30k): "
+          f"{sent:.2f} samples/sec")
+    rec = bench_recommendation(args.steps)
+    print(f"movielens_recsys (torch-CPU, batch 1600): {rec:.2f} samples/sec")
 
+    caveat = ("torch-CPU reimplementation of the reference model "
+              "(see tools/measure_baseline.py docstring: the v0.9.0 "
+              "C++ build requires Python 2.7 — unbuildable here; no "
+              "GPU present for the Paddle-GPU target)")
     with open(args.out) as f:
         base = json.load(f)
     base["published"] = {
         "vgg16_cifar10": {
             "samples_per_sec": round(vgg, 2),
             "config": "small_vgg CIFAR-10, batch 128, SGD momentum 0.9 + L2",
-            "how": "torch-CPU reimplementation of the reference model "
-                   "(see tools/measure_baseline.py docstring: the v0.9.0 "
-                   "C++ build requires Python 2.7 — unbuildable here; no "
-                   "GPU present for the Paddle-GPU target)",
+            "how": caveat,
             "hardware": hw,
         },
         "wmt14_seq2seq": {
             "samples_per_sec": round(s2s, 2),
             "config": "bi-GRU 512 encoder + attention GRU 512 decoder, "
                       "vocab 30000, batch 64, src/trg len 30, Adam",
+            "how": "torch-CPU reimplementation (same caveats)",
+            "hardware": hw,
+        },
+        "mnist_vgg": {
+            "samples_per_sec": round(mnist, 2),
+            "config": "small_vgg MNIST 1x28x28, batch 128, SGD momentum",
+            "how": "torch-CPU reimplementation (same caveats)",
+            "hardware": hw,
+        },
+        "imdb_sentiment_lstm": {
+            "samples_per_sec": round(sent, 2),
+            "config": "stacked_lstm_net: emb 128, 3 alternating fc+lstm "
+                      "pairs hid 512, vocab 30000, batch 128, len 100, Adam",
+            "how": "torch-CPU reimplementation (same caveats)",
+            "hardware": hw,
+        },
+        "movielens_recsys": {
+            "samples_per_sec": round(rec, 2),
+            "config": "embedding/fc 256 fusion, title conv-pool ctx 5, "
+                      "cos-sim regression, MovieLens-1M dims, batch 1600, "
+                      "RMSProp",
             "how": "torch-CPU reimplementation (same caveats)",
             "hardware": hw,
         },
