@@ -6,8 +6,10 @@ ref: python/paddle/trainer_config_helpers/networks.py:1257) — first-class
 long-context attention with three execution paths picked automatically:
 
   * dense   — one fused einsum-softmax-einsum (short sequences),
-  * blockwise — online-softmax over key blocks, O(T) memory (long sequences
-    on one device; ops/attention.py:blockwise_attention),
+  * flash   — fused pallas online-softmax kernel, score tiles resident in
+    VMEM (long sequences on TPU; ops/pallas_attention.py),
+  * blockwise — lax.scan online-softmax over key blocks, O(T) memory (the
+    portable long-sequence fallback; ops/attention.py:blockwise_attention),
   * ring    — context parallelism when the executor's mesh has a `seq` axis
     of size > 1: each device holds a sequence shard and K/V rotate around
     the ICI ring (parallel/context.py:ring_attention_sharded).
@@ -43,13 +45,19 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
     k_valid = k_arg.mask()
 
     mesh = ctx.mesh
+    from paddle_tpu.ops import pallas_attention
     from paddle_tpu.parallel.context import ring_attn_fn, seq_axis_size
     if mesh is not None and seq_axis_size(mesh) > 1:
         attn_fn = ring_attn_fn(mesh)
     elif k_arg.max_len >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
         import functools
-        attn_fn = functools.partial(
-            blockwise_attention, block_k=int(cfg.attrs.get("block_k", 512)))
+        if pallas_attention.supported():
+            attn_fn = functools.partial(
+                pallas_attention.flash_attention,
+                block_k=int(cfg.attrs.get("block_k", 128)))
+        else:
+            attn_fn = functools.partial(
+                blockwise_attention, block_k=int(cfg.attrs.get("block_k", 512)))
     else:
         attn_fn = dot_product_attention
 

@@ -1,0 +1,349 @@
+"""Flash attention in Pallas (TPU) — fused online-softmax attention.
+
+TPU-native "hot op" for the long-context path (NEW capability beyond the
+reference, whose closest analog is the additive simple_attention composite,
+ref: python/paddle/trainer_config_helpers/networks.py:1257).  The scan-based
+`ops/attention.py:blockwise_attention` stays as the portable fallback; this
+kernel computes the same math with the score tile resident in VMEM:
+
+  forward   — grid (B*H, Tq/Bq, Tk/Bk): for one query tile, fold key/value
+              tiles into the running (max, sum, acc) online-softmax state
+              held in VMEM scratch across the sequential innermost grid
+              axis; one [Bq,D]x[D,Bk] + one [Bq,Bk]x[Bk,D] MXU matmul per
+              tile, no [Tq,Tk] score matrix in HBM.
+  backward  — custom_vjp (FlashAttention-2 style): the forward saves only
+              the per-row log-sum-exp; two kernels recompute the score
+              tiles and produce dq (grid over q tiles) and dk/dv (grid
+              over k tiles).  delta = rowsum(do * o) is precomputed.
+
+Masking matches `dot_product_attention`: per-sequence key validity +
+causality, fully-masked rows output exactly 0 (their saved lse is +inf, so
+the backward recomputes p = 0 for them).  Query-row validity is applied
+OUTSIDE the kernel (out *= q_mask): the zeroed cotangent then kills all
+gradient contributions of invalid rows.
+
+Head dim and sequence lengths are zero-padded to tile multiples (lane dim
+128); zero k/v padding columns are inert in the dot products and padded key
+rows are masked invalid.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def supported(backend: Optional[str] = None) -> bool:
+    """Whether the pallas flash kernel may be used."""
+    if os.environ.get("PADDLE_TPU_PALLAS", "1") == "0":
+        return False
+    backend = backend or jax.default_backend()
+    if backend == "tpu":
+        return True
+    # off-TPU the kernel only runs in (slow) interpret mode — opt-in for tests
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ===========================================================================
+# forward
+# ===========================================================================
+
+def _fwd_kernel(H, Bq, Bk, scale, causal,
+                q_ref, k_ref, v_ref, kv_ref,
+                o_ref, lse_ref, m_s, l_s, acc_s):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+    # m/l live in the first lane of a [Bq, 128] scratch (TPU tiles are
+    # 128-lane; a [Bq, 1] buffer would violate the minimum tile)
+
+    q = q_ref[0].astype(jnp.float32)                     # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)                     # [Bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+
+    mask = kv_ref[0] > 0.0                               # [Bk] valid keys
+    mask = jnp.broadcast_to(mask[None, :], (Bq, Bk))
+    if causal:
+        qpos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+        kpos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        mask = jnp.logical_and(mask, kpos <= qpos)
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev, l_prev = m_s[:, :1], l_s[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(mask, p, 0.0)                          # kill -inf rows
+    corr = jnp.exp(m_prev - m_new)
+    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc_s[:] = acc_s[:] * corr + pv
+    m_s[:, :1] = m_new
+    l_s[:, :1] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_s[:, :1]
+        o_ref[0] = jnp.where(l > 0, acc_s[:] / jnp.maximum(l, 1e-30),
+                             0.0).astype(o_ref.dtype)
+        # +inf for fully-masked rows => backward p = exp(s - inf) = 0
+        lse_ref[0] = jnp.where(l[:, 0] > 0, m_s[:, 0] + jnp.log(l[:, 0]),
+                               jnp.inf)
+
+
+def _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    nq, nk = Tq // Bq, Tk // Bk
+    grid = (BH, nq, nk)
+    kernel = functools.partial(_fwd_kernel, H, Bq, Bk, scale, causal)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Bk), lambda bh, iq, ik: (bh // H, ik),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Bq), lambda bh, iq, ik: (bh, iq),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Bq, 128), jnp.float32),   # running max (lane 0)
+            pltpu.VMEM((Bq, 128), jnp.float32),   # running sum (lane 0)
+            pltpu.VMEM((Bq, D), jnp.float32),     # output accumulator
+        ],
+        interpret=_interpret(),
+    )(q, k, v, kv_mask)
+
+
+# ===========================================================================
+# backward
+# ===========================================================================
+
+def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
+                   q_ref, k_ref, v_ref, kv_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_s):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = jnp.broadcast_to((kv_ref[0] > 0.0)[None, :], (Bq, Bk))
+    if causal:
+        qpos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+        kpos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        mask = jnp.logical_and(mask, kpos <= qpos)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
+
+    do = do_ref[0].astype(jnp.float32)                           # [Bq, D]
+    dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [Bq, Bk]
+    ds = p * (dp - delta_ref[0][:, None]) * scale
+    dq_s[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(H, Bq, Bk, scale, causal,
+                    q_ref, k_ref, v_ref, kv_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_s, dv_s):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    q = q_ref[0].astype(jnp.float32)                              # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)                              # [Bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = jnp.broadcast_to((kv_ref[0] > 0.0)[None, :], (Bq, Bk))
+    if causal:
+        qpos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
+        kpos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
+        mask = jnp.logical_and(mask, kpos <= qpos)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)    # [Bq, Bk]
+
+    do = do_ref[0].astype(jnp.float32)                            # [Bq, D]
+    # dv += p^T @ do
+    dv_s[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [Bq, Bk]
+    ds = p * (dp - delta_ref[0][:, None]) * scale
+    # dk += ds^T @ q
+    dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, kv_mask, o, lse, do, H, scale, causal, Bq, Bk):
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    nq, nk = Tq // Bq, Tk // Bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                       # [BH, Tq]
+
+    q_spec = pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
+                           memory_space=pltpu.VMEM)
+    kmask_spec = pl.BlockSpec((1, Bk), lambda bh, iq, ik: (bh // H, ik),
+                              memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, Bq), lambda bh, iq, ik: (bh, iq),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, H, Bq, Bk, scale, causal),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, kmask_spec, q_spec,
+                  row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tq, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((Bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, kv_mask, do, lse, delta)[0]
+
+    # swapped grid: k tiles outer, q tiles inner (sequential accumulation)
+    q_spec2 = pl.BlockSpec((1, Bq, D), lambda bh, ik, iq: (bh, iq, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec2 = pl.BlockSpec((1, Bk, D), lambda bh, ik, iq: (bh, ik, 0),
+                            memory_space=pltpu.VMEM)
+    kmask_spec2 = pl.BlockSpec((1, Bk), lambda bh, ik, iq: (bh // H, ik),
+                               memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, Bq), lambda bh, ik, iq: (bh, iq),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, H, Bq, Bk, scale, causal),
+        grid=(BH, nk, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, kmask_spec2, q_spec2,
+                  row_spec2, row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((Bk, D), jnp.float32),
+                        pltpu.VMEM((Bk, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, kv_mask, do, lse, delta)
+    return dq, dk, dv
+
+
+# ===========================================================================
+# custom-vjp wrapper (padded, [BH, T, D] layout)
+# ===========================================================================
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
+    o, _ = _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk)
+    return o
+
+
+def _flash_fwd(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
+    o, lse = _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk)
+    return o, (q, k, v, kv_mask, o, lse)
+
+
+def _flash_bwd(H, scale, causal, Bq, Bk, res, do):
+    q, k, v, kv_mask, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, kv_mask, o, lse, do,
+                           H, scale, causal, Bq, Bk)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: Array, k: Array, v: Array,
+    q_valid: Optional[Array] = None,
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> Array:
+    """Drop-in for `dot_product_attention`: q [B,Tq,H,D], k/v [B,Tk,H,D]
+    -> [B,Tq,H,D], same masking semantics, fused pallas execution."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+
+    Bq, Bk = min(block_q, _round_up(Tq, 8)), min(block_k, _round_up(Tk, 8))
+    Tqp, Tkp = _round_up(Tq, Bq), _round_up(Tk, Bk)
+    Dp = _round_up(D, 128)
+
+    def to_bh(x, T, Tp):
+        x = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0), (0, Dp - D)))
+        return x.transpose(0, 2, 1, 3).reshape(B * H, Tp, -1)
+
+    qp = to_bh(q, Tq, Tqp)
+    kp = to_bh(k, Tk, Tkp)
+    vp = to_bh(v, Tk, Tkp)
+
+    kv_mask = jnp.ones((B, Tk), jnp.float32) if k_valid is None \
+        else k_valid.astype(jnp.float32)
+    kv_mask = jnp.pad(kv_mask, ((0, 0), (0, Tkp - Tk)))
+
+    o = _flash(qp, kp, vp, kv_mask, H, float(scale), bool(causal), Bq, Bk)
+    o = o.reshape(B, H, Tqp, Dp).transpose(0, 2, 1, 3)[:, :Tq, :, :D]
+    if q_valid is not None:
+        # invalid query rows output exactly 0; the zeroed cotangent also
+        # kills their dk/dv contributions in the backward kernels
+        o = o * q_valid[:, :, None, None].astype(o.dtype)
+    return o

@@ -1,0 +1,154 @@
+"""Pallas flash attention vs the dense reference — numeric oracle
+(the reference's CPU-vs-GPU comparison pattern, ref:
+math/tests/test_matrixCompare.cpp; here: interpret-mode pallas vs the
+fused-XLA dot_product_attention, forward AND gradients).  On real TPU the
+same kernels compile natively.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.attention import blockwise_attention, dot_product_attention
+from paddle_tpu.ops.pallas_attention import flash_attention
+
+
+def _case(rng, B, Tq, Tk, H, D, ragged=True):
+    q = jnp.asarray(rng.normal(size=(B, Tq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Tk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Tk, H, D)), jnp.float32)
+    if ragged:
+        klens = rng.integers(1, Tk + 1, B)
+        qlens = rng.integers(1, Tq + 1, B)
+        k_valid = jnp.asarray(np.arange(Tk)[None, :] < klens[:, None])
+        q_valid = jnp.asarray(np.arange(Tq)[None, :] < qlens[:, None])
+    else:
+        k_valid = q_valid = None
+    return q, k, v, q_valid, k_valid
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [
+    (2, 20, 24, 2, 16),     # ragged, unaligned sizes (exercise padding)
+    (1, 128, 128, 4, 32),   # aligned single block
+    (2, 130, 70, 2, 8),     # multi-block q, tiny head dim
+])
+def test_flash_matches_dense(causal, shape):
+    rng = np.random.default_rng(0)
+    q, k, v, q_valid, k_valid = _case(rng, *shape)
+
+    want = dot_product_attention(q, k, v, q_valid=q_valid,
+                                 k_valid=k_valid, causal=causal)
+    got = flash_attention(q, k, v, q_valid=q_valid, k_valid=k_valid,
+                          causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(fn):
+        def f(q, k, v):
+            o = fn(q, k, v, q_valid=q_valid, k_valid=k_valid, causal=causal)
+            return jnp.sum(jnp.sin(o))
+        return f
+
+    gw = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(lambda q, k, v: loss(
+        lambda *a, **kw: flash_attention(*a, block_q=64, block_k=64, **kw)
+    )(q, k, v), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gw, gg):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_flash_matches_blockwise_long():
+    """Long-sequence case: flash vs the scan-based online-softmax path."""
+    rng = np.random.default_rng(1)
+    q, k, v, q_valid, k_valid = _case(rng, 1, 384, 384, 2, 16)
+    want = blockwise_attention(q, k, v, q_valid=q_valid, k_valid=k_valid,
+                               causal=True, block_k=128)
+    got = flash_attention(q, k, v, q_valid=q_valid, k_valid=k_valid,
+                          causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero():
+    """A sequence whose keys are ALL invalid must output exactly 0 and
+    contribute zero gradient (dot_product_attention's contract)."""
+    rng = np.random.default_rng(2)
+    q, k, v, _, _ = _case(rng, 2, 8, 8, 1, 8, ragged=False)
+    k_valid = jnp.asarray(np.array([[True] * 8, [False] * 8]))
+    out = flash_attention(q, k, v, k_valid=k_valid)
+    assert np.all(np.asarray(out[1]) == 0.0)
+
+    g = jax.grad(lambda v: jnp.sum(
+        flash_attention(q, k, v, k_valid=k_valid)))(v)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert np.all(np.asarray(g[1]) == 0.0)
+
+
+def test_flash_bf16_close():
+    rng = np.random.default_rng(3)
+    q, k, v, q_valid, k_valid = _case(rng, 2, 33, 47, 2, 16)
+    want = dot_product_attention(q, k, v, q_valid=q_valid, k_valid=k_valid)
+    got = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16), q_valid=q_valid,
+                          k_valid=k_valid)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=0.05, atol=0.05)
+
+
+def test_layer_selects_flash_when_supported(monkeypatch):
+    """multi_head_attention layer picks the pallas kernel for long keys when
+    the backend supports it, and the step trains end-to-end."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    import numpy as np
+
+    # spy: the layer must actually route through the pallas kernel (a silent
+    # fallback to blockwise would train identically on this tiny config)
+    import paddle_tpu.graph.layers_attn as layers_attn_mod
+    from paddle_tpu.ops import pallas_attention as pa_mod
+    calls = []
+    real = pa_mod.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pa_mod, "flash_attention", spy)
+
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.dsl import (
+        MomentumOptimizer, SoftmaxActivation, classification_cost,
+        data_layer, fc_layer, multi_head_attention_layer, pooling_layer,
+        settings,
+    )
+    from paddle_tpu.dsl.poolings import AvgPooling
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    def conf():
+        settings(batch_size=4, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(momentum=0.9))
+        x = data_layer(name="x", size=16)
+        # block_k_min=8 forces the long-key path at T=16
+        attn = multi_head_attention_layer(x, size=16, num_heads=2,
+                                          causal=True, block_k_min=8,
+                                          block_k=8)
+        pooled = pooling_layer(input=attn, pooling_type=AvgPooling())
+        out = fc_layer(input=pooled, size=4, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=4))
+
+    cfg = parse_config_callable(conf)
+    tr = Trainer(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": Argument(value=rng.normal(size=(4, 16, 16)).astype(np.float32),
+                      lengths=np.array([16, 12, 16, 7], np.int32)),
+        "y": Argument(ids=rng.integers(0, 4, 4).astype(np.int32)),
+    }
+    losses = [float(tr.train_one_batch(batch)) for _ in range(8)]
+    assert calls, "layer did not route through the pallas flash kernel"
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
