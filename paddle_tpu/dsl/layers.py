@@ -949,6 +949,7 @@ def multi_head_attention_layer(
     causal: bool = False,
     block_k: Optional[int] = None,
     block_k_min: Optional[int] = None,
+    attn_impl: Optional[str] = None,
     name: Optional[str] = None,
     param_attr: Optional[Union[ParameterAttribute, list]] = None,
     bias_attr=False,
@@ -957,9 +958,10 @@ def multi_head_attention_layer(
     """Multi-head scaled-dot-product attention over padded sequences — NEW
     capability (the reference's closest analog is the additive-attention
     composite simple_attention, ref: networks.py:1257).  Self-attention when
-    key/value are omitted.  Executes dense/blockwise/ring automatically
-    (graph/layers_attn.py); with a `seq` mesh axis the sequence is context-
-    parallel via ring attention (parallel/context.py).
+    key/value are omitted.  Picks dense/flash(pallas)/blockwise/ring
+    automatically (graph/layers_attn.py; attn_impl forces one); with a `seq`
+    mesh axis the sequence is context-parallel via ring attention
+    (parallel/context.py).
 
     param_attr: one attribute applied to all four projections (q/k/v/out), or
     a list of four.  A single NAMED attribute would tie all projections to
@@ -980,10 +982,12 @@ def multi_head_attention_layer(
                       active_type="")
     cfg.attrs["num_heads"] = num_heads
     cfg.attrs["causal"] = causal
-    if block_k is not None:          # key-block size for the blockwise path
+    if block_k is not None:          # key-block size (blockwise/flash paths)
         cfg.attrs["block_k"] = block_k
-    if block_k_min is not None:      # min key length to switch to blockwise
+    if block_k_min is not None:      # min key length to leave the dense path
         cfg.attrs["block_k_min"] = block_k_min
+    if attn_impl is not None:        # force dense/flash/blockwise/ring
+        cfg.attrs["attn_impl"] = attn_impl
     for i, (inp, dim_in) in enumerate(
             [(query, query.size), (key, key.size), (value, value.size),
              (query, size)]):

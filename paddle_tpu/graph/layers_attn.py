@@ -44,20 +44,38 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
     q_valid = q_arg.mask()
     k_valid = k_arg.mask()
 
+    import functools
+
     mesh = ctx.mesh
     from paddle_tpu.ops import pallas_attention
     from paddle_tpu.parallel.context import ring_attn_fn, seq_axis_size
-    if mesh is not None and seq_axis_size(mesh) > 1:
-        attn_fn = ring_attn_fn(mesh)
-    elif k_arg.max_len >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
-        import functools
-        if pallas_attention.supported():
-            attn_fn = functools.partial(
-                pallas_attention.flash_attention,
-                block_k=int(cfg.attrs.get("block_k", 128)))
+    impl = str(cfg.attrs.get("attn_impl", "auto"))
+    if impl not in ("auto", "ring", "flash", "blockwise", "dense"):
+        raise ValueError(
+            f"layer {cfg.name!r}: unknown attn_impl {impl!r} "
+            f"(expected auto/ring/flash/blockwise/dense)")
+    if impl == "auto":
+        if mesh is not None and seq_axis_size(mesh) > 1:
+            impl = "ring"
+        elif k_arg.max_len >= int(cfg.attrs.get("block_k_min",
+                                                _BLOCKWISE_MIN_KEYS)):
+            impl = "flash" if pallas_attention.supported() else "blockwise"
         else:
-            attn_fn = functools.partial(
-                blockwise_attention, block_k=int(cfg.attrs.get("block_k", 512)))
+            impl = "dense"
+    if impl == "ring":
+        if mesh is None or seq_axis_size(mesh) < 2:
+            raise ValueError(
+                f"layer {cfg.name!r}: attn_impl='ring' needs the executor "
+                f"mesh to have a `seq` axis of size >= 2 (got "
+                f"{'no mesh' if mesh is None else dict(zip(mesh.axis_names, mesh.devices.shape))})")
+        attn_fn = ring_attn_fn(mesh)
+    elif impl == "flash":
+        attn_fn = functools.partial(
+            pallas_attention.flash_attention,
+            block_k=int(cfg.attrs.get("block_k", 128)))
+    elif impl == "blockwise":
+        attn_fn = functools.partial(
+            blockwise_attention, block_k=int(cfg.attrs.get("block_k", 512)))
     else:
         attn_fn = dot_product_attention
 

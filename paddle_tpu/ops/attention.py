@@ -176,6 +176,7 @@ def ring_attention(
     k_valid: Optional[Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> Array:
     """Context-parallel attention for use INSIDE `shard_map` over `axis_name`.
 
@@ -186,6 +187,12 @@ def ring_attention(
     every query row has attended to every key.  The python loop is unrolled
     (axis_size is static) so XLA can overlap each ppermute with the previous
     block's einsums — the collective rides ICI behind the MXU work.
+
+    On TPU each per-hop block runs the fused pallas flash kernel
+    (ring flash attention): the kernel returns the block's normalized output
+    + log-sum-exp, and blocks combine with exp(lse_b - m) weights — the same
+    online-softmax math, score tiles never leaving VMEM.  `use_flash=False`
+    forces the portable jnp fold (and is the oracle in tests).
     """
     B, Tl, H, D = q.shape
     if scale is None:
@@ -193,6 +200,14 @@ def ring_attention(
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if use_flash is None:
+        from paddle_tpu.ops import pallas_attention
+        use_flash = pallas_attention.supported()
+
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, idx, n, perm,
+                           q_valid, k_valid, causal, scale)
 
     q_pos = idx * Tl + jnp.arange(Tl)
     acc = _init_acc(B, Tl, H, D)
@@ -209,6 +224,52 @@ def ring_attention(
                 kv_blk = lax.ppermute(kv_blk, axis_name, perm)
     o, m, l = acc
     return _finalize(o, l, q.dtype)
+
+
+def _ring_flash(q, k, v, axis_name, idx, n, perm,
+                q_valid, k_valid, causal, scale):
+    """Ring attention with the pallas flash kernel per hop: each block call
+    yields (o_b normalized, lse_b); blocks fold into a running
+    (num, den, max) — o = num/den at the end.  Differentiable end-to-end
+    (the kernel's custom VJP accepts the lse cotangent; ppermute has a
+    transpose rule, so jax.grad produces the reverse ring automatically)."""
+    from paddle_tpu.ops.pallas_attention import flash_attention
+
+    B, Tl, H, D = q.shape
+    m_run = jnp.full((B, H, Tl), -jnp.inf, jnp.float32)
+    num = jnp.zeros((B, Tl, H, D), jnp.float32)
+    den = jnp.zeros((B, H, Tl), jnp.float32)
+
+    k_blk, v_blk, kv_blk = k, v, k_valid
+    for step in range(n):
+        src = (idx - step) % n                      # owner of the current block
+        o_b, lse_b = flash_attention(
+            q, k_blk, v_blk, q_valid=q_valid, k_valid=kv_blk, causal=causal,
+            scale=scale, q_offset=idx * Tl, k_offset=src * k_blk.shape[1],
+            return_lse=True)
+        m_new = jnp.maximum(m_run, lse_b)
+        alive = m_new > -jnp.inf
+        # sanitize BEFORE exp: -inf - -inf would be NaN, and a NaN in the
+        # untaken where-branch still poisons gradients (0 * NaN)
+        m_safe = jnp.where(alive, m_new, 0.0)
+        corr = jnp.where(alive & (m_run > -jnp.inf),
+                         jnp.exp(jnp.where(m_run > -jnp.inf, m_run, 0.0)
+                                 - m_safe), 0.0)
+        w = jnp.where(alive & (lse_b > -jnp.inf),
+                      jnp.exp(jnp.where(lse_b > -jnp.inf, lse_b, 0.0)
+                              - m_safe), 0.0)
+        num = num * jnp.moveaxis(corr, 1, 2)[..., None] \
+            + o_b.astype(jnp.float32) * jnp.moveaxis(w, 1, 2)[..., None]
+        den = den * corr + w
+        m_run = m_new
+        if step + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            if kv_blk is not None:
+                kv_blk = lax.ppermute(kv_blk, axis_name, perm)
+    den_t = jnp.moveaxis(den, 1, 2)[..., None]
+    return jnp.where(den_t > 0, num / jnp.maximum(den_t, 1e-30),
+                     0.0).astype(q.dtype)
 
 
 def multi_head_attention(

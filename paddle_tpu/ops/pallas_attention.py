@@ -14,13 +14,21 @@ kernel computes the same math with the score tile resident in VMEM:
   backward  — custom_vjp (FlashAttention-2 style): the forward saves only
               the per-row log-sum-exp; two kernels recompute the score
               tiles and produce dq (grid over q tiles) and dk/dv (grid
-              over k tiles).  delta = rowsum(do * o) is precomputed.
+              over k tiles).  delta = rowsum(do * o) is precomputed, and an
+              lse cotangent (from a ring combine) folds into it as
+              delta - dlse, since dlse/ds_j = p_j.
 
 Masking matches `dot_product_attention`: per-sequence key validity +
-causality, fully-masked rows output exactly 0 (their saved lse is +inf, so
-the backward recomputes p = 0 for them).  Query-row validity is applied
-OUTSIDE the kernel (out *= q_mask): the zeroed cotangent then kills all
-gradient contributions of invalid rows.
+causality, fully-masked rows output exactly 0 with lse = -inf (so a ring
+combine weighs them out naturally; the backward kernels' validity mask
+already zeroes their p).  Query-row validity is applied OUTSIDE the kernel
+(out *= q_mask): the zeroed cotangent then kills all gradient contributions
+of invalid rows.
+
+`q_offset` / `k_offset` (SMEM scalars, may be traced) globalize the causal
+positions so a ring/context-parallel caller can run the kernel on one
+(q-shard, k-shard) pair of a longer sequence — see
+`ops/attention.py:ring_attention`'s flash path.
 
 Head dim and sequence lengths are zero-padded to tile multiples (lane dim
 128); zero k/v padding columns are inert in the dot products and padded key
@@ -31,7 +39,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -62,12 +70,34 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def _tile_mask(kv_row, q_off, k_off, iq, ik, Bq, Bk, causal):
+    """[Bq, Bk] validity of one score tile: key validity x causality on
+    GLOBAL positions (offsets cover ring/context-parallel shards)."""
+    mask = jnp.broadcast_to((kv_row > 0.0)[None, :], (Bq, Bk))
+    if causal:
+        qpos = q_off + iq * Bq + jax.lax.broadcasted_iota(
+            jnp.int32, (Bq, Bk), 0)
+        kpos = k_off + ik * Bk + jax.lax.broadcasted_iota(
+            jnp.int32, (Bq, Bk), 1)
+        mask = jnp.logical_and(mask, kpos <= qpos)
+    return mask
+
+
+def _tile_live(q_off, k_off, iq, ik, Bq, Bk, causal):
+    """False iff causality masks the ENTIRE tile (its smallest key position
+    is beyond its largest query position) — those tiles skip both matmuls,
+    which halves the work of a long causal sequence."""
+    if not causal:
+        return True
+    return k_off + ik * Bk <= q_off + (iq + 1) * Bq - 1
+
+
 # ===========================================================================
 # forward
 # ===========================================================================
 
 def _fwd_kernel(H, Bq, Bk, scale, causal,
-                q_ref, k_ref, v_ref, kv_ref,
+                qoff_ref, koff_ref, q_ref, k_ref, v_ref, kv_ref,
                 o_ref, lse_ref, m_s, l_s, acc_s):
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -80,52 +110,56 @@ def _fwd_kernel(H, Bq, Bk, scale, causal,
     # m/l live in the first lane of a [Bq, 128] scratch (TPU tiles are
     # 128-lane; a [Bq, 1] buffer would violate the minimum tile)
 
-    q = q_ref[0].astype(jnp.float32)                     # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)                     # [Bk, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    mask = kv_ref[0] > 0.0                               # [Bk] valid keys
-    mask = jnp.broadcast_to(mask[None, :], (Bq, Bk))
-    if causal:
-        qpos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
-        kpos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        mask = jnp.logical_and(mask, kpos <= qpos)
-    s = jnp.where(mask, s, _NEG_INF)
+    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal))
+    def _():
+        q = q_ref[0].astype(jnp.float32)                 # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                 # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal)
+        s = jnp.where(mask, s, _NEG_INF)
 
-    m_prev, l_prev = m_s[:, :1], l_s[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)                          # kill -inf rows
-    corr = jnp.exp(m_prev - m_new)
-    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
-                             (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    acc_s[:] = acc_s[:] * corr + pv
-    m_s[:, :1] = m_new
-    l_s[:, :1] = l_new
+        m_prev, l_prev = m_s[:, :1], l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)                      # kill -inf rows
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_s[:] = acc_s[:] * corr + pv
+        m_s[:, :1] = m_new
+        l_s[:, :1] = l_new
 
     @pl.when(ik == nk - 1)
     def _():
         l = l_s[:, :1]
         o_ref[0] = jnp.where(l > 0, acc_s[:] / jnp.maximum(l, 1e-30),
                              0.0).astype(o_ref.dtype)
-        # +inf for fully-masked rows => backward p = exp(s - inf) = 0
+        # -inf for fully-masked rows: a ring combine weighs them out with
+        # exp(lse - total) = 0, and the backward mask already zeroes p
         lse_ref[0] = jnp.where(l[:, 0] > 0, m_s[:, 0] + jnp.log(l[:, 0]),
-                               jnp.inf)
+                               -jnp.inf)
 
 
-def _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
+def _scalar_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     nq, nk = Tq // Bq, Tk // Bk
-    grid = (BH, nq, nk)
     kernel = functools.partial(_fwd_kernel, H, Bq, Bk, scale, causal)
     return pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(BH, nq, nk),
         in_specs=[
+            _scalar_spec(),
+            _scalar_spec(),
             pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
@@ -151,7 +185,7 @@ def _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
             pltpu.VMEM((Bq, D), jnp.float32),     # output accumulator
         ],
         interpret=_interpret(),
-    )(q, k, v, kv_mask)
+    )(q_off, k_off, q, k, v, kv_mask)
 
 
 # ===========================================================================
@@ -159,6 +193,7 @@ def _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
 # ===========================================================================
 
 def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
+                   qoff_ref, koff_ref,
                    q_ref, k_ref, v_ref, kv_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_s):
     iq, ik = pl.program_id(1), pl.program_id(2)
@@ -168,24 +203,24 @@ def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
     def _():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = jnp.broadcast_to((kv_ref[0] > 0.0)[None, :], (Bq, Bk))
-    if causal:
-        qpos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
-        kpos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        mask = jnp.logical_and(mask, kpos <= qpos)
-    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    do = do_ref[0].astype(jnp.float32)                           # [Bq, D]
-    dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # [Bq, Bk]
-    ds = p * (dp - delta_ref[0][:, None]) * scale
-    dq_s[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
+    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal))
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
+
+        do = do_ref[0].astype(jnp.float32)                          # [Bq, D]
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_s[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _():
@@ -193,6 +228,7 @@ def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
 
 
 def _bwd_dkv_kernel(H, Bq, Bk, scale, causal,
+                    qoff_ref, koff_ref,
                     q_ref, k_ref, v_ref, kv_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_s, dv_s):
     ik, iq = pl.program_id(1), pl.program_id(2)
@@ -203,28 +239,28 @@ def _bwd_dkv_kernel(H, Bq, Bk, scale, causal,
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    q = q_ref[0].astype(jnp.float32)                              # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)                              # [Bk, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = jnp.broadcast_to((kv_ref[0] > 0.0)[None, :], (Bq, Bk))
-    if causal:
-        qpos = iq * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 0)
-        kpos = ik * Bk + jax.lax.broadcasted_iota(jnp.int32, (Bq, Bk), 1)
-        mask = jnp.logical_and(mask, kpos <= qpos)
-    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)    # [Bq, Bk]
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    do = do_ref[0].astype(jnp.float32)                            # [Bq, D]
-    # dv += p^T @ do
-    dv_s[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # [Bq, Bk]
-    ds = p * (dp - delta_ref[0][:, None]) * scale
-    # dk += ds^T @ q
-    dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
+    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal))
+    def _():
+        q = q_ref[0].astype(jnp.float32)                          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                          # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
+
+        do = do_ref[0].astype(jnp.float32)                          # [Bq, D]
+        # dv += p^T @ do
+        dv_s[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        # dk += ds^T @ q
+        dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
 
     @pl.when(iq == nq - 1)
     def _():
@@ -232,12 +268,16 @@ def _bwd_dkv_kernel(H, Bq, Bk, scale, causal,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, kv_mask, o, lse, do, H, scale, causal, Bq, Bk):
+def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
+              H, scale, causal, Bq, Bk):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     nq, nk = Tq // Bq, Tk // Bk
+    # d lse/ds_j = p_j, so the lse cotangent folds into the delta term:
+    # ds = p (dp - delta + dlse) = p (dp - (delta - dlse))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                       # [BH, Tq]
+                    axis=-1) - dlse                                # [BH, Tq]
+    delta = jnp.where(jnp.isfinite(delta), delta, 0.0)
 
     q_spec = pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
                           memory_space=pltpu.VMEM)
@@ -251,13 +291,14 @@ def _bwd_call(q, k, v, kv_mask, o, lse, do, H, scale, causal, Bq, Bk):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, H, Bq, Bk, scale, causal),
         grid=(BH, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, kmask_spec, q_spec,
+        in_specs=[_scalar_spec(), _scalar_spec(),
+                  q_spec, kv_spec, kv_spec, kmask_spec, q_spec,
                   row_spec, row_spec],
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((BH, Tq, D), q.dtype)],
         scratch_shapes=[pltpu.VMEM((Bq, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, kv_mask, do, lse, delta)[0]
+    )(q_off, k_off, q, k, v, kv_mask, do, lse, delta)[0]
 
     # swapped grid: k tiles outer, q tiles inner (sequential accumulation)
     q_spec2 = pl.BlockSpec((1, Bq, D), lambda bh, ik, iq: (bh, iq, 0),
@@ -268,10 +309,12 @@ def _bwd_call(q, k, v, kv_mask, o, lse, do, H, scale, causal, Bq, Bk):
                                memory_space=pltpu.VMEM)
     row_spec2 = pl.BlockSpec((1, Bq), lambda bh, ik, iq: (bh, iq),
                              memory_space=pltpu.VMEM)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, H, Bq, Bk, scale, causal),
         grid=(BH, nk, nq),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, kmask_spec2, q_spec2,
+        in_specs=[_scalar_spec(), _scalar_spec(),
+                  q_spec2, kv_spec2, kv_spec2, kmask_spec2, q_spec2,
                   row_spec2, row_spec2],
         out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
@@ -279,7 +322,7 @@ def _bwd_call(q, k, v, kv_mask, o, lse, do, H, scale, causal, Bq, Bk):
         scratch_shapes=[pltpu.VMEM((Bk, D), jnp.float32),
                         pltpu.VMEM((Bk, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, kv_mask, do, lse, delta)
+    )(q_off, k_off, q, k, v, kv_mask, do, lse, delta)
     return dq, dk, dv
 
 
@@ -287,22 +330,24 @@ def _bwd_call(q, k, v, kv_mask, o, lse, do, H, scale, causal, Bq, Bk):
 # custom-vjp wrapper (padded, [BH, T, D] layout)
 # ===========================================================================
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
-    o, _ = _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk)
-    return o
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
+    return _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal,
+                     Bq, Bk)
 
 
-def _flash_fwd(q, k, v, kv_mask, H, scale, causal, Bq, Bk):
-    o, lse = _fwd_call(q, k, v, kv_mask, H, scale, causal, Bq, Bk)
-    return o, (q, k, v, kv_mask, o, lse)
+def _flash_fwd(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
+    o, lse = _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal,
+                       Bq, Bk)
+    return (o, lse), (q, k, v, kv_mask, q_off, k_off, o, lse)
 
 
-def _flash_bwd(H, scale, causal, Bq, Bk, res, do):
-    q, k, v, kv_mask, o, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, kv_mask, o, lse, do,
+def _flash_bwd(H, scale, causal, Bq, Bk, res, cts):
+    q, k, v, kv_mask, q_off, k_off, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
                            H, scale, causal, Bq, Bk)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -316,9 +361,17 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
-) -> Array:
+    q_offset: Union[int, Array] = 0,
+    k_offset: Union[int, Array] = 0,
+    return_lse: bool = False,
+):
     """Drop-in for `dot_product_attention`: q [B,Tq,H,D], k/v [B,Tk,H,D]
-    -> [B,Tq,H,D], same masking semantics, fused pallas execution."""
+    -> [B,Tq,H,D], same masking semantics, fused pallas execution.
+
+    With `return_lse`, also returns the per-row log-sum-exp [B, H, Tq]
+    (fp32; -inf for fully-masked rows) so a context-parallel caller can
+    combine per-shard results; q_offset/k_offset globalize the causal
+    positions for such shard calls (scalars, may be traced)."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     if scale is None:
@@ -340,10 +393,18 @@ def flash_attention(
         else k_valid.astype(jnp.float32)
     kv_mask = jnp.pad(kv_mask, ((0, 0), (0, Tkp - Tk)))
 
-    o = _flash(qp, kp, vp, kv_mask, H, float(scale), bool(causal), Bq, Bk)
+    q_off = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    k_off = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    o, lse = _flash(qp, kp, vp, kv_mask, q_off, k_off,
+                    H, float(scale), bool(causal), Bq, Bk)
     o = o.reshape(B, H, Tqp, Dp).transpose(0, 2, 1, 3)[:, :Tq, :, :D]
     if q_valid is not None:
         # invalid query rows output exactly 0; the zeroed cotangent also
         # kills their dk/dv contributions in the backward kernels
         o = o * q_valid[:, :, None, None].astype(o.dtype)
-    return o
+    if not return_lse:
+        return o
+    lse = lse.reshape(B, H, Tqp)[:, :, :Tq]
+    if q_valid is not None:
+        lse = jnp.where(q_valid[:, None, :], lse, -jnp.inf)
+    return o, lse

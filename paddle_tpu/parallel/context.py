@@ -79,8 +79,11 @@ def ring_attention_sharded(
         kv = km if k_valid is not None else None
         return local(q, k, v, qv, kv)
 
+    # check_vma=False: the flash path's pallas_call outputs carry no
+    # varying-mesh-axes annotation (standard for custom kernels under
+    # manual sharding)
     fn = shard_map(wrapped, mesh=mesh, in_specs=tuple(in_specs),
-                   out_specs=qkv_spec)
+                   out_specs=qkv_spec, check_vma=False)
     return fn(*args)
 
 

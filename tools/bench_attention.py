@@ -1,0 +1,86 @@
+"""Micro-benchmark: attention implementations across sequence lengths.
+
+Compares dense (fused XLA), blockwise (lax.scan online-softmax), and flash
+(pallas kernel, TPU) on forward+backward wall time — the evidence behind
+the layer's auto-selection thresholds (graph/layers_attn.py).
+
+Usage: python tools/bench_attention.py [--lens 512,1024,4096] [--batch 4]
+       [--heads 8] [--dim 64] [--iters 20] [--dtype bfloat16]
+Prints one JSON line per (impl, seq_len).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_impl(name, fn, q, k, v, iters):
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, g
+
+    l, g = step(q, k, v)                       # compile + warmup
+    jax.block_until_ready((l, g))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l, g = step(q, k, v)
+    jax.block_until_ready((l, g))
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lens", default="512,1024,2048")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    from paddle_tpu.ops import pallas_attention
+    from paddle_tpu.ops.attention import (
+        blockwise_attention, dot_product_attention)
+
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    impls = {
+        "dense": dot_product_attention,
+        "blockwise": functools.partial(blockwise_attention, block_k=512),
+    }
+    if pallas_attention.supported():
+        impls["flash"] = pallas_attention.flash_attention
+
+    rng = np.random.default_rng(0)
+    for T in [int(x) for x in args.lens.split(",")]:
+        shape = (args.batch, T, args.heads, args.dim)
+        q = jnp.asarray(rng.normal(size=shape), dt)
+        k = jnp.asarray(rng.normal(size=shape), dt)
+        v = jnp.asarray(rng.normal(size=shape), dt)
+        for name, fn in impls.items():
+            try:
+                sec = bench_impl(name, fn, q, k, v, args.iters)
+                print(json.dumps({
+                    "impl": name, "seq_len": T, "ms_per_step": round(sec * 1e3, 3),
+                    "tokens_per_sec": round(args.batch * T / sec, 1)}))
+            except Exception as e:
+                print(json.dumps({"impl": name, "seq_len": T,
+                                  "error": f"{type(e).__name__}: {e}"}))
+
+
+if __name__ == "__main__":
+    main()
