@@ -17,6 +17,8 @@ long-context attention with three execution paths picked automatically:
 
 from __future__ import annotations
 
+import jax
+
 from paddle_tpu.config.schema import LayerConfig
 from paddle_tpu.graph.common import finish_layer
 from paddle_tpu.graph.context import ForwardContext
@@ -70,6 +72,12 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
                 f"{'no mesh' if mesh is None else dict(zip(mesh.axis_names, mesh.devices.shape))})")
         attn_fn = ring_attn_fn(mesh)
     elif impl == "flash":
+        if not pallas_attention.supported():
+            raise ValueError(
+                f"layer {cfg.name!r}: attn_impl='flash' needs a TPU backend "
+                f"(or PADDLE_TPU_PALLAS_INTERPRET=1 to opt into the slow "
+                f"interpret mode); current backend is "
+                f"{jax.default_backend()!r}")
         attn_fn = functools.partial(
             pallas_attention.flash_attention,
             block_k=int(cfg.attrs.get("block_k", 128)))

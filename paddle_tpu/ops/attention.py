@@ -267,9 +267,7 @@ def _ring_flash(q, k, v, axis_name, idx, n, perm,
             v_blk = lax.ppermute(v_blk, axis_name, perm)
             if kv_blk is not None:
                 kv_blk = lax.ppermute(kv_blk, axis_name, perm)
-    den_t = jnp.moveaxis(den, 1, 2)[..., None]
-    return jnp.where(den_t > 0, num / jnp.maximum(den_t, 1e-30),
-                     0.0).astype(q.dtype)
+    return _finalize(num, den, q.dtype)
 
 
 def multi_head_attention(

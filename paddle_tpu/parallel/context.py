@@ -63,9 +63,17 @@ def ring_attention_sharded(
     qkv_spec = P(d, SEQ_AXIS, None, None)
     val_spec = P(d, SEQ_AXIS)
 
+    # resolve the flash choice HERE (outside shard_map) so the vma check
+    # stays on for the pure-jnp ring, where it still validates the
+    # ppermute/accumulator plumbing; pallas_call outputs carry no
+    # varying-mesh-axes annotation, so the flash path must opt out
+    from paddle_tpu.ops import pallas_attention
+    use_flash = pallas_attention.supported()
+
     def local(q, k, v, q_valid, k_valid):
         return ring_attention(q, k, v, SEQ_AXIS, q_valid=q_valid,
-                              k_valid=k_valid, causal=causal, scale=scale)
+                              k_valid=k_valid, causal=causal, scale=scale,
+                              use_flash=use_flash)
 
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     args = [q, k, v]
@@ -79,11 +87,8 @@ def ring_attention_sharded(
         kv = km if k_valid is not None else None
         return local(q, k, v, qv, kv)
 
-    # check_vma=False: the flash path's pallas_call outputs carry no
-    # varying-mesh-axes annotation (standard for custom kernels under
-    # manual sharding)
     fn = shard_map(wrapped, mesh=mesh, in_specs=tuple(in_specs),
-                   out_specs=qkv_spec, check_vma=False)
+                   out_specs=qkv_spec, check_vma=not use_flash)
     return fn(*args)
 
 

@@ -1,0 +1,92 @@
+"""CTC loss oracles (ref: paddle/gserver/layers/LinearChainCTC.cpp; test
+pattern of test_LayerGrad's CTC cases):
+
+1. brute force — enumerate every alignment path of a tiny case and sum
+   probabilities; the alpha recursion must match exactly.
+2. torch.nn.functional.ctc_loss — an independent full-scale implementation.
+3. finite differences — gradient of the loss w.r.t. the probabilities.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.ctc import ctc_loss
+
+
+def _collapse(path, blank):
+    out = []
+    prev = None
+    for p in path:
+        if p != prev and p != blank:
+            out.append(p)
+        prev = p
+    return out
+
+
+def test_matches_brute_force_enumeration():
+    rng = np.random.default_rng(0)
+    T, C, blank = 4, 3, 0
+    logits = rng.normal(size=(1, T, C))
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    label = [1, 2]
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if _collapse(path, blank) == label:
+            total += np.prod([probs[0, t, c] for t, c in enumerate(path)])
+
+    got = ctc_loss(jnp.asarray(probs, jnp.float32),
+                   jnp.asarray([T], jnp.int32),
+                   jnp.asarray([label], jnp.int32),
+                   jnp.asarray([len(label)], jnp.int32), blank=blank)
+    np.testing.assert_allclose(float(got[0]), -np.log(total), rtol=1e-5)
+
+
+def test_matches_torch_ctc():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    B, T, C, L, blank = 3, 9, 5, 3, 0
+    logits = rng.normal(size=(B, T, C)).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    in_lens = np.array([9, 7, 5], np.int64)
+    lbl_lens = np.array([3, 2, 1], np.int64)
+    labels = rng.integers(1, C, (B, L)).astype(np.int64)
+
+    want = torch.nn.functional.ctc_loss(
+        torch.log(torch.tensor(probs)).transpose(0, 1),  # [T, B, C]
+        torch.tensor(labels), torch.tensor(in_lens), torch.tensor(lbl_lens),
+        blank=blank, reduction="none", zero_infinity=False).numpy()
+
+    got = ctc_loss(jnp.asarray(probs), jnp.asarray(in_lens, jnp.int32),
+                   jnp.asarray(labels, jnp.int32),
+                   jnp.asarray(lbl_lens, jnp.int32), blank=blank)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_finite_differences():
+    rng = np.random.default_rng(2)
+    B, T, C, L = 2, 5, 4, 2
+    with jax.enable_x64():
+        logits = jnp.asarray(rng.normal(size=(B, T, C)), jnp.float64)
+        in_lens = jnp.asarray([5, 4], jnp.int32)
+        labels = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+        lbl_lens = jnp.asarray([2, 1], jnp.int32)
+
+        def loss(logits):
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.sum(ctc_loss(probs, in_lens, labels, lbl_lens))
+
+        g = jax.grad(loss)(logits)
+        eps = 1e-6
+        for _ in range(12):
+            b, t, c = (int(rng.integers(B)), int(rng.integers(T)),
+                       int(rng.integers(C)))
+            d = jnp.zeros_like(logits).at[b, t, c].set(eps)
+            fd = (loss(logits + d) - loss(logits - d)) / (2 * eps)
+            np.testing.assert_allclose(float(g[b, t, c]), float(fd),
+                                       rtol=1e-4, atol=1e-7)
