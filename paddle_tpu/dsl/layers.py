@@ -950,6 +950,8 @@ def multi_head_attention_layer(
     block_k: Optional[int] = None,
     block_k_min: Optional[int] = None,
     attn_impl: Optional[str] = None,
+    num_kv_heads: Optional[int] = None,
+    window: Optional[int] = None,
     name: Optional[str] = None,
     param_attr: Optional[Union[ParameterAttribute, list]] = None,
     bias_attr=False,
@@ -969,6 +971,12 @@ def multi_head_attention_layer(
     key = key if key is not None else query
     value = value if value is not None else key
     assert size % num_heads == 0, "size must divide evenly into heads"
+    if num_kv_heads is not None:
+        assert num_kv_heads >= 1 and num_heads % num_kv_heads == 0, \
+            f"num_kv_heads must be >= 1 and divide num_heads " \
+            f"(got {num_kv_heads} for {num_heads} heads)"
+    assert window is None or window >= 1, \
+        f"window must be >= 1 (got {window}); window=0 would mask every key"
     if isinstance(param_attr, ParameterAttribute):
         assert not param_attr.name, \
             "a single named param_attr would share ONE matrix across the " \
@@ -988,10 +996,16 @@ def multi_head_attention_layer(
         cfg.attrs["block_k_min"] = block_k_min
     if attn_impl is not None:        # force dense/flash/blockwise/ring
         cfg.attrs["attn_impl"] = attn_impl
-    for i, (inp, dim_in) in enumerate(
-            [(query, query.size), (key, key.size), (value, value.size),
-             (query, size)]):
-        pname = _make_param(name, i, [dim_in, size], attrs[i])
+    if num_kv_heads is not None:     # grouped-query attention
+        cfg.attrs["num_kv_heads"] = num_kv_heads
+    if window is not None:           # sliding-window attention
+        cfg.attrs["window"] = window
+    kv_dim = size if num_kv_heads is None \
+        else (size // num_heads) * num_kv_heads
+    for i, (inp, dim_in, dim_out) in enumerate(
+            [(query, query.size, size), (key, key.size, kv_dim),
+             (value, value.size, kv_dim), (query, size, size)]):
+        pname = _make_param(name, i, [dim_in, dim_out], attrs[i])
         cfg.inputs.append(LayerInput(input_layer_name=inp.name,
                                      input_parameter_name=pname))
     cfg.bias_parameter_name = _bias_name(name, bias_attr, [1, size])

@@ -37,7 +37,8 @@ _BLOCKWISE_MIN_KEYS = 1024
 @register_layer("multi_head_attention")
 def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """inputs: [query, key, value, (query again carrying the out-proj param)];
-    attrs: num_heads, causal, block_k."""
+    attrs: num_heads, causal, block_k, block_k_min, attn_impl,
+    num_kv_heads (grouped-query), window (sliding-window)."""
     q_arg, k_arg, v_arg = (ctx.get_input(cfg, i) for i in range(3))
     w_q, w_k, w_v, w_o = (ctx.param_of(cfg, i) for i in range(4))
     num_heads = int(cfg.attrs["num_heads"])
@@ -91,5 +92,9 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
         q_arg.value, k_arg.value, v_arg.value,
         w_q, w_k, w_v, w_o, num_heads,
         q_valid=q_valid, k_valid=k_valid, causal=causal,
-        bias_o=ctx.bias_of(cfg), attn_fn=attn_fn)
+        bias_o=ctx.bias_of(cfg), attn_fn=attn_fn,
+        num_kv_heads=(int(cfg.attrs["num_kv_heads"])
+                      if "num_kv_heads" in cfg.attrs else None),
+        window=(int(cfg.attrs["window"])
+                if "window" in cfg.attrs else None))
     return finish_layer(ctx, cfg, out, like=q_arg)

@@ -46,11 +46,19 @@ def _score_mask(
     q_valid: Optional[Array],   # [B, Tq] or None
     k_valid: Optional[Array],   # [B, Tk] or None
     causal: bool,
+    window: Optional[int] = None,
 ) -> Optional[Array]:
-    """Combined validity mask broadcastable to [B, 1, Tq, Tk]; None = all valid."""
+    """Combined validity mask broadcastable to [B, 1, Tq, Tk]; None = all valid.
+
+    `window` keeps only keys with |q_pos - k_pos| < window (sliding-window /
+    local attention; one-sided when combined with causal)."""
     mask = None
     if causal:
         mask = (k_pos[None, :] <= q_pos[:, None])[None, None]    # [1,1,Tq,Tk]
+    if window is not None:
+        d = q_pos[:, None] - k_pos[None, :]
+        w = (jnp.abs(d) < window)[None, None]
+        mask = w if mask is None else jnp.logical_and(mask, w)
     if k_valid is not None:
         kv = k_valid[:, None, None, :]                           # [B,1,1,Tk]
         mask = kv if mask is None else jnp.logical_and(mask, kv)
@@ -60,19 +68,34 @@ def _score_mask(
     return mask
 
 
+def _expand_kv_heads(k: Array, v: Array, num_heads: int):
+    """Grouped-query attention: k/v carry H_kv <= H heads; repeat each kv
+    head over its query-head group so every impl sees matching heads."""
+    h_kv = k.shape[2]
+    if h_kv == num_heads:
+        return k, v
+    assert num_heads % h_kv == 0, \
+        f"num_heads {num_heads} not divisible by num_kv_heads {h_kv}"
+    rep = num_heads // h_kv
+    return (jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2))
+
+
 def dot_product_attention(
     q: Array, k: Array, v: Array,
     q_valid: Optional[Array] = None,
     k_valid: Optional[Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> Array:
-    """Dense reference attention. q [B,Tq,H,D], k/v [B,Tk,H,D] -> [B,Tq,H,D]."""
+    """Dense reference attention. q [B,Tq,H,D], k/v [B,Tk,H_kv,D] (H_kv may
+    divide H for grouped-query attention) -> [B,Tq,H,D]."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    k, v = _expand_kv_heads(k, v, q.shape[2])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     mask = _score_mask(jnp.arange(q.shape[1]), jnp.arange(k.shape[1]),
-                       q_valid, k_valid, causal)
+                       q_valid, k_valid, causal, window)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
@@ -89,6 +112,7 @@ def _online_block(
     q_pos: Array, k_pos: Array,
     q_valid: Optional[Array], k_valid_blk: Optional[Array],
     causal: bool, scale: float,
+    window: Optional[int] = None,
 ) -> tuple[Array, Array, Array]:
     """Fold one key/value block into the online-softmax accumulator.
 
@@ -96,7 +120,7 @@ def _online_block(
     """
     o, m, l = acc
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale       # [B,H,Tq,Tk]
-    mask = _score_mask(q_pos, k_pos, q_valid, k_valid_blk, causal)
+    mask = _score_mask(q_pos, k_pos, q_valid, k_valid_blk, causal, window)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -129,13 +153,16 @@ def blockwise_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_k: int = 512,
+    window: Optional[int] = None,
 ) -> Array:
     """Online-softmax attention over key blocks — O(Tq * block_k) score memory.
 
-    Same math as `dot_product_attention`; the scan carry holds (o, m, l) so
-    the full [Tq, Tk] score matrix never exists.
+    Same math as `dot_product_attention` (incl. grouped kv heads and sliding
+    window); the scan carry holds (o, m, l) so the full [Tq, Tk] score
+    matrix never exists.
     """
     B, Tq, H, D = q.shape
+    k, v = _expand_kv_heads(k, v, H)
     Tk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
@@ -159,7 +186,7 @@ def blockwise_attention(
         i = xs["i"]
         k_pos = i * block_k + jnp.arange(block_k)
         acc = _online_block(acc, q, xs["k"], xs["v"], q_pos, k_pos,
-                            q_valid, xs.get("kv"), causal, scale)
+                            q_valid, xs.get("kv"), causal, scale, window)
         return acc, None
 
     xs = {"i": jnp.arange(n_blocks), "k": kb, "v": vb}
@@ -177,6 +204,7 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> Array:
     """Context-parallel attention for use INSIDE `shard_map` over `axis_name`.
 
@@ -207,7 +235,7 @@ def ring_attention(
 
     if use_flash:
         return _ring_flash(q, k, v, axis_name, idx, n, perm,
-                           q_valid, k_valid, causal, scale)
+                           q_valid, k_valid, causal, scale, window)
 
     q_pos = idx * Tl + jnp.arange(Tl)
     acc = _init_acc(B, Tl, H, D)
@@ -215,8 +243,11 @@ def ring_attention(
     for step in range(n):
         src = (idx - step) % n                      # owner of the current block
         k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
-        acc = _online_block(acc, q, k_blk, v_blk, q_pos, k_pos,
-                            q_valid, kv_blk, causal, scale)
+        # grouped kv heads expand AFTER the rotation, so the ring moves the
+        # small H_kv tensors over ICI
+        k_use, v_use = _expand_kv_heads(k_blk, v_blk, H)
+        acc = _online_block(acc, q, k_use, v_use, q_pos, k_pos,
+                            q_valid, kv_blk, causal, scale, window)
         if step + 1 < n:
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -227,7 +258,7 @@ def ring_attention(
 
 
 def _ring_flash(q, k, v, axis_name, idx, n, perm,
-                q_valid, k_valid, causal, scale):
+                q_valid, k_valid, causal, scale, window=None):
     """Ring attention with the pallas flash kernel per hop: each block call
     yields (o_b normalized, lse_b); blocks fold into a running
     (num, den, max) — o = num/den at the end.  Differentiable end-to-end
@@ -246,7 +277,7 @@ def _ring_flash(q, k, v, axis_name, idx, n, perm,
         o_b, lse_b = flash_attention(
             q, k_blk, v_blk, q_valid=q_valid, k_valid=kv_blk, causal=causal,
             scale=scale, q_offset=idx * Tl, k_offset=src * k_blk.shape[1],
-            return_lse=True)
+            return_lse=True, window=window)
         m_new = jnp.maximum(m_run, lse_b)
         alive = m_new > -jnp.inf
         # sanitize BEFORE exp: -inf - -inf would be NaN, and a NaN in the
@@ -281,17 +312,25 @@ def multi_head_attention(
     causal: bool = False,
     bias_o: Optional[Array] = None,
     attn_fn=dot_product_attention,
+    num_kv_heads: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> Array:
     """Projected multi-head attention; attn_fn pluggable (dense / blockwise /
-    a ring closure from parallel/context.py)."""
+    flash / a ring closure from parallel/context.py).
+
+    num_kv_heads < num_heads gives grouped-query attention (w_k/w_v project
+    to num_kv_heads * head_dim); window gives sliding-window attention."""
     B, Tq, _ = query.shape
     Tk = key.shape[1]
     model_dim = w_q.shape[1]
     Dh = model_dim // num_heads
+    h_kv = num_kv_heads or num_heads
     q = (query @ w_q).reshape(B, Tq, num_heads, Dh)
-    k = (key @ w_k).reshape(B, Tk, num_heads, Dh)
-    v = (value @ w_v).reshape(B, Tk, num_heads, Dh)
-    o = attn_fn(q, k, v, q_valid=q_valid, k_valid=k_valid, causal=causal)
+    k = (key @ w_k).reshape(B, Tk, h_kv, Dh)
+    v = (value @ w_v).reshape(B, Tk, h_kv, Dh)
+    kw = {} if window is None else {"window": window}
+    o = attn_fn(q, k, v, q_valid=q_valid, k_valid=k_valid, causal=causal,
+                **kw)
     out = o.reshape(B, Tq, model_dim) @ w_o
     if bias_o is not None:
         out = out + bias_o

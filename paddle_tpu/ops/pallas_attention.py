@@ -70,33 +70,47 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _tile_mask(kv_row, q_off, k_off, iq, ik, Bq, Bk, causal):
-    """[Bq, Bk] validity of one score tile: key validity x causality on
-    GLOBAL positions (offsets cover ring/context-parallel shards)."""
+def _tile_mask(kv_row, q_off, k_off, iq, ik, Bq, Bk, causal, window):
+    """[Bq, Bk] validity of one score tile: key validity x causality x
+    sliding window, on GLOBAL positions (offsets cover ring/context-parallel
+    shards)."""
     mask = jnp.broadcast_to((kv_row > 0.0)[None, :], (Bq, Bk))
-    if causal:
+    if causal or window is not None:
         qpos = q_off + iq * Bq + jax.lax.broadcasted_iota(
             jnp.int32, (Bq, Bk), 0)
         kpos = k_off + ik * Bk + jax.lax.broadcasted_iota(
             jnp.int32, (Bq, Bk), 1)
-        mask = jnp.logical_and(mask, kpos <= qpos)
+        if causal:
+            mask = jnp.logical_and(mask, kpos <= qpos)
+        if window is not None:
+            mask = jnp.logical_and(mask, jnp.abs(qpos - kpos) < window)
     return mask
 
 
-def _tile_live(q_off, k_off, iq, ik, Bq, Bk, causal):
-    """False iff causality masks the ENTIRE tile (its smallest key position
-    is beyond its largest query position) — those tiles skip both matmuls,
-    which halves the work of a long causal sequence."""
-    if not causal:
-        return True
-    return k_off + ik * Bk <= q_off + (iq + 1) * Bq - 1
+def _tile_live(q_off, k_off, iq, ik, Bq, Bk, causal, window):
+    """False iff causality/window masks the ENTIRE tile — those tiles skip
+    both matmuls (halves long-causal work; makes sliding-window cost
+    O(T * window) instead of O(T^2))."""
+    q_lo = q_off + iq * Bq
+    q_hi = q_lo + Bq - 1
+    k_lo = k_off + ik * Bk
+    k_hi = k_lo + Bk - 1
+    live = True
+    if causal:
+        live = jnp.logical_and(live, k_lo <= q_hi)
+    if window is not None:
+        # tile intersects the |q - k| < window band
+        live = jnp.logical_and(live, k_hi > q_lo - window)
+        if not causal:
+            live = jnp.logical_and(live, k_lo < q_hi + window)
+    return live
 
 
 # ===========================================================================
 # forward
 # ===========================================================================
 
-def _fwd_kernel(H, Bq, Bk, scale, causal,
+def _fwd_kernel(H, Bq, Bk, scale, causal, window,
                 qoff_ref, koff_ref, q_ref, k_ref, v_ref, kv_ref,
                 o_ref, lse_ref, m_s, l_s, acc_s):
     iq, ik = pl.program_id(1), pl.program_id(2)
@@ -112,13 +126,14 @@ def _fwd_kernel(H, Bq, Bk, scale, causal,
 
     q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal))
+    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal, window))
     def _():
         q = q_ref[0].astype(jnp.float32)                 # [Bq, D]
         k = k_ref[0].astype(jnp.float32)                 # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal)
+        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal,
+                          window)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev, l_prev = m_s[:, :1], l_s[:, :1]
@@ -149,11 +164,22 @@ def _scalar_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
+def _kv_index(H, H_kv):
+    """Map the query-head grid index bh in [0, B*H) to its kv row in
+    [0, B*H_kv) — grouped-query attention reads kv straight from the small
+    [B*H_kv, Tk, D] array, never materializing the repeat in HBM."""
+    rep = H // H_kv
+    return lambda bh: (bh // H) * H_kv + (bh % H) // rep
+
+
+def _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal, window,
+              Bq, Bk):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
+    H_kv = k.shape[0] // (BH // H)
+    kvi = _kv_index(H, H_kv)
     nq, nk = Tq // Bq, Tk // Bk
-    kernel = functools.partial(_fwd_kernel, H, Bq, Bk, scale, causal)
+    kernel = functools.partial(_fwd_kernel, H, Bq, Bk, scale, causal, window)
     return pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
@@ -162,9 +188,9 @@ def _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
             _scalar_spec(),
             pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
+            pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (kvi(bh), ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
+            pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (kvi(bh), ik, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Bk), lambda bh, iq, ik: (bh // H, ik),
                          memory_space=pltpu.VMEM),
@@ -192,7 +218,7 @@ def _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
 # backward
 # ===========================================================================
 
-def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
+def _bwd_dq_kernel(H, Bq, Bk, scale, causal, window,
                    qoff_ref, koff_ref,
                    q_ref, k_ref, v_ref, kv_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_s):
@@ -205,13 +231,14 @@ def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
 
     q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal))
+    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal, window))
     def _():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal)
+        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal,
+                          window)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
 
         do = do_ref[0].astype(jnp.float32)                          # [Bq, D]
@@ -227,27 +254,32 @@ def _bwd_dq_kernel(H, Bq, Bk, scale, causal,
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(H, Bq, Bk, scale, causal,
+def _bwd_dkv_kernel(H, nq, Bq, Bk, scale, causal, window,
                     qoff_ref, koff_ref,
                     q_ref, k_ref, v_ref, kv_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_s, dv_s):
-    ik, iq = pl.program_id(1), pl.program_id(2)
-    nq = pl.num_programs(2)
+    # grid (B*H_kv, nk, rep*nq): the sequential inner axis walks every
+    # (query head of the group) x (q tile) pair, so one program owns each
+    # dk/dv block and grouped-query heads accumulate without HBM expansion
+    ik, inner = pl.program_id(1), pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    iq = inner % nq
 
-    @pl.when(iq == 0)
+    @pl.when(inner == 0)
     def _():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
     q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal))
+    @pl.when(_tile_live(q_off, k_off, iq, ik, Bq, Bk, causal, window))
     def _():
         q = q_ref[0].astype(jnp.float32)                          # [Bq, D]
         k = k_ref[0].astype(jnp.float32)                          # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal)
+        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal,
+                          window)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
 
         do = do_ref[0].astype(jnp.float32)                          # [Bq, D]
@@ -262,16 +294,20 @@ def _bwd_dkv_kernel(H, Bq, Bk, scale, causal,
         dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(inner == n_inner - 1)
     def _():
         dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
 def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
-              H, scale, causal, Bq, Bk):
+              H, scale, causal, window, Bq, Bk):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
+    BHkv = k.shape[0]
+    H_kv = BHkv // (BH // H)
+    rep = H // H_kv
+    kvi = _kv_index(H, H_kv)
     nq, nk = Tq // Bq, Tk // Bk
     # d lse/ds_j = p_j, so the lse cotangent folds into the delta term:
     # ds = p (dp - delta + dlse) = p (dp - (delta - dlse))
@@ -281,7 +317,7 @@ def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
 
     q_spec = pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
                           memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (bh, ik, 0),
+    kv_spec = pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (kvi(bh), ik, 0),
                            memory_space=pltpu.VMEM)
     kmask_spec = pl.BlockSpec((1, Bk), lambda bh, iq, ik: (bh // H, ik),
                               memory_space=pltpu.VMEM)
@@ -289,7 +325,7 @@ def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
                             memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, H, Bq, Bk, scale, causal),
+        functools.partial(_bwd_dq_kernel, H, Bq, Bk, scale, causal, window),
         grid=(BH, nq, nk),
         in_specs=[_scalar_spec(), _scalar_spec(),
                   q_spec, kv_spec, kv_spec, kmask_spec, q_spec,
@@ -300,25 +336,33 @@ def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
         interpret=_interpret(),
     )(q_off, k_off, q, k, v, kv_mask, do, lse, delta)[0]
 
-    # swapped grid: k tiles outer, q tiles inner (sequential accumulation)
-    q_spec2 = pl.BlockSpec((1, Bq, D), lambda bh, ik, iq: (bh, iq, 0),
-                           memory_space=pltpu.VMEM)
-    kv_spec2 = pl.BlockSpec((1, Bk, D), lambda bh, ik, iq: (bh, ik, 0),
+    # swapped grid: k tiles outer; the inner axis walks (group head, q tile)
+    # pairs so grouped kv heads accumulate their whole group sequentially
+    def bh_of(bhkv, inner):
+        return (bhkv // H_kv) * H + (bhkv % H_kv) * rep + inner // nq
+
+    q_spec2 = pl.BlockSpec(
+        (1, Bq, D), lambda bhkv, ik, inner: (bh_of(bhkv, inner), inner % nq, 0),
+        memory_space=pltpu.VMEM)
+    kv_spec2 = pl.BlockSpec((1, Bk, D), lambda bhkv, ik, inner: (bhkv, ik, 0),
                             memory_space=pltpu.VMEM)
-    kmask_spec2 = pl.BlockSpec((1, Bk), lambda bh, ik, iq: (bh // H, ik),
-                               memory_space=pltpu.VMEM)
-    row_spec2 = pl.BlockSpec((1, Bq), lambda bh, ik, iq: (bh, iq),
-                             memory_space=pltpu.VMEM)
+    kmask_spec2 = pl.BlockSpec(
+        (1, Bk), lambda bhkv, ik, inner: (bhkv // H_kv, ik),
+        memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec(
+        (1, Bq), lambda bhkv, ik, inner: (bh_of(bhkv, inner), inner % nq),
+        memory_space=pltpu.VMEM)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, H, Bq, Bk, scale, causal),
-        grid=(BH, nk, nq),
+        functools.partial(_bwd_dkv_kernel, H, nq, Bq, Bk, scale, causal,
+                          window),
+        grid=(BHkv, nk, rep * nq),
         in_specs=[_scalar_spec(), _scalar_spec(),
                   q_spec2, kv_spec2, kv_spec2, kmask_spec2, q_spec2,
                   row_spec2, row_spec2],
         out_specs=[kv_spec2, kv_spec2],
-        out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
-                   jax.ShapeDtypeStruct((BH, Tk, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((BHkv, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BHkv, Tk, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((Bk, D), jnp.float32),
                         pltpu.VMEM((Bk, D), jnp.float32)],
         interpret=_interpret(),
@@ -330,23 +374,24 @@ def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
 # custom-vjp wrapper (padded, [BH, T, D] layout)
 # ===========================================================================
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
-def _flash(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, kv_mask, q_off, k_off, H, scale, causal, window, Bq, Bk):
     return _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal,
-                     Bq, Bk)
+                     window, Bq, Bk)
 
 
-def _flash_fwd(q, k, v, kv_mask, q_off, k_off, H, scale, causal, Bq, Bk):
+def _flash_fwd(q, k, v, kv_mask, q_off, k_off, H, scale, causal, window,
+               Bq, Bk):
     o, lse = _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal,
-                       Bq, Bk)
+                       window, Bq, Bk)
     return (o, lse), (q, k, v, kv_mask, q_off, k_off, o, lse)
 
 
-def _flash_bwd(H, scale, causal, Bq, Bk, res, cts):
+def _flash_bwd(H, scale, causal, window, Bq, Bk, res, cts):
     q, k, v, kv_mask, q_off, k_off, o, lse = res
     do, dlse = cts
     dq, dk, dv = _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
-                           H, scale, causal, Bq, Bk)
+                           H, scale, causal, window, Bq, Bk)
     return dq, dk, dv, None, None, None
 
 
@@ -364,6 +409,7 @@ def flash_attention(
     q_offset: Union[int, Array] = 0,
     k_offset: Union[int, Array] = 0,
     return_lse: bool = False,
+    window: Optional[int] = None,
 ):
     """Drop-in for `dot_product_attention`: q [B,Tq,H,D], k/v [B,Tk,H,D]
     -> [B,Tq,H,D], same masking semantics, fused pallas execution.
@@ -373,6 +419,9 @@ def flash_attention(
     combine per-shard results; q_offset/k_offset globalize the causal
     positions for such shard calls (scalars, may be traced)."""
     B, Tq, H, D = q.shape
+    H_kv = k.shape[2]
+    assert H % H_kv == 0, \
+        f"num_heads {H} not divisible by num_kv_heads {H_kv}"
     Tk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
@@ -383,11 +432,11 @@ def flash_attention(
 
     def to_bh(x, T, Tp):
         x = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0), (0, Dp - D)))
-        return x.transpose(0, 2, 1, 3).reshape(B * H, Tp, -1)
+        return x.transpose(0, 2, 1, 3).reshape(B * x.shape[2], Tp, -1)
 
-    qp = to_bh(q, Tq, Tqp)
-    kp = to_bh(k, Tk, Tkp)
-    vp = to_bh(v, Tk, Tkp)
+    qp = to_bh(q, Tq, Tqp)                   # [B*H, Tqp, Dp]
+    kp = to_bh(k, Tk, Tkp)                   # [B*H_kv, Tkp, Dp] — kv stay
+    vp = to_bh(v, Tk, Tkp)                   # at their grouped head count
 
     kv_mask = jnp.ones((B, Tk), jnp.float32) if k_valid is None \
         else k_valid.astype(jnp.float32)
@@ -396,7 +445,8 @@ def flash_attention(
     q_off = jnp.asarray(q_offset, jnp.int32).reshape(1)
     k_off = jnp.asarray(k_offset, jnp.int32).reshape(1)
     o, lse = _flash(qp, kp, vp, kv_mask, q_off, k_off,
-                    H, float(scale), bool(causal), Bq, Bk)
+                    H, float(scale), bool(causal),
+                    None if window is None else int(window), Bq, Bk)
     o = o.reshape(B, H, Tqp, Dp).transpose(0, 2, 1, 3)[:, :Tq, :, :D]
     if q_valid is not None:
         # invalid query rows output exactly 0; the zeroed cotangent also

@@ -55,6 +55,7 @@ def ring_attention_sharded(
     k_valid: Optional[Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> Array:
     """Context-parallel attention over the mesh: batch sharded on `data`,
     time sharded on `seq`, ring over the seq axis.  Works under an outer
@@ -73,7 +74,7 @@ def ring_attention_sharded(
     def local(q, k, v, q_valid, k_valid):
         return ring_attention(q, k, v, SEQ_AXIS, q_valid=q_valid,
                               k_valid=k_valid, causal=causal, scale=scale,
-                              use_flash=use_flash)
+                              use_flash=use_flash, window=window)
 
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     args = [q, k, v]
@@ -96,8 +97,8 @@ def ring_attn_fn(mesh: Mesh, causal_default: bool = False):
     """An `attn_fn` for ops.attention.multi_head_attention that routes through
     the sharded ring. Signature matches dot_product_attention."""
     def fn(q, k, v, q_valid=None, k_valid=None, causal=causal_default,
-           scale=None):
+           scale=None, window=None):
         return ring_attention_sharded(mesh, q, k, v, q_valid=q_valid,
                                       k_valid=k_valid, causal=causal,
-                                      scale=scale)
+                                      scale=scale, window=window)
     return fn
