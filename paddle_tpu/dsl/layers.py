@@ -952,6 +952,8 @@ def multi_head_attention_layer(
     attn_impl: Optional[str] = None,
     num_kv_heads: Optional[int] = None,
     window: Optional[int] = None,
+    use_rope: bool = False,
+    rope_theta: float = 10000.0,
     name: Optional[str] = None,
     param_attr: Optional[Union[ParameterAttribute, list]] = None,
     bias_attr=False,
@@ -977,6 +979,9 @@ def multi_head_attention_layer(
             f"(got {num_kv_heads} for {num_heads} heads)"
     assert window is None or window >= 1, \
         f"window must be >= 1 (got {window}); window=0 would mask every key"
+    assert not use_rope or (size // num_heads) % 2 == 0, \
+        f"use_rope needs an even head dim (got size {size} / {num_heads} " \
+        f"heads = {size // num_heads})"
     if isinstance(param_attr, ParameterAttribute):
         assert not param_attr.name, \
             "a single named param_attr would share ONE matrix across the " \
@@ -1000,6 +1005,9 @@ def multi_head_attention_layer(
         cfg.attrs["num_kv_heads"] = num_kv_heads
     if window is not None:           # sliding-window attention
         cfg.attrs["window"] = window
+    if use_rope:                     # rotary position embeddings
+        cfg.attrs["use_rope"] = True
+        cfg.attrs["rope_theta"] = rope_theta
     kv_dim = size if num_kv_heads is None \
         else (size // num_heads) * num_kv_heads
     for i, (inp, dim_in, dim_out) in enumerate(

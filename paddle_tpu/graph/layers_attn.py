@@ -38,7 +38,8 @@ _BLOCKWISE_MIN_KEYS = 1024
 def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """inputs: [query, key, value, (query again carrying the out-proj param)];
     attrs: num_heads, causal, block_k, block_k_min, attn_impl,
-    num_kv_heads (grouped-query), window (sliding-window)."""
+    num_kv_heads (grouped-query), window (sliding-window),
+    use_rope/rope_theta (rotary position embeddings)."""
     q_arg, k_arg, v_arg = (ctx.get_input(cfg, i) for i in range(3))
     w_q, w_k, w_v, w_o = (ctx.param_of(cfg, i) for i in range(4))
     num_heads = int(cfg.attrs["num_heads"])
@@ -96,5 +97,7 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
         num_kv_heads=(int(cfg.attrs["num_kv_heads"])
                       if "num_kv_heads" in cfg.attrs else None),
         window=(int(cfg.attrs["window"])
-                if "window" in cfg.attrs else None))
+                if "window" in cfg.attrs else None),
+        use_rope=bool(cfg.attrs.get("use_rope", False)),
+        rope_theta=float(cfg.attrs.get("rope_theta", 10000.0)))
     return finish_layer(ctx, cfg, out, like=q_arg)

@@ -68,6 +68,30 @@ def _score_mask(
     return mask
 
 
+def rope(x: Array, positions: Array, theta: float = 10000.0) -> Array:
+    """Rotary position embedding (RoPE, Su et al. 2021) — NEW capability
+    beyond the reference.  x [B, T, H, D] with D even, positions [T] (or
+    [B, T]) absolute token positions; rotate-half convention (feature i
+    pairs with i + D/2, the GPT-NeoX/llama layout — NOT the interleaved
+    consecutive-pair GPT-J layout) with position-dependent angles, so q·k
+    depends only on relative offsets.
+    Applied to q/k BEFORE attention, it composes with every implementation
+    (dense/blockwise/flash/ring) — for ring/context-parallel shards pass the
+    shard's global positions."""
+    D = x.shape[-1]
+    assert D % 2 == 0, f"rope needs an even head dim, got {D}"
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions[..., None].astype(jnp.float32) * freqs     # [..., T, half]
+    if ang.ndim == 2:                                          # [T, half]
+        ang = ang[None]                                        # [1, T, half]
+    cos = jnp.cos(ang)[:, :, None, :]                          # [B|1, T, 1, half]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.astype(x.dtype)
+
+
 def _expand_kv_heads(k: Array, v: Array, num_heads: int):
     """Grouped-query attention: k/v carry H_kv <= H heads; repeat each kv
     head over its query-head group so every impl sees matching heads."""
@@ -314,12 +338,15 @@ def multi_head_attention(
     attn_fn=dot_product_attention,
     num_kv_heads: Optional[int] = None,
     window: Optional[int] = None,
+    use_rope: bool = False,
+    rope_theta: float = 10000.0,
 ) -> Array:
     """Projected multi-head attention; attn_fn pluggable (dense / blockwise /
     flash / a ring closure from parallel/context.py).
 
     num_kv_heads < num_heads gives grouped-query attention (w_k/w_v project
-    to num_kv_heads * head_dim); window gives sliding-window attention."""
+    to num_kv_heads * head_dim); window gives sliding-window attention;
+    use_rope applies rotary position embeddings to q/k."""
     B, Tq, _ = query.shape
     Tk = key.shape[1]
     model_dim = w_q.shape[1]
@@ -328,6 +355,9 @@ def multi_head_attention(
     q = (query @ w_q).reshape(B, Tq, num_heads, Dh)
     k = (key @ w_k).reshape(B, Tk, h_kv, Dh)
     v = (value @ w_v).reshape(B, Tk, h_kv, Dh)
+    if use_rope:
+        q = rope(q, jnp.arange(Tq), rope_theta)
+        k = rope(k, jnp.arange(Tk), rope_theta)
     kw = {} if window is None else {"window": window}
     o = attn_fn(q, k, v, q_valid=q_valid, k_valid=k_valid, causal=causal,
                 **kw)

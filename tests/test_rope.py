@@ -1,0 +1,114 @@
+"""Rotary position embedding oracles — NEW capability beyond the reference.
+
+1. relative-position property: RoPE'd q·k must depend only on the offset
+   (q_pos - k_pos), not absolute positions.
+2. norm preservation: rotation never changes vector norms.
+3. cross-implementation parity: dense vs flash vs ring with global shard
+   positions all agree on roped inputs.
+4. end-to-end: a DSL model with use_rope trains, and can recover a task
+   that NEEDS position information (unlike bare attention, which is
+   permutation-equivariant over keys).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.attention import dot_product_attention, rope
+from paddle_tpu.ops.pallas_attention import flash_attention
+
+
+def test_relative_position_property():
+    rng = np.random.default_rng(0)
+    D = 16
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, D)), jnp.float32)
+
+    def score(qpos, kpos):
+        qr = rope(q, jnp.asarray([qpos]))
+        kr = rope(k, jnp.asarray([kpos]))
+        return float(jnp.sum(qr * kr))
+
+    # same offset, different absolute positions -> same score
+    np.testing.assert_allclose(score(7, 3), score(104, 100), rtol=1e-5)
+    np.testing.assert_allclose(score(5, 5), score(400, 400), rtol=1e-5)
+    # different offsets -> different scores
+    assert abs(score(7, 3) - score(7, 5)) > 1e-4
+
+
+def test_norm_preserved():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 9, 3, 8)), jnp.float32)
+    r = rope(x, jnp.arange(9))
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(r), axis=-1),
+                               np.linalg.norm(np.asarray(x), axis=-1),
+                               rtol=1e-5)
+
+
+def test_impl_parity_on_roped_inputs():
+    rng = np.random.default_rng(2)
+    B, T, H, D = 2, 32, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    qr, kr = rope(q, jnp.arange(T)), rope(k, jnp.arange(T))
+
+    want = dot_product_attention(qr, kr, v, causal=True)
+    got = flash_attention(qr, kr, v, causal=True, block_q=8, block_k=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_shard_positions_match_global(monkeypatch):
+    """rope applied to the FULL sequence before sharding == per-shard rope
+    with global positions (what a context-parallel caller must use)."""
+    rng = np.random.default_rng(3)
+    T, n = 16, 4
+    x = jnp.asarray(rng.normal(size=(1, T, 2, 8)), jnp.float32)
+    whole = rope(x, jnp.arange(T))
+    Tl = T // n
+    shards = [rope(x[:, i * Tl:(i + 1) * Tl], jnp.arange(i * Tl, (i + 1) * Tl))
+              for i in range(n)]
+    np.testing.assert_allclose(np.asarray(jnp.concatenate(shards, axis=1)),
+                               np.asarray(whole), rtol=1e-6)
+
+
+def test_rope_model_learns_positional_task():
+    """Label = sign of the FIRST token's feature.  Bare mean-pooled
+    attention cannot distinguish token order; RoPE makes it learnable."""
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.dsl import (
+        AdamOptimizer, SoftmaxActivation, classification_cost, data_layer,
+        fc_layer, multi_head_attention_layer, pooling_layer, settings,
+    )
+    from paddle_tpu.dsl.poolings import MaxPooling
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    def conf():
+        settings(batch_size=8, learning_rate=0.05,
+                 learning_method=AdamOptimizer())
+        x = data_layer(name="x", size=16)
+        a = multi_head_attention_layer(x, size=16, num_heads=4,
+                                       use_rope=True, causal=True)
+        p = pooling_layer(input=a, pooling_type=MaxPooling())
+        out = fc_layer(input=p, size=2, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=2))
+
+    cfg = parse_config_callable(conf)
+    tr = Trainer(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    T = 12
+    data = []
+    for _ in range(5):
+        x = rng.normal(size=(8, T, 16)).astype(np.float32)
+        y = (x[:, 0, 0] > 0).astype(np.int32)
+        data.append({"x": Argument(value=x,
+                                   lengths=np.full((8,), T, np.int32)),
+                     "y": Argument(ids=y)})
+    hist = [float(np.mean([tr.train_one_batch(b) for b in data]))
+            for _ in range(15)]
+    assert np.isfinite(hist).all()
+    assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
