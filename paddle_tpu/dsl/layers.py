@@ -982,6 +982,10 @@ def multi_head_attention_layer(
     assert not use_rope or (size // num_heads) % 2 == 0, \
         f"use_rope needs an even head dim (got size {size} / {num_heads} " \
         f"heads = {size // num_heads})"
+    assert not use_rope or key is query, \
+        "use_rope requires self-attention: rotating decoder queries and " \
+        "unrelated encoder keys by their own arange positions imposes a " \
+        "spurious relative-position bias in cross-attention"
     if isinstance(param_attr, ParameterAttribute):
         assert not param_attr.name, \
             "a single named param_attr would share ONE matrix across the " \

@@ -117,6 +117,9 @@ class Trainer:
         # 198-389, REGISTER_BARRIER_TIMER_SERVER)
         from paddle_tpu.parallel.barrier_stat import BarrierTimer
         self.barrier_stat = BarrierTimer()
+        # immutable after construction; _validate_batch uses it per batch
+        self._data_layers = {l.name: l for l in self.model.layers
+                             if l.type == "data"}
 
     # -- compiled steps ---------------------------------------------------
     def _build_train_step_fn(self):
@@ -220,6 +223,44 @@ class Trainer:
             self.net_state = new_net
         return loss, partials, host_out
 
+    def _validate_batch(self, batch: dict[str, Argument]) -> None:
+        """Clear errors for the common feed mistakes BEFORE tracing: a
+        missing/misspelled key would otherwise silently skip downstream
+        layers (the generation-path skip in builder.forward) and surface as
+        'model has no cost layers'; out-of-range ids would gather garbage
+        and train on NaNs.  Host-side numpy checks only — device arrays are
+        not synced."""
+        data_layers = self._data_layers
+        missing = sorted(set(data_layers) - set(batch))
+        if missing:
+            raise KeyError(
+                f"batch is missing feed(s) for data layer(s) {missing}; "
+                f"fed keys: {sorted(batch)}")
+        unknown = sorted(set(batch) - set(data_layers))
+        if unknown:
+            raise KeyError(
+                f"batch feeds unknown key(s) {unknown} — not data layers "
+                f"(expected: {sorted(data_layers)}); a feed shadowing a "
+                f"computed layer would silently override it")
+        sizes = {}
+        for name, arg in batch.items():
+            if arg.value is None and arg.ids is None:
+                raise ValueError(f"feed {name!r} carries neither dense "
+                                 f"values nor ids")
+            sizes[name] = arg.batch_size
+            cfg = data_layers[name]
+            ids = arg.ids
+            if (isinstance(ids, np.ndarray) and arg.sparse_dim == 0
+                    and cfg.size > 0 and ids.size):
+                hi, lo = int(ids.max()), int(ids.min())
+                if hi >= cfg.size or lo < 0:
+                    raise ValueError(
+                        f"feed {name!r}: id {hi if hi >= cfg.size else lo} "
+                        f"out of range for data layer size {cfg.size} — "
+                        f"this would gather garbage and train on NaNs")
+        if len(set(sizes.values())) > 1:
+            raise ValueError(f"feeds disagree on batch size: {sizes}")
+
     def train_one_batch(self, batch: dict[str, Argument]):
         """(ref: TrainerInternal::trainOneBatch).
 
@@ -229,6 +270,7 @@ class Trainer:
         layer-level localisation; otherwise losses buffer on device and are
         bulk-checked every nonfinite_check_period batches, so dispatch
         pipelines with device compute."""
+        self._validate_batch(batch)
         loss, partials, host_out = self._dispatch_step(batch)
         self._acc = self.evaluators.accumulate(getattr(self, "_acc", {}), partials)
         if self.evaluators.host_configs:

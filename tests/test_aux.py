@@ -147,3 +147,49 @@ class TestTrainerMainJobs:
         assert out.returncode == 0, out.stderr[-2000:]
         blob = out.stdout + out.stderr
         assert "mean_abs" in blob
+
+
+def test_batch_validation_errors():
+    """Common feed mistakes fail fast with specific messages, instead of
+    'model has no cost layers' (missing key silently skipping layers) or
+    NaN training (out-of-range ids gathering garbage)."""
+    import numpy as np
+    import pytest
+
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.dsl import (
+        MomentumOptimizer, SoftmaxActivation, classification_cost,
+        data_layer, embedding_layer, fc_layer, pooling_layer, settings,
+    )
+    from paddle_tpu.dsl.poolings import MaxPooling
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    def conf():
+        settings(batch_size=4, learning_rate=0.1,
+                 learning_method=MomentumOptimizer())
+        w = data_layer(name="word", size=50)
+        emb = embedding_layer(input=w, size=8)
+        p = pooling_layer(input=emb, pooling_type=MaxPooling())
+        out = fc_layer(input=p, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="label", size=3))
+
+    tr = Trainer(parse_config_callable(conf), seed=0)
+    ids = np.zeros((4, 6), np.int32)
+    lens = np.full((4,), 6, np.int32)
+    good = {"word": Argument(ids=ids, lengths=lens),
+            "label": Argument(ids=np.zeros((4,), np.int32))}
+
+    with pytest.raises(KeyError, match="missing feed.*label"):
+        tr.train_one_batch({"word": good["word"]})
+    with pytest.raises(KeyError, match="unknown key.*wrod"):
+        tr.train_one_batch({**good, "wrod": good["word"]})
+    with pytest.raises(ValueError, match="out of range.*size 50"):
+        tr.train_one_batch({**good,
+                            "word": Argument(ids=ids + 99, lengths=lens)})
+    with pytest.raises(ValueError, match="disagree on batch size"):
+        tr.train_one_batch({**good,
+                            "label": Argument(ids=np.zeros((2,), np.int32))})
+    with pytest.raises(ValueError, match="neither dense values nor ids"):
+        tr.train_one_batch({**good, "label": Argument()})
+    assert np.isfinite(float(tr.train_one_batch(good)))

@@ -75,9 +75,12 @@ def test_ring_shard_positions_match_global(monkeypatch):
                                np.asarray(whole), rtol=1e-6)
 
 
-def test_rope_model_learns_positional_task():
-    """Label = sign of the FIRST token's feature.  Bare mean-pooled
-    attention cannot distinguish token order; RoPE makes it learnable."""
+def test_layer_use_rope_not_a_noop(monkeypatch):
+    """use_rope must actually rotate q/k in the layer path: (a) a spy on
+    ops.attention.rope records the calls, (b) with identical params the
+    layer output differs between use_rope on/off, and (c) the model trains
+    with finite decreasing loss."""
+    import paddle_tpu.ops.attention as attn_mod
     from paddle_tpu.config.parser import parse_config_callable
     from paddle_tpu.dsl import (
         AdamOptimizer, SoftmaxActivation, classification_cost, data_layer,
@@ -87,28 +90,45 @@ def test_rope_model_learns_positional_task():
     from paddle_tpu.parameter.argument import Argument
     from paddle_tpu.trainer.trainer import Trainer
 
-    def conf():
-        settings(batch_size=8, learning_rate=0.05,
-                 learning_method=AdamOptimizer())
-        x = data_layer(name="x", size=16)
-        a = multi_head_attention_layer(x, size=16, num_heads=4,
-                                       use_rope=True, causal=True)
-        p = pooling_layer(input=a, pooling_type=MaxPooling())
-        out = fc_layer(input=p, size=2, act=SoftmaxActivation())
-        classification_cost(input=out, label=data_layer(name="y", size=2))
+    calls = []
+    real = attn_mod.rope
+    monkeypatch.setattr(attn_mod, "rope",
+                        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
 
-    cfg = parse_config_callable(conf)
-    tr = Trainer(cfg, seed=0)
+    def conf(with_rope):
+        def f():
+            settings(batch_size=8, learning_rate=0.05,
+                     learning_method=AdamOptimizer())
+            x = data_layer(name="x", size=16)
+            a = multi_head_attention_layer(x, size=16, num_heads=4,
+                                           use_rope=with_rope, causal=True)
+            p = pooling_layer(input=a, pooling_type=MaxPooling())
+            out = fc_layer(input=p, size=2, act=SoftmaxActivation())
+            classification_cost(input=out,
+                                label=data_layer(name="y", size=2))
+        return f
+
     rng = np.random.default_rng(0)
     T = 12
-    data = []
-    for _ in range(5):
-        x = rng.normal(size=(8, T, 16)).astype(np.float32)
-        y = (x[:, 0, 0] > 0).astype(np.int32)
-        data.append({"x": Argument(value=x,
-                                   lengths=np.full((8,), T, np.int32)),
-                     "y": Argument(ids=y)})
-    hist = [float(np.mean([tr.train_one_batch(b) for b in data]))
-            for _ in range(15)]
-    assert np.isfinite(hist).all()
-    assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
+    x = rng.normal(size=(8, T, 16)).astype(np.float32)
+    batch = {"x": Argument(value=x, lengths=np.full((8,), T, np.int32)),
+             "y": Argument(ids=(x[:, 0, 0] > 0).astype(np.int32))}
+
+    tr_on = Trainer(parse_config_callable(conf(True)), seed=0)
+    tr_off = Trainer(parse_config_callable(conf(False)), seed=0)
+    # identical initial params (same seed/graph shapes) -> any output
+    # difference is RoPE's doing
+    for k in tr_on.params:
+        np.testing.assert_array_equal(np.asarray(tr_on.params[k]),
+                                      np.asarray(tr_off.params[k]))
+    loss_on = float(tr_on.train_one_batch(batch))
+    n_calls = len(calls)
+    loss_off = float(tr_off.train_one_batch(batch))
+    assert n_calls >= 2, "rope was not invoked for q and k"
+    assert len(calls) == n_calls, "rope invoked with use_rope=False"
+    assert abs(loss_on - loss_off) > 1e-6, "use_rope did not change the model"
+
+    losses = [loss_on] + [float(tr_on.train_one_batch(batch))
+                          for _ in range(9)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
