@@ -18,6 +18,16 @@ pipeline, where an async host pipeline keeps data resident ahead of
 compute (ref: the reference's DoubleBuffer prefetch,
 gserver/dataproviders/DataProvider.h:260).  MFU is reported from XLA's own
 flop count for the compiled step against the chip's peak.
+
+Failure model (ref: the reference's benchmark mode always emits a timing
+record — paddle/trainer/TrainerBenchmark.cpp, TrainerMain.cpp:106-107):
+the orchestrating process NEVER imports jax — a wedged TPU tunnel blocks
+every in-process backend init forever, so all device work happens in child
+processes (`bench.py --bench NAME`) under hard timeouts.  The record always
+prints and exits 0: on an unhealthy/dead backend it carries `"error"` plus
+clearly-labeled last-known-good numbers from PERF_LOG.jsonl.  Every
+successful run is appended to PERF_LOG.jsonl (timestamped) so a failed
+end-of-round capture still leaves verifiable on-TPU evidence.
 """
 
 from __future__ import annotations
@@ -27,6 +37,9 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_PERF_LOG = os.path.join(_REPO, "PERF_LOG.jsonl")
 
 def _chip_peak_tflops(dtype: str) -> float:
     import jax
@@ -50,8 +63,7 @@ def _chip_peak_tflops(dtype: str) -> float:
 def _baseline_ratio(value: float, key: str) -> float:
     """value / measured reference samples/sec (0.0 = baseline not measured)."""
     try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BASELINE.json")) as f:
+        with open(os.path.join(_REPO, "BASELINE.json")) as f:
             base = json.load(f).get("published", {}).get(key, {})
         ref = float(base.get("samples_per_sec", 0.0))
         return round(value / ref, 2) if ref > 0 else 0.0
@@ -284,40 +296,237 @@ def bench_recommendation(dtype: str) -> dict:
             "vs_baseline": _baseline_ratio(v, "movielens_recsys")}
 
 
-def main() -> None:
-    import time
+BENCHES = {
+    "vgg": bench_vgg,
+    "seq2seq": bench_seq2seq,
+    "mnist": bench_mnist,
+    "sentiment": bench_sentiment,
+    "recommendation": bench_recommendation,
+}
+
+
+def _child(name: str) -> None:
+    """Run ONE bench in this (child) process; print exactly one JSON line.
+
+    Exceptions become {"error": ...} — the child always exits 0 so the
+    parent distinguishes "bench failed" (JSON with error) from "backend
+    wedged" (timeout/no output).
+    """
     import traceback
 
-    # bfloat16 is the TPU-native float: fp32 master params, bf16 matmuls on
-    # the MXU, fp32 softmax/BN-stats/loss (BENCH_DTYPE=float32 opts out)
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    # wall-clock budget for the non-headline benches: a degraded TPU tunnel
-    # (slow remote compiles) must not stall the whole record — whatever
-    # doesn't fit is reported as skipped rather than hanging the driver
-    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "900"))
-    t0 = time.perf_counter()
+    try:
+        out = BENCHES[name](dtype)
+    except Exception as e:
+        traceback.print_exc()
+        out = {"error": f"{type(e).__name__}: {e}"}
+    print("BENCH_JSON:" + json.dumps(out), flush=True)
 
-    vgg = bench_vgg(dtype)
-    out = dict(vgg)
+
+# ---------------------------------------------------------------------------
+# Orchestrator (parent) — pure stdlib, never imports jax.
+# ---------------------------------------------------------------------------
+
+def _run_group(argv: list[str], timeout_s: float):
+    """Run argv in its OWN process group under a hard timeout, SIGKILLing
+    the whole group on expiry.  subprocess.run's timeout only kills the
+    direct child; a wedged jax child can leave a helper process holding the
+    pipe, blocking the parent's drain forever — exactly the tunnel-death
+    scenario this orchestrator must survive.  Returns (rc, stdout, stderr);
+    rc None => timed out."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        return None, out, err
+
+
+def _spawn(name: str, timeout_s: float) -> dict:
+    """Run `bench.py --bench name` in a subprocess under a hard timeout."""
+    rc, stdout, stderr = _run_group(
+        [sys.executable, os.path.abspath(__file__), "--bench", name],
+        timeout_s)
+    if rc is None:
+        return {"error": f"timeout after {timeout_s:.0f}s (backend wedged?)"}
+    for line in reversed((stdout or "").splitlines()):
+        if line.startswith("BENCH_JSON:"):
+            try:
+                result = json.loads(line[len("BENCH_JSON:"):])
+            except ValueError:
+                break
+            if "error" in result and stderr:
+                # keep the child's traceback in the driver log — the JSON
+                # record carries only the one-line error
+                sys.stderr.write(f"--- bench {name} child stderr ---\n"
+                                 f"{stderr[-4000:]}\n")
+            return result
+    tail = ((stderr or "") + (stdout or ""))[-400:]
+    return {"error": f"no result (rc={rc}): {tail!r}"}
+
+
+def _health_check(timeout_s: float) -> dict:
+    """Probe the backend from a throwaway process; never wedges the parent."""
+    code = ("import jax; d = jax.devices(); "
+            "print('HEALTH:' + d[0].platform + ':' + d[0].device_kind)")
+    rc, stdout, stderr = _run_group([sys.executable, "-c", code], timeout_s)
+    if rc is None:
+        return {"ok": False, "why": f"backend init hung >{timeout_s:.0f}s"}
+    for line in (stdout or "").splitlines():
+        if line.startswith("HEALTH:"):
+            _, platform, kind = line.split(":", 2)
+            return {"ok": True, "platform": platform, "device_kind": kind}
+    return {"ok": False, "why": f"rc={rc}: {(stderr or '')[-300:]!r}"}
+
+
+def _last_known_good() -> dict | None:
+    """Most recent complete record from PERF_LOG.jsonl (newest last).
+    Nested extras that errored/were skipped in that run are stripped — a
+    degraded fallback must not advertise errored extras as known-good."""
+    try:
+        with open(_PERF_LOG) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        r = rec.get("record")
+        if isinstance(r, dict) and "error" not in r and r.get("value"):
+            rec["record"] = {
+                k: v for k, v in r.items()
+                if not (isinstance(v, dict) and ("error" in v or "skipped" in v))}
+            return rec
+    return None
+
+
+def _append_perf_log(record: dict) -> None:
+    import datetime
+
+    entry = {"ts": datetime.datetime.now(datetime.timezone.utc)
+                     .isoformat(timespec="seconds"),
+             "record": record}
+    try:
+        with open(_PERF_LOG, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def _degraded_record(err: str) -> dict:
+    """The always-parseable fallback: `error` + clearly-labeled
+    last-known-good numbers (or an explicit zero record if none exist)."""
+    out = {"error": err, "degraded": True}
+    lkg = _last_known_good()
+    if lkg:
+        out.update(lkg["record"])
+        out["degraded_source"] = (
+            f"last-known-good measured {lkg['ts']} (PERF_LOG.jsonl)")
+    else:
+        out.update({"metric": "vgg16_cifar10_train_samples_per_sec_per_chip",
+                    "value": 0.0, "unit": "samples/sec/chip",
+                    "vs_baseline": 0.0})
+    return out
+
+
+def main() -> None:
+    import time
+
+    t0 = time.perf_counter()
+    # wall-clock budget for the whole record: a degraded tunnel (slow remote
+    # compiles) must not stall the driver — whatever doesn't fit is reported
+    # as skipped rather than hanging
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "1800"))
+    per_bench = float(os.environ.get("BENCH_SUBPROC_TIMEOUT_S", "900"))
+    health_timeout = float(os.environ.get("BENCH_HEALTH_TIMEOUT_S", "90"))
+
+    def _left() -> float:
+        return budget - (time.perf_counter() - t0)
+
+    # -- backend health, with one bounded retry (the axon tunnel sometimes
+    #    recovers on its own after a transient death); clamped to the
+    #    remaining budget like everything else
+    health = _health_check(min(health_timeout, max(_left(), 5)))
+    if not health["ok"] and _left() > 10:
+        time.sleep(min(float(os.environ.get("BENCH_HEALTH_RETRY_DELAY_S",
+                                            "60")), max(_left() - 5, 0)))
+        health = _health_check(min(health_timeout, max(_left(), 5)))
+
+    if not health["ok"]:
+        # Backend unrecoverable: emit a degraded-but-parseable record.
+        print(json.dumps(
+            _degraded_record(f"TPU backend unavailable: {health['why']}")))
+        return
+
+    # -- headline (VGG). One in-place retry after a fresh health check: a
+    #    mid-bench tunnel death shows up as a timeout/error here.  Every
+    #    spawn/check is clamped to the remaining overall budget so the
+    #    documented wall-clock bound holds even through the retry path.
+    degraded = False
+    if _left() <= 30:
+        degraded = True
+        out = _degraded_record(
+            f"budget {budget:.0f}s exhausted before the headline bench")
+    else:
+        out = _spawn("vgg", min(per_bench, _left()))
+    if not degraded and "error" in out:
+        first_err = out["error"]
+        if _left() > 2 * health_timeout and \
+                _health_check(min(health_timeout, _left()))["ok"] and \
+                _left() > 30:
+            out = _spawn("vgg", min(per_bench, _left()))
+        if "error" in out:
+            degraded = True
+            out = _degraded_record(
+                f"headline failed twice: {first_err} / {out['error']}")
+    if not degraded:
+        # only stamp fresh measurements — a merged last-known-good record
+        # keeps the platform fields of the run that measured it
+        out["platform"] = health.get("platform", "?")
+        out["device_kind"] = health.get("device_kind", "?")
 
     extras = []
     if os.environ.get("BENCH_SKIP_S2S", "0") != "1":
-        extras.append(("seq2seq", bench_seq2seq))
+        extras.append("seq2seq")
     if os.environ.get("BENCH_EXTENDED", "1") != "0":
         # the three remaining BASELINE.md configs (BENCH_EXTENDED=0 skips)
-        extras += [("mnist", bench_mnist), ("sentiment", bench_sentiment),
-                   ("recommendation", bench_recommendation)]
-    for key, fn in extras:
-        if time.perf_counter() - t0 > budget:
+        extras += ["mnist", "sentiment", "recommendation"]
+    for key in extras:
+        if degraded:
+            # the backend just failed the headline twice — spawning more
+            # benches against it would only overwrite the last-known-good
+            # extras merged above with fresh timeouts
+            if key not in out:
+                out[key] = {"skipped": "backend degraded before extras"}
+            continue
+        left = _left()
+        if left <= 30:
             out[key] = {"skipped": f"time budget {budget:.0f}s exhausted"}
             continue
-        try:
-            out[key] = fn(dtype)
-        except Exception as e:       # one failing extra must not kill the record
-            traceback.print_exc()
-            out[key] = {"error": f"{type(e).__name__}: {e}"}
+        out[key] = _spawn(key, min(per_bench, left))
+
+    if "error" not in out and out.get("value"):
+        _append_perf_log(out)
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--bench":
+        _child(sys.argv[2])
+    else:
+        main()

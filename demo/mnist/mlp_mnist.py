@@ -12,7 +12,7 @@ define_py_data_sources2(
     obj="process")
 
 settings(
-    batch_size=128,
+    batch_size=get_config_arg("batch_size", int, 128),
     learning_rate=0.1 / 128.0,
     learning_method=MomentumOptimizer(momentum=0.9),
     regularization=L2Regularization(5e-4 * 128))

@@ -39,40 +39,46 @@ def _check_sparse_ids(ids: np.ndarray, dim: int, name: str) -> None:
             f"out of range for dim={dim}")
 
 
+def _sparse_row(row, binary: bool):
+    """One sparse row -> (ids, vals): a list of column ids for binary slots,
+    a list of (id, value) pairs for value slots (ref: PyDataProvider2.py
+    sparse_binary_vector vs sparse_vector)."""
+    if binary:
+        ids = np.asarray(row, np.int32)
+        return ids, np.ones(len(row), np.float32)
+    ids = np.asarray([p[0] for p in row], np.int32)
+    vals = np.asarray([p[1] for p in row], np.float32)
+    return ids, vals
+
+
 def make_batch(samples: list, types: list[InputType], names: list[str],
                pad_len: Optional[int] = None) -> dict[str, Argument]:
     """Assemble one padded batch: sample tuples -> {layer_name: Argument}."""
     B = len(samples)
     out: dict[str, Argument] = {}
     for slot, (name, t) in enumerate(zip(names, types)):
-        vals = [s[slot] for s in samples]
+        # samples are tuples aligned with input_types, or dicts keyed by
+        # slot name (ref: PyDataProvider2.cpp also accepts dict yields)
+        vals = [s[name] if isinstance(s, dict) else s[slot] for s in samples]
         if t.seq_type == SeqType.NO_SEQUENCE:
             if t.kind == SlotKind.DENSE:
                 arr = np.asarray(vals, np.float32).reshape(B, t.dim)
                 out[name] = Argument(value=arr)
             elif t.kind == SlotKind.INDEX:
                 out[name] = Argument(ids=np.asarray(vals, np.int32).reshape(B))
-            elif t.kind == SlotKind.SPARSE_BINARY:
-                # sparse row representation: padded [B, K] nonzero ids + a
-                # validity mask — memory ∝ nnz, never ∝ dim (ref:
-                # SparseRowMatrix.h; PyDataProvider2 sparse_binary_vector)
+            else:
+                # sparse row representation: padded [B, K] nonzero ids +
+                # values (1/0 validity for binary slots) — memory ∝ nnz,
+                # never ∝ dim (ref: SparseRowMatrix.h; PyDataProvider2
+                # sparse_binary_vector / sparse_vector)
+                binary = t.kind == SlotKind.SPARSE_BINARY
                 K = _bucket_len(max((len(v) for v in vals), default=1) or 1)
                 ids = np.zeros((B, K), np.int32)
                 w = np.zeros((B, K), np.float32)
                 for i, row in enumerate(vals):
-                    n = len(row)
-                    ids[i, :n] = np.asarray(row, np.int32)
-                    w[i, :n] = 1.0
-                _check_sparse_ids(ids, t.dim, name)
-                out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim)
-            elif t.kind == SlotKind.SPARSE_VALUE:
-                K = _bucket_len(max((len(p) for p in vals), default=1) or 1)
-                ids = np.zeros((B, K), np.int32)
-                w = np.zeros((B, K), np.float32)
-                for i, pairs in enumerate(vals):
-                    for k, (j, v) in enumerate(pairs):
-                        ids[i, k] = j
-                        w[i, k] = v
+                    rid, rv = _sparse_row(row, binary)
+                    ids[i, :len(rid)] = rid
+                    w[i, :len(rid)] = rv
                 _check_sparse_ids(ids, t.dim, name)
                 out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim)
         elif t.seq_type == SeqType.SUB_SEQUENCE:
@@ -102,7 +108,25 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                         arr[i, j, :len(ss)] = np.asarray(ss, np.float32)
                 out[name] = Argument(value=arr, lengths=n_sub, sub_lengths=sub_l)
             else:
-                raise NotImplementedError("sparse sub-sequence slots")
+                # sparse rows per timestep of each subsequence: [B, S, T, K]
+                # ids + values — the same nnz-proportional representation as
+                # the flat-sequence sparse slots, one nesting level deeper
+                # (ref: PyDataProvider2.py sparse_*_sub_sequence)
+                binary = t.kind == SlotKind.SPARSE_BINARY
+                K = _bucket_len(max((len(row) for subs in vals
+                                     for ss in subs for row in ss),
+                                    default=1) or 1)
+                ids = np.zeros((B, S, T, K), np.int32)
+                w = np.zeros((B, S, T, K), np.float32)
+                for i, subs in enumerate(vals):
+                    for j, ss in enumerate(subs):
+                        for k, row in enumerate(ss):
+                            rid, rv = _sparse_row(row, binary)
+                            ids[i, j, k, :len(rid)] = rid
+                            w[i, j, k, :len(rid)] = rv
+                _check_sparse_ids(ids, t.dim, name)
+                out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim,
+                                     lengths=n_sub, sub_lengths=sub_l)
         else:
             lengths = np.asarray([len(v) for v in vals], np.int32)
             T = pad_len or _bucket_len(int(lengths.max()) if B else 1)
@@ -116,23 +140,24 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
                 for i, seq in enumerate(vals):
                     arr[i, :len(seq)] = np.asarray(seq, np.float32)
                 out[name] = Argument(value=arr, lengths=lengths)
-            elif t.kind == SlotKind.SPARSE_BINARY:
-                # per-timestep sparse rows: [B, T, K] ids + validity — same
-                # nnz-proportional representation as the non-sequence slot
-                K = _bucket_len(max((len(ids) for seq in vals for ids in seq),
+            else:
+                # per-timestep sparse rows: [B, T, K] ids + values — same
+                # nnz-proportional representation as the non-sequence slots
+                # (ref: PyDataProvider2.py sparse_binary_vector_sequence /
+                # sparse_vector_sequence)
+                binary = t.kind == SlotKind.SPARSE_BINARY
+                K = _bucket_len(max((len(row) for seq in vals for row in seq),
                                     default=1) or 1)
                 ids = np.zeros((B, T, K), np.int32)
                 w = np.zeros((B, T, K), np.float32)
                 for i, seq in enumerate(vals):
                     for j, row in enumerate(seq):
-                        n = len(row)
-                        ids[i, j, :n] = np.asarray(row, np.int32)
-                        w[i, j, :n] = 1.0
+                        rid, rv = _sparse_row(row, binary)
+                        ids[i, j, :len(rid)] = rid
+                        w[i, j, :len(rid)] = rv
                 _check_sparse_ids(ids, t.dim, name)
                 out[name] = Argument(ids=ids, sparse_vals=w, sparse_dim=t.dim,
                                      lengths=lengths)
-            else:
-                raise NotImplementedError("sparse_value sequences")
     return out
 
 
@@ -177,7 +202,8 @@ class DataFeeder:
     def _sample_sort_key(self, s) -> int:
         for slot, t in enumerate(self.types):
             if t.seq_type != SeqType.NO_SEQUENCE:
-                return len(s[slot])
+                return len(s[self.names[slot]] if isinstance(s, dict)
+                           else s[slot])
         return 0
 
     def batches(self) -> Iterator[dict[str, Argument]]:

@@ -77,6 +77,18 @@ def integer_value_sub_sequence(value_range: int) -> InputType:
     return InputType(value_range, SlotKind.INDEX, SeqType.SUB_SEQUENCE)
 
 
+def dense_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.DENSE, SeqType.SUB_SEQUENCE)
+
+
+def sparse_binary_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_BINARY, SeqType.SUB_SEQUENCE)
+
+
+def sparse_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_VALUE, SeqType.SUB_SEQUENCE)
+
+
 class CacheType(enum.Enum):
     """(ref: PyDataProvider2.py CacheType)."""
 

@@ -1,0 +1,48 @@
+"""N-device vs 1-device training-equivalence oracle.
+
+The reference asserts that multi-trainer / remote-updater training produces
+IDENTICAL final parameters to local training (ref: paddle/trainer/tests/
+test_CompareSparse.cpp:133-152, test_TrainerOnePass.cpp:123-291).  This is
+the shared implementation behind tests/test_dp_parity.py and the driver's
+dryrun_multichip phase 3b — one source of truth for the tolerances.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+LOSS_RTOL, LOSS_ATOL = 2e-4, 1e-6
+PARAM_RTOL, PARAM_ATOL = 3e-4, 2e-5
+
+
+def train_for_parity(config, batches, mesh, seed: int = 1):
+    """Train one Trainer over `batches`; return (losses, host params)."""
+    from paddle_tpu.trainer.trainer import Trainer
+
+    tr = Trainer(config, seed=seed, mesh=mesh)
+    losses = [float(tr.train_one_batch(b)) for b in batches]
+    params = {k: np.asarray(jax.device_get(v)) for k, v in tr.params.items()}
+    return np.asarray(losses), params
+
+
+def assert_dp_parity(config, batches, mesh, seed: int = 1,
+                     config2: Optional[object] = None) -> None:
+    """Train the same config+seed+batches on `mesh` and on one device; the
+    loss trajectories and final parameters must match.  `config2` supplies a
+    distinct (identically-parsed) config object when the caller's configs
+    are not safely reusable across Trainer instances."""
+    l1, p1 = train_for_parity(config, batches, None, seed)
+    ln, pn = train_for_parity(config2 if config2 is not None else config,
+                              batches, mesh, seed)
+    assert np.isfinite(l1).all() and np.isfinite(ln).all()
+    np.testing.assert_allclose(
+        ln, l1, rtol=LOSS_RTOL, atol=LOSS_ATOL,
+        err_msg="dp loss trajectory diverged from dp=1")
+    assert p1.keys() == pn.keys()
+    for name in p1:
+        np.testing.assert_allclose(
+            pn[name], p1[name], rtol=PARAM_RTOL, atol=PARAM_ATOL,
+            err_msg=f"final parameter {name!r} diverged under dp")

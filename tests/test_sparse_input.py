@@ -139,6 +139,129 @@ classification_cost(input=prob, label=data_layer(name="label", size=2))
         os.remove(path)
 
 
+def test_sparse_value_sequence_matches_dense():
+    """sparse_vector_sequence packs per-timestep (id, value) rows as
+    [B, T, K] with memory ∝ nnz; fc over it == fc over the dense sequence,
+    and the gradient scatter-adds into only the touched rows."""
+    from paddle_tpu.data.provider import sparse_vector_sequence
+    from paddle_tpu.graph.layers_core import _input_matmul
+
+    rng = np.random.default_rng(2)
+    dim, dout = 128, 4
+    w = rng.normal(size=(dim, dout)).astype(np.float32)
+    seqs = [
+        [[(1, 0.5), (7, -2.0)], [(0, 3.0)]],             # len 2
+        [[(10, 1.5)], [(11, -1.0), (12, 2.0)], [(127, 4.0)]],  # len 3
+    ]
+    b = make_batch([(s, 0) for s in seqs],
+                   [sparse_vector_sequence(dim), integer_value(2)],
+                   ["feats", "label"])
+    arg = b["feats"]
+    B, T, K = arg.ids.shape
+    assert (B, K) == (2, 8) and arg.sparse_dim == dim and arg.value is None
+    np.testing.assert_array_equal(np.asarray(arg.lengths), [2, 3])
+
+    dense = np.zeros((B, T, dim), np.float32)
+    for i, s in enumerate(seqs):
+        for j, row in enumerate(s):
+            for c, v in row:
+                dense[i, j, c] = v
+    np.testing.assert_allclose(np.asarray(_input_matmul(arg, w)),
+                               dense @ w, rtol=1e-5, atol=1e-6)
+
+    g = np.asarray(jax.grad(lambda p: _input_matmul(arg, p).sum())(w))
+    gd = np.asarray(jax.grad(lambda p: jnp_matmul_sum(dense, p))(w))
+    np.testing.assert_allclose(g, gd, rtol=1e-5, atol=1e-6)
+    touched = set(np.flatnonzero(np.abs(g).sum(-1)).tolist())
+    assert touched == {1, 7, 0, 10, 11, 12, 127}
+
+
+def jnp_matmul_sum(x, p):
+    import jax.numpy as jnp
+    return jnp.matmul(x, p).sum()
+
+
+def test_sparse_subsequence_slots_match_dense():
+    """sparse_{binary,}_vector_sub_sequence pack as [B, S, T, K] ids+vals
+    with lengths (#subseqs) and sub_lengths (tokens per subseq); fc over
+    them == fc over the dense [B, S, T, dim] oracle (ref:
+    PyDataProvider2.py:57-107 — the full input-type × sequence-level
+    matrix)."""
+    from paddle_tpu.data.provider import (
+        sparse_binary_vector_sub_sequence, sparse_vector_sub_sequence)
+    from paddle_tpu.graph.layers_core import _input_matmul
+
+    rng = np.random.default_rng(3)
+    dim, dout = 96, 3
+    w = rng.normal(size=(dim, dout)).astype(np.float32)
+
+    # binary: doc = list of sentences, sentence = list of sparse rows
+    docs = [
+        [[[1, 5], [7]], [[2, 3, 95]]],          # 2 subseqs, lens 2/1
+        [[[0]]],                                # 1 subseq, len 1
+    ]
+    b = make_batch([(d, 0) for d in docs],
+                   [sparse_binary_vector_sub_sequence(dim), integer_value(2)],
+                   ["feats", "label"])
+    arg = b["feats"]
+    B, S, T, K = arg.ids.shape
+    assert arg.sparse_dim == dim and arg.value is None
+    np.testing.assert_array_equal(np.asarray(arg.lengths), [2, 1])
+    assert np.asarray(arg.sub_lengths)[0, 0] == 2
+    dense = np.zeros((B, S, T, dim), np.float32)
+    for i, d in enumerate(docs):
+        for j, ss in enumerate(d):
+            for k, row in enumerate(ss):
+                dense[i, j, k, row] = 1.0
+    np.testing.assert_allclose(np.asarray(_input_matmul(arg, w)),
+                               dense @ w, rtol=1e-5, atol=1e-6)
+
+    # weighted variant + gradient parity with the dense oracle
+    docsv = [
+        [[[(1, 0.5)], [(7, -2.0), (8, 1.0)]]],
+        [[[(0, 3.0)]], [[(90, 1.0)], [(91, -1.0)]]],
+    ]
+    argv = make_batch([(d, 0) for d in docsv],
+                      [sparse_vector_sub_sequence(dim), integer_value(2)],
+                      ["feats", "label"])["feats"]
+    B, S, T, K = argv.ids.shape
+    densev = np.zeros((B, S, T, dim), np.float32)
+    for i, d in enumerate(docsv):
+        for j, ss in enumerate(d):
+            for k, row in enumerate(ss):
+                for c, v in row:
+                    densev[i, j, k, c] = v
+    np.testing.assert_allclose(np.asarray(_input_matmul(argv, w)),
+                               densev @ w, rtol=1e-5, atol=1e-6)
+    g = np.asarray(jax.grad(lambda p: _input_matmul(argv, p).sum())(w))
+    gd = np.asarray(jax.grad(lambda p: jnp_matmul_sum(densev, p))(w))
+    np.testing.assert_allclose(g, gd, rtol=1e-5, atol=1e-6)
+    # to_dense escape hatch round-trips the nested layout
+    np.testing.assert_allclose(np.asarray(argv.to_dense().value), densev,
+                               rtol=1e-6)
+
+
+def test_dict_samples_match_tuple_samples():
+    """Providers may yield dict samples keyed by slot name instead of
+    aligned tuples (ref: PyDataProvider2.cpp dict-yield support); both
+    forms must assemble identical batches."""
+    from paddle_tpu.data.provider import sparse_vector_sequence
+
+    dim = 32
+    seqs = [[[(1, 0.5)], [(2, -1.0), (3, 2.0)]], [[(0, 1.0)]]]
+    labels = [0, 1]
+    types = [sparse_vector_sequence(dim), integer_value(2)]
+    names = ["feats", "label"]
+    bt = make_batch(list(zip(seqs, labels)), types, names)
+    bd = make_batch([{"feats": s, "label": l} for s, l in zip(seqs, labels)],
+                    types, names)
+    for k in bt:
+        for f in ("ids", "sparse_vals", "lengths"):
+            a, b = getattr(bt[k], f), getattr(bd[k], f)
+            if a is not None:
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_quick_start_lr_at_100k_vocab():
     """The quick_start LR shape trains at dict_dim=200k: memory ∝ nnz."""
     from paddle_tpu.config.parser import parse_config
