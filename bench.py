@@ -189,6 +189,7 @@ def bench_seq2seq(dtype: str) -> dict:
         "value": round(train_sps, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": _baseline_ratio(train_sps, "wmt14_seq2seq"),
+        "mfu": round(_step_mfu(tr, batches[0], train_sps, batch_size, dtype), 4),
         "beam_decode_tokens_per_sec": round(decode_tps, 2),
     }
 

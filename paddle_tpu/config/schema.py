@@ -426,6 +426,9 @@ class OptimizationConfig(_Serializable):
     # TPU additions
     dtype: str = "float32"              # param dtype
     compute_dtype: str = ""             # '' = same as dtype; 'bfloat16' for MXU speed
+    # GPipe microbatches per batch for config-driven pipeline parallelism
+    # (layers annotated device=N); 0 = one microbatch per pipeline stage
+    pipeline_micro_batches: int = 0
 
 
 @_schema

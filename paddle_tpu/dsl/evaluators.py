@@ -13,7 +13,8 @@ __all__ = [
     "chunk_evaluator", "ctc_error_evaluator", "value_printer_evaluator",
     "rank_auc_evaluator", "seq_classification_error_evaluator",
     "maxid_printer_evaluator", "seqtext_printer_evaluator",
-    "classification_error_printer_evaluator",
+    "classification_error_printer_evaluator", "gradient_printer_evaluator",
+    "maxframe_printer_evaluator",
 ]
 
 
@@ -119,3 +120,16 @@ def classification_error_printer_evaluator(input: LayerOutput,
                                            label: LayerOutput,
                                            name=None) -> None:
     _add("classification_error_printer", [input, label], name)
+
+
+def gradient_printer_evaluator(input: LayerOutput, name=None) -> None:
+    """Print the layer's OUTPUT GRADIENT each batch (ref: Evaluator.cpp
+    GradientPrinter).  The trainer recreates the grad buffer autodiff
+    elides via an additive-zero probe at the layer."""
+    _add("gradient_printer", [input], name)
+
+
+def maxframe_printer_evaluator(input: LayerOutput, name=None) -> None:
+    """Print each sequence's value-maximizing frame (ref: Evaluator.cpp
+    MaxFramePrinter)."""
+    _add("max_frame_printer", [input], name)

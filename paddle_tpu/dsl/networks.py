@@ -246,16 +246,42 @@ def simple_attention(encoded_sequence: LayerOutput,
                      decoder_state: LayerOutput,
                      transform_param_attr=None,
                      softmax_param_attr=None,
-                     name: Optional[str] = None) -> LayerOutput:
+                     name: Optional[str] = None,
+                     fused: bool = True) -> LayerOutput:
     """Bahdanau-style additive attention (ref: networks.py simple_attention:1257).
 
     Must be called inside a recurrent_group step; encoded_sequence/encoded_proj
     are StaticInput aliases holding [B, T, D] sequences; decoder_state is a
     per-step [B, D] memory.  Returns the context vector [B, D].
+
+    fused=True (default) emits ONE additive_attention_step layer — same
+    math and the same two parameters (identical names, shapes and creation
+    order, so seeded init and checkpoints match the composite) but executed
+    as a single fused pass (pallas kernel on TPU; graph/layers_attn.py).
+    fused=False builds the reference's 5-layer composite.
     """
-    from paddle_tpu.dsl.layers import addto_layer, scaling_layer
+    from paddle_tpu.config.schema import LayerConfig, LayerInput
+    from paddle_tpu.dsl.layers import _make_param, addto_layer, scaling_layer
     from paddle_tpu.dsl.poolings import SumPooling
     name = name or current_context().unique_name("attention")
+    if fused:
+        w_name = _make_param(f"{name}_transform", 0,
+                             [decoder_state.size, encoded_proj.size],
+                             transform_param_attr)
+        v_name = _make_param(f"{name}_scores", 0, [encoded_proj.size, 1],
+                             softmax_param_attr)
+        cfg = LayerConfig(name=name, type="additive_attention_step",
+                          size=encoded_sequence.size)
+        cfg.inputs.append(LayerInput(input_layer_name=decoder_state.name,
+                                     input_parameter_name=w_name))
+        cfg.inputs.append(LayerInput(input_layer_name=encoded_proj.name,
+                                     input_parameter_name=v_name))
+        cfg.inputs.append(LayerInput(input_layer_name=encoded_sequence.name))
+        current_context().add_layer(cfg)
+        return LayerOutput(name, "additive_attention_step",
+                           encoded_sequence.size,
+                           parents=[decoder_state, encoded_proj,
+                                    encoded_sequence])
     with mixed_layer(name=f"{name}_transform", size=encoded_proj.size,
                      act=LinearActivation(), bias_attr=False) as proj_state:
         proj_state += full_matrix_projection(decoder_state, size=encoded_proj.size,

@@ -121,15 +121,10 @@ class GraphExecutor:
         return {p.name for p in self.model.parameters if p.is_static}
 
     # -- forward ----------------------------------------------------------
-    def forward(
-        self,
-        params: dict[str, Array],
-        feed: dict[str, Argument],
-        state: Optional[dict[str, Any]] = None,
-        mode: str = TRAIN,
-        rng: Optional[jax.Array] = None,
-    ) -> tuple[dict[str, Argument], dict[str, Array], dict[str, Any]]:
-        """Run the graph. Returns (layer outputs, per-sample costs, new state)."""
+    def prepare(self, params: dict[str, Array], feed: dict[str, Argument]):
+        """Pre-forward transforms shared by every execution path (plain
+        forward and the pipeline executor): stop_gradient on static
+        parameters, and the mixed-precision cast of float params/inputs."""
         static = self.static_param_names
         if static:
             params = {k: (jax.lax.stop_gradient(v) if k in static else v)
@@ -146,6 +141,26 @@ class GraphExecutor:
                     arg = arg.replace(sparse_vals=arg.sparse_vals.astype(dt))
                 return arg
             feed = {name: _cast(arg) for name, arg in feed.items()}
+        return params, feed
+
+    def forward(
+        self,
+        params: dict[str, Array],
+        feed: dict[str, Argument],
+        state: Optional[dict[str, Any]] = None,
+        mode: str = TRAIN,
+        rng: Optional[jax.Array] = None,
+        probes: Optional[dict[str, Array]] = None,
+    ) -> tuple[dict[str, Argument], dict[str, Array], dict[str, Any]]:
+        """Run the graph. Returns (layer outputs, per-sample costs, new state).
+
+        `probes` maps layer names to zero arrays added to those layers'
+        outputs: grad of the loss w.r.t. a probe IS that layer's output
+        gradient — how the gradient_printer evaluator observes what the
+        reference reads from Layer::getOutputGrad() (ref: Evaluator.cpp
+        GradientPrinter; hand-written backward buffers replaced by autodiff).
+        """
+        params, feed = self.prepare(params, feed)
         ctx = ForwardContext(
             model=self.model, params=params, mode=mode, rng=rng,
             state_in=state or {}, mesh=self.mesh,
@@ -159,7 +174,10 @@ class GraphExecutor:
                     # depends on a generator group's output — only produced by
                     # generate(); skip in plain forward
                     continue
-                ctx.outputs[cfg.name] = get_layer_fn(cfg.type)(ctx, cfg)
+                out = get_layer_fn(cfg.type)(ctx, cfg)
+                if probes and cfg.name in probes and out.value is not None:
+                    out = out.replace(value=out.value + probes[cfg.name])
+                ctx.outputs[cfg.name] = out
             else:
                 sm: SubModelConfig = item
                 if sm.generator is not None and not sm.in_links:
@@ -174,11 +192,13 @@ class GraphExecutor:
         state: Optional[dict[str, Any]] = None,
         mode: str = TRAIN,
         rng: Optional[jax.Array] = None,
+        probes: Optional[dict[str, Array]] = None,
     ) -> tuple[Array, tuple[dict[str, Argument], dict[str, Array], dict[str, Any]]]:
         """Mean summed cost over the batch (ref: Argument::sumCosts / the
         reference divides by batch size at the updater via batch_size scaling —
         here the loss is per-sample mean, and the optimizer LR semantics match)."""
-        outputs, costs, new_state = self.forward(params, feed, state, mode, rng)
+        outputs, costs, new_state = self.forward(params, feed, state, mode, rng,
+                                                 probes)
         assert costs, "model has no cost layers"
         from paddle_tpu.utils.dtypes import promote_compute
         total = None

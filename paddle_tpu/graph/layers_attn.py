@@ -101,3 +101,32 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
         use_rope=bool(cfg.attrs.get("use_rope", False)),
         rope_theta=float(cfg.attrs.get("rope_theta", 10000.0)))
     return finish_layer(ctx, cfg, out, like=q_arg)
+
+
+@register_layer("additive_attention_step")
+def additive_attention_step_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """One fused Bahdanau attention step inside a decoder scan (the
+    reference's simple_attention composite collapsed into a single layer —
+    ref: networks.py:1257 fc/expand/addto/sequence-softmax/scaling/pool).
+
+    inputs: [decoder_state [B,Ds] (carries W [Ds,D]),
+             encoded_proj [B,T,D] static link (carries v [D,1]),
+             encoded_sequence [B,T,Dv] static link];
+    output: context [B, Dv].
+    """
+    dec = ctx.get_input(cfg, 0)
+    proj = ctx.get_input(cfg, 1)
+    seq = ctx.get_input(cfg, 2)
+    w = ctx.param_of(cfg, 0)
+    v = ctx.param_of(cfg, 1)
+    mask = proj.mask() if proj.lengths is not None else (
+        seq.mask() if seq.lengths is not None else None)
+
+    from paddle_tpu.ops.attention import additive_attention_step
+    from paddle_tpu.ops import pallas_additive
+    fn = additive_attention_step
+    if pallas_additive.supported() and \
+            str(cfg.attrs.get("attn_impl", "auto")) != "dense":
+        fn = pallas_additive.additive_attention_step
+    out = fn(dec.value, w, v.reshape(-1), proj.value, seq.value, mask)
+    return finish_layer(ctx, cfg, out, like=dec)

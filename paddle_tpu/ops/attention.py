@@ -365,3 +365,32 @@ def multi_head_attention(
     if bias_o is not None:
         out = out + bias_o
     return out
+
+
+def additive_attention_step(
+    dec_state: Array,      # [B, Ds] decoder state for THIS timestep
+    w: Array,              # [Ds, D] state transform
+    v: Array,              # [D] scoring vector
+    enc_proj: Array,       # [B, T, D] pre-projected encoder states
+    enc_seq: Array,        # [B, T, Dv] encoder values
+    mask: Optional[Array] = None,   # [B, T] validity
+) -> Array:
+    """One Bahdanau additive-attention step, fused (ref: the reference's
+    simple_attention 5-layer composite — networks.py:1257: fc + expand +
+    addto/tanh + sequence-softmax + scaling + seq-pool).
+
+    Single expression so XLA fuses score computation, masking, softmax and
+    the context reduction into one pass over [B, T, D] instead of
+    materializing each composite layer's [B, T, D] intermediate — inside
+    the decoder scan this is the bandwidth-bound hot path (PERF.md: seq2seq
+    gains need fewer bytes/step, not fewer flops).  Returns [B, Dv].
+    """
+    from paddle_tpu.utils.dtypes import promote_compute
+
+    s = jnp.einsum("btd,d->bt",
+                   jnp.tanh(enc_proj + (dec_state @ w)[:, None, :]), v)
+    s = promote_compute(s)                      # fp32 softmax
+    if mask is not None:
+        s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
+    alpha = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bt,btd->bd", alpha.astype(enc_seq.dtype), enc_seq)

@@ -1,0 +1,202 @@
+"""Fused Bahdanau additive-attention step in Pallas (TPU).
+
+The seq2seq decoder's per-timestep hot path (ref: the reference's
+simple_attention composite, networks.py:1257) is bandwidth-bound inside the
+training scan (PERF.md: prefix-hoisting LOST 13% — the win is fewer
+bytes/step, not fewer flops).  XLA already fuses the single-expression
+formulation (ops/attention.py:additive_attention_step) well; this kernel
+goes one step further and keeps the whole [bT, D] tanh/score tile in VMEM:
+
+  grid (B/bB, T/bT), T innermost sequential: per tile compute
+  tanh(enc_proj + u)·v scores, fold them into a running online-softmax
+  (max, sum, context-acc) held in VMEM scratch, and emit context = acc/sum
+  at the last tile.  enc_proj and enc_seq are each read from HBM exactly
+  once; no [B, T, D] intermediate (tanh activations, scores, weights) is
+  ever written back.
+
+Key-validity comes from a [B, 128] broadcast-lengths column (not a [B, T]
+mask): a 2-D mask block would pin the T tile to 128 lanes, padding T=30
+decoder benches 4x; with lengths in a fixed 128-lane column the T tile
+only needs sublane alignment (8 fp32 / 16 bf16 — the bf16 minimum follows
+the same rule ADVICE flagged for the flash kernel).
+
+Backward: custom_vjp that recomputes through the jnp reference formulation
+— the step is tiny relative to the decoder GRU, and the training scan
+already remats its whole body, so a hand-written backward kernel would
+only duplicate what jax.vjp emits fused.
+
+The u = dec_state @ w projection stays OUTSIDE the kernel: it is one MXU
+matmul XLA fuses into the surrounding step; the kernel fuses what XLA will
+not — the [B, T, D]-shaped elementwise/softmax/reduce chain.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def supported(backend: Optional[str] = None) -> bool:
+    if os.environ.get("PADDLE_TPU_PALLAS", "1") == "0":
+        return False
+    backend = backend or jax.default_backend()
+    if backend == "tpu":
+        return True
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _kernel(bB, bT, u_ref, v_ref, proj_ref, seq_ref, len_ref,
+            out_ref, m_s, l_s, acc_s):
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(it == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    u = u_ref[...].astype(jnp.float32)                    # [bB, D]
+    h = jnp.tanh(proj_ref[...].astype(jnp.float32) + u[:, None, :])
+    D = h.shape[-1]
+    # [bB*bT, D] @ [D, 1] on the MXU -> scores [bB, bT]
+    s = jax.lax.dot_general(
+        h.reshape(bB * bT, D), v_ref[...].astype(jnp.float32).reshape(D, 1),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(bB, bT)
+    # validity: global t index < length (lengths ride a [bB, 128] column)
+    tpos = it * bT + jax.lax.broadcasted_iota(jnp.int32, (bB, bT), 1)
+    valid = tpos < len_ref[:, :1].astype(jnp.int32)
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev, l_prev = m_s[:, :1], l_s[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)         # [bB, bT]
+    corr = jnp.exp(m_prev - m_new)
+    l_s[:, :1] = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(                              # [bB, 1, Dv]
+        p[:, None, :], seq_ref[...].astype(jnp.float32),
+        (((2,), (1,)), ((0,), (0,))))
+    acc_s[:] = acc_s[:] * corr + pv[:, 0, :]
+    m_s[:, :1] = m_new
+
+    @pl.when(it == nt - 1)
+    def _():
+        l = l_s[:, :1]
+        out_ref[...] = (acc_s[:] / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def _fwd_pallas(u, v, enc_proj, enc_seq, lengths):
+    B, T, D = enc_proj.shape
+    Dv = enc_seq.shape[-1]
+    # bf16 minimum tile is (16, 128); fp32 is (8, 128)
+    sub = 16 if any(a.dtype == jnp.bfloat16
+                    for a in (u, enc_proj, enc_seq)) else 8
+    bB = min(16, _round_up(B, sub))
+    bT = min(512, _round_up(T, sub))
+    Bp, Tp = _round_up(B, bB), _round_up(T, bT)
+    Dp, Dvp = _round_up(D, 128), _round_up(Dv, 128)
+    # zero-padding is inert: padded D columns of u/enc_proj contribute
+    # tanh(0+0)=0 times v's zero pad to the scores; padded T rows are
+    # invalid via lengths; padded Dv columns are sliced off the output
+    u = jnp.pad(u, ((0, Bp - B), (0, Dp - D)))
+    v = jnp.pad(v.reshape(1, -1), ((0, 0), (0, Dp - D)))
+    enc_proj = jnp.pad(enc_proj, ((0, Bp - B), (0, Tp - T), (0, Dp - D)))
+    enc_seq = jnp.pad(enc_seq, ((0, Bp - B), (0, Tp - T), (0, Dvp - Dv)))
+    len_col = jnp.broadcast_to(
+        jnp.pad(lengths.astype(jnp.float32), (0, Bp - B))[:, None], (Bp, 128))
+
+    kernel = functools.partial(_kernel, bB, bT)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Bp // bB, Tp // bT),
+        in_specs=[
+            pl.BlockSpec((bB, Dp), lambda ib, it: (ib, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Dp), lambda ib, it: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bB, bT, Dp), lambda ib, it: (ib, it, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bB, bT, Dvp), lambda ib, it: (ib, it, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bB, 128), lambda ib, it: (ib, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bB, Dvp), lambda ib, it: (ib, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Bp, Dvp), enc_seq.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bB, 128), jnp.float32),   # running max (lane 0)
+            pltpu.VMEM((bB, 128), jnp.float32),   # running sum (lane 0)
+            pltpu.VMEM((bB, Dvp), jnp.float32),   # context accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(u, v, enc_proj, enc_seq, len_col)
+    return out[:B, :Dv]
+
+
+def _reference(dec_state, w, v, enc_proj, enc_seq, lengths):
+    from paddle_tpu.ops.attention import additive_attention_step as ref
+    T = enc_proj.shape[1]
+    mask = jnp.arange(T)[None, :] < lengths.astype(jnp.int32)[:, None]
+    return ref(dec_state, w, v, enc_proj, enc_seq, mask)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _fused(dec_state, w, v, enc_proj, enc_seq, lengths):
+    u = (dec_state @ w).astype(enc_proj.dtype)
+    return _fwd_pallas(u, v, enc_proj, enc_seq, lengths)
+
+
+def _vjp_fwd(dec_state, w, v, enc_proj, enc_seq, lengths):
+    out = _fused(dec_state, w, v, enc_proj, enc_seq, lengths)
+    return out, (dec_state, w, v, enc_proj, enc_seq, lengths)
+
+
+def _vjp_bwd(res, g):
+    dec_state, w, v, enc_proj, enc_seq, lengths = res
+    _, vjp = jax.vjp(_reference, dec_state, w, v, enc_proj, enc_seq,
+                     lengths)
+    d_dec, d_w, d_v, d_proj, d_seq, _ = vjp(g)
+    return d_dec, d_w, d_v, d_proj, d_seq, jnp.zeros_like(lengths)
+
+
+_fused.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def additive_attention_step(
+    dec_state: Array,
+    w: Array,
+    v: Array,
+    enc_proj: Array,
+    enc_seq: Array,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Pallas-fused additive attention step; same contract as
+    ops/attention.py:additive_attention_step."""
+    B, T, _ = enc_proj.shape
+    if mask is None:
+        lengths = jnp.full((B,), T, jnp.float32)
+    else:
+        lengths = jnp.sum(mask.astype(jnp.float32), axis=-1)
+    return _fused(dec_state, w, v, enc_proj, enc_seq, lengths)

@@ -53,6 +53,12 @@ def _unflatten_dicts(flat: dict[str, np.ndarray]) -> dict:
 
 
 def pass_dir(save_dir: str, pass_id: int) -> str:
+    """pass_id < 0 = a snapshot taken BEFORE the first pass completed: it
+    gets its own label so it can never collide with (or shadow) the real
+    end-of-pass-0 `pass-00000` snapshot, and resuming from it does not skip
+    training pass 0."""
+    if pass_id < 0:
+        return os.path.join(save_dir, "pass-init")
     return os.path.join(save_dir, f"pass-{pass_id:05d}")
 
 
@@ -84,9 +90,12 @@ def save_checkpoint(
 
 
 def _delete_old(save_dir: str, keep_last: int) -> None:
-    """(ref: ParamUtil::deleteParameters keeps save_only_one / latest)."""
+    """(ref: ParamUtil::deleteParameters keeps save_only_one / latest).
+    The pre-training pass-init snapshot counts as the oldest."""
     dirs = sorted(
         (m.group(0) for m in (re.match(r"pass-\d{5}$", x) for x in os.listdir(save_dir)) if m))
+    if os.path.isdir(os.path.join(save_dir, "pass-init")):
+        dirs.insert(0, "pass-init")
     for old in dirs[:-keep_last]:
         shutil.rmtree(os.path.join(save_dir, old), ignore_errors=True)
 
@@ -104,6 +113,9 @@ def load_checkpoint(path: str) -> dict[str, Any]:
                 # given the save_dir root, resume from its newest pass
                 # (ref: ParamUtil --start_pass resume semantics)
                 npz = os.path.join(path, f"pass-{lp:05d}", "model.npz")
+            elif os.path.exists(os.path.join(path, "pass-init", "model.npz")):
+                # only a pre-training snapshot exists: resume from it
+                npz = os.path.join(path, "pass-init", "model.npz")
     data = np.load(npz, allow_pickle=False)
     flat = {k: data[k] for k in data.files}
     trees: dict[str, dict] = {"params": {}, "opt": {}, "net": {}}
@@ -112,11 +124,15 @@ def load_checkpoint(path: str) -> dict[str, Any]:
                if k.startswith(prefix + SEP)}
         trees[prefix] = _unflatten_dicts(sub)
     out: dict[str, Any] = dict(trees)
-    m = re.match(r"pass-(\d{5})$", os.path.basename(os.path.dirname(npz)))
+    base = os.path.basename(os.path.dirname(npz))
+    m = re.match(r"pass-(\d{5})$", base)
     if m:
         # which pass this snapshot belongs to, so a resumed Trainer can
         # continue the numbering instead of re-saving from pass-00000
         out["pass_id"] = int(m.group(1))
+    elif base == "pass-init":
+        # pre-training snapshot: the resumed run starts at pass 0
+        out["pass_id"] = -1
     cfg_path = os.path.join(os.path.dirname(npz), "trainer_config.json")
     if os.path.exists(cfg_path):
         out["config_json"] = open(cfg_path).read()

@@ -564,6 +564,37 @@ register_host_evaluator("classification_error_printer")(
     _make_printer(_cls_err_print))
 
 
+def _max_frame_print(cfg, args):
+    """Per sequence, print the value-maximizing frame and its index
+    (ref: Evaluator.cpp MaxFramePrinter — selects each sequence's frame
+    with the maximal output value)."""
+    a = args[0]
+    v = np.asarray(a.value)
+    if v.ndim == 2:
+        v = v[:, None, :]               # [B, 1, D]: non-sequence = 1 frame
+    lengths = np.asarray(a.lengths) if a.lengths is not None else None
+    lines = []
+    for b in range(v.shape[0]):
+        L = int(lengths[b]) if lengths is not None else v.shape[1]
+        frames = v[b, :max(L, 1)]
+        t = int(np.argmax(frames.max(axis=-1)))
+        lines.append(f"seq {b}: frame {t} "
+                     f"{np.array2string(frames[t], threshold=10)}")
+    return "; ".join(lines[:8])
+
+
+register_host_evaluator("max_frame_printer")(_make_printer(_max_frame_print))
+
+# gradient_printer: prints the probed layer's OUTPUT GRADIENT, delivered by
+# the trainer as a __grad__<layer> Argument computed via an additive-zero
+# probe (ref: Evaluator.cpp GradientPrinter reads Layer::getOutputGrad() —
+# autodiff has no per-layer grad buffers, so the probe recreates them on
+# demand for exactly the printed layers).
+register_host_evaluator("gradient_printer")(_make_printer(
+    lambda cfg, args: " ".join(np.array2string(np.asarray(a.data), threshold=20)
+                               for a in args)))
+
+
 # -- driver -----------------------------------------------------------------
 
 class EvaluatorSet:
@@ -574,13 +605,26 @@ class EvaluatorSet:
         self.configs = [e for e in model.evaluators if e.type in evaluator_registry]
         self.host_configs = [e for e in model.evaluators
                              if e.type in host_evaluator_registry]
+        # True = silently skip evaluators whose input layers are absent
+        # from the step outputs (the Trainer sets this under pipeline
+        # parallelism, where stage-internal activations never surface);
+        # False (default) = a missing layer is a loud config error
+        self.allow_missing = False
+
+    @staticmethod
+    def _host_keys(cfg: EvaluatorConfig) -> list[str]:
+        """Output-dict keys one host evaluator consumes: layer names, or the
+        trainer-provided __grad__<layer> probe results for gradient_printer."""
+        if cfg.type == "gradient_printer":
+            return ["__grad__" + n for n in cfg.input_layer_names]
+        return list(cfg.input_layer_names)
 
     @property
     def host_layer_names(self) -> list[str]:
-        """Layers whose outputs host evaluators need fetched each batch."""
+        """Keys host evaluators need fetched from the step outputs each batch."""
         names: list[str] = []
         for cfg in self.host_configs:
-            for n in cfg.input_layer_names:
+            for n in self._host_keys(cfg):
                 if n not in names:
                     names.append(n)
         return names
@@ -591,9 +635,18 @@ class EvaluatorSet:
 
     def host_update(self, host_state: dict, outputs: dict[str, Argument]) -> None:
         """Feed one batch's (host-resident) outputs to every host evaluator."""
-        cache = {n: _np_arg(outputs[n]) for n in self.host_layer_names}
+        cache = {n: _np_arg(outputs[n]) for n in self.host_layer_names
+                 if n in outputs}
         for cfg in self.host_configs:
-            args = [cache[n] for n in cfg.input_layer_names]
+            keys = self._host_keys(cfg)
+            missing = [n for n in keys if n not in cache]
+            if missing:
+                if self.allow_missing:
+                    continue   # stage-internal under pipeline parallelism
+                raise KeyError(
+                    f"host evaluator {cfg.name!r} ({cfg.type}) references "
+                    f"{missing} absent from the step outputs")
+            args = [cache[n] for n in keys]
             host_evaluator_registry[cfg.type][1](cfg, args, host_state[cfg.name])
 
     def finalize_host(self, host_state: dict) -> dict[str, float]:
@@ -606,9 +659,23 @@ class EvaluatorSet:
         return out
 
     def batch_partials(self, outputs, feed) -> dict[str, dict]:
-        """Called inside jit: returns {evaluator_name: partials}."""
+        """Called inside jit: returns {evaluator_name: partials}.
+
+        When `allow_missing` is set (the Trainer sets it under pipeline
+        parallelism, where intermediate activations never materialize
+        outside their stage), an evaluator whose input layers are
+        unavailable is skipped; on the plain path a missing layer is a
+        config error and fails loudly."""
         res = {}
         for cfg in self.configs:
+            missing = [n for n in cfg.input_layer_names
+                       if n not in outputs and n not in feed]
+            if missing:
+                if self.allow_missing:
+                    continue
+                raise KeyError(
+                    f"evaluator {cfg.name!r} ({cfg.type}) references "
+                    f"layer(s) {missing} absent from the forward outputs")
             batch_fn, _ = evaluator_registry[cfg.type]
             res[cfg.name] = batch_fn(cfg, outputs, feed)
         return res

@@ -81,11 +81,26 @@ class Trainer:
         self.config = config
         self.model = config.model_config
         self.opt = config.opt_config
-        self.executor = GraphExecutor(
-            self.model, mesh=mesh,
-            compute_dtype=FLAGS.compute_dtype or self.opt.compute_dtype)
+        cdt = FLAGS.compute_dtype or self.opt.compute_dtype
+        from paddle_tpu.parallel.mesh import PIPE_AXIS, axis_size
+        if mesh is not None and axis_size(mesh, PIPE_AXIS) > 1 \
+                and any(l.device >= 0 for l in self.model.layers):
+            # config-driven pipeline parallelism: device=N layer annotations
+            # map onto pipe-axis stages (ref: ParallelNeuralNetwork.h:35-70)
+            from paddle_tpu.parallel.pipeline_config import PipelineExecutor
+            self.executor = PipelineExecutor(
+                self.model, mesh,
+                n_micro=self.opt.pipeline_micro_batches, compute_dtype=cdt)
+        else:
+            self.executor = GraphExecutor(self.model, mesh=mesh,
+                                          compute_dtype=cdt)
         self.updater = ParameterUpdater(self.model, self.opt)
         self.evaluators = EvaluatorSet(self.model)
+        # under pipeline parallelism stage-internal activations never
+        # surface, so evaluators referencing them are skipped rather than
+        # failing; the plain path keeps missing layers a loud error
+        self.evaluators.allow_missing = not isinstance(self.executor,
+                                                       GraphExecutor)
         self.seed = seed
         self.mesh = mesh
         self.rng = jax.random.PRNGKey(seed)
@@ -122,15 +137,71 @@ class Trainer:
                              if l.type == "data"}
 
     # -- compiled steps ---------------------------------------------------
+    @property
+    def _probe_names(self) -> list[str]:
+        """Layers whose OUTPUT GRADIENT a gradient_printer evaluator wants
+        (ref: Evaluator.cpp GradientPrinter reads getOutputGrad()); only
+        supported on the plain GraphExecutor path."""
+        if not isinstance(self.executor, GraphExecutor):
+            return []
+        names: list[str] = []
+        for cfg in self.model.evaluators:
+            if cfg.type != "gradient_printer":
+                continue
+            for n in cfg.input_layer_names:
+                # probes are injected by forward()'s root layer loop only —
+                # a silent zero for group-internal layers would masquerade
+                # as a real gradient, so reject loudly
+                if n in self.executor._sub_of:
+                    raise NotImplementedError(
+                        f"gradient_printer on {n!r}: the layer runs inside "
+                        f"recurrent group "
+                        f"{self.executor._sub_of[n].name!r}, where output-"
+                        f"grad probes are not injected — probe a layer "
+                        f"outside the group (e.g. the group's consumer)")
+                if n not in self.executor.layer_map or \
+                        self.executor.layer_map[n].type == "data":
+                    raise ValueError(
+                        f"gradient_printer on {n!r}: not a computed layer")
+                if n not in names:
+                    names.append(n)
+        return names
+
     def _build_train_step_fn(self):
         executor, updater, evaluators = self.executor, self.updater, self.evaluators
+        probe_names = self._probe_names
 
         def train_step(params, opt_state, net_state, batch, rng):
-            def loss_fn(p):
-                loss, aux = executor.loss(p, batch, net_state, TRAIN, rng)
-                return loss, aux
-            (loss, (outputs, costs, new_net)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            if probe_names:
+                # additive zeros at the probed layers: d(loss)/d(probe) is
+                # exactly the layer's output gradient
+                shapes = jax.eval_shape(
+                    lambda p: executor.forward(p, batch, net_state, TRAIN,
+                                               rng)[0], params)
+                for n in probe_names:
+                    assert shapes[n].value is not None, (
+                        f"gradient_printer on {n!r}: the layer's output has "
+                        f"no dense value to probe (ids-only output)")
+                probes = {n: jnp.zeros(shapes[n].value.shape,
+                                       shapes[n].value.dtype)
+                          for n in probe_names}
+
+                def loss_fn(p, pr):
+                    loss, aux = executor.loss(p, batch, net_state, TRAIN, rng,
+                                              probes=pr)
+                    return loss, aux
+                (loss, (outputs, costs, new_net)), (grads, probe_grads) = \
+                    jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                       has_aux=True)(params, probes)
+                outputs = dict(outputs)
+                for n, g in probe_grads.items():
+                    outputs["__grad__" + n] = Argument(value=g)
+            else:
+                def loss_fn(p):
+                    loss, aux = executor.loss(p, batch, net_state, TRAIN, rng)
+                    return loss, aux
+                (loss, (outputs, costs, new_net)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
             if self.mesh is not None:
                 # grads are averaged across data shards by XLA automatically
                 # via sharding propagation; nothing to do here.
@@ -139,7 +210,7 @@ class Trainer:
             new_params, new_opt = updater.step(params, grads, opt_state, bsz)
             partials = evaluators.batch_partials(outputs, batch)
             host_out = {n: outputs[n].flatten_image()
-                        for n in evaluators.host_layer_names}
+                        for n in evaluators.host_layer_names if n in outputs}
             return new_params, new_opt, new_net, loss, partials, host_out
 
         return train_step
@@ -152,7 +223,7 @@ class Trainer:
             loss, (outputs, costs, _) = executor.loss(params, batch, net_state, TEST, rng)
             partials = evaluators.batch_partials(outputs, batch)
             host_out = {n: outputs[n].flatten_image()
-                        for n in evaluators.host_layer_names}
+                        for n in evaluators.host_layer_names if n in outputs}
             return loss, partials, host_out
 
         return test_step
@@ -622,8 +693,12 @@ class Trainer:
         here process 0 under multi-host jax.distributed)."""
         if jax.process_index() != 0:
             return ""
+        # pass_id 0 = nothing completed yet: label the snapshot pass-init
+        # instead of clamping into the pass-00000 slot (which the real
+        # end-of-pass-0 save owns; resuming from a clamped one would
+        # silently skip training pass 0)
         return ckpt.save_checkpoint(
-            save_dir, max(self.pass_id - 1, 0), jax.device_get(self.params),
+            save_dir, self.pass_id - 1, jax.device_get(self.params),
             jax.device_get(self.opt_state), jax.device_get(self.net_state),
             config_json=self.config.to_json(), keep_last=keep_last)
 

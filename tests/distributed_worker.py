@@ -69,6 +69,13 @@ def main() -> int:
     tr._drain_losses()
     print("RESULT pid={} losses={}".format(
         pid, ",".join(f"{l:.10f}" for l in losses)), flush=True)
+    # final parameters, for the single-process equivalence oracle in the
+    # test (ref: test_CompareSparse.cpp — multi-trainer == local training)
+    for name in sorted(tr.params):
+        flat = np.asarray(jax.device_get(tr.params[name])).ravel()
+        print(f"RESULT pid={pid} param {name} "
+              f"sum={flat.sum():.8f} asum={np.abs(flat).sum():.8f}",
+              flush=True)
 
     # barrier stats straggler table exercises process_allgather
     bt = tr.barrier_stat

@@ -68,3 +68,54 @@ def test_checkpoint_roundtrip(tmp_path):
     for name in tr.params:
         np.testing.assert_allclose(np.asarray(tr.params[name]),
                                    np.asarray(tr2.params[name]), rtol=1e-6)
+
+
+def test_pre_pass_save_is_labeled_init(tmp_path):
+    """A save taken BEFORE pass 0 completes must not occupy pass-00000
+    (that slot belongs to the real end-of-pass-0 snapshot), and resuming
+    from it must not skip training pass 0."""
+    cfg = parse_config_callable(mlp_config)
+    tr = Trainer(cfg, seed=7)
+    d0 = tr.save(str(tmp_path))
+    assert d0.endswith("pass-init")
+
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(synth_provider, ["dummy"], ["features", "label"],
+                        batch_size=32, seed=3)
+    tr.train_one_pass(batches=feeder.batches())
+    d1 = tr.save(str(tmp_path))
+    assert d1.endswith("pass-00000"), d1   # no collision with the init save
+
+    # resuming from the init snapshot trains pass 0 — even on a trainer
+    # whose own pass counter had advanced
+    tr2 = Trainer(cfg, seed=99)
+    tr2.pass_id = 5
+    tr2.load(d0)
+    assert tr2.pass_id == 0
+    # resuming from the end-of-pass-0 snapshot trains pass 1
+    tr3 = Trainer(cfg, seed=99)
+    tr3.load(d1)
+    assert tr3.pass_id == 1
+
+
+def test_init_only_save_dir_resumes_and_prunes(tmp_path):
+    """Root-dir resume works when pass-init is the ONLY snapshot, and
+    keep_last treats pass-init as the oldest prunable entry."""
+    import os
+    cfg = parse_config_callable(mlp_config)
+    tr = Trainer(cfg, seed=7)
+    tr.save(str(tmp_path))                       # pass-init only
+    tr2 = Trainer(cfg, seed=99)
+    tr2.load(str(tmp_path))                      # root-dir resume
+    assert tr2.pass_id == 0
+    for name in tr.params:
+        np.testing.assert_allclose(np.asarray(tr.params[name]),
+                                   np.asarray(tr2.params[name]), rtol=1e-6)
+
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(synth_provider, ["dummy"], ["features", "label"],
+                        batch_size=32, seed=3)
+    tr.train_one_pass(batches=feeder.batches())
+    tr.save(str(tmp_path), keep_last=1)          # prunes pass-init
+    entries = sorted(os.listdir(str(tmp_path)))
+    assert entries == ["pass-00000"], entries

@@ -68,3 +68,57 @@ def test_two_process_data_parallel_training():
     l0, l1 = losses_of(outs[0]), losses_of(outs[1])
     assert l0 == l1, f"process losses diverged:\n{l0}\n{l1}"
     assert all("straggler_ok" in o for o in outs)
+
+    # -- single-process equivalence oracle (ref: trainer/tests/
+    #    test_CompareSparse.cpp:133-152 — multi-trainer training must equal
+    #    local training): rebuild the same model/seed in THIS process, feed
+    #    the concatenated global batches, and require the same losses and
+    #    final parameters the workers printed.
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    def conf():
+        from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
+                                    TanhActivation, classification_cost,
+                                    data_layer, fc_layer, settings)
+        settings(batch_size=16, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(momentum=0.9))
+        x = data_layer(name="x", size=16)
+        h = fc_layer(input=x, size=32, act=TanhActivation())
+        out = fc_layer(input=h, size=4, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=4))
+
+    tr = Trainer(parse_config_callable(conf), seed=7, mesh=None)
+    rngs = [np.random.default_rng(100 + i) for i in range(2)]
+    W = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    local_losses = []
+    for _ in range(4):
+        xs, ys = [], []
+        for r in rngs:        # same per-process streams, concatenated
+            x = r.normal(size=(8, 16)).astype(np.float32)
+            xs.append(x)
+            ys.append(np.argmax(x @ W, -1).astype(np.int32))
+        loss = tr.train_one_batch({"x": Argument(value=np.concatenate(xs)),
+                                   "y": Argument(ids=np.concatenate(ys))})
+        local_losses.append(float(loss))
+
+    dist_losses = [float(v) for v in l0.split(",")]
+    np.testing.assert_allclose(dist_losses, local_losses, rtol=2e-4,
+                               atol=1e-6,
+                               err_msg="2-process losses != local training")
+
+    import re as _re
+    import jax as _jax
+    dist_params = {m.group(1): (float(m.group(2)), float(m.group(3)))
+                   for m in _re.finditer(
+                       r"param (\S+) sum=(\S+) asum=(\S+)", outs[0])}
+    assert dist_params, "workers printed no param summaries"
+    for name, v in tr.params.items():
+        flat = np.asarray(_jax.device_get(v)).ravel()
+        s, a = dist_params[name]
+        np.testing.assert_allclose([flat.sum(), np.abs(flat).sum()], [s, a],
+                                   rtol=3e-4, atol=2e-5,
+                                   err_msg=f"param {name!r} != local run")
