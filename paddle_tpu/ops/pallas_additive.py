@@ -164,7 +164,10 @@ def _reference(dec_state, w, v, enc_proj, enc_seq, lengths):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=())
 def _fused(dec_state, w, v, enc_proj, enc_seq, lengths):
-    u = (dec_state @ w).astype(enc_proj.dtype)
+    # keep the (tiny) state projection in fp32 — the kernel folds it into
+    # fp32 scores anyway, and a bf16 round-trip here costs real accuracy
+    # against the reference formulation
+    u = jnp.matmul(dec_state.astype(jnp.float32), w.astype(jnp.float32))
     return _fwd_pallas(u, v, enc_proj, enc_seq, lengths)
 
 

@@ -154,6 +154,56 @@ def test_pipeline_sequence_boundary():
         np.testing.assert_allclose(pp[name], p1[name], rtol=3e-4, atol=2e-5)
 
 
+def test_pipeline_with_recurrent_group_stage():
+    """A recurrent group (LSTM-style scan) whole inside stage 0, classifier
+    on stage 1 — the scan runs inside its stage's lax.switch branch and the
+    pooled sequence output crosses the boundary."""
+    V, T = 20, 6
+
+    def conf():
+        from paddle_tpu.dsl import (
+            ExtraLayerAttribute, MomentumOptimizer, ParamAttr,
+            SoftmaxActivation, TanhActivation, classification_cost,
+            data_layer, embedding_layer, fc_layer, last_seq, memory,
+            recurrent_group, settings,
+        )
+        settings(batch_size=B, learning_rate=0.05,
+                 learning_method=MomentumOptimizer(momentum=0.9),
+                 pipeline_micro_batches=2)
+        w = data_layer(name="word", size=V)
+        emb = embedding_layer(input=w, size=12,
+                              param_attr=ParamAttr(initial_std=0.1,
+                                                   name="emb"))
+
+        def step(y):
+            mem = memory(name="state", size=12)
+            return fc_layer(input=[y, mem], size=12, act=TanhActivation(),
+                            name="state",
+                            layer_attr=ExtraLayerAttribute(device=0))
+
+        rnn = recurrent_group(name="rg", step=step, input=emb)
+        rep = last_seq(input=rnn, layer_attr=ExtraLayerAttribute(device=0))
+        out = fc_layer(input=rep, size=NCLS, act=SoftmaxActivation(),
+                       layer_attr=ExtraLayerAttribute(device=1))
+        classification_cost(input=out,
+                            label=data_layer(name="label", size=NCLS))
+
+    rng = np.random.default_rng(3)
+    batches = []
+    for _ in range(6):
+        batches.append({
+            "word": Argument(ids=rng.integers(0, V, (B, T)).astype(np.int32),
+                             lengths=rng.integers(1, T + 1, B)
+                             .astype(np.int32)),
+            "label": Argument(ids=rng.integers(0, NCLS, B).astype(np.int32)),
+        })
+    l1, p1, _ = _train(conf, None, batches)
+    lp, pp, _ = _train(conf, make_mesh(data=4, pipe=2), batches)
+    np.testing.assert_allclose(lp, l1, rtol=2e-4, atol=1e-6)
+    for name in p1:
+        np.testing.assert_allclose(pp[name], p1[name], rtol=3e-4, atol=2e-5)
+
+
 def test_pipeline_rejects_bad_annotations():
     """Non-contiguous device order fails with a clear message."""
     def conf():

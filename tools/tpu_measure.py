@@ -1,0 +1,126 @@
+"""One-shot TPU measurement session — run the moment the tunnel is up.
+
+The axon TPU tunnel dies unpredictably (it killed the round-2 bench
+record), so every pending on-hardware measurement is queued here in
+priority order, each in its OWN subprocess under a hard timeout with its
+output persisted immediately — a mid-session tunnel death keeps
+everything already measured.  Priorities (VERDICT round 2):
+
+  1. backend health probe
+  2. pallas on-device parity (tools/tpu_parity.py — kernels never ran on
+     real TPU; cheapest, unblocks trusting everything else)
+  3. attention micro-bench across lengths (tools/bench_attention.py) —
+     evidence for the layer auto-selection crossover
+  4. quick bench (vgg + seq2seq) -> PERF_LOG.jsonl snapshot
+  5. full 5-config bench -> PERF_LOG.jsonl snapshot
+
+Results land under MEASURE/<step>.out (+ PERF_LOG.jsonl via bench.py).
+The parent process never imports jax (a wedged tunnel blocks any backend
+init forever).
+
+Usage: python tools/tpu_measure.py [--skip=parity,attn_bench_f32]
+(step names: parity, attn_bench, attn_bench_f32, bench_quick, bench_full)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "MEASURE")
+
+
+def run_step(name: str, argv: list[str], timeout_s: float,
+             env_extra: dict | None = None) -> bool:
+    os.makedirs(OUT, exist_ok=True)
+    path = os.path.join(OUT, f"{name}.out")
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env,
+                            cwd=REPO, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = ""
+        rc = -9
+    dt = time.time() - t0
+    with open(path, "w") as f:
+        f.write(f"# rc={rc} seconds={dt:.1f} argv={argv}\n")
+        f.write(out or "")
+    print(json.dumps({"step": name, "rc": rc, "seconds": round(dt, 1),
+                      "out": path}), flush=True)
+    return rc == 0
+
+
+def health(timeout_s: float = 90) -> bool:
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('HEALTH', d.platform, d.device_kind)")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False
+    ok = "HEALTH tpu" in (p.stdout or "")
+    print(json.dumps({"step": "health", "ok": ok,
+                      "detail": (p.stdout or p.stderr or "")[-200:].strip()}),
+          flush=True)
+    return ok
+
+
+def main() -> int:
+    skip: set[str] = set()
+    args = list(sys.argv[1:])
+    while args:
+        a = args.pop(0)
+        if a.startswith("--skip="):
+            skip |= set(a.split("=", 1)[1].split(","))
+        elif a == "--skip" and args:
+            skip |= set(args.pop(0).split(","))
+    if not health():
+        print(json.dumps({"fatal": "TPU not healthy; nothing run"}))
+        return 1
+
+    py = sys.executable
+    steps = [
+        ("parity", [py, "tools/tpu_parity.py"], 900, {}),
+        ("attn_bench",
+         [py, "tools/bench_attention.py", "--lens", "512,1024,2048,4096,16384",
+          "--iters", "10"], 1500, {}),
+        ("attn_bench_f32",
+         [py, "tools/bench_attention.py", "--lens", "512,1024,4096",
+          "--iters", "10", "--dtype", "float32"], 900, {}),
+        ("bench_quick", [py, "bench.py"], 1500,
+         {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
+        ("bench_full", [py, "bench.py"], 2400,
+         {"BENCH_TIME_BUDGET_S": "2100"}),
+    ]
+    for name, argv, to, env in steps:
+        if name in skip:
+            continue
+        ok = run_step(name, argv, to, env)
+        if not ok and not health(45):
+            # a failed step + dead tunnel: stop burning the remaining
+            # steps' timeouts against a wedged backend (everything
+            # measured so far is already persisted under MEASURE/)
+            print(json.dumps({"fatal": f"tunnel died during {name}"}))
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""On-device pallas parity checks — run on a REAL TPU.
+
+The interpret-mode oracles (tests/test_pallas_attention.py,
+tests/test_additive_attention.py) validate the math; this validates
+mosaic compilation/tiling on hardware for the shapes ADVICE flagged
+(bf16 sublane minimums, short/unaligned sequences).  Prints one JSON
+line per case; exit 0 iff all pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _case(name, fn):
+    try:
+        fn()
+        print(json.dumps({"case": name, "ok": True}), flush=True)
+        return True
+    except Exception as e:
+        print(json.dumps({"case": name, "ok": False,
+                          "error": f"{type(e).__name__}: {str(e)[:200]}"}),
+              flush=True)
+        return False
+
+
+def flash_cases():
+    from paddle_tpu.ops import pallas_attention
+    from paddle_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(0)
+    cases = []
+    #       B, T,    H, D,  dtype,        causal, tol
+    shapes = [
+        (2, 512, 4, 64, jnp.float32, True, 2e-3),
+        (2, 1024, 8, 64, jnp.bfloat16, True, 3e-2),
+        (1, 7, 2, 64, jnp.bfloat16, False, 3e-2),     # T < 16 (bf16 min)
+        (2, 300, 4, 80, jnp.float32, True, 2e-3),     # T,D unaligned
+    ]
+    for i, (B, T, H, D, dt, causal, tol) in enumerate(shapes):
+        def run(B=B, T=T, H=H, D=D, dt=dt, causal=causal, tol=tol):
+            q = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+            k = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+            v = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+            got = jax.jit(lambda q, k, v: pallas_attention.flash_attention(
+                q, k, v, causal=causal))(q, k, v)
+            want = dot_product_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(want, np.float32),
+                rtol=tol, atol=tol)
+            # backward compiles + matches
+            g1 = jax.grad(lambda q: jnp.sum(pallas_attention.flash_attention(
+                q, k, v, causal=causal).astype(jnp.float32)))(q)
+            g2 = jax.grad(lambda q: jnp.sum(dot_product_attention(
+                q, k, v, causal=causal).astype(jnp.float32)))(q)
+            np.testing.assert_allclose(np.asarray(g1, np.float32),
+                                       np.asarray(g2, np.float32),
+                                       rtol=tol * 5, atol=tol * 5)
+        cases.append((f"flash_{i}_B{B}_T{T}_H{H}_D{D}_{jnp.dtype(dt).name}",
+                      run))
+    return cases
+
+
+def additive_cases():
+    from paddle_tpu.ops import pallas_additive
+    from paddle_tpu.ops.attention import additive_attention_step as ref
+
+    rng = np.random.default_rng(1)
+    cases = []
+    shapes = [
+        (64, 30, 512, 512, 512, jnp.bfloat16, 8e-2),  # the seq2seq shape
+        (5, 7, 11, 19, 13, jnp.float32, 2e-4),        # everything unaligned
+        (3, 5, 8, 16, 16, jnp.bfloat16, 8e-2),        # T < 16 bf16
+    ]
+    for i, (B, T, Ds, D, Dv, dt, tol) in enumerate(shapes):
+        def run(B=B, T=T, Ds=Ds, D=D, Dv=Dv, dt=dt, tol=tol):
+            dec = jnp.asarray(rng.normal(size=(B, Ds)), dt)
+            w = jnp.asarray(rng.normal(size=(Ds, D)) * 0.2, dt)
+            v = jnp.asarray(rng.normal(size=(D,)), dt)
+            proj = jnp.asarray(rng.normal(size=(B, T, D)), dt)
+            seq = jnp.asarray(rng.normal(size=(B, T, Dv)), dt)
+            lens = rng.integers(1, T + 1, B).astype(np.int32)
+            mask = jnp.arange(T)[None, :] < jnp.asarray(lens)[:, None]
+            got = jax.jit(pallas_additive.additive_attention_step)(
+                dec, w, v, proj, seq, mask)
+            # oracle in fp32: the kernel keeps everything fp32 internally,
+            # so bf16 cases compare against the fp32 math with a
+            # bf16-rounding tolerance (the bf16-throughout jnp path is the
+            # NOISIER of the two)
+            want = ref(*(a.astype(jnp.float32)
+                         for a in (dec, w, v, proj, seq)), mask)
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(want, np.float32),
+                rtol=tol, atol=tol)
+        cases.append((f"additive_{i}_B{B}_T{T}_{jnp.dtype(dt).name}", run))
+    return cases
+
+
+def main() -> int:
+    dev = jax.devices()[0]
+    print(json.dumps({"platform": dev.platform,
+                      "device_kind": dev.device_kind}), flush=True)
+    ok = True
+    for name, fn in flash_cases() + additive_cases():
+        ok &= _case(name, fn)
+    print(json.dumps({"all_ok": bool(ok)}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
