@@ -1,0 +1,39 @@
+"""MNIST MLP with config-driven pipeline parallelism.
+
+The same model as mlp_mnist.py, annotated with per-layer pipeline stages
+the way the reference places layers on devices (ref: ParallelNeuralNetwork
+`device=N`; trainer_config_helpers device attr).  Train it on a mesh with
+a `pipe` axis:
+
+    from paddle_tpu.parallel.mesh import make_mesh
+    tr = Trainer(parse_config("demo/mnist/mlp_mnist_pp.py", ""),
+                 mesh=make_mesh(data=4, pipe=2))
+
+or via the CLI: --mesh_shape=data:4,pipe:2.  Training is EXACT vs the
+un-pipelined config (tests/test_pipeline_config.py).
+"""
+
+from paddle_tpu.dsl import *
+
+define_py_data_sources2(
+    train_list="demo/mnist/train.list",
+    test_list="demo/mnist/test.list",
+    module="demo.mnist.mnist_provider",
+    obj="process")
+
+settings(
+    batch_size=get_config_arg("batch_size", int, 128),
+    learning_rate=0.1 / 128.0,
+    learning_method=MomentumOptimizer(momentum=0.9),
+    regularization=L2Regularization(5e-4 * 128),
+    pipeline_micro_batches=get_config_arg("micro_batches", int, 4))
+
+img = data_layer(name="pixel", size=784)
+h1 = fc_layer(input=img, size=128, act=TanhActivation(),
+              layer_attr=ExtraLayerAttribute(device=0))
+h2 = fc_layer(input=h1, size=128, act=TanhActivation(),
+              layer_attr=ExtraLayerAttribute(device=1))
+predict = fc_layer(input=h2, size=10, act=SoftmaxActivation(),
+                   layer_attr=ExtraLayerAttribute(device=1))
+label = data_layer(name="label", size=10)
+classification_cost(input=predict, label=label)

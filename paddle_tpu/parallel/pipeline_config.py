@@ -166,9 +166,22 @@ class PipelineExecutor:
         self.inner, self.stages = split_stages(model, self.n_stages)
         self.inner.mesh = None        # stage bodies run mesh-local
         self.inner.compute_dtype = compute_dtype
-        self.compute_dtype = compute_dtype
         self.payload_names = _stage_io(model, self.stages)
         self._spec_cache: dict = {}
+
+    @property
+    def compute_dtype(self) -> str:
+        return self.inner.compute_dtype
+
+    @compute_dtype.setter
+    def compute_dtype(self, value: str) -> None:
+        # checkgrad toggles executor.compute_dtype; the inner executor's
+        # prepare() is what actually applies the cast.  Boundary specs
+        # record traced dtypes, so a dtype change invalidates the cache
+        # (its key is shapes-only).
+        if value != self.inner.compute_dtype:
+            self._spec_cache.clear()
+        self.inner.compute_dtype = value
 
     # -- GraphExecutor surface -------------------------------------------
     def init_params(self, rng):

@@ -112,3 +112,25 @@ def test_model_zoo_classify_runs(capsys):
     classify_main([])
     out = capsys.readouterr().out
     assert "sample 0: label=" in out
+
+
+def test_mlp_mnist_pp_demo_trains_on_pipe_mesh():
+    """The pipeline demo config (device=N annotations) trains on a
+    (data, pipe) mesh through the real provider, and its losses match the
+    un-annotated mlp_mnist.py trained on the same batches."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.pipeline_config import PipelineExecutor
+
+    cfg_pp = parse_config("demo/mnist/mlp_mnist_pp.py",
+                          "batch_size=16,micro_batches=2")
+    tr = Trainer(cfg_pp, seed=0, mesh=make_mesh(data=4, pipe=2))
+    assert isinstance(tr.executor, PipelineExecutor)
+    it = tr.train_batches()
+    batches = [next(it) for _ in range(4)]
+    losses = [float(tr.train_one_batch(b)) for b in batches]
+    assert all(np.isfinite(l) for l in losses), losses
+
+    cfg_ref = parse_config("demo/mnist/mlp_mnist.py", "batch_size=16")
+    tr_ref = Trainer(cfg_ref, seed=0)
+    ref_losses = [float(tr_ref.train_one_batch(b)) for b in batches]
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=1e-6)
