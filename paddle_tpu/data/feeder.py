@@ -175,11 +175,27 @@ class DataFeeder:
         drop_last: bool = True,
         bucket_by_length: bool = True,
         prefetch: int = 2,
+        constant_slots: Optional[list] = None,
     ):
         self.prov = prov
         self.file_list = file_list
         names = prov.input_names
         self.names = names if names else input_names
+        # constant slots fill the model input names AFTER the provider's
+        # slots, each a [B, 1] fixed value (ref: DataProvider.cpp:177-195)
+        self.constant_slots = list(constant_slots or [])
+        if self.constant_slots:
+            if names:          # dict-style provider: names are declared
+                extra = [n for n in input_names if n not in names]
+            else:              # list-style: provider fills the first slots
+                extra = list(input_names[len(self.types):])
+            assert len(extra) == len(self.constant_slots), (
+                f"constant_slots has {len(self.constant_slots)} value(s) but "
+                f"the model leaves {len(extra)} input(s) {extra} unfed by "
+                f"the provider's {len(self.types)} slot(s)")
+            self._const_names = extra
+        else:
+            self._const_names = []
         self.types = prov.input_types
         self.batch_size = batch_size
         self.shuffle = prov.settings.should_shuffle if shuffle is None else shuffle
@@ -223,14 +239,34 @@ class DataFeeder:
                 ch.sort(key=self._sample_sort_key)
                 samples.extend(ch)
         bs = self.batch_size
-        batch_idx = list(range(0, len(samples), bs))
+        calc = self.prov.settings.calc_batch_size
+        if calc is not None:
+            # cost-weighted batching (ref: PyDataProvider2.py
+            # calc_batch_size:265 — each sample contributes a custom batch
+            # weight, e.g. its token count; a batch closes when the
+            # accumulated weight reaches batch_size, and may exceed it
+            # like the reference's can_over_batch_size mode)
+            chunks, cur, acc = [], [], 0.0
+            for s in samples:
+                cur.append(s)
+                acc += calc(s)     # raw weight — fractional costs accumulate
+                if acc >= bs:
+                    chunks.append(cur)
+                    cur, acc = [], 0
+            if cur and not self.drop_last:
+                chunks.append(cur)
+        else:
+            chunks = [samples[i:i + bs] for i in range(0, len(samples), bs)]
+            if chunks and len(chunks[-1]) < bs and self.drop_last:
+                chunks.pop()
         if self.shuffle and self.bucket_by_length:
-            self.rng.shuffle(batch_idx)
-        for i in batch_idx:
-            chunk = samples[i:i + bs]
-            if len(chunk) < bs and self.drop_last:
-                continue
-            yield make_batch(chunk, self.types, self.names)
+            self.rng.shuffle(chunks)
+        for chunk in chunks:
+            batch = make_batch(chunk, self.types, self.names)
+            for name, val in zip(self._const_names, self.constant_slots):
+                batch[name] = Argument(
+                    value=np.full((len(chunk), 1), val, np.float32))
+            yield batch
 
     def prefetched_batches(self) -> Iterator[dict[str, Argument]]:
         """Background-thread prefetch (ref: DataProvider.h DoubleBuffer)."""

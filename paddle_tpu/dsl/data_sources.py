@@ -18,18 +18,24 @@ def define_py_data_sources2(
     module: str,
     obj: str,
     args: Any = None,
+    constant_slots: Optional[list] = None,
 ) -> None:
     """Declare train/test providers backed by @provider functions
-    (ref: data_sources.py:173; PyDataProvider2)."""
+    (ref: data_sources.py:173; PyDataProvider2).  `constant_slots` appends
+    fixed-value [B, 1] slots after the provider's slots (ref:
+    config_parser.py:888; DataProvider.cpp:177-195)."""
     ctx = current_context()
     import json
     args_str = json.dumps(args) if args is not None else ""
+    const = [float(v) for v in (constant_slots or [])]
     if train_list is not None:
         ctx.data = DataConfig(type="py2", files=train_list, load_data_module=module,
-                              load_data_object=obj, load_data_args=args_str)
+                              load_data_object=obj, load_data_args=args_str,
+                              constant_slots=const)
     if test_list is not None:
         ctx.test_data = DataConfig(type="py2", files=test_list, load_data_module=module,
-                                   load_data_object=obj, load_data_args=args_str)
+                                   load_data_object=obj, load_data_args=args_str,
+                                   constant_slots=const)
 
 
 def define_multi_py_data_sources2(

@@ -257,11 +257,17 @@ class Trainer:
         return DataFeeder(
             prov, files, input_names=self.model.input_layer_names,
             batch_size=self.opt.batch_size, seed=self.seed,
-            drop_last=train, shuffle=None if train else False)
+            drop_last=train, shuffle=None if train else False,
+            constant_slots=data_cfg.constant_slots)
 
     def train_batches(self) -> Iterator[dict[str, Argument]]:
         assert self.config.data_config is not None, "config has no data source"
-        return self._feeder(self.config.data_config, True).prefetched_batches()
+        feeder = self._feeder(self.config.data_config, True)
+        if not self.config.data_config.async_load_data:
+            # ref: --async_load_data=false / DataConfig.async_load_data —
+            # assemble batches synchronously on the training thread
+            return feeder.batches()
+        return feeder.prefetched_batches()
 
     # -- loops ------------------------------------------------------------
     def _dispatch_step(self, batch: dict[str, Argument]):

@@ -271,3 +271,67 @@ def test_beam_controls_ban_token_and_count_steps():
     controls2 = BeamSearchControls(norm_path=lambda s, l: s * 0.0)
     _, z = generate(gex, params, feed, controls=controls2)
     np.testing.assert_array_equal(np.asarray(z), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# calc_batch_size (cost-weighted batching)
+# ---------------------------------------------------------------------------
+
+def test_calc_batch_size_token_weighted_batches():
+    """calc_batch_size weights each sample's contribution to the batch
+    budget (ref: PyDataProvider2.py:265 — e.g. token counts, so long
+    sequences form smaller batches); batches may exceed the budget like
+    the reference's can_over_batch_size mode."""
+    import numpy as np
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.data.provider import integer_value_sequence, integer_value, provider
+
+    lens = [5, 5, 5, 9, 9, 2, 2, 2, 2, 2]
+
+    @provider(input_types={"w": integer_value_sequence(50),
+                           "label": integer_value(2)},
+              should_shuffle=False,
+              calc_batch_size=lambda s: len(s["w"]))
+    def p(settings, filename):
+        for L in lens:
+            yield {"w": list(range(L)), "label": 0}
+
+    feeder = DataFeeder(p, ["f"], ["w", "label"], batch_size=10,
+                        drop_last=False, bucket_by_length=False,
+                        shuffle=False)
+    batches = list(feeder.batches())
+    sizes = [int(b["w"].batch_size) for b in batches]
+    # 5+5=10 | 5+9=14 (over-budget close) | 9+2=11 | 2+2+2+2=8 (tail kept)
+    assert sizes == [2, 2, 2, 4], sizes
+    # every sample arrives exactly once
+    assert sum(int(np.asarray(b["w"].lengths).sum()) for b in batches) == sum(lens)
+
+    # drop_last=True discards the under-budget tail
+    feeder2 = DataFeeder(p, ["f"], ["w", "label"], batch_size=10,
+                         drop_last=True, bucket_by_length=False,
+                         shuffle=False)
+    assert [int(b["w"].batch_size) for b in feeder2.batches()] == [2, 2, 2]
+
+
+def test_constant_slots_fill_extra_inputs():
+    """DataConfig.constant_slots appends fixed-value [B, 1] slots after the
+    provider's slots (ref: config_parser.py:888, DataProvider.cpp:177-195)."""
+    import numpy as np
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.data.provider import dense_vector, integer_value, provider
+
+    @provider(input_types={"x": dense_vector(2), "label": integer_value(2)},
+              should_shuffle=False)
+    def p(settings, filename):
+        for i in range(8):
+            yield {"x": [float(i), 0.0], "label": i % 2}
+
+    feeder = DataFeeder(p, ["f"], ["x", "label", "c1", "c2"], batch_size=4,
+                        drop_last=False, constant_slots=[0.5, -2.0])
+    batches = list(feeder.batches())
+    assert len(batches) == 2
+    for b in batches:
+        np.testing.assert_array_equal(np.asarray(b["c1"].value),
+                                      np.full((4, 1), 0.5, np.float32))
+        np.testing.assert_array_equal(np.asarray(b["c2"].value),
+                                      np.full((4, 1), -2.0, np.float32))
