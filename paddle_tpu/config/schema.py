@@ -429,6 +429,10 @@ class OptimizationConfig(_Serializable):
     # GPipe microbatches per batch for config-driven pipeline parallelism
     # (layers annotated device=N); 0 = one microbatch per pipeline stage
     pipeline_micro_batches: int = 0
+    # ZeRO-1: shard optimizer slot buffers over the data axis (the pserver
+    # design where each server updates 1/N of every parameter — here XLA
+    # keeps the update sharded and gathers only the fresh params)
+    shard_optimizer_state: bool = False
 
 
 @_schema

@@ -24,7 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.config.schema import ModelConfig
-from paddle_tpu.parallel.mesh import DATA_AXIS
+from paddle_tpu.parallel.mesh import DATA_AXIS, axis_size
 from paddle_tpu.parameter.argument import Argument
 
 
@@ -52,27 +52,47 @@ def global_put(x, sharding: NamedSharding):
 _global_put = global_put
 
 
-def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state: Any):
+def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict,
+                        opt_state: Any, shard_opt: bool = False):
     """Place params (+ optimizer slots) on the mesh per their partition specs.
     Parameters marked sparse_update (embedding tables) default to vocab-dim
-    sharding — the pserver-shard analog (see parallel/sparse.py)."""
+    sharding — the pserver-shard analog (see parallel/sparse.py).
+
+    shard_opt=True (ZeRO-1; settings(shard_optimizer_state=True)) shards
+    every optimizer slot buffer's leading dim over the `data` axis — the
+    TPU-native form of the pserver design where each server holds and
+    updates 1/N of every parameter's optimizer state (ref:
+    ParameterServer2's per-server parameter blocks); XLA partitions the
+    update math along the slot sharding and inserts the gathers the next
+    step needs.  Slots of explicitly-sharded (tp) parameters keep their
+    parameter's spec; leaves whose leading dim doesn't divide stay
+    replicated."""
     from paddle_tpu.parallel.sparse import embedding_partition_spec
     specs = {p.name: p.partition_spec for p in model.parameters}
     emb_spec = embedding_partition_spec(mesh)
     if emb_spec is not None:
-        axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[emb_spec[0]]
+        n_emb = axis_size(mesh, emb_spec[0])
         for p in model.parameters:
             if p.sparse_update and not p.partition_spec \
-                    and len(p.dims) == 2 and p.dims[0] % axis_size == 0:
+                    and len(p.dims) == 2 and p.dims[0] % n_emb == 0:
                 specs[p.name] = emb_spec
     out_params = {
         name: _global_put(v, param_sharding(mesh, specs.get(name)))
         for name, v in params.items()
     }
 
+    n_data = axis_size(mesh, DATA_AXIS)
+
+    def slot_sharding(name, leaf):
+        if shard_opt and not specs.get(name) and n_data > 1 \
+                and hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[0] % n_data == 0:
+            return NamedSharding(mesh, P(DATA_AXIS))
+        return param_sharding(mesh, specs.get(name))
+
     def place_slots(slots_for_param, name):
-        sh = param_sharding(mesh, specs.get(name))
-        return jax.tree.map(lambda x: _global_put(x, sh), slots_for_param)
+        return jax.tree.map(
+            lambda x: _global_put(x, slot_sharding(name, x)), slots_for_param)
 
     opt_state = dict(opt_state)
     if "slots" in opt_state:
@@ -80,7 +100,7 @@ def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict, opt_state:
             name: place_slots(s, name) for name, s in opt_state["slots"].items()}
     if "average" in opt_state:
         opt_state["average"] = {
-            name: _global_put(v, param_sharding(mesh, specs.get(name)))
+            name: place_slots(v, name)
             for name, v in opt_state["average"].items()}
     return out_params, opt_state
 

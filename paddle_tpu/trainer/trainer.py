@@ -116,7 +116,8 @@ class Trainer:
         if mesh is not None:
             from paddle_tpu.parallel.dp import shard_train_objects
             self.params, self.opt_state = shard_train_objects(
-                mesh, self.model, self.params, self.opt_state)
+                mesh, self.model, self.params, self.opt_state,
+                shard_opt=self.opt.shard_optimizer_state)
         self._train_step_fn = self._build_train_step_fn()
         self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
         self._test_step = self._build_test_step()
@@ -697,6 +698,11 @@ class Trainer:
     def save(self, save_dir: str, keep_last: int = 0) -> str:
         """(ref: ParamUtil::saveParametersOnePass; only trainer 0 saves —
         here process 0 under multi-host jax.distributed)."""
+        # every process participates in the gather of non-addressable
+        # shards (ZeRO-1 slots span hosts); only process 0 writes
+        params = _host_tree(self.params)
+        opt_state = _host_tree(self.opt_state)
+        net_state = _host_tree(self.net_state)
         if jax.process_index() != 0:
             return ""
         # pass_id 0 = nothing completed yet: label the snapshot pass-init
@@ -704,8 +710,7 @@ class Trainer:
         # end-of-pass-0 save owns; resuming from a clamped one would
         # silently skip training pass 0)
         return ckpt.save_checkpoint(
-            save_dir, self.pass_id - 1, jax.device_get(self.params),
-            jax.device_get(self.opt_state), jax.device_get(self.net_state),
+            save_dir, self.pass_id - 1, params, opt_state, net_state,
             config_json=self.config.to_json(), keep_last=keep_last)
 
     def load(self, path: str) -> None:
@@ -725,11 +730,31 @@ class Trainer:
             self.opt_state = _merge_state(tmpl, data["opt"])
         if data.get("net"):
             self.net_state = jax.tree.map(jnp.asarray, data["net"])
+        if self.mesh is not None:
+            # restore mesh placement (incl. ZeRO-1 slot sharding) — the
+            # loaded host arrays would otherwise train replicated, silently
+            # undoing the sharded-optimizer memory saving
+            from paddle_tpu.parallel.dp import shard_train_objects
+            self.params, self.opt_state = shard_train_objects(
+                self.mesh, self.model, self.params, self.opt_state,
+                shard_opt=self.opt.shard_optimizer_state)
         if "pass_id" in data:
             # continue the pass numbering: the snapshot is named after its
             # last completed pass, so the resumed run trains (and next
             # saves) pass N+1 instead of colliding with pass-00000
             self.pass_id = data["pass_id"] + 1
+
+
+def _host_tree(tree):
+    """Device -> host copy that works for arrays spanning non-addressable
+    devices (multi-host ZeRO-1 slot shards): gather those across processes;
+    plain device_get for everything else."""
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+    return jax.tree.map(fetch, tree)
 
 
 def _merge_state(template, loaded):
