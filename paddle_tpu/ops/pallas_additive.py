@@ -107,11 +107,10 @@ def _kernel(bB, bT, u_ref, v_ref, proj_ref, seq_ref, len_ref,
 def _fwd_pallas(u, v, enc_proj, enc_seq, lengths):
     B, T, D = enc_proj.shape
     Dv = enc_seq.shape[-1]
-    # bf16 minimum tile is (16, 128); fp32 is (8, 128)
-    sub = 16 if any(a.dtype == jnp.bfloat16
-                    for a in (u, enc_proj, enc_seq)) else 8
-    bB = min(16, _round_up(B, sub))
-    bT = min(512, _round_up(T, sub))
+    from paddle_tpu.utils.dtypes import sublane_min
+    sub = sublane_min(u, enc_proj, enc_seq)
+    bB = _round_up(min(16, _round_up(B, sub)), sub)
+    bT = _round_up(min(512, _round_up(T, sub)), sub)
     Bp, Tp = _round_up(B, bB), _round_up(T, bT)
     Dp, Dvp = _round_up(D, 128), _round_up(Dv, 128)
     # zero-padding is inert: padded D columns of u/enc_proj contribute

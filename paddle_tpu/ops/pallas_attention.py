@@ -426,7 +426,14 @@ def flash_attention(
     if scale is None:
         scale = D ** -0.5
 
-    Bq, Bk = min(block_q, _round_up(Tq, 8)), min(block_k, _round_up(Tk, 8))
+    # low-precision (bf16/fp16) minimum TPU tile is (16, 128) vs fp32's
+    # (8, 128): both the auto-sized tile for short sequences AND any
+    # caller-chosen block must round up to the dtype's sublane minimum or
+    # Mosaic rejects the block shapes
+    from paddle_tpu.utils.dtypes import sublane_min
+    sub = sublane_min(q, k, v)
+    Bq = _round_up(min(block_q, _round_up(Tq, sub)), sub)
+    Bk = _round_up(min(block_k, _round_up(Tk, sub)), sub)
     Tqp, Tkp = _round_up(Tq, Bq), _round_up(Tk, Bk)
     Dp = _round_up(D, 128)
 

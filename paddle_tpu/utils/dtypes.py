@@ -15,3 +15,10 @@ def promote_compute(x: jax.Array) -> jax.Array:
     if x.dtype in LOW_PRECISION:
         return x.astype(jnp.float32)
     return x
+
+
+def sublane_min(*arrays) -> int:
+    """Minimum TPU sublane tile for the widest-constrained array dtype:
+    2-byte floats (bf16/fp16) need (16, 128) tiles, 4-byte (8, 128).
+    Pallas kernels round their second-minor block dims with this."""
+    return 16 if any(a.dtype in LOW_PRECISION for a in arrays) else 8
