@@ -204,6 +204,41 @@ def test_pipeline_with_recurrent_group_stage():
         np.testing.assert_allclose(pp[name], p1[name], rtol=3e-4, atol=2e-5)
 
 
+def test_pipeline_bf16_compute_close_to_unpipelined():
+    """Mixed precision under pp: bf16 activations cross stage boundaries
+    through the fp32 carrier (cast bf16 -> f32 -> bf16 is exact), so bf16
+    pipelined training must track bf16 un-pipelined training to bf16
+    tolerance."""
+    def conf():
+        from paddle_tpu.dsl import (
+            ExtraLayerAttribute, MomentumOptimizer, ReluActivation,
+            SoftmaxActivation, TanhActivation, classification_cost,
+            data_layer, fc_layer, settings,
+        )
+        settings(batch_size=B, learning_rate=0.05,
+                 learning_method=MomentumOptimizer(momentum=0.9),
+                 compute_dtype="bfloat16", pipeline_micro_batches=2)
+        x = data_layer(name="pixel", size=DIN)
+        h0 = fc_layer(input=x, size=32, act=TanhActivation(),
+                      layer_attr=ExtraLayerAttribute(device=0))
+        h1 = fc_layer(input=h0, size=32, act=ReluActivation(),
+                      layer_attr=ExtraLayerAttribute(device=1))
+        out = fc_layer(input=h1, size=NCLS, act=SoftmaxActivation(),
+                       layer_attr=ExtraLayerAttribute(device=1))
+        classification_cost(input=out,
+                            label=data_layer(name="label", size=NCLS))
+
+    batches = _batches(6, np.random.default_rng(4))
+    l1, p1, _ = _train(conf, None, batches)
+    lp, pp, _ = _train(conf, make_mesh(data=4, pipe=2), batches)
+    assert np.isfinite(l1).all() and np.isfinite(lp).all()
+    # bf16 tolerance: the carrier round-trip is exact, but reduction
+    # orders differ between the pipelined and monolithic programs
+    np.testing.assert_allclose(lp, l1, rtol=2e-2, atol=2e-2)
+    for name in p1:
+        np.testing.assert_allclose(pp[name], p1[name], rtol=3e-2, atol=3e-2)
+
+
 def test_pipeline_rejects_bad_annotations():
     """Non-contiguous device order fails with a clear message."""
     def conf():

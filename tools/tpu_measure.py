@@ -11,15 +11,18 @@ everything already measured.  Priorities (VERDICT round 2):
      real TPU; cheapest, unblocks trusting everything else)
   3. attention micro-bench across lengths (tools/bench_attention.py) —
      evidence for the layer auto-selection crossover
-  4. quick bench (vgg + seq2seq) -> PERF_LOG.jsonl snapshot
-  5. full 5-config bench -> PERF_LOG.jsonl snapshot
+  4. additive-attention kernel vs jnp (tools/bench_additive.py) —
+     evidence for the decoder-step routing default
+  5. quick bench (vgg + seq2seq) -> PERF_LOG.jsonl snapshot
+  6. full 5-config bench -> PERF_LOG.jsonl snapshot
 
 Results land under MEASURE/<step>.out (+ PERF_LOG.jsonl via bench.py).
 The parent process never imports jax (a wedged tunnel blocks any backend
 init forever).
 
 Usage: python tools/tpu_measure.py [--skip=parity,attn_bench_f32]
-(step names: parity, attn_bench, attn_bench_f32, bench_quick, bench_full)
+(step names: parity, attn_bench, attn_bench_f32, additive_bench,
+bench_quick, bench_full)
 """
 
 from __future__ import annotations
@@ -104,6 +107,7 @@ def main() -> int:
         ("attn_bench_f32",
          [py, "tools/bench_attention.py", "--lens", "512,1024,4096",
           "--iters", "10", "--dtype", "float32"], 900, {}),
+        ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
         ("bench_quick", [py, "bench.py"], 1500,
          {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
         ("bench_full", [py, "bench.py"], 2400,
