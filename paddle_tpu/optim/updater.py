@@ -92,9 +92,77 @@ class ParameterUpdater:
         if self.use_average:
             state["average"] = {name: jnp.array(p) for name, p in params.items()}
             state["average_count"] = jnp.zeros((), jnp.int32)
+        if self.accum_n > 1:
+            # accumulate in >= fp32: summing N low-precision gradients with
+            # a rounding per add would break the concatenated-batch
+            # equivalence exactly for the configs accumulation targets
+            def acc_zeros(p):
+                dt = jnp.promote_types(p.dtype, jnp.float32) if \
+                    jnp.issubdtype(p.dtype, jnp.floating) else p.dtype
+                return jnp.zeros(p.shape, dt)
+            state["grad_accum"] = {
+                name: acc_zeros(p) for name, p in params.items()
+                if not self.param_cfgs[name].is_static}
+            state["grad_accum_count"] = jnp.zeros((), jnp.int32)
+            state["grad_accum_samples"] = jnp.zeros((), jnp.int32)
         return state
 
+    @property
+    def accum_n(self) -> int:
+        """Gradient-accumulation window (ref: RemoteParameterUpdater.cpp:206
+        num_batches_per_send_parameter — gradients accumulate locally for N
+        batches before one parameter update)."""
+        return max(int(self.opt.num_batches_per_send_parameter), 1)
+
     def step(
+        self,
+        params: dict[str, Array],
+        grads: dict[str, Array],
+        state: dict[str, Any],
+        batch_size: int,
+    ) -> tuple[dict[str, Array], dict[str, Any]]:
+        """One training-step update; pure, call under jit.  With
+        num_batches_per_send_parameter = N > 1, gradients accumulate and
+        the optimizer applies once per N batches on their mean — identical
+        math to training on the N batches concatenated."""
+        N = self.accum_n
+        if N == 1:
+            return self._apply(params, grads, state, batch_size)
+
+        # sample-weighted: each micro-batch's MEAN gradient re-scales by its
+        # size, so unequal micro-batches (drop_last=False tails,
+        # calc_batch_size mode) still reproduce the concatenated-batch mean
+        acc = {name: state["grad_accum"][name]
+               + batch_size * grads[name].astype(state["grad_accum"][name].dtype)
+               for name in state["grad_accum"] if name in grads}
+        for name in state["grad_accum"]:       # params without grads this step
+            acc.setdefault(name, state["grad_accum"][name])
+        cnt = state["grad_accum_count"] + 1
+        n_samples = state["grad_accum_samples"] + batch_size
+        core = {k: v for k, v in state.items()
+                if k not in ("grad_accum", "grad_accum_count",
+                             "grad_accum_samples")}
+
+        def apply_branch(_):
+            denom = n_samples.astype(jnp.float32)
+            mean = {n: (a / denom).astype(a.dtype) for n, a in acc.items()}
+            p2, s2 = self._apply(params, mean, core, n_samples)
+            s2 = dict(s2)
+            s2["grad_accum"] = jax.tree.map(jnp.zeros_like, acc)
+            s2["grad_accum_count"] = jnp.zeros((), jnp.int32)
+            s2["grad_accum_samples"] = jnp.zeros((), jnp.int32)
+            return p2, s2
+
+        def skip_branch(_):
+            s2 = dict(core)
+            s2["grad_accum"] = acc
+            s2["grad_accum_count"] = cnt
+            s2["grad_accum_samples"] = n_samples
+            return dict(params), s2
+
+        return jax.lax.cond(cnt >= N, apply_branch, skip_branch, None)
+
+    def _apply(
         self,
         params: dict[str, Array],
         grads: dict[str, Array],
@@ -182,6 +250,15 @@ class ParameterUpdater:
     def finish_pass(self, state):
         state = dict(state)
         state["pass_id"] = state["pass_id"] + 1
+        if "grad_accum" in state:
+            # a partially-filled accumulation window does not straddle the
+            # pass boundary (its batches would otherwise apply under the
+            # next pass's LR schedule); the trailing < N batches are
+            # dropped, the same convention as the feeder's drop_last
+            state["grad_accum"] = jax.tree.map(jnp.zeros_like,
+                                               state["grad_accum"])
+            state["grad_accum_count"] = jnp.zeros((), jnp.int32)
+            state["grad_accum_samples"] = jnp.zeros((), jnp.int32)
         return state
 
     def averaged_params(self, params, state):

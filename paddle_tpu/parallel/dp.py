@@ -102,6 +102,12 @@ def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict,
         opt_state["average"] = {
             name: place_slots(v, name)
             for name, v in opt_state["average"].items()}
+    if "grad_accum" in opt_state:
+        # gradient accumulators follow their parameter's spec (like
+        # averaging copies); ZeRO slot-sharding applies to them too
+        opt_state["grad_accum"] = {
+            name: place_slots(v, name)
+            for name, v in opt_state["grad_accum"].items()}
     return out_params, opt_state
 
 
