@@ -1,0 +1,68 @@
+"""`python -m paddle_tpu <command>` — the unified CLI entry point.
+
+TPU-native analog of the reference's `paddle` shell wrapper (ref:
+paddle/scripts/submit_local.sh.in:109-134: train / merge_model / pserver /
+dump_config / make_diagram / version dispatch).  `pserver` is gone — the
+fleet collapsed into jax.distributed + XLA collectives; `cluster_launch`
+takes its place for starting a multi-host run.
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMMANDS = {
+    "train": ("paddle_tpu.trainer_main",
+              "train/test/checkgrad/time a config (paddle_trainer analog)"),
+    "merge_model": ("paddle_tpu.tools.merge_model",
+                    "bundle config + weights into one deployable file"),
+    "dump_config": ("paddle_tpu.tools.dump_config",
+                    "print a parsed config as JSON"),
+    "make_diagram": ("paddle_tpu.tools.make_model_diagram",
+                     "render the layer graph as graphviz"),
+    "show_model": ("paddle_tpu.tools.show_model",
+                   "summarize a checkpoint's parameters"),
+    "plotcurve": ("paddle_tpu.tools.plotcurve",
+                  "plot training-log cost curves"),
+    "cluster_launch": ("paddle_tpu.tools.cluster_launch",
+                       "start a multi-host run over ssh (pserver-fleet analog)"),
+}
+
+
+def _version() -> str:
+    import jax
+
+    from paddle_tpu import __version__
+    return (f"paddle_tpu {__version__} (PaddlePaddle v0.9.0 capability "
+            f"rebuild, TPU-native) on jax {jax.__version__}")
+
+
+def usage() -> str:
+    lines = ["usage: python -m paddle_tpu <command> [args...]", "",
+             "commands:"]
+    for name, (_, desc) in COMMANDS.items():
+        lines.append(f"  {name:<15} {desc}")
+    lines += ["  version         print version", "  --help          this text"]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("--help", "-h", "help"):
+        print(usage())
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "version":
+        print(_version())
+        return 0
+    if cmd not in COMMANDS:
+        print(f"unknown command {cmd!r}\n\n{usage()}", file=sys.stderr)
+        return 2
+    import importlib
+    mod = importlib.import_module(COMMANDS[cmd][0])
+    rc = mod.main(rest)
+    return int(rc or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

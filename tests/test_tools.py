@@ -185,3 +185,28 @@ def test_embedding_zoo_roundtrip(tmp_path):
              "--usr_model", str(usr_npy),
              "--usr_dict", str(tmp_path / "usr.dict")])
     np.testing.assert_array_equal(np.load(usr_npy), out)
+
+
+def test_cli_multiplexer_dispatch(tmp_path, capsys):
+    """`python -m paddle_tpu <cmd>` dispatches like the reference's `paddle`
+    shell wrapper (ref: paddle/scripts/submit_local.sh.in:109-134)."""
+    import paddle_tpu.__main__ as cli
+
+    assert cli.main(["--help"]) == 0
+    assert "train" in capsys.readouterr().out
+    assert cli.main(["version"]) == 0
+    assert "paddle_tpu" in capsys.readouterr().out
+    assert cli.main(["no_such_cmd"]) == 2
+
+    # a real dispatch: dump_config through the multiplexer
+    cfg = tmp_path / "c.py"
+    cfg.write_text(
+        "from paddle_tpu.dsl import *\n"
+        "settings(batch_size=4, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "o = fc_layer(input=x, size=2, act=SoftmaxActivation())\n"
+        "classification_cost(input=o, label=data_layer(name='y', size=2))\n")
+    assert cli.main(["dump_config", str(cfg)]) == 0
+    out = capsys.readouterr().out
+    import json
+    assert json.loads(out)["model_config"]["layers"]
