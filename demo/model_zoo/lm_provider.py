@@ -1,0 +1,32 @@
+"""Synthetic token-stream provider for the transformer LM demo: sequences
+from a repeated-motif language so next-token prediction is learnable."""
+
+import numpy as np
+
+from paddle_tpu.data.provider import integer_value_sequence, provider
+
+
+def _init(settings, file_list, **kw):
+    """Resize the declared slot dims to the config-driven vocab (ref:
+    PyDataProvider2 init_hook pattern — providers that depend on a
+    dictionary size learn it at initialize() time)."""
+    vocab = int(kw.get("vocab", 256))
+    settings.args = vocab
+    settings.slots = {"tokens": integer_value_sequence(vocab),
+                      "next_tokens": integer_value_sequence(vocab)}
+
+
+@provider(input_types={"tokens": integer_value_sequence(256),
+                       "next_tokens": integer_value_sequence(256)},
+          should_shuffle=True, init_hook=_init)
+def process(settings, filename):
+    vocab = settings.args if isinstance(settings.args, int) else 256
+    rng = np.random.default_rng(7)
+    motifs = [rng.integers(2, vocab, rng.integers(3, 8)).tolist()
+              for _ in range(8)]
+    for _ in range(256):
+        seq = [1]                                    # BOS
+        while len(seq) < 33:
+            seq += motifs[int(rng.integers(0, len(motifs)))]
+        seq = seq[:33]
+        yield {"tokens": seq[:-1], "next_tokens": seq[1:]}

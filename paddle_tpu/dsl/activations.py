@@ -7,6 +7,7 @@ __all__ = [
     "SigmoidActivation", "SoftmaxActivation", "SequenceSoftmaxActivation",
     "ReluActivation", "BReluActivation", "SoftReluActivation", "STanhActivation",
     "AbsActivation", "SquareActivation", "ExpActivation", "LogActivation",
+    "GeluActivation",
 ]
 
 
@@ -70,6 +71,11 @@ class ExpActivation(BaseActivation):
 
 class LogActivation(BaseActivation):
     name = "log"
+
+
+class GeluActivation(BaseActivation):
+    """tanh-approximated GELU (beyond the reference's zoo)."""
+    name = "gelu"
 
 
 def act_name(act) -> str:

@@ -46,6 +46,7 @@ __all__ = [
     "img_conv_layer", "img_pool_layer", "img_cmrnorm_layer", "batch_norm_layer",
     "bilinear_interp_layer", "block_expand_layer", "maxout_layer", "spp_layer",
     "conv_shift_layer", "multi_head_attention_layer", "moe_layer",
+    "layer_norm_layer",
     "maxid_layer", "sampling_id_layer", "eos_layer",
     "cos_sim", "cos_sim_vecmat", "trans_layer", "resize_layer",
     "slope_intercept_layer", "scaling_layer", "interpolation_layer",
@@ -1026,6 +1027,30 @@ def multi_head_attention_layer(
     return LayerOutput(name, "multi_head_attention", size,
                        parents=[query, key, value],
                        seq_level=query.seq_level)
+
+
+def layer_norm_layer(
+    input: LayerOutput,
+    name: Optional[str] = None,
+    param_attr: Optional[ParameterAttribute] = None,
+    bias_attr=True,
+    layer_attr: Optional[ExtraLayerAttribute] = None,
+) -> LayerOutput:
+    """Last-dim layer normalization with learned scale/bias (beyond the
+    reference's zoo — required by the transformer-era blocks; see
+    graph/layers_misc.py layer_norm)."""
+    name = _name(name, "layer_norm")
+    cfg = LayerConfig(name=name, type="layer_norm", size=input.size,
+                      active_type="")
+    pa = param_attr or ParameterAttribute(initial_mean=1.0, initial_std=0.0)
+    pname = _make_param(name, 0, [1, input.size], pa)
+    cfg.inputs.append(LayerInput(input_layer_name=input.name,
+                                 input_parameter_name=pname))
+    cfg.bias_parameter_name = _bias_name(name, bias_attr, [1, input.size])
+    _layer_attr_fields(cfg, layer_attr)
+    current_context().add_layer(cfg)
+    return LayerOutput(name, "layer_norm", input.size, parents=[input],
+                       seq_level=input.seq_level)
 
 
 def moe_layer(

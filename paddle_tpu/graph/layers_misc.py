@@ -216,3 +216,24 @@ def selective_fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
         else:
             acc = acc * sel.value
     return finish_layer(ctx, cfg, acc, like=feat_inputs[0])
+
+
+@register_layer("layer_norm")
+def layer_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Last-dim layer normalization with learned scale/bias — beyond the
+    reference's zoo (its only norms are cross-map response norms,
+    NormLayer.cpp); the transformer-era block needs it.  Statistics in
+    fp32 under mixed precision."""
+    x = ctx.get_input(cfg, 0)
+    from paddle_tpu.utils.dtypes import promote_compute
+    v32 = promote_compute(x.value)
+    mean = jnp.mean(v32, axis=-1, keepdims=True)
+    var = jnp.var(v32, axis=-1, keepdims=True)
+    normed = (v32 - mean) * jax.lax.rsqrt(var + 1e-6)
+    scale = ctx.param_of(cfg, 0)
+    if scale is not None:
+        normed = normed * promote_compute(scale).reshape(-1)
+    b = ctx.bias_of(cfg)
+    if b is not None:
+        normed = normed + promote_compute(b).reshape(-1)
+    return finish_layer(ctx, cfg, normed.astype(x.value.dtype), like=x)

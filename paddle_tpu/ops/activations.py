@@ -106,6 +106,13 @@ def square(x: Array, **_) -> Array:
     return jnp.square(x)
 
 
+@_register("gelu")
+def gelu(x: Array, **_) -> Array:
+    """tanh-approximated GELU (beyond the reference's zoo — the
+    transformer-era nonlinearity; approximation keeps it MXU/VPU cheap)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
 @_register("exponential")
 def exponential(x: Array, **_) -> Array:
     return jnp.exp(x)
