@@ -1,0 +1,120 @@
+"""Autoregressive decoding for sequence-in/logits-out models (the
+transformer LM family).
+
+The reference's generation story is beam search over recurrent groups
+(RecurrentGradientMachine; graph/generator.py here).  Full-sequence
+attention models have no recurrent group to unroll, so this provides the
+matching TPU-native decode loop: ONE compiled `lax.scan` over a
+fixed-size token buffer — each step runs the full forward on the padded
+prefix (masked by the running length), reads the next-token logits at the
+last valid position, and samples greedy / temperature / top-k.
+
+Re-design note: a per-layer KV cache would make each step O(T) instead of
+O(T^2); at the classic benchmark scales the whole-prefix re-forward is
+one fused program XLA pipelines well, and it needs zero layer-level
+support — the cacheized variant is a later optimization, not a
+correctness feature.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.builder import GraphExecutor
+from paddle_tpu.graph.context import TEST
+from paddle_tpu.parameter.argument import Argument
+
+Array = jax.Array
+
+
+def lm_generate(
+    executor: GraphExecutor,
+    params: dict[str, Array],
+    prompt_ids,                   # [B, P] int32 prompt tokens
+    prompt_lengths=None,          # [B] valid prompt lengths (default: P)
+    max_new: int = 32,
+    *,
+    input_name: Optional[str] = None,
+    logits_name: Optional[str] = None,
+    temperature: float = 0.0,     # 0 = greedy
+    top_k: int = 0,               # 0 = full distribution
+    eos_id: int = -1,             # -1 = never stop early
+    rng: Optional[Array] = None,
+):
+    """Returns (tokens [B, P+max_new], lengths [B]) — the prompt plus up to
+    max_new sampled tokens per row (rows stop growing at eos_id).
+
+    The model is any config whose `input_name` data layer takes an id
+    sequence and whose `logits_name` layer emits [B, T, vocab]
+    (next-token distribution at each position) — the transformer LM
+    shape.  Defaults: the first id-sequence input layer and the last
+    non-cost layer.
+    """
+    model = executor.model
+    if input_name is None:
+        input_name = model.input_layer_names[0]
+    if logits_name is None:
+        non_cost = [l.name for l in model.layers
+                    if not l.type.endswith("cross-entropy")
+                    and "cost" not in l.type and l.type != "data"]
+        logits_name = non_cost[-1]
+
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, P = prompt_ids.shape
+    total = P + max_new
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((B,), P, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    buf0 = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt_ids)
+
+    def step(carry, key):
+        buf, lengths, done = carry
+        feed = {input_name: Argument(ids=buf, lengths=lengths)}
+        outputs, _, _ = executor.forward(params, feed, None, TEST, None)
+        logits = outputs[logits_name].value          # [B, total, V]
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
+            if _is_probs(model, logits_name) else last.astype(jnp.float32)
+        if temperature <= 0.0:
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        else:
+            scaled = last / temperature
+            if top_k > 0:
+                # exact k-best support via top_k (ref pattern:
+                # graph/generator.py beam candidate selection): scatter the
+                # k values back to -inf elsewhere so ties at the kth value
+                # can never widen the candidate set
+                vals, idxs = jax.lax.top_k(scaled, top_k)
+                scaled = jnp.full_like(scaled, -jnp.inf).at[
+                    jnp.arange(scaled.shape[0])[:, None], idxs].set(vals)
+            nxt = jax.random.categorical(key, scaled).astype(jnp.int32)
+        # frozen rows keep their buffer and length
+        write_pos = jnp.clip(lengths, 0, total - 1)
+        new_buf = buf.at[jnp.arange(B), write_pos].set(
+            jnp.where(done, buf[jnp.arange(B), write_pos], nxt))
+        new_len = jnp.where(done, lengths, jnp.minimum(lengths + 1, total))
+        new_done = jnp.logical_or(done, jnp.logical_or(
+            nxt == eos_id, new_len >= total))
+        return (new_buf, new_len, new_done), None
+
+    keys = jax.random.split(rng, max_new)
+    (buf, lengths, _), _ = jax.lax.scan(
+        step, (buf0, prompt_lengths, jnp.zeros((B,), bool)), keys)
+    return buf, lengths
+
+
+def _is_probs(model, logits_name: str) -> bool:
+    """Whether the logits layer emits probabilities (softmax activation) —
+    sampled through log; raw-activation layers sample directly."""
+    for l in model.layers:
+        if l.name == logits_name:
+            return l.active_type in ("softmax", "sequence_softmax")
+    return False

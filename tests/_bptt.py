@@ -1,0 +1,9 @@
+
+from paddle_tpu.dsl import *
+settings(batch_size=2, learning_rate=0.1)
+x = data_layer(name="x", size=4)
+proj = fc_layer(input=x, size=8, act=LinearActivation(), bias_attr=False)
+rnn = recurrent_layer(input=proj, name="rnn_out")
+rep = last_seq(input=rnn)
+out = fc_layer(input=rep, size=2, act=SoftmaxActivation())
+classification_cost(input=out, label=data_layer(name="label", size=2))
