@@ -35,6 +35,42 @@ def test_mnist_mlp_dp8_matches_dp1():
     assert_dp_parity(cfg, batches, make_mesh(data=8))
 
 
+def test_lstm_sequence_model_dp8_matches_dp1():
+    """A recurrent (LSTM-scan) sequence model under dp: the scan carry,
+    masking, and per-step psum'd gradients must reproduce dp=1 exactly —
+    the parity matrix's sequence-model cell."""
+    from paddle_tpu.config.parser import parse_config_callable
+
+    def conf():
+        from paddle_tpu.dsl import (AdamOptimizer, ParamAttr,
+                                    SoftmaxActivation, classification_cost,
+                                    data_layer, embedding_layer, fc_layer,
+                                    last_seq, settings, simple_lstm)
+        settings(batch_size=16, learning_rate=0.005,
+                 learning_method=AdamOptimizer())
+        w = data_layer(name="word", size=50)
+        emb = embedding_layer(input=w, size=12,
+                              param_attr=ParamAttr(initial_std=0.1))
+        lstm = simple_lstm(input=emb, size=16)
+        rep = last_seq(input=lstm)
+        out = fc_layer(input=rep, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+
+    rng = np.random.default_rng(5)
+    B, T = 16, 7
+    batches = []
+    for _ in range(8):
+        batches.append({
+            "word": Argument(ids=rng.integers(0, 50, (B, T)).astype(np.int32),
+                             lengths=rng.integers(2, T + 1, B)
+                             .astype(np.int32)),
+            "y": Argument(ids=rng.integers(0, 3, B).astype(np.int32)),
+        })
+    cfg = parse_config_callable(conf)
+    assert_dp_parity(cfg, batches, make_mesh(data=8),
+                     config2=parse_config_callable(conf))
+
+
 def test_zero1_sharded_optimizer_matches_dp1():
     """ZeRO-1 (settings(shard_optimizer_state=True)): optimizer slot
     buffers shard their leading dim over `data` — the pserver

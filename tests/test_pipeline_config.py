@@ -239,6 +239,37 @@ def test_pipeline_bf16_compute_close_to_unpipelined():
         np.testing.assert_allclose(pp[name], p1[name], rtol=3e-2, atol=3e-2)
 
 
+def test_pipeline_with_gradient_accumulation():
+    """Pipeline parallelism composes with gradient accumulation: pp training
+    with num_batches_per_send_parameter=2 must equal un-pipelined
+    accumulated training on the same batches."""
+    def conf():
+        from paddle_tpu.dsl import (
+            ExtraLayerAttribute, MomentumOptimizer, SoftmaxActivation,
+            TanhActivation, classification_cost, data_layer, fc_layer,
+            settings,
+        )
+        settings(batch_size=B, learning_rate=0.05,
+                 learning_method=MomentumOptimizer(momentum=0.9),
+                 pipeline_micro_batches=2,
+                 num_batches_per_send_parameter=2)
+        x = data_layer(name="pixel", size=DIN)
+        h = fc_layer(input=x, size=32, act=TanhActivation(),
+                     layer_attr=ExtraLayerAttribute(device=0))
+        out = fc_layer(input=h, size=NCLS, act=SoftmaxActivation(),
+                       layer_attr=ExtraLayerAttribute(device=1))
+        classification_cost(input=out,
+                            label=data_layer(name="label", size=NCLS))
+
+    batches = _batches(8, np.random.default_rng(6))
+    l1, p1, _ = _train(conf, None, batches)
+    lp, pp, tr = _train(conf, make_mesh(data=4, pipe=2), batches)
+    assert int(tr.opt_state["num_updates"]) == 4       # 8 batches / N=2
+    np.testing.assert_allclose(lp, l1, rtol=2e-4, atol=1e-6)
+    for name in p1:
+        np.testing.assert_allclose(pp[name], p1[name], rtol=3e-4, atol=2e-5)
+
+
 def test_pipeline_rejects_bad_annotations():
     """Non-contiguous device order fails with a clear message."""
     def conf():
