@@ -55,7 +55,7 @@ __all__ = [
     "classification_cost", "regression_cost", "cross_entropy",
     "cross_entropy_with_selfnorm", "soft_binary_class_cross_entropy",
     "multi_binary_label_cross_entropy", "rank_cost", "lambda_cost",
-    "huber_cost", "sum_cost",
+    "huber_cost", "sum_cost", "auc_validation", "pnpair_validation",
     "crf_layer", "crf_decoding_layer", "ctc_layer", "nce_layer", "hsigmoid",
     "recurrent_group", "memory", "StaticInput", "SubsequenceInput",
     "GeneratedInput", "beam_search", "sub_network",
@@ -1249,9 +1249,9 @@ def print_layer(input: LayerOutput, name=None) -> LayerOutput:
 
 def _cost_layer(type_: str, inputs: list[LayerOutput], name, coeff: float = 1.0,
                 cfg_extra: Optional[dict] = None, prefix: str = "cost",
-                params: Optional[list] = None) -> LayerOutput:
+                params: Optional[list] = None, size: int = 1) -> LayerOutput:
     name = _name(name, prefix)
-    cfg = LayerConfig(name=name, type=type_, size=1, coeff=coeff)
+    cfg = LayerConfig(name=name, type=type_, size=size, coeff=coeff)
     for i, inp in enumerate(inputs):
         li = LayerInput(input_layer_name=inp.name)
         if params and params[i] is not None:
@@ -1265,7 +1265,7 @@ def _cost_layer(type_: str, inputs: list[LayerOutput], name, coeff: float = 1.0,
                 cfg.attrs[k] = v
     current_context().add_layer(cfg)
     current_context().model.output_layer_names.append(name)
-    return LayerOutput(name, type_, 1, parents=inputs)
+    return LayerOutput(name, type_, size, parents=inputs)
 
 
 def classification_cost(input: LayerOutput, label: LayerOutput, weight=None,
@@ -1337,6 +1337,26 @@ def huber_cost(input: LayerOutput, label: LayerOutput, name=None,
 def sum_cost(input: LayerOutput, name=None, coeff: float = 1.0) -> LayerOutput:
     """Sum the input as a cost (ref: SumCostLayer)."""
     return _cost_layer("sum_cost", [input], name, coeff)
+
+
+def auc_validation(input: LayerOutput, label: LayerOutput, weight=None,
+                   name=None) -> LayerOutput:
+    """In-graph AUC during training (ref: AucValidation —
+    config_parser.py:1961, ValidationLayer.cpp): pass-through layer whose
+    (score, label[, weight]) inputs feed a last-column-auc evaluator."""
+    inputs = [input, label] + ([weight] if weight is not None else [])
+    return _cost_layer("auc-validation", inputs, name, prefix="auc_validation",
+                       size=input.size)
+
+
+def pnpair_validation(input: LayerOutput, label: LayerOutput,
+                      info: LayerOutput, weight=None, name=None) -> LayerOutput:
+    """In-graph positive-negative pair rate (ref: PnpairValidation —
+    config_parser.py:1962, ValidationLayer.cpp): (score, label, query-info
+    [, weight]) feed a pnpair evaluator grouping by info id."""
+    inputs = [input, label, info] + ([weight] if weight is not None else [])
+    return _cost_layer("pnpair-validation", inputs, name,
+                       prefix="pnpair_validation", size=input.size)
 
 
 def crf_layer(input: LayerOutput, label: LayerOutput, size: Optional[int] = None,

@@ -42,7 +42,7 @@ def _flatten_seq(out: Argument, lbl: Argument):
     return out.value, lbl, None
 
 
-@register_layer("multi-class-cross-entropy")
+@register_layer("multi-class-cross-entropy", cost=True)
 def multi_class_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """-log p[label]; input is a probability distribution (softmax already
     applied as the previous layer's activation, matching the reference's
@@ -63,7 +63,7 @@ def multi_class_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument
     return _record(ctx, cfg, cost)
 
 
-@register_layer("multi_class_cross_entropy_with_selfnorm")
+@register_layer("multi_class_cross_entropy_with_selfnorm", cost=True)
 def selfnorm_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """CE + alpha * log(Z)^2 self-normalization penalty
     (ref: MultiClassCrossEntropyWithSelfNorm::forwardImp)."""
@@ -77,7 +77,7 @@ def selfnorm_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return _record(ctx, cfg, cost)
 
 
-@register_layer("soft_binary_class_cross_entropy")
+@register_layer("soft_binary_class_cross_entropy", cost=True)
 def soft_binary_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """-sum t*log(p) + (1-t)*log(1-p) with soft targets
     (ref: SoftBinaryClassCrossEntropy::forwardImp)."""
@@ -88,7 +88,7 @@ def soft_binary_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument
     return _record(ctx, cfg, cost)
 
 
-@register_layer("multi_binary_label_cross_entropy")
+@register_layer("multi_binary_label_cross_entropy", cost=True)
 def multi_binary_label_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Binary CE against a set of positive label ids
     (ref: MultiBinaryLabelCrossEntropy::forwardImp; label is a sparse binary
@@ -100,7 +100,7 @@ def multi_binary_label_cross_entropy(ctx: ForwardContext, cfg: LayerConfig) -> A
     return _record(ctx, cfg, cost)
 
 
-@register_layer("square_error")
+@register_layer("square_error", cost=True)
 def square_error(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """0.5 * ||out - label||^2 (ref: SumOfSquaresCostLayer::forwardImp)."""
     out, lbl = ctx.get_input(cfg, 0), ctx.get_input(cfg, 1)
@@ -112,7 +112,7 @@ def square_error(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return _record(ctx, cfg, cost)
 
 
-@register_layer("rank-cost")
+@register_layer("rank-cost", cost=True)
 def rank_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Pairwise ranking: -t*o + log(1 + exp(o)), o = s_a - s_b
     (ref: RankingCost::forwardImp)."""
@@ -124,7 +124,7 @@ def rank_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return Argument(value=cost[:, None])
 
 
-@register_layer("huber_classification", "huber")
+@register_layer("huber_classification", "huber", cost=True)
 def huber_two_class(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Two-class huber cost on a scalar score with labels {0,1} -> y in {-1,1}
     (ref: HuberTwoClass::forwardImp)."""
@@ -136,7 +136,7 @@ def huber_two_class(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return _record(ctx, cfg, cost)
 
 
-@register_layer("sum_cost")
+@register_layer("sum_cost", cost=True)
 def sum_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Sum input values as cost (ref: SumCostLayer)."""
     out = ctx.get_input(cfg, 0)
@@ -148,7 +148,7 @@ def sum_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return Argument(value=cost[:, None])
 
 
-@register_layer("lambda_cost")
+@register_layer("lambda_cost", cost=True)
 def lambda_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """LambdaRank NDCG cost over each list (sequence) (ref: LambdaCost).
 
@@ -169,3 +169,22 @@ def lambda_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     pair_cost = jax.nn.softplus(-sdiff) * better * gain_w * pair_valid
     cost = jnp.sum(pair_cost, axis=(1, 2))
     return _record(ctx, cfg, cost)
+
+
+# -- in-graph validation layers ---------------------------------------------
+
+@register_layer("auc-validation", "pnpair-validation")
+def validation_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
+    """Evaluation inside the graph during training (ref:
+    paddle/gserver/layers/ValidationLayer.cpp; created at Layer.cpp:116-119;
+    DSL side config_parser.py:1961-1962).
+
+    The reference's AucValidation/PnpairValidation wrap an Evaluator
+    ('last-column-auc' / 'pnpair') fed every forward, with a no-op
+    backward.  Here the layer itself is a stop-gradient pass-through of
+    its score input; the evaluator wiring is synthesized from the layer
+    config by EvaluatorSet (trainer/evaluators.py), which already owns
+    the start/eval/finish accumulation protocol.
+    """
+    out = ctx.get_input(cfg, 0)
+    return jax.tree.map(jax.lax.stop_gradient, out)

@@ -320,7 +320,7 @@ def eos_id_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 # structured-output layers: CRF / CTC
 # ---------------------------------------------------------------------------
 
-@register_layer("crf")
+@register_layer("crf", cost=True)
 def crf_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Linear-chain CRF negative log-likelihood over each sequence
     (ref: CRFLayer.cpp, LinearChainCRF.cpp)."""
@@ -351,7 +351,7 @@ def crf_decoding_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return Argument(ids=path, lengths=x.lengths)
 
 
-@register_layer("ctc")
+@register_layer("ctc", cost=True)
 def ctc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """CTC loss (ref: CTCLayer.cpp, LinearChainCTC.cpp)."""
     from paddle_tpu.ops.ctc import ctc_loss
@@ -367,7 +367,7 @@ def ctc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 # sampled-softmax family
 # ---------------------------------------------------------------------------
 
-@register_layer("nce")
+@register_layer("nce", cost=True)
 def nce_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Noise-contrastive estimation cost (ref: NCELayer.cpp,
     MultinomialSampler.cpp).  Samples num_neg_samples negatives per example
@@ -392,7 +392,7 @@ def nce_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     return Argument(value=cost[:, None])
 
 
-@register_layer("hsigmoid")
+@register_layer("hsigmoid", cost=True)
 def hsigmoid_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Hierarchical sigmoid cost over a complete binary tree
     (ref: HierarchicalSigmoidLayer.cpp, math/MatrixBitCode.cpp)."""

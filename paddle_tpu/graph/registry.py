@@ -20,13 +20,21 @@ LayerFn = Callable[..., "Argument"]
 
 layer_registry: dict[str, LayerFn] = {}
 
+# Layer types whose output is a training cost (they write ctx.costs) —
+# the analog of the reference's CostLayer subtree (ref:
+# paddle/gserver/layers/CostLayer.cpp). Consumers (e.g. lm_decode's
+# logits-layer default) use this instead of string-matching type names.
+cost_layer_types: set[str] = set()
 
-def register_layer(*type_names: str):
+
+def register_layer(*type_names: str, cost: bool = False):
     def deco(fn: LayerFn) -> LayerFn:
         for name in type_names:
             if name in layer_registry:
                 raise ValueError(f"duplicate layer type {name!r}")
             layer_registry[name] = fn
+            if cost:
+                cost_layer_types.add(name)
         return fn
     return deco
 

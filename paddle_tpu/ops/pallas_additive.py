@@ -195,10 +195,25 @@ def additive_attention_step(
     mask: Optional[Array] = None,
 ) -> Array:
     """Pallas-fused additive attention step; same contract as
-    ops/attention.py:additive_attention_step."""
+    ops/attention.py:additive_attention_step.
+
+    The kernel is lengths-based: it reads the mask only as a per-row
+    valid-prefix count.  A mask that is not prefix-contiguous (or has an
+    all-invalid row, where the dense path returns the uniform average)
+    is detected at trace time via a runtime lax.cond and routed to the
+    dense path, so the public contract really is the dense one.
+    """
     B, T, _ = enc_proj.shape
     if mask is None:
         lengths = jnp.full((B,), T, jnp.float32)
-    else:
-        lengths = jnp.sum(mask.astype(jnp.float32), axis=-1)
-    return _fused(dec_state, w, v, enc_proj, enc_seq, lengths)
+        return _fused(dec_state, w, v, enc_proj, enc_seq, lengths)
+    m = mask.astype(bool)
+    lengths = jnp.sum(m.astype(jnp.float32), axis=-1)
+    prefix = jnp.arange(T)[None, :] < lengths.astype(jnp.int32)[:, None]
+    kernel_ok = jnp.logical_and(jnp.all(m == prefix), jnp.all(lengths > 0))
+    from paddle_tpu.ops.attention import additive_attention_step as dense
+    return jax.lax.cond(
+        kernel_ok,
+        lambda: _fused(dec_state, w, v, enc_proj, enc_seq, lengths),
+        lambda: dense(dec_state, w, v, enc_proj, enc_seq, m).astype(
+            enc_seq.dtype))

@@ -209,7 +209,9 @@ class PipelineExecutor:
         graph on a microbatch-shaped feed.  Static per batch signature."""
         sig = tuple(sorted(
             (n, a.value is not None and tuple(a.value.shape[1:]),
-             a.ids is not None and tuple(a.ids.shape[1:]), a.sparse_dim)
+             a.ids is not None and tuple(a.ids.shape[1:]), a.sparse_dim,
+             a.lengths is not None,
+             a.sub_lengths is not None and tuple(a.sub_lengths.shape[1:]))
             for n, a in feed.items()))
         key = (sig, mb)
         if key in self._spec_cache:

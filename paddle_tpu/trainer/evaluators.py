@@ -105,15 +105,22 @@ _AUC_BINS = 1024
 
 
 def _auc_batch(cfg, outputs, feed):
-    """(ref: Evaluator.cpp AucEvaluator — 2 x kBinNum histograms)."""
+    """(ref: Evaluator.cpp AucEvaluator — 2 x kBinNum histograms; created
+    with colIdx=-1 for 'last-column-auc' (Evaluator.cpp:857-858), so the
+    score is always the LAST output column; optional 3rd input = per-sample
+    weight)."""
     out = _get(outputs, cfg.input_layer_names[0])
     lbl = _get(outputs, cfg.input_layer_names[1])
     p = out.value
-    pos_prob = p[..., 1] if p.shape[-1] == 2 else p[..., 0]
+    pos_prob = p[..., -1]
     y = lbl.ids.astype(jnp.float32).reshape(pos_prob.shape)
+    w = jnp.ones_like(pos_prob)
+    if len(cfg.input_layer_names) > 2:
+        wt = _get(outputs, cfg.input_layer_names[2])
+        w = wt.value.reshape(pos_prob.shape).astype(jnp.float32)
     idx = jnp.clip((pos_prob * _AUC_BINS).astype(jnp.int32), 0, _AUC_BINS - 1)
-    pos_hist = jnp.zeros((_AUC_BINS,), jnp.float32).at[idx].add(y)
-    neg_hist = jnp.zeros((_AUC_BINS,), jnp.float32).at[idx].add(1.0 - y)
+    pos_hist = jnp.zeros((_AUC_BINS,), jnp.float32).at[idx].add(y * w)
+    neg_hist = jnp.zeros((_AUC_BINS,), jnp.float32).at[idx].add((1.0 - y) * w)
     return {"pos": pos_hist, "neg": neg_hist}
 
 
@@ -569,6 +576,11 @@ def _max_frame_print(cfg, args):
     (ref: Evaluator.cpp MaxFramePrinter — selects each sequence's frame
     with the maximal output value)."""
     a = args[0]
+    if a.value is None:
+        raise ValueError(
+            f"max_frame_printer on {cfg.input_layer_names!r}: probed layer has "
+            f"no dense value (ids-only output) — point it at a layer that "
+            f"emits values")
     v = np.asarray(a.value)
     if v.ndim == 2:
         v = v[:, None, :]               # [B, 1, D]: non-sequence = 1 frame
@@ -601,9 +613,24 @@ class EvaluatorSet:
     """Accumulates all configured evaluators across batches
     (ref: Evaluator start/eval/finish + printStats protocol)."""
 
+    # validation layer type -> evaluator it hosts (ref: ValidationLayer.cpp
+    # AucValidation::init sets type 'last-column-auc', PnpairValidation::init
+    # sets 'pnpair'; the layer is a pass-through registered in
+    # graph/layers_cost.py)
+    _VALIDATION_LAYERS = {"auc-validation": "last-column-auc",
+                          "pnpair-validation": "pnpair"}
+
     def __init__(self, model: ModelConfig):
-        self.configs = [e for e in model.evaluators if e.type in evaluator_registry]
-        self.host_configs = [e for e in model.evaluators
+        evals = list(model.evaluators)
+        for layer in model.layers:
+            ev_type = self._VALIDATION_LAYERS.get(layer.type)
+            if ev_type is not None:
+                evals.append(EvaluatorConfig(
+                    name=layer.name, type=ev_type,
+                    input_layer_names=[i.input_layer_name
+                                       for i in layer.inputs]))
+        self.configs = [e for e in evals if e.type in evaluator_registry]
+        self.host_configs = [e for e in evals
                              if e.type in host_evaluator_registry]
         # True = silently skip evaluators whose input layers are absent
         # from the step outputs (the Trainer sets this under pipeline

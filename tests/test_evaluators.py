@@ -222,3 +222,31 @@ def test_host_evaluator_in_trainer():
     assert np.isfinite(loss)
     stats = tr.evaluators.finalize_host(tr._host_acc)
     assert any("chunk" in k or "true_chunks" in k for k in stats)
+
+
+# -- last-column-auc --------------------------------------------------------
+
+def test_auc_uses_last_column_and_weight():
+    """ref: Evaluator.cpp:857 creates AucEvaluator(-1): score is always the
+    LAST output column; optional 3rd input is a per-sample weight."""
+    import jax.numpy as jnp
+    from paddle_tpu.trainer.evaluators import evaluator_registry
+
+    batch, final = evaluator_registry["last-column-auc"]
+    # 3-column output; only the last column separates the classes
+    out = Argument(value=jnp.array([[.5, .2, .9], [.5, .2, .1],
+                                    [.5, .2, .95]], jnp.float32))
+    lbl = Argument(ids=jnp.array([1, 0, 0]))
+    w_zero_bad = Argument(value=jnp.array([[1.], [1.], [0.]], jnp.float32))
+
+    cfg2 = EvaluatorConfig(name="a", type="last-column-auc",
+                           input_layer_names=["o", "l"])
+    res = batch(cfg2, {"o": out, "l": lbl}, {})
+    auc = final(cfg2, {k: np.asarray(v) for k, v in res.items()})["auc"]
+    assert auc == pytest.approx(0.5)   # one concordant, one discordant pair
+
+    cfg3 = EvaluatorConfig(name="a", type="last-column-auc",
+                           input_layer_names=["o", "l", "w"])
+    res = batch(cfg3, {"o": out, "l": lbl, "w": w_zero_bad}, {})
+    auc = final(cfg3, {k: np.asarray(v) for k, v in res.items()})["auc"]
+    assert auc == pytest.approx(1.0)   # the discordant sample has weight 0

@@ -1,0 +1,126 @@
+"""auc-validation / pnpair-validation layer types — evaluation inside the
+graph during training (ref: paddle/gserver/layers/ValidationLayer.cpp,
+created at Layer.cpp:116-119; config classes config_parser.py:1961-1962).
+
+Oracle strategy: run the model forward once to get its actual scores, compute
+AUC / pnpair with straight numpy, and require the in-graph validation layers
+to report the same numbers through Trainer.test()."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.data.provider import dense_vector, integer_value, provider
+from paddle_tpu.dsl import (
+    SoftmaxActivation, TanhActivation, auc_validation, classification_cost,
+    data_layer, fc_layer, pnpair_validation, settings,
+)
+from paddle_tpu.trainer.trainer import Trainer
+
+DIM = 8
+N = 64
+
+
+def _config():
+    settings(batch_size=16, learning_rate=0.1)
+    x = data_layer(name="x", size=DIM)
+    h = fc_layer(input=x, size=16, act=TanhActivation())
+    out = fc_layer(input=h, size=2, act=SoftmaxActivation())
+    lbl = data_layer(name="label", size=2)
+    qid = data_layer(name="qid", size=N)
+    classification_cost(input=out, label=lbl)
+    auc_validation(input=out, label=lbl, name="val_auc")
+    pnpair_validation(input=out, label=lbl, info=qid, name="val_pnpair")
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((N, DIM)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    qid = (np.arange(N) // 8).astype(np.int32)     # 8 queries of 8 rows
+    return x, y, qid
+
+
+@provider(input_types={"x": dense_vector(DIM), "label": integer_value(2),
+                       "qid": integer_value(N)})
+def _prov(settings, fname):
+    x, y, qid = _data()
+    for i in range(N):
+        yield [x[i], int(y[i]), int(qid[i])]
+
+
+def _numpy_auc(scores, labels, bins=1024):
+    """The evaluator's own histogram method, independently re-derived."""
+    idx = np.clip((scores * bins).astype(np.int64), 0, bins - 1)
+    pos = np.bincount(idx, weights=labels, minlength=bins)
+    neg = np.bincount(idx, weights=1.0 - labels, minlength=bins)
+    tp, fp = np.cumsum(pos[::-1]), np.cumsum(neg[::-1])
+    tpr = np.concatenate([[0.0], tp / tp[-1]])
+    fpr = np.concatenate([[0.0], fp / fp[-1]])
+    return float(np.trapezoid(tpr, fpr))
+
+
+def _numpy_pnpair(scores, labels, qid):
+    pos = neg = 0.0
+    for q in np.unique(qid):
+        sel = qid == q
+        s, l = scores[sel], labels[sel]
+        for a in range(len(s)):
+            for b in range(a + 1, len(s)):
+                if l[a] == l[b]:
+                    continue
+                if (s[a] - s[b]) * (l[a] - l[b]) > 0:
+                    pos += 1.0
+                elif (s[a] - s[b]) * (l[a] - l[b]) < 0:
+                    neg += 1.0
+    return pos, neg
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = parse_config_callable(_config)
+    tr = Trainer(cfg, seed=11)
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(_prov, ["d"], ["x", "label", "qid"],
+                        batch_size=16, seed=3, shuffle=False, drop_last=False)
+    metrics = tr.test(batches=feeder.batches())
+    # model scores for the oracle: forward via the executor
+    x, y, qid = _data()
+    from paddle_tpu.parameter.argument import Argument
+    outputs, _, _ = tr.executor.forward(
+        tr.params,
+        {"x": Argument(value=x), "label": Argument(ids=y),
+         "qid": Argument(ids=qid)},
+        None, "test", None)
+    score_layer = [l.name for l in tr.model.layers if l.type == "fc"][-1]
+    scores = np.asarray(outputs[score_layer].value)[:, 1]
+    return metrics, scores, y, qid
+
+
+def test_auc_validation_matches_numpy(trained):
+    metrics, scores, y, qid = trained
+    key = [k for k in metrics if "val_auc" in k and "auc" in k]
+    assert key, f"auc-validation metric missing from {sorted(metrics)}"
+    want = _numpy_auc(scores, y.astype(np.float64))
+    assert metrics[key[0]] == pytest.approx(want, abs=1e-6)
+
+
+def test_pnpair_validation_matches_numpy(trained):
+    metrics, scores, y, qid = trained
+    key = [k for k in metrics if "val_pnpair" in k and k.endswith("pnpair")]
+    assert key, f"pnpair-validation metric missing from {sorted(metrics)}"
+    pos, neg = _numpy_pnpair(scores, y, qid)
+    assert metrics[key[0]] == pytest.approx(pos / max(neg, 1e-8), rel=1e-6)
+
+
+def test_validation_layers_train_ok():
+    """Training with validation layers present must run and not affect
+    gradients (reference backward is a no-op)."""
+    cfg = parse_config_callable(_config)
+    tr = Trainer(cfg, seed=11)
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(_prov, ["d"], ["x", "label", "qid"],
+                        batch_size=16, seed=3)
+    stats = tr.train_one_pass(batches=feeder.batches())
+    assert np.isfinite(stats["cost"])
+    assert any("val_auc" in k for k in stats), sorted(stats)
