@@ -433,6 +433,12 @@ class OptimizationConfig(_Serializable):
     # design where each server updates 1/N of every parameter — here XLA
     # keeps the update sharded and gathers only the fresh params)
     shard_optimizer_state: bool = False
+    # ZeRO stage over the data axis (generalizes shard_optimizer_state):
+    #   0 = off (or 1 if shard_optimizer_state is set)
+    #   1 = optimizer slots sharded
+    #   2 = + gradients reduce-scattered to the same shards
+    #   3 = + parameters stored sharded (FSDP; gathered on use by XLA)
+    zero_stage: int = 0
 
 
 @_schema

@@ -119,14 +119,23 @@ def additive_attention_step_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argu
     seq = ctx.get_input(cfg, 2)
     w = ctx.param_of(cfg, 0)
     v = ctx.param_of(cfg, 1)
-    mask = proj.mask() if proj.lengths is not None else (
-        seq.mask() if seq.lengths is not None else None)
+    lengths = proj.lengths if proj.lengths is not None else seq.lengths
 
     from paddle_tpu.ops.attention import additive_attention_step
     from paddle_tpu.ops import pallas_additive
-    fn = additive_attention_step
     if pallas_additive.supported() and \
             str(cfg.attrs.get("attn_impl", "auto")) != "dense":
-        fn = pallas_additive.additive_attention_step
-    out = fn(dec.value, w, v.reshape(-1), proj.value, seq.value, mask)
+        # lengths flow straight into the kernel: the mask here is always a
+        # length prefix, so the kernel's runtime contiguity guard (which
+        # costs an O(B*T) check + lax.cond inside the decoder scan) is
+        # statically unnecessary
+        out = pallas_additive.additive_attention_step(
+            dec.value, w, v.reshape(-1), proj.value, seq.value,
+            lengths=lengths)
+    else:
+        mask = None
+        if lengths is not None:
+            mask = (proj.mask() if proj.lengths is not None else seq.mask())
+        out = additive_attention_step(dec.value, w, v.reshape(-1),
+                                      proj.value, seq.value, mask)
     return finish_layer(ctx, cfg, out, like=dec)

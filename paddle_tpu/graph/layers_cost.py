@@ -173,7 +173,7 @@ def lambda_cost(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 
 # -- in-graph validation layers ---------------------------------------------
 
-@register_layer("auc-validation", "pnpair-validation")
+@register_layer("auc-validation", "pnpair-validation", validation=True)
 def validation_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Evaluation inside the graph during training (ref:
     paddle/gserver/layers/ValidationLayer.cpp; created at Layer.cpp:116-119;

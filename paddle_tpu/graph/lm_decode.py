@@ -57,9 +57,10 @@ def lm_generate(
     if input_name is None:
         input_name = model.input_layer_names[0]
     if logits_name is None:
-        from paddle_tpu.graph.registry import cost_layer_types
-        non_cost = [l.name for l in model.layers
-                    if l.type not in cost_layer_types and l.type != "data"]
+        from paddle_tpu.graph.registry import (cost_layer_types,
+                                               validation_layer_types)
+        skip = cost_layer_types | validation_layer_types | {"data"}
+        non_cost = [l.name for l in model.layers if l.type not in skip]
         logits_name = non_cost[-1]
 
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)

@@ -26,8 +26,13 @@ layer_registry: dict[str, LayerFn] = {}
 # logits-layer default) use this instead of string-matching type names.
 cost_layer_types: set[str] = set()
 
+# Validation layer types (ref: ValidationLayer.h) — in-graph evaluator
+# hosts; pass-throughs, never a model's real output.
+validation_layer_types: set[str] = set()
 
-def register_layer(*type_names: str, cost: bool = False):
+
+def register_layer(*type_names: str, cost: bool = False,
+                   validation: bool = False):
     def deco(fn: LayerFn) -> LayerFn:
         for name in type_names:
             if name in layer_registry:
@@ -35,6 +40,8 @@ def register_layer(*type_names: str, cost: bool = False):
             layer_registry[name] = fn
             if cost:
                 cost_layer_types.add(name)
+            if validation:
+                validation_layer_types.add(name)
         return fn
     return deco
 

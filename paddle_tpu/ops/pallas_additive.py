@@ -193,27 +193,36 @@ def additive_attention_step(
     enc_proj: Array,
     enc_seq: Array,
     mask: Optional[Array] = None,
+    lengths: Optional[Array] = None,
 ) -> Array:
     """Pallas-fused additive attention step; same contract as
     ops/attention.py:additive_attention_step.
 
     The kernel is lengths-based: it reads the mask only as a per-row
-    valid-prefix count.  A mask that is not prefix-contiguous (or has an
-    all-invalid row, where the dense path returns the uniform average)
-    is detected at trace time via a runtime lax.cond and routed to the
-    dense path, so the public contract really is the dense one.
+    valid-prefix count.  Callers that statically know their mask is a
+    length prefix (the graph layer derives it from Argument lengths)
+    should pass `lengths` directly — no guard, no mask materialization.
+    A caller-supplied `mask` instead goes through a runtime
+    prefix-contiguity check (lax.cond) and falls back to the dense path
+    when it isn't a prefix (or has an all-invalid row, where the dense
+    path returns the uniform average), so the public mask contract
+    really is the dense one.
     """
     B, T, _ = enc_proj.shape
+    if lengths is not None:
+        assert mask is None, "pass mask or lengths, not both"
+        return _fused(dec_state, w, v, enc_proj, enc_seq,
+                      lengths.astype(jnp.float32))
     if mask is None:
-        lengths = jnp.full((B,), T, jnp.float32)
-        return _fused(dec_state, w, v, enc_proj, enc_seq, lengths)
+        full = jnp.full((B,), T, jnp.float32)
+        return _fused(dec_state, w, v, enc_proj, enc_seq, full)
     m = mask.astype(bool)
-    lengths = jnp.sum(m.astype(jnp.float32), axis=-1)
-    prefix = jnp.arange(T)[None, :] < lengths.astype(jnp.int32)[:, None]
-    kernel_ok = jnp.logical_and(jnp.all(m == prefix), jnp.all(lengths > 0))
+    lens = jnp.sum(m.astype(jnp.float32), axis=-1)
+    prefix = jnp.arange(T)[None, :] < lens.astype(jnp.int32)[:, None]
+    kernel_ok = jnp.logical_and(jnp.all(m == prefix), jnp.all(lens > 0))
     from paddle_tpu.ops.attention import additive_attention_step as dense
     return jax.lax.cond(
         kernel_ok,
-        lambda: _fused(dec_state, w, v, enc_proj, enc_seq, lengths),
+        lambda: _fused(dec_state, w, v, enc_proj, enc_seq, lens),
         lambda: dense(dec_state, w, v, enc_proj, enc_seq, m).astype(
             enc_seq.dtype))

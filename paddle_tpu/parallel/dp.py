@@ -52,8 +52,59 @@ def global_put(x, sharding: NamedSharding):
 _global_put = global_put
 
 
+def effective_zero_stage(opt_config) -> int:
+    """ZeRO stage from an OptimizationConfig: zero_stage, floored at 1 when
+    the older shard_optimizer_state flag is set."""
+    stage = int(getattr(opt_config, "zero_stage", 0))
+    if getattr(opt_config, "shard_optimizer_state", False):
+        stage = max(stage, 1)
+    return stage
+
+
+def _zero_eligible(spec, n_data: int, leaf) -> bool:
+    """A leaf can shard its leading dim over `data`: no explicit (tp/emb)
+    spec, a divisible leading dim, and a real array."""
+    return (not spec and n_data > 1 and hasattr(leaf, "ndim")
+            and leaf.ndim >= 1 and leaf.shape[0] % n_data == 0)
+
+
+def effective_param_specs(mesh: Mesh, model: ModelConfig) -> dict:
+    """Per-parameter partition specs INCLUDING the implicit vocab-dim
+    defaulting for sparse_update embedding tables (parallel/sparse.py) —
+    the single source of eligibility for params, slots AND gradients, so
+    the three can never disagree about a parameter's home axis."""
+    from paddle_tpu.parallel.sparse import embedding_partition_spec
+    specs = {p.name: p.partition_spec for p in model.parameters}
+    emb_spec = embedding_partition_spec(mesh)
+    if emb_spec is not None:
+        n_emb = axis_size(mesh, emb_spec[0])
+        for p in model.parameters:
+            if p.sparse_update and not p.partition_spec \
+                    and len(p.dims) == 2 and p.dims[0] % n_emb == 0:
+                specs[p.name] = emb_spec
+    return specs
+
+
+def zero_grad_shardings(mesh: Mesh, model: ModelConfig,
+                        params: dict) -> dict[str, Optional[NamedSharding]]:
+    """Per-parameter gradient shardings for ZeRO stage >= 2: the gradient of
+    every eligible parameter is reduce-scattered onto the data axis (XLA
+    replaces its all-reduce) so the optimizer update runs sharded — the
+    pserver addGradient design, where each server only ever receives its
+    own 1/N of each gradient (ref: ParameterServer2.h:501 addGradient +
+    :120-145 block maps).  Explicitly-sharded params (tp, vocab-sharded
+    embeddings) are left alone — their gradients already follow the
+    parameter's own axis."""
+    specs = effective_param_specs(mesh, model)
+    n_data = axis_size(mesh, DATA_AXIS)
+    return {name: NamedSharding(mesh, P(DATA_AXIS))
+            if _zero_eligible(specs.get(name), n_data, leaf) else None
+            for name, leaf in params.items()}
+
+
 def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict,
-                        opt_state: Any, shard_opt: bool = False):
+                        opt_state: Any, shard_opt: bool = False,
+                        zero_stage: int = 0):
     """Place params (+ optimizer slots) on the mesh per their partition specs.
     Parameters marked sparse_update (embedding tables) default to vocab-dim
     sharding — the pserver-shard analog (see parallel/sparse.py).
@@ -66,29 +117,40 @@ def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict,
     update math along the slot sharding and inserts the gathers the next
     step needs.  Slots of explicitly-sharded (tp) parameters keep their
     parameter's spec; leaves whose leading dim doesn't divide stay
-    replicated."""
-    from paddle_tpu.parallel.sparse import embedding_partition_spec
-    specs = {p.name: p.partition_spec for p in model.parameters}
-    emb_spec = embedding_partition_spec(mesh)
-    if emb_spec is not None:
-        n_emb = axis_size(mesh, emb_spec[0])
-        for p in model.parameters:
-            if p.sparse_update and not p.partition_spec \
-                    and len(p.dims) == 2 and p.dims[0] % n_emb == 0:
-                specs[p.name] = emb_spec
+    replicated.
+
+    zero_stage extends this (settings(zero_stage=N)): stage >= 1 implies
+    shard_opt; stage >= 3 (FSDP) also stores every eligible PARAMETER
+    sharded on its leading dim — XLA all-gathers a parameter just before
+    use and discards the gathered copy, and the sharded optimizer update
+    writes each shard in place (grads arrive reduce-scattered via
+    zero_grad_shardings at stage >= 2)."""
+    shard_opt = shard_opt or zero_stage >= 1
+    specs = effective_param_specs(mesh, model)
+    n_data = axis_size(mesh, DATA_AXIS)
+    if zero_stage >= 3:
+        # FSDP parameter sharding: eligible params get P(data) on dim 0 so
+        # their slots/grads/update all follow the same shards
+        for name, v in params.items():
+            if name in specs and specs[name]:
+                continue
+            if _zero_eligible(specs.get(name), n_data, v):
+                specs[name] = [DATA_AXIS] + [None] * (np.ndim(v) - 1)
+
     out_params = {
         name: _global_put(v, param_sharding(mesh, specs.get(name)))
         for name, v in params.items()
     }
 
-    n_data = axis_size(mesh, DATA_AXIS)
-
     def slot_sharding(name, leaf):
-        if shard_opt and not specs.get(name) and n_data > 1 \
-                and hasattr(leaf, "ndim") and leaf.ndim >= 1 \
-                and leaf.shape[0] % n_data == 0:
+        spec = specs.get(name)
+        if shard_opt and _zero_eligible(spec, n_data, leaf):
             return NamedSharding(mesh, P(DATA_AXIS))
-        return param_sharding(mesh, specs.get(name))
+        if spec and hasattr(leaf, "ndim") and leaf.ndim != len(spec):
+            # a slot whose rank differs from its parameter's (e.g. a scalar
+            # accumulator) cannot reuse the parameter's spec
+            return NamedSharding(mesh, P())
+        return param_sharding(mesh, spec)
 
     def place_slots(slots_for_param, name):
         return jax.tree.map(

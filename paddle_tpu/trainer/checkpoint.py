@@ -15,12 +15,76 @@ import json
 import os
 import re
 import shutil
+import struct
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
 SEP = "|"  # path separator inside npz keys
+
+# -- reference v0.9.0 binary Parameter format --------------------------------
+# Header {int32 version; uint32 valueSize; uint64 size} followed by
+# size*valueSize raw little-endian reals (ref: parameter/Parameter.h:300-306
+# kFormatVersion=0, Parameter.cpp:309-381 save/load); a pass-%05d dir holds
+# one such file per parameter, named by the parameter.
+_REF_HEADER = struct.Struct("<iIQ")
+
+
+def read_reference_parameter(path: str) -> np.ndarray:
+    """Read one reference-format parameter file -> flat float array."""
+    with open(path, "rb") as f:
+        raw = f.read(_REF_HEADER.size)
+        if len(raw) < _REF_HEADER.size:
+            raise ValueError(f"{path}: too short for a parameter header")
+        version, value_size, size = _REF_HEADER.unpack(raw)
+        if version != 0:
+            raise ValueError(f"{path}: unsupported format version {version}")
+        if value_size not in (4, 8):
+            raise ValueError(f"{path}: unsupported valueSize {value_size}")
+        dtype = np.float32 if value_size == 4 else np.float64
+        data = np.frombuffer(f.read(size * value_size), dtype=dtype)
+        if data.size != size:
+            raise ValueError(
+                f"{path}: header promises {size} values, file has {data.size}")
+    return data
+
+
+def write_reference_parameter(path: str, arr: np.ndarray) -> None:
+    """Write a flat array in the reference binary format (export /
+    test-synthesis counterpart of read_reference_parameter)."""
+    flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+    with open(path, "wb") as f:
+        f.write(_REF_HEADER.pack(0, 4, flat.size))
+        f.write(flat.tobytes())
+
+
+def _is_reference_parameter_file(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(_REF_HEADER.size)
+        if len(raw) < _REF_HEADER.size:
+            return False
+        version, value_size, size = _REF_HEADER.unpack(raw)
+    except OSError:
+        return False
+    return (version == 0 and value_size in (4, 8)
+            and os.path.getsize(path) == _REF_HEADER.size + size * value_size)
+
+
+def load_reference_pass_dir(d: str) -> dict[str, np.ndarray]:
+    """Import a reference pass-%05d directory: every well-formed parameter
+    file, keyed by file name (= parameter name).  Arrays come back FLAT —
+    the caller reshapes against its model's parameter dims."""
+    out: dict[str, np.ndarray] = {}
+    for name in sorted(os.listdir(d)):
+        p = os.path.join(d, name)
+        if os.path.isfile(p) and _is_reference_parameter_file(p):
+            out[name] = read_reference_parameter(p)
+    if not out:
+        raise ValueError(
+            f"{d}: no reference-format parameter files found")
+    return out
 
 
 def _flatten(tree: Any, prefix: str) -> dict[str, np.ndarray]:
@@ -112,10 +176,26 @@ def load_checkpoint(path: str) -> dict[str, Any]:
             if lp >= 0:
                 # given the save_dir root, resume from its newest pass
                 # (ref: ParamUtil --start_pass resume semantics)
-                npz = os.path.join(path, f"pass-{lp:05d}", "model.npz")
+                cand = os.path.join(path, f"pass-{lp:05d}")
+                npz = os.path.join(cand, "model.npz")
+                if not os.path.exists(npz):
+                    # a reference-produced save_dir: its pass dirs hold raw
+                    # binary parameter files instead of model.npz
+                    return {"params": load_reference_pass_dir(cand),
+                            "reference_format": True, "pass_id": lp}
             elif os.path.exists(os.path.join(path, "pass-init", "model.npz")):
                 # only a pre-training snapshot exists: resume from it
                 npz = os.path.join(path, "pass-init", "model.npz")
+            elif os.path.isdir(path) and any(
+                    _is_reference_parameter_file(os.path.join(path, x))
+                    for x in os.listdir(path)):
+                # a reference v0.9.0 pass directory given directly
+                out: dict[str, Any] = {"params": load_reference_pass_dir(path),
+                                       "reference_format": True}
+                m = re.match(r"pass-(\d{5})$", os.path.basename(path))
+                if m:
+                    out["pass_id"] = int(m.group(1))
+                return out
     data = np.load(npz, allow_pickle=False)
     flat = {k: data[k] for k in data.files}
     trees: dict[str, dict] = {"params": {}, "opt": {}, "net": {}}

@@ -114,10 +114,15 @@ class Trainer:
         self.pass_id = 0
 
         if mesh is not None:
-            from paddle_tpu.parallel.dp import shard_train_objects
+            from paddle_tpu.parallel.dp import (effective_zero_stage,
+                                                shard_train_objects)
+            self.zero_stage = effective_zero_stage(self.opt)
             self.params, self.opt_state = shard_train_objects(
                 mesh, self.model, self.params, self.opt_state,
-                shard_opt=self.opt.shard_optimizer_state)
+                shard_opt=self.opt.shard_optimizer_state,
+                zero_stage=self.zero_stage)
+        else:
+            self.zero_stage = 0
         self._train_step_fn = self._build_train_step_fn()
         self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
         self._test_step = self._build_test_step()
@@ -171,6 +176,22 @@ class Trainer:
     def _build_train_step_fn(self):
         executor, updater, evaluators = self.executor, self.updater, self.evaluators
         probe_names = self._probe_names
+        grad_shardings = None
+        if self.mesh is not None and self.zero_stage >= 2:
+            # ZeRO-2: pin each eligible gradient to the data axis so XLA
+            # emits a reduce-scatter instead of an all-reduce and the
+            # optimizer update runs on 1/N shards (the pserver addGradient
+            # contract — each server receives only its own blocks)
+            from paddle_tpu.parallel.dp import zero_grad_shardings
+            grad_shardings = zero_grad_shardings(self.mesh, self.model,
+                                                 self.params)
+
+        def constrain_grads(grads):
+            if grad_shardings is None:
+                return grads
+            return {n: jax.lax.with_sharding_constraint(g, grad_shardings[n])
+                    if grad_shardings.get(n) is not None else g
+                    for n, g in grads.items()}
 
         def train_step(params, opt_state, net_state, batch, rng):
             if probe_names:
@@ -194,6 +215,7 @@ class Trainer:
                 (loss, (outputs, costs, new_net)), (grads, probe_grads) = \
                     jax.value_and_grad(loss_fn, argnums=(0, 1),
                                        has_aux=True)(params, probes)
+                grads = constrain_grads(grads)
                 outputs = dict(outputs)
                 for n, g in probe_grads.items():
                     outputs["__grad__" + n] = Argument(value=g)
@@ -203,6 +225,7 @@ class Trainer:
                     return loss, aux
                 (loss, (outputs, costs, new_net)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
+                grads = constrain_grads(grads)
             if self.mesh is not None:
                 # grads are averaged across data shards by XLA automatically
                 # via sharding propagation; nothing to do here.
@@ -717,10 +740,20 @@ class Trainer:
         """(ref: ParamUtil::loadParameters / --init_model_path)."""
         data = ckpt.load_checkpoint(path)
         loaded = data["params"]
+        ref_fmt = data.get("reference_format", False)
+        self.params = dict(self.params)
         for name in self.params:
             assert name in loaded, f"checkpoint missing parameter {name!r}"
-            self.params = dict(self.params)
-            self.params[name] = jnp.asarray(loaded[name])
+            cur = self.params[name]
+            arr = jnp.asarray(loaded[name])
+            if ref_fmt:
+                # reference files are flat fp32 (Parameter.cpp:309-313):
+                # restore this model's shape/dtype
+                assert arr.size == cur.size, (
+                    f"parameter {name!r}: reference file has {arr.size} "
+                    f"values, model expects {cur.size}")
+                arr = arr.reshape(cur.shape).astype(cur.dtype)
+            self.params[name] = arr
         # rebuild pruning masks from the loaded magnitudes (the reference
         # reloads its mask file on --init_model_path too)
         self.params = self.updater.apply_init_hooks(self.params)
@@ -737,7 +770,8 @@ class Trainer:
             from paddle_tpu.parallel.dp import shard_train_objects
             self.params, self.opt_state = shard_train_objects(
                 self.mesh, self.model, self.params, self.opt_state,
-                shard_opt=self.opt.shard_optimizer_state)
+                shard_opt=self.opt.shard_optimizer_state,
+                zero_stage=self.zero_stage)
         if "pass_id" in data:
             # continue the pass numbering: the snapshot is named after its
             # last completed pass, so the resumed run trains (and next

@@ -12,10 +12,13 @@ import numpy as np
 import pytest
 
 from paddle_tpu.ops.attention import (
+
     blockwise_attention,
     dot_product_attention,
     multi_head_attention,
 )
+
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
 
 
 def _rand_qkv(rng, B=2, T=16, H=2, D=4, Tk=None):

@@ -16,6 +16,9 @@ from paddle_tpu.ops.attention import (
     blockwise_attention, dot_product_attention, ring_attention)
 from paddle_tpu.ops.pallas_attention import flash_attention
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
+
 
 def _case(rng, B, T, H, H_kv, D):
     q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)

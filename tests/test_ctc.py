@@ -17,6 +17,9 @@ import jax.numpy as jnp
 
 from paddle_tpu.ops.ctc import ctc_loss
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
+
 
 def _collapse(path, blank):
     out = []

@@ -8,6 +8,9 @@ import pytest
 from paddle_tpu.config.parser import parse_config
 from paddle_tpu.trainer.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
+
 ALL_CONFIGS = [
     "demo/sentiment/trainer_config.py",
     "demo/sequence_tagging/rnn_crf.py",

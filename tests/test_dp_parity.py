@@ -18,6 +18,10 @@ from paddle_tpu.config.parser import parse_config
 from paddle_tpu.parallel.mesh import make_mesh
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.trainer.parity import assert_dp_parity
+import pytest
+
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
 
 
 def test_mnist_mlp_dp8_matches_dp1():

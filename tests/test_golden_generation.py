@@ -19,6 +19,10 @@ from paddle_tpu.config.parser import parse_config
 from paddle_tpu.graph.builder import GraphExecutor
 from paddle_tpu.graph.generator import generate
 from paddle_tpu.parameter.argument import Argument
+import pytest
+
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
 
 GOLDEN = os.path.join(REPO, "tests/golden/seq2seq_beam.json")
 

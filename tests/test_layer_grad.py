@@ -15,6 +15,9 @@ from paddle_tpu.graph.builder import GraphExecutor
 from paddle_tpu.graph.context import TEST
 from paddle_tpu.parameter.argument import Argument
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
+
 
 def fd_check(cfg, feed, seed=0, eps=1e-5, rtol=1e-3, atol=1e-6, n_coords=6):
     """Central-difference check in float64 (float32 FD noise would swamp the

@@ -30,6 +30,8 @@ from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.tools.torch2paddle import convert_state_dict
 from paddle_tpu.trainer.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
 LR = 0.002
 MOM = 0.9
 L2 = 5e-4
@@ -38,6 +40,7 @@ STEPS = 8
 
 CFG = """
 from paddle_tpu.dsl import *
+
 settings(batch_size=16, learning_rate=0.002,
          learning_method=MomentumOptimizer(momentum=0.9),
          regularization=L2Regularization(5e-4))

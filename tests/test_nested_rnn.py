@@ -20,6 +20,10 @@ from paddle_tpu.data.provider import (integer_value,
                                       integer_value_sequence,
                                       integer_value_sub_sequence)
 from paddle_tpu.graph.builder import GraphExecutor
+import pytest
+
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
 
 NEST_CFG = os.path.join(REPO, "tests/configs/sequence_nest_rnn.py")
 FLAT_CFG = os.path.join(REPO, "tests/configs/sequence_rnn.py")

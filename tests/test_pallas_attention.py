@@ -14,6 +14,9 @@ import jax.numpy as jnp
 from paddle_tpu.ops.attention import blockwise_attention, dot_product_attention
 from paddle_tpu.ops.pallas_attention import flash_attention
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
+
 
 def _case(rng, B, Tq, Tk, H, D, ragged=True):
     q = jnp.asarray(rng.normal(size=(B, Tq, H, D)), jnp.float32)

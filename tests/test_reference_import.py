@@ -1,0 +1,97 @@
+"""Importing reference v0.9.0 binary checkpoints (ref:
+parameter/Parameter.cpp:309-381 — header {version=0, valueSize, size} + raw
+little-endian reals; trainer/ParamUtil.cpp pass-%05d dirs with one file per
+parameter)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.dsl import (
+    SoftmaxActivation, TanhActivation, classification_cost, data_layer,
+    fc_layer, settings,
+)
+from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.trainer.trainer import Trainer
+
+
+def _config():
+    settings(batch_size=8, learning_rate=0.1)
+    x = data_layer(name="x", size=6)
+    h = fc_layer(input=x, size=5, act=TanhActivation())
+    out = fc_layer(input=h, size=3, act=SoftmaxActivation())
+    classification_cost(input=out, label=data_layer(name="label", size=3))
+
+
+def test_parameter_file_roundtrip(tmp_path):
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4) * 0.25
+    p = str(tmp_path / "___fc_layer_0__.w0")
+    ckpt.write_reference_parameter(p, arr)
+    # exact on-disk layout: 16-byte header {0, 4, 12} + 48 bytes of floats
+    raw = open(p, "rb").read()
+    assert len(raw) == 16 + 48
+    assert raw[:16] == (0).to_bytes(4, "little") + (4).to_bytes(4, "little") \
+        + (12).to_bytes(8, "little")
+    back = ckpt.read_reference_parameter(p)
+    np.testing.assert_array_equal(back, arr.reshape(-1))
+
+
+def test_reject_malformed(tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(b"\x07\x00\x00\x00" + b"\x04\x00\x00\x00" + (8).to_bytes(8, "little"))
+        f.write(np.zeros(8, np.float32).tobytes())
+    with pytest.raises(ValueError, match="version"):
+        ckpt.read_reference_parameter(p)
+    assert not ckpt._is_reference_parameter_file(p)
+
+
+def _synthesize_pass_dir(d, trainer, seed=0):
+    """Write every model parameter as a v0.9 binary file, as the reference
+    trainer would have saved it."""
+    rng = np.random.default_rng(seed)
+    os.makedirs(d, exist_ok=True)
+    want = {}
+    for name, cur in trainer.params.items():
+        vals = rng.standard_normal(np.asarray(cur).size).astype(np.float32)
+        ckpt.write_reference_parameter(os.path.join(d, name), vals)
+        want[name] = vals.reshape(np.asarray(cur).shape)
+    return want
+
+
+def test_import_reference_pass_dir(tmp_path):
+    cfg = parse_config_callable(_config)
+    tr = Trainer(cfg, seed=3)
+    d = str(tmp_path / "pass-00007")
+    want = _synthesize_pass_dir(d, tr)
+    tr.load(d)
+    for name, w in want.items():
+        got = np.asarray(tr.params[name])
+        np.testing.assert_allclose(got, w.astype(got.dtype), rtol=1e-6)
+    assert tr.pass_id == 8      # resumes after the imported pass
+
+
+def test_import_reference_save_root(tmp_path):
+    """Given the reference's save_dir root, resume from its newest pass."""
+    cfg = parse_config_callable(_config)
+    tr = Trainer(cfg, seed=3)
+    _synthesize_pass_dir(str(tmp_path / "pass-00001"), tr, seed=1)
+    want = _synthesize_pass_dir(str(tmp_path / "pass-00002"), tr, seed=2)
+    tr.load(str(tmp_path))
+    for name, w in want.items():
+        got = np.asarray(tr.params[name])
+        np.testing.assert_allclose(got, w.astype(got.dtype), rtol=1e-6)
+
+
+def test_size_mismatch_fails_loudly(tmp_path):
+    cfg = parse_config_callable(_config)
+    tr = Trainer(cfg, seed=3)
+    d = tmp_path / "pass-00000"
+    d.mkdir()
+    for name in tr.params:
+        ckpt.write_reference_parameter(str(d / name),
+                                       np.zeros(2, np.float32))
+    with pytest.raises(AssertionError, match="reference file"):
+        tr.load(str(d))

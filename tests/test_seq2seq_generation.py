@@ -17,6 +17,10 @@ from paddle_tpu.graph.builder import GraphExecutor
 from paddle_tpu.graph.generator import generate
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.trainer.trainer import Trainer
+import pytest
+
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
 
 CONFIG = os.path.join(REPO, "demo/seqToseq/seqToseq_net.py")
 

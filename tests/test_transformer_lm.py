@@ -10,6 +10,9 @@ from paddle_tpu.config.parser import parse_config
 from paddle_tpu.parallel.mesh import make_mesh
 from paddle_tpu.trainer.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "not slow")
+
+
 CFG = "demo/model_zoo/transformer_lm.py"
 
 

@@ -1,0 +1,146 @@
+"""ZeRO-2/3 (FSDP) over the data axis — exactness + sharding assertions.
+
+The discipline from VERDICT r3: any new sharding mode must (a) keep the
+dp-parity oracle green (identical losses/params to 1-device training — the
+reference's test_CompareSparse contract) and (b) observably shard what it
+claims to shard.  Ref for the design being generalized:
+paddle/pserver/ParameterServer2.h:120-145 (per-server parameter blocks),
+:501 addGradient (each server receives only its own gradient blocks)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.trainer.parity import assert_dp_parity
+from paddle_tpu.trainer.trainer import Trainer
+
+
+def _mnist_batches(n=12, B=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"pixel": Argument(value=(rng.random((B, 784), np.float32) - 0.5)),
+         "label": Argument(ids=rng.integers(0, 10, B).astype(np.int32))}
+        for _ in range(n)
+    ]
+
+
+def _cfg(zero_stage, B=16):
+    cfg = parse_config("demo/mnist/mlp_mnist.py", f"batch_size={B}")
+    cfg.opt_config.zero_stage = zero_stage
+    return cfg
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_stage_parity(stage):
+    """dp=8 with ZeRO stage 2/3 must reproduce dp=1 exactly."""
+    batches = _mnist_batches()
+    assert_dp_parity(_cfg(stage), batches, make_mesh(data=8),
+                     config2=_cfg(stage))
+
+
+def _data_sharded(arr, mesh) -> bool:
+    sh = arr.sharding
+    return isinstance(sh, jax.sharding.NamedSharding) and \
+        sh.spec and sh.spec[0] == "data"
+
+
+def test_zero3_param_and_slot_sharding():
+    """Stage 3: every eligible parameter (leading dim % 8 == 0) and its
+    optimizer slots live sharded over `data`; ineligible ones replicated."""
+    mesh = make_mesh(data=8)
+    tr = Trainer(_cfg(3), seed=2, mesh=mesh)
+    sharded = {n for n, v in tr.params.items() if _data_sharded(v, mesh)}
+    for name, v in tr.params.items():
+        if v.shape[0] % 8 == 0:
+            assert name in sharded, f"{name} {v.shape} should be data-sharded"
+        else:
+            assert name not in sharded, f"{name} {v.shape} must stay replicated"
+    assert sharded, "no parameter got sharded at stage 3"
+    for name, slots in tr.opt_state["slots"].items():
+        for leaf in jax.tree.leaves(slots):
+            if leaf.ndim >= 1 and leaf.shape[0] % 8 == 0:
+                assert _data_sharded(leaf, mesh), \
+                    f"slot of {name} not sharded: {leaf.shape}"
+
+
+def test_zero3_memory_footprint():
+    """The point of FSDP: per-device parameter bytes shrink ~N-fold for
+    eligible params.  Check addressable shard sizes."""
+    mesh = make_mesh(data=8)
+    tr = Trainer(_cfg(3), seed=2, mesh=mesh)
+    for name, v in tr.params.items():
+        if v.shape[0] % 8 == 0:
+            shard = v.addressable_shards[0].data
+            assert shard.size == v.size // 8, (
+                f"{name}: shard holds {shard.size} of {v.size} elements")
+
+
+def test_zero3_checkpoint_roundtrip(tmp_path):
+    """Save gathers shards to host; load re-shards; params identical and
+    still sharded after the round-trip."""
+    mesh = make_mesh(data=8)
+    batches = _mnist_batches(n=3)
+    tr = Trainer(_cfg(3), seed=2, mesh=mesh)
+    for b in batches:
+        tr.train_one_batch(b)
+    before = {n: np.asarray(jax.device_get(v)) for n, v in tr.params.items()}
+    d = tr.save(str(tmp_path))
+    tr2 = Trainer(_cfg(3), seed=77, mesh=mesh)
+    tr2.load(d)
+    for n in before:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(tr2.params[n])), before[n])
+    assert any(_data_sharded(v, mesh) for v in tr2.params.values())
+
+
+def test_zero_stage_flag_normalization():
+    """shard_optimizer_state=True floors the stage at 1."""
+    from paddle_tpu.parallel.dp import effective_zero_stage
+    cfg = _cfg(0)
+    cfg.opt_config.shard_optimizer_state = True
+    assert effective_zero_stage(cfg.opt_config) == 1
+    cfg.opt_config.zero_stage = 3
+    assert effective_zero_stage(cfg.opt_config) == 3
+
+
+def test_zero2_leaves_vocab_sharded_embeddings_alone():
+    """A sparse_update embedding defaults to vocab-dim (model-axis) sharding;
+    ZeRO >= 2 must NOT pin its gradient to the data axis — params, slots and
+    grads must agree on the parameter's home axis."""
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.parallel.dp import zero_grad_shardings
+
+    def conf():
+        from paddle_tpu.dsl import (ParamAttr, SoftmaxActivation,
+                                    classification_cost, data_layer,
+                                    embedding_layer, fc_layer, last_seq,
+                                    settings)
+        settings(batch_size=16, learning_rate=0.1, zero_stage=2)
+        w = data_layer(name="word", size=64)
+        emb = embedding_layer(input=w, size=8,
+                              param_attr=ParamAttr(sparse_update=True))
+        out = fc_layer(input=last_seq(input=emb), size=4,
+                       act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=4))
+
+    cfg = parse_config_callable(conf)
+    mesh = make_mesh(data=2, model=4)
+    tr = Trainer(cfg, seed=1, mesh=mesh)
+    gs = zero_grad_shardings(mesh, cfg.model_config, tr.params)
+    emb_names = [p.name for p in cfg.model_config.parameters
+                 if p.sparse_update]
+    assert emb_names
+    for n in emb_names:
+        assert gs[n] is None, (
+            f"embedding {n} gradient pinned to data axis despite "
+            f"vocab sharding")
+    # the table itself must be model-axis sharded, and SOME dense param's
+    # grad must be data-pinned (the stage-2 mechanism is active)
+    for n in emb_names:
+        spec = tr.params[n].sharding.spec
+        assert spec and spec[0] == "model", f"{n} table not vocab-sharded: {spec}"
+    assert any(s is not None for s in gs.values())
