@@ -429,6 +429,11 @@ class OptimizationConfig(_Serializable):
     # GPipe microbatches per batch for config-driven pipeline parallelism
     # (layers annotated device=N); 0 = one microbatch per pipeline stage
     pipeline_micro_batches: int = 0
+    # 'gpipe' (all-forward then autodiff backward; in-flight activations
+    # grow with the microbatch count) or '1f1b' (one-forward-one-backward
+    # with per-stage recompute; in-flight boundary carriers capped at the
+    # stage count — the schedule for microbatch counts >> stages)
+    pipeline_schedule: str = "gpipe"
     # ZeRO-1: shard optimizer slot buffers over the data axis (the pserver
     # design where each server updates 1/N of every parameter — here XLA
     # keeps the update sharded and gathers only the fresh params)

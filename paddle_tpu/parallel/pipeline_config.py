@@ -156,18 +156,34 @@ class PipelineExecutor:
     loss(params, feed, state, mode, rng) signature."""
 
     def __init__(self, model: ModelConfig, mesh, n_micro: int = 0,
-                 compute_dtype: str = ""):
+                 compute_dtype: str = "", schedule: str = "gpipe"):
         self.model = model
         self.mesh = mesh
         self.n_stages = axis_size(mesh, PIPE_AXIS)
         assert self.n_stages > 1, \
             "PipelineExecutor needs a pipe mesh axis of size > 1"
         self.n_micro = n_micro or self.n_stages
+        assert schedule in ("gpipe", "1f1b"), (
+            f"unknown pipeline_schedule {schedule!r}; use 'gpipe' or '1f1b'")
+        self.schedule = schedule
         self.inner, self.stages = split_stages(model, self.n_stages)
         self.inner.mesh = None        # stage bodies run mesh-local
         self.inner.compute_dtype = compute_dtype
         self.payload_names = _stage_io(model, self.stages)
         self._spec_cache: dict = {}
+
+    def schedule_info(self) -> dict:
+        """Bubble/memory accounting for the active schedule.  Both schedules
+        share the bubble fraction (S-1)/(M+S-1) per direction; 1F1B's win is
+        the in-flight boundary-carrier cap: S instead of M."""
+        S, M = self.n_stages, self.n_micro
+        return {
+            "schedule": self.schedule,
+            "stages": S,
+            "micro_batches": M,
+            "bubble_fraction": (S - 1) / (M + S - 1),
+            "in_flight_carriers": S if self.schedule == "1f1b" else M,
+        }
 
     @property
     def compute_dtype(self) -> str:
@@ -288,21 +304,13 @@ class PipelineExecutor:
             out[s.name] = Argument(value=val, lengths=lengths, sub_lengths=sub)
         return out
 
-    # -- the pipelined loss ----------------------------------------------
-    def loss(self, params, feed, state=None, mode: str = TRAIN, rng=None):
-        assert not state, "pipeline executor carries no layer state"
-        params, feed = self.inner.prepare(params, feed)
-        S, M = self.n_stages, self.n_micro
-        n_data = axis_size(self.mesh, DATA_AXIS)
-        B = next(iter(feed.values())).batch_size
-        assert B % (M * n_data) == 0, (
-            f"batch {B} not divisible by {M} microbatches x {n_data} data "
-            f"shards")
-        mb = B // (M * n_data)
-        specs, width = self._boundary_specs(feed, mb)
+    def _stage_branches(self, specs, width: int, mb: int, mode: str):
+        """Per-stage body functions with one UNIFORM signature
+        (p, recv[mb,width], feed_mb, key) -> (out[mb,width], cost[mb]) —
+        uniformity is what lets lax.switch host S heterogeneous stages,
+        and (for 1F1B) what makes per-stage jax.vjp cotangents stackable."""
+        S = self.n_stages
         model, inner = self.model, self.inner
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
 
         def make_branch(s: int):
             items = self.stages[s]
@@ -334,7 +342,33 @@ class PipelineExecutor:
 
             return branch
 
-        branches = [make_branch(s) for s in range(S)]
+        return [make_branch(s) for s in range(S)]
+
+    def _prologue(self, params, feed, rng):
+        """Shared entry for both schedules: prepare, microbatch sizing,
+        boundary specs, rng default.  One place so the divisibility rule
+        and spec derivation can never diverge between GPipe and 1F1B."""
+        params, feed = self.inner.prepare(params, feed)
+        M = self.n_micro
+        n_data = axis_size(self.mesh, DATA_AXIS)
+        B = next(iter(feed.values())).batch_size
+        assert B % (M * n_data) == 0, (
+            f"batch {B} not divisible by {M} microbatches x {n_data} data "
+            f"shards")
+        mb = B // (M * n_data)
+        specs, width = self._boundary_specs(feed, mb)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return params, feed, B, mb, specs, width, rng
+
+    # -- the pipelined loss ----------------------------------------------
+    def loss(self, params, feed, state=None, mode: str = TRAIN, rng=None):
+        assert not state, "pipeline executor carries no layer state"
+        S, M = self.n_stages, self.n_micro
+        params, feed, B, mb, specs, width, rng = self._prologue(
+            params, feed, rng)
+
+        branches = self._stage_branches(specs, width, mb, mode)
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
 
         def local(p, feed_loc, key):
@@ -374,3 +408,150 @@ class PipelineExecutor:
             check_vma=False)
         total = fn(params, feed, rng)
         return total, ({}, {}, {})
+
+    # -- 1F1B: hand-scheduled forward/backward --------------------------
+    def loss_and_grad(self, params, feed, mode: str = TRAIN, rng=None):
+        """One-forward-one-backward schedule (pipeline_schedule='1f1b').
+
+        GPipe above runs ALL forwards then lets autodiff transpose the
+        scan — in-flight state grows with the microbatch count M.  Here
+        the backward is hand-scheduled so each stage alternates F and B
+        with per-stage recompute from the stashed INPUT carrier: at most
+        S boundary carriers are live per stage, independent of M (the
+        memory property 1F1B exists for).
+
+        Lockstep schedule (global tick t, stage s, microbatch m):
+          forward  F(s,m) at t = s + 2m
+          backward B(s,m) at t = 2S - 1 - s + 2m
+        Consecutive stages line up exactly one ppermute hop apart in both
+        directions (F(s+1,m) = F(s,m)+1; B(s-1,m) = B(s,m)+1), so the two
+        rings deliver just-in-time and only the input stash (m mod S)
+        buffers state.  Dataflow-identical to GPipe/unpipelined — the
+        phase-2a exactness oracle and tests/test_pipeline_config.py assert
+        it; total ticks 2(M+S-1), bubble fraction (S-1)/(M+S-1) per
+        direction (see schedule_info()).
+
+        Returns (loss, grads) w.r.t. `params` — the Trainer calls this
+        instead of wrapping loss() in jax.value_and_grad.
+        """
+        raw_dtypes = {k: v.dtype for k, v in params.items()}
+        S, M = self.n_stages, self.n_micro
+        params, feed, B, mb, specs, width, rng = self._prologue(
+            params, feed, rng)
+
+        fwd_branches = self._stage_branches(specs, width, mb, mode)
+
+        def make_bwd(s: int):
+            f = fwd_branches[s]
+
+            def bwd(p, stash_in, feed_mb, key, d_out, d_cost):
+                # recompute the stage forward under vjp from its stashed
+                # input carrier; the cotangents are stackable across the
+                # lax.switch because every branch returns the same
+                # (out[mb,width], cost[mb]) shapes
+                (_, _), vjp_fn = jax.vjp(
+                    lambda pp, rr: f(pp, rr, feed_mb, key), p, stash_in)
+                d_p, d_recv = vjp_fn((d_out, d_cost))
+                return d_p, d_recv
+
+            return bwd
+
+        bwd_branches = [make_bwd(s) for s in range(S)]
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i, i - 1) for i in range(1, S)]
+        # grads accumulate in >= fp32 regardless of the compute dtype —
+        # the same semantics autodiff's cast-transpose gives the GPipe path
+        gacc0 = {k: jnp.zeros(v.shape,
+                              jnp.promote_types(v.dtype, jnp.float32)
+                              if jnp.issubdtype(v.dtype, jnp.floating)
+                              else v.dtype)
+                 for k, v in params.items()}
+
+        def local(p, feed_loc, key):
+            stage = lax.axis_index(PIPE_AXIS)
+            T = 2 * (M + S - 1)
+
+            def feed_at(m_idx):
+                return jax.tree.map(
+                    lambda x: lax.dynamic_slice_in_dim(x, m_idx * mb, mb),
+                    feed_loc)
+
+            def tick(carry, t):
+                recv_f, recv_b, stash, loss_buf, gacc = carry
+                # -- forward leg: F(s,m) at t = s + 2m
+                ft = t - stage
+                is_f = jnp.logical_and(ft >= 0, jnp.logical_and(
+                    ft % 2 == 0, ft // 2 < M))
+                m_f = jnp.clip(ft // 2, 0, M - 1)
+                key_f = jax.random.fold_in(key, m_f * S + stage)
+
+                def run_f(_):
+                    return lax.switch(stage, fwd_branches, p, recv_f,
+                                      feed_at(m_f), key_f)
+
+                def skip_f(_):
+                    return (jnp.zeros((mb, width), jnp.float32),
+                            jnp.zeros((mb,), jnp.float32))
+
+                out_f, cost = lax.cond(is_f, run_f, skip_f, None)
+                # stash this microbatch's input carrier for its backward
+                stash = jnp.where(is_f,
+                                  stash.at[m_f % S].set(recv_f), stash)
+                banked = lax.dynamic_update_index_in_dim(
+                    loss_buf, cost[None], m_f, axis=0)
+                loss_buf = jnp.where(
+                    jnp.logical_and(is_f, stage == S - 1), banked, loss_buf)
+
+                # -- backward leg: B(s,m) at t = 2S - 1 - s + 2m
+                bt = t - (2 * S - 1 - stage)
+                is_b = jnp.logical_and(bt >= 0, jnp.logical_and(
+                    bt % 2 == 0, bt // 2 < M))
+                m_b = jnp.clip(bt // 2, 0, M - 1)
+                key_b = jax.random.fold_in(key, m_b * S + stage)
+                # the last stage's cost output seeds the chain; upstream
+                # stages' cost outputs are constant zeros, so the shared
+                # ones-cotangent only contributes there
+                d_cost = jnp.ones((mb,), jnp.float32)
+
+                def run_b(gacc_in):
+                    d_p, d_recv = lax.switch(
+                        stage, bwd_branches, p, stash[m_b % S],
+                        feed_at(m_b), key_b, recv_b, d_cost)
+                    return jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), gacc_in, d_p), \
+                        d_recv
+
+                def skip_b(gacc_in):
+                    # idle tick: no zeros tree, no dead accumulation adds
+                    return gacc_in, jnp.zeros((mb, width), jnp.float32)
+
+                gacc, d_recv = lax.cond(is_b, run_b, skip_b, gacc)
+
+                recv_f = lax.ppermute(out_f, PIPE_AXIS, fwd_perm)
+                recv_b = lax.ppermute(d_recv, PIPE_AXIS, bwd_perm)
+                return (recv_f, recv_b, stash, loss_buf, gacc), None
+
+            carry0 = (jnp.zeros((mb, width), jnp.float32),
+                      jnp.zeros((mb, width), jnp.float32),
+                      jnp.zeros((S, mb, width), jnp.float32),
+                      jnp.zeros((M, mb), jnp.float32),
+                      gacc0)
+            (_, _, _, loss_buf, gacc), _ = lax.scan(tick, carry0,
+                                                    jnp.arange(T))
+            local_sum = jnp.sum(jnp.where(stage == S - 1, loss_buf, 0.0))
+            total = lax.psum(lax.psum(local_sum, PIPE_AXIS), DATA_AXIS)
+            grads = jax.tree.map(
+                lambda g: lax.psum(lax.psum(g, PIPE_AXIS), DATA_AXIS) / B,
+                gacc)
+            return total / B, grads
+
+        from jax.sharding import PartitionSpec as P
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P()), out_specs=(P(), P()),
+            check_vma=False)
+        total, grads = fn(params, feed, rng)
+        # grads are w.r.t. the prepared (compute-dtype) params; cast back
+        # to the raw parameter dtypes, as autodiff's cast-transpose would
+        grads = {k: g.astype(raw_dtypes[k]) for k, g in grads.items()}
+        return total, grads

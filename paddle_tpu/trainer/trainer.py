@@ -90,7 +90,8 @@ class Trainer:
             from paddle_tpu.parallel.pipeline_config import PipelineExecutor
             self.executor = PipelineExecutor(
                 self.model, mesh,
-                n_micro=self.opt.pipeline_micro_batches, compute_dtype=cdt)
+                n_micro=self.opt.pipeline_micro_batches, compute_dtype=cdt,
+                schedule=self.opt.pipeline_schedule or "gpipe")
         else:
             self.executor = GraphExecutor(self.model, mesh=mesh,
                                           compute_dtype=cdt)
@@ -219,6 +220,14 @@ class Trainer:
                 outputs = dict(outputs)
                 for n, g in probe_grads.items():
                     outputs["__grad__" + n] = Argument(value=g)
+            elif getattr(executor, "schedule", None) == "1f1b":
+                # hand-scheduled pipeline backward (1F1B with per-stage
+                # recompute) — the executor returns grads itself instead of
+                # sitting behind jax.value_and_grad
+                loss, grads = executor.loss_and_grad(params, batch,
+                                                     TRAIN, rng)
+                outputs, costs, new_net = {}, {}, net_state
+                grads = constrain_grads(grads)
             else:
                 def loss_fn(p):
                     loss, aux = executor.loss(p, batch, net_state, TRAIN, rng)
@@ -561,8 +570,15 @@ class Trainer:
             # jit once: every perturbed evaluation reuses the same executable
             loss_fn = jax.jit(lambda p: self.executor.loss(
                 p, batch, self.net_state, TEST, rng)[0])
-            grads = jax.jit(jax.grad(lambda p: self.executor.loss(
-                p, batch, self.net_state, TEST, rng)[0]))(self.params)
+            if getattr(self.executor, "schedule", None) == "1f1b":
+                # audit the grads TRAINING actually uses: the hand-
+                # scheduled loss_and_grad backward, not the autodiff of
+                # loss() that only the gpipe schedule trains with
+                _, grads = jax.jit(lambda p: self.executor.loss_and_grad(
+                    p, batch, TEST, rng))(self.params)
+            else:
+                grads = jax.jit(jax.grad(lambda p: self.executor.loss(
+                    p, batch, self.net_state, TEST, rng)[0]))(self.params)
             return self._check_gradient_inner(loss_fn, grads, epsilon,
                                               max_entries)
         finally:
