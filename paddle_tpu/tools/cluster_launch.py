@@ -31,12 +31,17 @@ import sys
 
 
 def build_commands(hosts: list[str], port: int, workspace: str,
-                   trainer_args: list[str], python: str = "python") -> list[list[str]]:
-    """One ssh command per host; host 0 doubles as the jax.distributed
-    coordinator (ref: conf.py HOSTS + --trainer_id assignment)."""
+                   trainer_args: list[str], python: str = "python",
+                   local: bool = False) -> list[list[str]]:
+    """One command per host; host 0 doubles as the jax.distributed
+    coordinator (ref: conf.py HOSTS + --trainer_id assignment).  With
+    local=True the commands run under a local shell instead of ssh — the
+    single-machine multi-process form (ref: scripts/submit_local.sh.in)."""
     if not hosts:
         raise SystemExit("cluster_launch: no hosts given (--hosts host0,host1,...)")
-    coordinator = f"{hosts[0]}:{port}"
+    # local mode ignores the host NAMES (only the count matters), so the
+    # rendezvous must be on this machine no matter what the user listed
+    coordinator = f"localhost:{port}" if local else f"{hosts[0]}:{port}"
     cmds = []
     for pid, host in enumerate(hosts):
         inner = (
@@ -46,7 +51,10 @@ def build_commands(hosts: list[str], port: int, workspace: str,
             f"--num_processes={len(hosts)} --process_id={pid} "
             + " ".join(shlex.quote(a) for a in trainer_args)
         )
-        cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, inner])
+        if local:
+            cmds.append(["sh", "-c", inner])
+        else:
+            cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, inner])
     return cmds
 
 
@@ -60,15 +68,22 @@ def main(argv=None) -> int:
     ap.add_argument("--workspace", default=".",
                     help="working directory on every host")
     ap.add_argument("--python", default="python")
+    ap.add_argument("--local", action="store_true",
+                    help="run every process on THIS machine via a local "
+                         "shell instead of ssh (submit_local analog)")
     ap.add_argument("--dry_run", action="store_true",
                     help="print the ssh commands without running them")
+    ap.add_argument("--timeout", type=float, default=0,
+                    help="kill the whole fleet (nonzero exit) after this "
+                         "many seconds — a wedged jax.distributed "
+                         "rendezvous otherwise blocks forever")
     args, trainer_args = ap.parse_known_args(argv)
     if trainer_args and trainer_args[0] == "--":
         trainer_args = trainer_args[1:]
 
     hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
     cmds = build_commands(hosts, args.port, args.workspace, trainer_args,
-                          args.python)
+                          args.python, local=args.local)
     if args.dry_run:
         for c in cmds:
             print(" ".join(shlex.quote(p) for p in c))
@@ -79,9 +94,18 @@ def main(argv=None) -> int:
     # as soon as any process exits nonzero
     import time
     procs = [subprocess.Popen(c) for c in cmds]
+    deadline = time.monotonic() + args.timeout if args.timeout > 0 else None
     rc = 0
     try:
         while procs:
+            if deadline is not None and time.monotonic() > deadline:
+                print(f"cluster_launch: --timeout={args.timeout}s expired; "
+                      f"killing {len(procs)} processes", file=sys.stderr)
+                for q in procs:
+                    q.kill()
+                for q in procs:
+                    q.wait()
+                return rc or 124
             for p in list(procs):
                 code = p.poll()
                 if code is None:

@@ -28,6 +28,7 @@ def main() -> int:
     coord = sys.argv[1]
     num_procs = int(sys.argv[2])
     pid = int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"   # 'dp' | 'tpdp'
 
     from paddle_tpu.parallel.mesh import init_distributed, make_mesh
     init_distributed(coord, num_procs, pid)
@@ -37,24 +38,50 @@ def main() -> int:
     from paddle_tpu.parameter.argument import Argument
     from paddle_tpu.trainer.trainer import Trainer
 
+    model_par = 2 if mode == "tpdp" else 1
+    data_par = num_procs // model_par
+
     def conf():
-        from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
-                                    TanhActivation, classification_cost,
-                                    data_layer, fc_layer, settings)
-        settings(batch_size=8 * num_procs, learning_rate=0.1,
+        from paddle_tpu.dsl import (MomentumOptimizer, ParameterAttribute,
+                                    SoftmaxActivation, TanhActivation,
+                                    classification_cost, data_layer,
+                                    fc_layer, settings)
+        settings(batch_size=8 * data_par, learning_rate=0.1,
                  learning_method=MomentumOptimizer(momentum=0.9))
         x = data_layer(name="x", size=16)
-        h = fc_layer(input=x, size=32, act=TanhActivation())
-        out = fc_layer(input=h, size=4, act=SoftmaxActivation())
+        tp = (ParameterAttribute(partition_spec=[None, "model"])
+              if model_par > 1 else None)
+        tp2 = (ParameterAttribute(partition_spec=["model", None])
+               if model_par > 1 else None)
+        h = fc_layer(input=x, size=32, act=TanhActivation(), param_attr=tp)
+        out = fc_layer(input=h, size=4, act=SoftmaxActivation(),
+                       param_attr=tp2)
         classification_cost(input=out, label=data_layer(name="y", size=4))
 
     cfg = parse_config_callable(conf)
-    mesh = make_mesh()          # data axis spans both processes' devices
+    if model_par > 1:
+        # devices laid out [data, model]: device i -> data row i // model_par
+        mesh = make_mesh(data=data_par, model=model_par)
+    else:
+        mesh = make_mesh()      # data axis spans every process's devices
     tr = Trainer(cfg, seed=7, mesh=mesh)
 
-    # per-process data: DIFFERENT shards (seeded by process id), global
-    # batch = concatenation over processes
-    rng = np.random.default_rng(100 + pid)
+    if model_par > 1:
+        # tp params must REALLY shard across processes: each process holds
+        # 1/model_par of the annotated weights
+        w0 = tr.params["___fc_layer_0__.w0"]
+        assert not w0.is_fully_addressable
+        local = w0.addressable_shards[0].data
+        assert local.shape[1] * model_par == w0.shape[1], (
+            local.shape, w0.shape)
+        print(f"RESULT pid={pid} tp_shard_ok local={local.shape} "
+              f"global={w0.shape}", flush=True)
+
+    # per-process data: one stream per DATA ROW (processes replicating the
+    # same data shard across `model` must feed identical rows), global
+    # batch = concatenation over data rows
+    data_row = pid // model_par
+    rng = np.random.default_rng(100 + data_row)
     W = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
     losses = []
     for _ in range(4):
@@ -71,8 +98,10 @@ def main() -> int:
         pid, ",".join(f"{l:.10f}" for l in losses)), flush=True)
     # final parameters, for the single-process equivalence oracle in the
     # test (ref: test_CompareSparse.cpp — multi-trainer == local training)
-    for name in sorted(tr.params):
-        flat = np.asarray(jax.device_get(tr.params[name])).ravel()
+    from paddle_tpu.trainer.trainer import _host_tree
+    host_params = _host_tree(tr.params)
+    for name in sorted(host_params):
+        flat = np.asarray(host_params[name]).ravel()
         print(f"RESULT pid={pid} param {name} "
               f"sum={flat.sum():.8f} asum={np.abs(flat).sum():.8f}",
               flush=True)

@@ -32,82 +32,104 @@ def _free_port() -> int:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
 
-
-def test_two_process_data_parallel_training():
-    port = _free_port()
+def _worker_env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)          # 1 CPU device per process
     env["PYTHONPATH"] = ""              # keep the axon plugin out
+    return env
 
+
+def _run_workers(n: int, mode: str = "dp", timeout: float = 300):
+    """Launch n distributed_worker.py processes, return their outputs;
+    kills survivors (one worker dying pre-rendezvous leaves the others
+    blocked in jax.distributed.initialize)."""
+    port = _free_port()
+    args_tail = [mode] if mode != "dp" else []
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, f"localhost:{port}", "2", str(i)],
+            [sys.executable, WORKER, f"localhost:{port}", str(n), str(i)]
+            + args_tail,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env, cwd=REPO)
-        for i in range(2)
+            env=_worker_env(), cwd=REPO)
+        for i in range(n)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
-        # one worker dying pre-rendezvous leaves the other blocked in
-        # jax.distributed.initialize — never leak it past the test
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.communicate()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    return outs
 
-    def losses_of(out):
-        for ln in out.splitlines():
-            if "losses=" in ln:
-                return ln.split("losses=")[1].strip()
-        raise AssertionError(f"no losses line:\n{out}")
 
-    l0, l1 = losses_of(outs[0]), losses_of(outs[1])
-    assert l0 == l1, f"process losses diverged:\n{l0}\n{l1}"
-    assert all("straggler_ok" in o for o in outs)
+def _losses_of(out: str) -> str:
+    for ln in out.splitlines():
+        if "losses=" in ln:
+            return ln.split("losses=")[1].strip()
+    raise AssertionError(f"no losses line:\n{out}")
 
-    # -- single-process equivalence oracle (ref: trainer/tests/
-    #    test_CompareSparse.cpp:133-152 — multi-trainer training must equal
-    #    local training): rebuild the same model/seed in THIS process, feed
-    #    the concatenated global batches, and require the same losses and
-    #    final parameters the workers printed.
+
+def _oracle_conf():
+    """The exact model distributed_worker.py trains in dp mode (tp
+    annotations in tpdp mode are placement-only, so this oracle serves
+    both)."""
+    from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
+                                TanhActivation, classification_cost,
+                                data_layer, fc_layer, settings)
+    settings(batch_size=16, learning_rate=0.1,
+             learning_method=MomentumOptimizer(momentum=0.9))
+    x = data_layer(name="x", size=16)
+    h = fc_layer(input=x, size=32, act=TanhActivation())
+    out = fc_layer(input=h, size=4, act=SoftmaxActivation())
+    classification_cost(input=out, label=data_layer(name="y", size=4))
+
+
+def _oracle_losses(n_rows: int, steps: int = 4):
+    """Single-process training on the concatenated global batches the
+    workers fed (one stream per data row) — the test_CompareSparse
+    equivalence bar."""
     import numpy as np
 
     from paddle_tpu.config.parser import parse_config_callable
     from paddle_tpu.parameter.argument import Argument
     from paddle_tpu.trainer.trainer import Trainer
 
-    def conf():
-        from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
-                                    TanhActivation, classification_cost,
-                                    data_layer, fc_layer, settings)
-        settings(batch_size=16, learning_rate=0.1,
-                 learning_method=MomentumOptimizer(momentum=0.9))
-        x = data_layer(name="x", size=16)
-        h = fc_layer(input=x, size=32, act=TanhActivation())
-        out = fc_layer(input=h, size=4, act=SoftmaxActivation())
-        classification_cost(input=out, label=data_layer(name="y", size=4))
-
-    tr = Trainer(parse_config_callable(conf), seed=7, mesh=None)
-    rngs = [np.random.default_rng(100 + i) for i in range(2)]
+    tr = Trainer(parse_config_callable(_oracle_conf), seed=7, mesh=None)
+    rngs = [np.random.default_rng(100 + row) for row in range(n_rows)]
     W = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
-    local_losses = []
-    for _ in range(4):
+    losses = []
+    for _ in range(steps):
         xs, ys = [], []
-        for r in rngs:        # same per-process streams, concatenated
+        for r in rngs:
             x = r.normal(size=(8, 16)).astype(np.float32)
             xs.append(x)
             ys.append(np.argmax(x @ W, -1).astype(np.int32))
         loss = tr.train_one_batch({"x": Argument(value=np.concatenate(xs)),
                                    "y": Argument(ids=np.concatenate(ys))})
-        local_losses.append(float(loss))
+        losses.append(float(loss))
+    return losses, tr
 
+
+
+
+def test_two_process_data_parallel_training():
+    outs = _run_workers(2, timeout=240)
+    l0, l1 = _losses_of(outs[0]), _losses_of(outs[1])
+    assert l0 == l1, f"process losses diverged:\n{l0}\n{l1}"
+    assert all("straggler_ok" in o for o in outs)
+
+    # -- single-process equivalence oracle (ref: trainer/tests/
+    #    test_CompareSparse.cpp:133-152 — multi-trainer training must equal
+    #    local training)
+    import numpy as np
+    local_losses, tr = _oracle_losses(n_rows=2)
     dist_losses = [float(v) for v in l0.split(",")]
     np.testing.assert_allclose(dist_losses, local_losses, rtol=2e-4,
                                atol=1e-6,
@@ -121,7 +143,69 @@ def test_two_process_data_parallel_training():
     assert dist_params, "workers printed no param summaries"
     for name, v in tr.params.items():
         flat = np.asarray(_jax.device_get(v)).ravel()
-        s, a = dist_params[name]
-        np.testing.assert_allclose([flat.sum(), np.abs(flat).sum()], [s, a],
+        sm, a = dist_params[name]
+        np.testing.assert_allclose([flat.sum(), np.abs(flat).sum()], [sm, a],
                                    rtol=3e-4, atol=2e-5,
                                    err_msg=f"param {name!r} != local run")
+
+
+def test_four_process_tp_by_dp_training():
+    """4 REAL processes over a (data=2, model=2) mesh: tp-annotated weights
+    shard ACROSS processes (1/2 per device), data rows shard over the other
+    axis, and all 4 processes must agree bit-for-bit on every step loss.
+    The 2-process test covers pure dp; this is the tp x dp cell of the
+    multi-host matrix."""
+    outs = _run_workers(4, mode="tpdp", timeout=300)
+    ls = [_losses_of(o) for o in outs]
+    assert len(set(ls)) == 1, "process losses diverged:\n" + "\n".join(ls)
+    assert all("tp_shard_ok" in o for o in outs), \
+        "tp params did not shard across processes"
+
+    # single-process equivalence: same model (tp annotations are placement
+    # only), same global batches, mesh=None
+    import numpy as np
+    local_losses, _ = _oracle_losses(n_rows=2)
+    dist_losses = [float(v) for v in ls[0].split(",")]
+    np.testing.assert_allclose(dist_losses, local_losses, rtol=2e-4,
+                               atol=1e-6,
+                               err_msg="tp x dp losses != local training")
+
+
+def test_cluster_launch_local_integration(tmp_path):
+    """NON-dry-run launcher test: cluster_launch --local starts 2 real
+    trainer_main processes under jax.distributed on this machine (the
+    submit_local.sh analog of the reference's fabric launcher) and both
+    must train the MNIST MLP demo one pass to completion."""
+    from paddle_tpu.tools import cluster_launch
+
+    port = _free_port()
+    save = tmp_path / "out"
+    env_patch = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "", "XLA_FLAGS": None}
+    old = {k: os.environ.get(k) for k in env_patch}
+    for k, v in env_patch.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        # --timeout: a grabbed port or wedged rendezvous must fail the
+        # test, not hang the suite (the launcher kills the fleet at the
+        # deadline and returns nonzero)
+        rc = cluster_launch.main([
+            "--hosts", "localhost,localhost", "--port", str(port),
+            "--local", "--workspace", REPO, "--timeout", "240",
+            "--python", sys.executable, "--",
+            "--config=demo/mnist/mlp_mnist.py",
+            "--config_args=batch_size=32",
+            "--num_passes=1", f"--save_dir={save}",
+            "--log_period=5",
+        ])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0, "cluster_launch --local run failed"
+    # process 0 saved the pass checkpoint
+    assert (save / "pass-00000" / "model.npz").exists()
