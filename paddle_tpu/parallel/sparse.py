@@ -82,3 +82,173 @@ def sharded_embedding_lookup(mesh: Mesh, table: Array, ids: Array,
         out_specs=P(),
     )
     return fn(table, ids)
+
+
+# ---------------------------------------------------------------------------
+# shard-traffic diagnostics (ref: pserver/SparseParameterDistribution.{h,cpp})
+# ---------------------------------------------------------------------------
+
+def sharded_table_feeds(mesh: Mesh, model) -> dict[str, tuple[list[str], int, int]]:
+    """Map each vocab-sharded sparse_update table to the data layers whose
+    ids index it: {param_name: (input_layer_names, vocab, n_shards)}.
+
+    A table is fed wherever a layer input names the parameter and either
+    carries a `table` projection or belongs to a layer type that indexes its
+    weight by ids (table_projection / selective_fc's gather path).  Only the
+    edges whose source layer's ids actually arrive in the batch (data layers)
+    can be probed host-side — in-graph id producers are skipped, like the
+    reference only probing what prepareSendData ships."""
+    from paddle_tpu.parallel.dp import effective_param_specs
+    from paddle_tpu.parallel.mesh import axis_size
+    specs = effective_param_specs(mesh, model)
+    data_layers = {l.name for l in model.layers if l.type == "data"}
+    out: dict[str, tuple[list[str], int, int]] = {}
+    for p in model.parameters:
+        spec = specs.get(p.name)
+        if not (p.sparse_update and spec and len(p.dims) == 2):
+            continue
+        n = axis_size(mesh, spec[0]) if spec[0] else 1
+        if n <= 1:
+            continue
+        feeds = []
+        for layer in model.layers:
+            for inp in layer.inputs:
+                if inp.input_parameter_name != p.name:
+                    continue
+                if inp.input_layer_name in data_layers \
+                        and inp.input_layer_name not in feeds:
+                    feeds.append(inp.input_layer_name)
+        if feeds:
+            out[p.name] = (feeds, p.dims[0], n)
+    return out
+
+
+class SparseShardStats:
+    """Row-touch balance check for vocab-sharded tables — the TPU analog of
+    the reference's SparseParameterDistribution (ref:
+    pserver/SparseParameterDistribution.cpp:49-119): there the client
+    counted bytes shipped to each pserver for sparse parameters and, after
+    `check_sparse_distribution_batches`, crashed if too many batches were
+    unbalanced.  Here the 'traffic' is which table shard each batch's ids
+    touch: an id-skewed dataset concentrates gather+grad work (and, on the
+    explicit path, psum payload utility) on one device's rows.
+
+    Same flags, same thresholds: a batch is unbalanced when any shard's
+    touch count exceeds `unbalance_degree` x the mean or falls below
+    mean / `unbalance_degree`; after `batches` probes, raise if the
+    unbalanced fraction exceeds `ratio` (strict=True) else warn."""
+
+    def __init__(self, tables: dict[str, tuple[list[str], int, int]],
+                 batches: int = 100, unbalance_degree: float = 2.0,
+                 ratio: float = 0.6, strict: bool = True,
+                 show_log: bool = False):
+        import numpy as np
+        self.tables = tables
+        self.batches = batches
+        self.unbalance_degree = unbalance_degree
+        self.ratio = ratio
+        self.strict = strict
+        self.show_log = show_log
+        self.counts = {name: np.zeros(n, dtype=np.int64)
+                       for name, (_, _, n) in tables.items()}
+        self.batch_passed = 0
+        self.unbalance_cnt = 0
+        self.done = False
+        # hard cap on probes: batches that never meet the evidence
+        # threshold must not pay the host id-fetch forever
+        self.probe_budget = 10 * max(batches, 1)
+
+    def probe_batch(self, batch: dict) -> None:
+        """Accumulate one batch's per-shard touch counts and run the
+        per-batch balance check (ref: probeDistribution +
+        checkAndResetDistribution, called once per prepareSendData)."""
+        import numpy as np
+        if self.done:
+            return
+        self.probe_budget -= 1
+        if self.probe_budget < 0:
+            from paddle_tpu.utils.logger import get_logger
+            get_logger("sparse_dist").info(
+                "sparse distribution check stopping: probe budget spent "
+                "with only %d/%d judged batches (per-batch id counts too "
+                "small to carry balance evidence)", self.batch_passed,
+                self.batches)
+            self.done = True
+            return
+        touched = False
+        for name, (feeds, vocab, n) in self.tables.items():
+            # ceil like GSPMD's uneven sharding (and explicit specs need not
+            # divide evenly), so ids map to the shard that actually owns them
+            shard_rows = -(-vocab // n)
+            for feed in feeds:
+                arg = batch.get(feed)
+                ids = getattr(arg, "ids", None)
+                if ids is None:
+                    continue
+                ids = np.asarray(jax.device_get(ids))
+                lengths = getattr(arg, "lengths", None)
+                if lengths is not None and ids.ndim == 2:
+                    # padded cells are not traffic — the feeder pads id
+                    # slots with 0, which would inflate shard 0's count
+                    valid = (np.arange(ids.shape[1])[None, :]
+                             < np.asarray(jax.device_get(lengths))[:, None])
+                    flat = ids[valid]
+                else:
+                    flat = ids.reshape(-1)
+                flat = flat[(flat >= 0) & (flat < vocab)]
+                if flat.size == 0:
+                    continue
+                self.counts[name] += np.bincount(
+                    np.minimum(flat // shard_rows, n - 1), minlength=n)
+                touched = True
+        if touched:
+            self._check_and_reset()
+
+    def _check_and_reset(self) -> None:
+        import numpy as np
+        from paddle_tpu.utils.logger import get_logger
+        log = get_logger("sparse_dist")
+        unbalanced = False
+        judged = False
+        for name, c in self.counts.items():
+            tot = int(c.sum())
+            if self.show_log and tot:
+                log.info("sparse distribution %s: %s rows/shard", name,
+                         c.tolist())
+            # a batch with fewer than ~16 ids per shard carries no balance
+            # evidence: with avg touches a ~ Poisson(tot/n), the low-side
+            # test (c*degree < avg) false-positives with non-trivial
+            # probability until avg >= ~16 — don't judge such batches
+            if tot < 16 * len(c):
+                continue
+            judged = True
+            avg = tot / len(c)
+            if (c > self.unbalance_degree * avg).any() or \
+                    (c * self.unbalance_degree < avg).any():
+                unbalanced = True
+        if not judged:
+            for c in self.counts.values():
+                c[:] = 0
+            return
+        self.unbalance_cnt += int(unbalanced)
+        self.batch_passed += 1
+        if self.batch_passed >= self.batches:
+            self.done = True
+            frac = self.unbalance_cnt / self.batch_passed
+            for name, c in self.counts.items():
+                log.info("last sparse distribution sample %s: %s", name,
+                         c.tolist())
+            log.info("unbalanced sparse batches: %d / %d",
+                     self.unbalance_cnt, self.batch_passed)
+            if frac > self.ratio:
+                msg = (f"unbalanced sparse id distribution across table "
+                       f"shards ({self.unbalance_cnt}/{self.batch_passed} "
+                       f"batches > degree {self.unbalance_degree}): id-skew "
+                       f"concentrates embedding work on one device — try "
+                       f"shuffling/remapping ids (ref: "
+                       f"SparseParameterDistribution.cpp:108-118)")
+                if self.strict:
+                    raise RuntimeError(msg)
+                log.warning(msg)
+        for c in self.counts.values():
+            c[:] = 0

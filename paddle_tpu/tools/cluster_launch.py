@@ -44,9 +44,14 @@ def build_commands(hosts: list[str], port: int, workspace: str,
     coordinator = f"localhost:{port}" if local else f"{hosts[0]}:{port}"
     cmds = []
     for pid, host in enumerate(hosts):
+        # exec: the shell must BECOME the trainer, so in --local mode the
+        # kill/terminate paths in main() signal the trainer itself, not an
+        # sh wrapper (an orphaned trainer keeps the coordinator port
+        # blocked); over ssh the -tt pty makes a dropped connection HUP
+        # the remote trainer for the same reason
         inner = (
             f"cd {shlex.quote(workspace)} && "
-            f"{python} -m paddle_tpu.trainer_main "
+            f"exec {python} -m paddle_tpu.trainer_main "
             f"--coordinator_address={coordinator} "
             f"--num_processes={len(hosts)} --process_id={pid} "
             + " ".join(shlex.quote(a) for a in trainer_args)
@@ -54,7 +59,8 @@ def build_commands(hosts: list[str], port: int, workspace: str,
         if local:
             cmds.append(["sh", "-c", inner])
         else:
-            cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, inner])
+            cmds.append(["ssh", "-tt", "-o", "StrictHostKeyChecking=no",
+                         host, inner])
     return cmds
 
 

@@ -142,6 +142,21 @@ class Trainer:
         # immutable after construction; _validate_batch uses it per batch
         self._data_layers = {l.name: l for l in self.model.layers
                              if l.type == "data"}
+        # shard-traffic balance check for vocab-sharded tables (ref:
+        # pserver/SparseParameterDistribution; --check_sparse_distribution)
+        self.sparse_stats = None
+        if mesh is not None and FLAGS.check_sparse_distribution:
+            from paddle_tpu.parallel.sparse import (SparseShardStats,
+                                                    sharded_table_feeds)
+            feeds = sharded_table_feeds(mesh, self.model)
+            if feeds:
+                self.sparse_stats = SparseShardStats(
+                    feeds,
+                    batches=int(FLAGS.check_sparse_distribution_batches),
+                    unbalance_degree=float(
+                        FLAGS.check_sparse_distribution_unbalance_degree),
+                    ratio=float(FLAGS.check_sparse_distribution_ratio),
+                    show_log=bool(FLAGS.show_check_sparse_distribution_log))
 
     # -- compiled steps ---------------------------------------------------
     @property
@@ -381,6 +396,8 @@ class Trainer:
         bulk-checked every nonfinite_check_period batches, so dispatch
         pipelines with device compute."""
         self._validate_batch(batch)
+        if self.sparse_stats is not None:
+            self.sparse_stats.probe_batch(batch)
         loss, partials, host_out = self._dispatch_step(batch)
         self._acc = self.evaluators.accumulate(getattr(self, "_acc", {}), partials)
         if self.evaluators.host_configs:

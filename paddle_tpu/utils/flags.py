@@ -136,6 +136,17 @@ define_flag("prev_batch_state", False, "truncated-BPTT continuation: "
             "forward recurrent layers start from the previous batch's final "
             "hidden state instead of zeros (ref: RecurrentLayer.cpp "
             "prevOutput_; feed consecutive chunks of long streams in order)")
+define_flag("check_sparse_distribution", False,
+            "check vocab-sharded table ids for balanced per-shard traffic "
+            "(ref: --check_sparse_distribution_in_pserver)")
+define_flag("show_check_sparse_distribution_log", False,
+            "log per-shard row-touch counts for every probed batch")
+define_flag("check_sparse_distribution_batches", 100,
+            "run the sparse distribution check for N batches, then stop")
+define_flag("check_sparse_distribution_ratio", 0.6,
+            "crash if more than this fraction of checked batches is unbalanced")
+define_flag("check_sparse_distribution_unbalance_degree", 2.0,
+            "max/mean row-touch ratio beyond which a batch counts unbalanced")
 # multi-host bootstrap (ref: --trainer_id/--pservers of the pserver fleet)
 define_flag("coordinator_address", "", "jax.distributed coordinator host:port")
 define_flag("num_processes", 0, "number of cluster processes")

@@ -76,19 +76,21 @@ def _losses_of(out: str) -> str:
     raise AssertionError(f"no losses line:\n{out}")
 
 
-def _oracle_conf():
+def _oracle_conf(n_rows=2):
     """The exact model distributed_worker.py trains in dp mode (tp
     annotations in tpdp mode are placement-only, so this oracle serves
-    both)."""
-    from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
-                                TanhActivation, classification_cost,
-                                data_layer, fc_layer, settings)
-    settings(batch_size=16, learning_rate=0.1,
-             learning_method=MomentumOptimizer(momentum=0.9))
-    x = data_layer(name="x", size=16)
-    h = fc_layer(input=x, size=32, act=TanhActivation())
-    out = fc_layer(input=h, size=4, act=SoftmaxActivation())
-    classification_cost(input=out, label=data_layer(name="y", size=4))
+    both); batch_size mirrors the workers' 8*data_par."""
+    def conf():
+        from paddle_tpu.dsl import (MomentumOptimizer, SoftmaxActivation,
+                                    TanhActivation, classification_cost,
+                                    data_layer, fc_layer, settings)
+        settings(batch_size=8 * n_rows, learning_rate=0.1,
+                 learning_method=MomentumOptimizer(momentum=0.9))
+        x = data_layer(name="x", size=16)
+        h = fc_layer(input=x, size=32, act=TanhActivation())
+        out = fc_layer(input=h, size=4, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=4))
+    return conf
 
 
 def _oracle_losses(n_rows: int, steps: int = 4):
@@ -101,7 +103,8 @@ def _oracle_losses(n_rows: int, steps: int = 4):
     from paddle_tpu.parameter.argument import Argument
     from paddle_tpu.trainer.trainer import Trainer
 
-    tr = Trainer(parse_config_callable(_oracle_conf), seed=7, mesh=None)
+    tr = Trainer(parse_config_callable(_oracle_conf(n_rows)), seed=7,
+                 mesh=None)
     rngs = [np.random.default_rng(100 + row) for row in range(n_rows)]
     W = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
     losses = []
@@ -115,6 +118,25 @@ def _oracle_losses(n_rows: int, steps: int = 4):
                                    "y": Argument(ids=np.concatenate(ys))})
         losses.append(float(loss))
     return losses, tr
+
+
+def _assert_matches_local(worker_out: str, tr):
+    """Workers' printed final-param summaries must match the local-run
+    oracle (ref: test_CompareSparse.cpp — multi-trainer == local)."""
+    import re
+
+    import jax
+    import numpy as np
+    dist_params = {m.group(1): (float(m.group(2)), float(m.group(3)))
+                   for m in re.finditer(
+                       r"param (\S+) sum=(\S+) asum=(\S+)", worker_out)}
+    assert dist_params, "workers printed no param summaries"
+    for name, v in tr.params.items():
+        flat = np.asarray(jax.device_get(v)).ravel()
+        sm, a = dist_params[name]
+        np.testing.assert_allclose([flat.sum(), np.abs(flat).sum()], [sm, a],
+                                   rtol=3e-4, atol=2e-5,
+                                   err_msg=f"param {name!r} != local run")
 
 
 
@@ -135,18 +157,7 @@ def test_two_process_data_parallel_training():
                                atol=1e-6,
                                err_msg="2-process losses != local training")
 
-    import re as _re
-    import jax as _jax
-    dist_params = {m.group(1): (float(m.group(2)), float(m.group(3)))
-                   for m in _re.finditer(
-                       r"param (\S+) sum=(\S+) asum=(\S+)", outs[0])}
-    assert dist_params, "workers printed no param summaries"
-    for name, v in tr.params.items():
-        flat = np.asarray(_jax.device_get(v)).ravel()
-        sm, a = dist_params[name]
-        np.testing.assert_allclose([flat.sum(), np.abs(flat).sum()], [sm, a],
-                                   rtol=3e-4, atol=2e-5,
-                                   err_msg=f"param {name!r} != local run")
+    _assert_matches_local(outs[0], tr)
 
 
 def test_four_process_tp_by_dp_training():
@@ -164,11 +175,15 @@ def test_four_process_tp_by_dp_training():
     # single-process equivalence: same model (tp annotations are placement
     # only), same global batches, mesh=None
     import numpy as np
-    local_losses, _ = _oracle_losses(n_rows=2)
+    local_losses, tr = _oracle_losses(n_rows=2)
     dist_losses = [float(v) for v in ls[0].split(",")]
     np.testing.assert_allclose(dist_losses, local_losses, rtol=2e-4,
                                atol=1e-6,
                                err_msg="tp x dp losses != local training")
+
+    # final params too: a model-axis reconstruction bug (shards tiled in
+    # the wrong order by _host_tree) shows up here, not in the losses
+    _assert_matches_local(outs[0], tr)
 
 
 def test_cluster_launch_local_integration(tmp_path):

@@ -1,0 +1,238 @@
+"""doOperation vector-math analog + sparse shard-traffic diagnostics.
+
+Mirrors ref: pserver/ParameterServer2.cpp op_* semantics (transliterated
+numpy oracles below) and pserver/SparseParameterDistribution.cpp's
+balance-check behavior (unbalanced batches counted, crash past ratio)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel import vecmath
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.sparse import SparseShardStats, sharded_table_feeds
+
+N = 64
+
+
+def _sharded_pair(mesh, seed):
+    rng = np.random.default_rng(seed)
+    sh = NamedSharding(mesh, P("data"))
+    u = jax.device_put(rng.normal(size=N).astype(np.float32), sh)
+    v = jax.device_put(rng.normal(size=N).astype(np.float32), sh)
+    return u, v
+
+
+def test_utv_au_bv_sharded_match_numpy():
+    mesh = make_mesh(data=8)
+    u, v = _sharded_pair(mesh, 0)
+    un, vn = np.asarray(u), np.asarray(v)
+    np.testing.assert_allclose(float(jax.jit(vecmath.utv)(u, v)),
+                               un.astype(np.float64) @ vn, rtol=1e-5)
+    out = jax.jit(lambda u, v: vecmath.au_bv(u, v, 0.3, -1.7))(u, v)
+    np.testing.assert_allclose(np.asarray(out), 0.3 * un - 1.7 * vn,
+                               rtol=1e-5)
+    out3 = vecmath.au_bv_cw(u, v, u + v, 0.5, 2.0, -1.0)
+    np.testing.assert_allclose(np.asarray(out3),
+                               0.5 * un + 2.0 * vn - (un + vn), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(vecmath.au(u, 2.5)), 2.5 * un,
+                               rtol=1e-6)
+
+
+def _steepest_oracle(grad, x, w):
+    # transliteration of ref: ParameterServer2.cpp:1301-1315
+    d = np.zeros_like(grad)
+    for i in range(len(grad)):
+        if x[i] < 0:
+            d[i] = -grad[i] + w
+        elif x[i] > 0:
+            d[i] = -grad[i] - w
+        elif grad[i] < -w:
+            d[i] = -grad[i] - w
+        elif grad[i] > w:
+            d[i] = -grad[i] + w
+    return d
+
+
+def _dir_deriv_oracle(d, grad, x, w):
+    # transliteration of ref: ParameterServer2.cpp:1352-1363
+    s = 0.0
+    for i in range(len(d)):
+        if d[i] == 0:
+            continue
+        if x[i] < 0 or (x[i] == 0 and d[i] < 0):
+            s += d[i] * (grad[i] - w)
+        else:
+            s += d[i] * (grad[i] + w)
+    return s
+
+
+def test_owlqn_ops_match_reference_semantics():
+    rng = np.random.default_rng(1)
+    grad = rng.normal(size=N).astype(np.float32)
+    # force exact zeros so every branch of the orthant logic is exercised
+    x = rng.normal(size=N).astype(np.float32)
+    x[::5] = 0.0
+    w = 0.4
+    d = np.asarray(vecmath.make_steepest_desc_dir(jnp.asarray(grad),
+                                                  jnp.asarray(x), w))
+    np.testing.assert_allclose(d, _steepest_oracle(grad, x, w), rtol=1e-6)
+
+    fixed = np.asarray(vecmath.fix_dir_signs(jnp.asarray(grad),
+                                             jnp.asarray(d)))
+    assert (fixed[grad * d <= 0] == 0).all()
+    assert np.array_equal(fixed[grad * d > 0], grad[grad * d > 0])
+
+    dd = float(vecmath.dir_deriv(jnp.asarray(d), jnp.asarray(grad),
+                                 jnp.asarray(x), w))
+    np.testing.assert_allclose(dd, _dir_deriv_oracle(d, grad, x, w),
+                               rtol=1e-4)
+
+    newx = x + 0.5 * d
+    proj = np.asarray(vecmath.fix_omega_signs(jnp.asarray(x),
+                                              jnp.asarray(newx)))
+    assert (proj[x * newx < 0] == 0).all()
+    np.testing.assert_allclose(
+        float(vecmath.l1_cost(jnp.asarray(x), w)), w * np.abs(x).sum(),
+        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# SparseParameterDistribution analog
+# ---------------------------------------------------------------------------
+
+class _Arg:
+    def __init__(self, ids, lengths=None):
+        self.ids = ids
+        self.lengths = lengths
+
+
+def _stats(n_shards=4, vocab=64, **kw):
+    return SparseShardStats({"emb_w": (["w"], vocab, n_shards)}, **kw)
+
+
+def test_balanced_ids_pass():
+    st = _stats(batches=5, strict=True)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        st.probe_batch({"w": _Arg(rng.integers(0, 64, 128))})
+    assert st.done and st.unbalance_cnt == 0
+
+
+def test_skewed_ids_crash_past_ratio():
+    st = _stats(batches=4, ratio=0.5, strict=True)
+    with pytest.raises(RuntimeError, match="unbalanced sparse id"):
+        for _ in range(4):
+            # every id lands in shard 0 (ids < 16 of vocab 64 over 4 shards)
+            st.probe_batch({"w": _Arg(np.zeros(128, np.int32))})
+    assert st.batch_passed == 4 and st.unbalance_cnt == 4
+
+
+def test_skewed_ids_warn_when_not_strict():
+    st = _stats(batches=3, ratio=0.5, strict=False)
+    for _ in range(3):
+        st.probe_batch({"w": _Arg(np.full(64, 63, np.int32))})
+    assert st.done and st.unbalance_cnt == 3
+
+
+def test_padding_not_counted_as_traffic():
+    """Pad cells (feeder pads id slots with 0) must not inflate shard 0:
+    balanced real ids in heavily padded batches stay balanced."""
+    st = _stats(batches=4, ratio=0.25, strict=True)
+    rng = np.random.default_rng(2)
+    for _ in range(4):
+        ids = np.zeros((16, 32), np.int64)  # mostly padding -> id 0
+        lengths = np.full(16, 8, np.int64)
+        for r in range(16):
+            ids[r, :8] = rng.integers(0, 64, 8)
+        st.probe_batch({"w": _Arg(ids, lengths)})
+    assert st.done
+    # with pads counted, every batch would be shard-0 skewed and raise
+    assert st.unbalance_cnt <= 1
+
+
+def test_uneven_vocab_uses_ceil_shards():
+    # vocab 10 over 4 shards: GSPMD owns rows ceil-wise, 3/3/3/1
+    st = SparseShardStats({"emb_w": (["w"], 10, 4)}, batches=1, strict=False)
+    st.probe_batch({"w": _Arg(np.tile(np.arange(10), 8))})
+    assert st.batch_passed == 1  # no div-by-zero, ids 9 -> shard 3
+
+
+def test_tiny_batches_carry_no_balance_evidence():
+    # 6 ids over 8 shards: some shard is always 0-touch; must not be
+    # judged, and the probe must STOP once the budget is spent (no
+    # per-batch host fetch forever)
+    st = SparseShardStats({"emb_w": (["w"], 64, 8)}, batches=2, ratio=0.0)
+    rng = np.random.default_rng(3)
+    for _ in range(100):
+        st.probe_batch({"w": _Arg(rng.integers(0, 64, 6))})
+    assert st.batch_passed == 0 and st.unbalance_cnt == 0
+    assert st.done  # budget (10*batches) spent -> probing switched off
+
+
+def test_probe_stops_after_budget():
+    st = _stats(batches=2)
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        st.probe_batch({"w": _Arg(rng.integers(0, 64, 64))})
+    assert st.batch_passed == 2  # later batches are free (ref: batchPassed_ gate)
+
+
+def _emb_conf(batch_size=16):
+    """Shared tiny embedding->fc model with a vocab-shardable table."""
+    def conf():
+        from paddle_tpu.dsl import (
+            ParamAttr, MomentumOptimizer, TanhActivation, data_layer,
+            embedding_layer, fc_layer, pooling_layer, regression_cost,
+            settings, SumPooling,
+        )
+        settings(batch_size=batch_size, learning_rate=0.05,
+                 learning_method=MomentumOptimizer(momentum=0.0))
+        w = data_layer(name="w", size=64)
+        emb = embedding_layer(input=w, size=8,
+                              param_attr=ParamAttr(name="emb_w",
+                                                   sparse_update=True,
+                                                   initial_std=0.1))
+        pooled = pooling_layer(input=emb, pooling_type=SumPooling())
+        out = fc_layer(input=pooled, size=1, act=TanhActivation(),
+                       param_attr=ParamAttr(initial_std=0.1))
+        regression_cost(input=out, label=data_layer(name="y", size=1))
+    return conf
+
+
+def test_sharded_table_feeds_mapping():
+    from paddle_tpu.config.parser import parse_config_callable
+
+    cfg = parse_config_callable(_emb_conf())
+    mesh = make_mesh(data=2, model=4)
+    feeds = sharded_table_feeds(mesh, cfg.model_config)
+    assert feeds == {"emb_w": (["w"], 64, 4)}
+    # an unsharded mesh probes nothing
+    solo = make_mesh(data=1, devices=jax.devices()[:1])
+    assert sharded_table_feeds(solo, cfg.model_config) == {}
+
+
+def test_trainer_probes_when_flag_set():
+    from paddle_tpu.config.parser import parse_config_callable
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+    from paddle_tpu.utils.flags import FLAGS
+
+    cfg = parse_config_callable(_emb_conf(batch_size=8))
+    old = FLAGS.check_sparse_distribution
+    FLAGS.check_sparse_distribution = True
+    try:
+        tr = Trainer(cfg, seed=0, mesh=make_mesh(data=2, model=4))
+        assert tr.sparse_stats is not None
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 64, (8, 8)).astype(np.int32)
+        batch = {"w": Argument(ids=ids,
+                               lengths=np.full(8, 8, np.int32)),
+                 "y": Argument(value=np.zeros((8, 1), np.float32))}
+        tr.train_one_batch(batch)
+        assert tr.sparse_stats.batch_passed == 1
+        assert int(sum(c.sum() for c in tr.sparse_stats.counts.values())) == 0
+    finally:
+        FLAGS.check_sparse_distribution = old
