@@ -23,6 +23,7 @@ window = get_config_arg("window", int, 0)             # 0 = full attention
 ffn_mult = get_config_arg("ffn_mult", int, 4)
 batch_size = get_config_arg("batch_size", int, 16)
 compute_dtype = get_config_arg("compute_dtype", str, "")
+attn_impl = get_config_arg("attn_impl", str, "auto")  # auto/dense/flash/blockwise/ring
 
 define_py_data_sources2(
     train_list="demo/model_zoo/lm_train.list", test_list=None,
@@ -48,6 +49,7 @@ for i in range(n_layers):
     attn = multi_head_attention_layer(
         attn_in, size=dim, num_heads=n_heads, causal=True, use_rope=True,
         num_kv_heads=n_kv_heads or None, window=window or None,
+        attn_impl=attn_impl if attn_impl != "auto" else None,
         name=f"blk{i}_attn")
     h = addto_layer(input=[h, attn], act=LinearActivation(),
                     name=f"blk{i}_res1", bias_attr=False)

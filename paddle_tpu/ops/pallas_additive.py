@@ -78,10 +78,14 @@ def _kernel(bB, bT, u_ref, v_ref, proj_ref, seq_ref, len_ref,
     h = jnp.tanh(proj_ref[...].astype(jnp.float32) + u[:, None, :])
     D = h.shape[-1]
     # [bB*bT, D] @ [D, 1] on the MXU -> scores [bB, bT]
+    # HIGHEST: on hardware the MXU's default fp32 path is a single bf16
+    # pass (~1e-2 relative) — these dots are vector-sized (N=1 / M=1), so
+    # full fp32 costs nothing and keeps the kernel's fp32 contract honest
     s = jax.lax.dot_general(
         h.reshape(bB * bT, D), v_ref[...].astype(jnp.float32).reshape(D, 1),
         (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).reshape(bB, bT)
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).reshape(bB, bT)
     # validity: global t index < length (lengths ride a [bB, 128] column)
     tpos = it * bT + jax.lax.broadcasted_iota(jnp.int32, (bB, bT), 1)
     valid = tpos < len_ref[:, :1].astype(jnp.int32)
@@ -94,7 +98,9 @@ def _kernel(bB, bT, u_ref, v_ref, proj_ref, seq_ref, len_ref,
     l_s[:, :1] = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(                              # [bB, 1, Dv]
         p[:, None, :], seq_ref[...].astype(jnp.float32),
-        (((2,), (1,)), ((0,), (0,))))
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
     acc_s[:] = acc_s[:] * corr + pv[:, 0, :]
     m_s[:, :1] = m_new
 

@@ -132,7 +132,7 @@ def _fwd_kernel(H, Bq, Bk, scale, causal, window,
         k = k_ref[0].astype(jnp.float32)                 # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal,
+        mask = _tile_mask(kv_ref[0, 0], q_off, k_off, iq, ik, Bq, Bk, causal,
                           window)
         s = jnp.where(mask, s, _NEG_INF)
 
@@ -156,8 +156,8 @@ def _fwd_kernel(H, Bq, Bk, scale, causal, window,
                              0.0).astype(o_ref.dtype)
         # -inf for fully-masked rows: a ring combine weighs them out with
         # exp(lse - total) = 0, and the backward mask already zeroes p
-        lse_ref[0] = jnp.where(l[:, 0] > 0, m_s[:, 0] + jnp.log(l[:, 0]),
-                               -jnp.inf)
+        lse_ref[0, 0] = jnp.where(l[:, 0] > 0, m_s[:, 0] + jnp.log(l[:, 0]),
+                                  -jnp.inf)
 
 
 def _scalar_spec():
@@ -192,18 +192,22 @@ def _fwd_call(q, k, v, kv_mask, q_off, k_off, H, scale, causal, window,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (kvi(bh), ik, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Bk), lambda bh, iq, ik: (bh // H, ik),
+            # 2-D arrays ride with a singleton middle dim: mosaic requires
+            # the block's last-two dims be (8k, 128k) or equal the array's —
+            # a (1, Bk) block on [B, Tk] has sublane dim 1 != B and is
+            # rejected on hardware (interpret mode never checks)
+            pl.BlockSpec((1, 1, Bk), lambda bh, iq, ik: (bh // H, 0, ik),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Bq), lambda bh, iq, ik: (bh, iq),
+            pl.BlockSpec((1, 1, Bq), lambda bh, iq, ik: (bh, 0, iq),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, Tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((Bq, 128), jnp.float32),   # running max (lane 0)
@@ -237,15 +241,15 @@ def _bwd_dq_kernel(H, Bq, Bk, scale, causal, window,
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal,
+        mask = _tile_mask(kv_ref[0, 0], q_off, k_off, iq, ik, Bq, Bk, causal,
                           window)
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)  # [Bq, Bk]
 
         do = do_ref[0].astype(jnp.float32)                          # [Bq, D]
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         dq_s[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
 
@@ -278,9 +282,9 @@ def _bwd_dkv_kernel(H, nq, Bq, Bk, scale, causal, window,
         k = k_ref[0].astype(jnp.float32)                          # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = _tile_mask(kv_ref[0], q_off, k_off, iq, ik, Bq, Bk, causal,
+        mask = _tile_mask(kv_ref[0, 0], q_off, k_off, iq, ik, Bq, Bk, causal,
                           window)
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)  # [Bq, Bk]
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)  # [Bq, Bk]
 
         do = do_ref[0].astype(jnp.float32)                          # [Bq, D]
         # dv += p^T @ do
@@ -289,7 +293,7 @@ def _bwd_dkv_kernel(H, nq, Bq, Bk, scale, causal, window,
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         # dk += ds^T @ q
         dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
@@ -312,16 +316,16 @@ def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
     # d lse/ds_j = p_j, so the lse cotangent folds into the delta term:
     # ds = p (dp - delta + dlse) = p (dp - (delta - dlse))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1) - dlse                                # [BH, Tq]
+                    axis=-1)[:, None, :] - dlse                 # [BH, 1, Tq]
     delta = jnp.where(jnp.isfinite(delta), delta, 0.0)
 
     q_spec = pl.BlockSpec((1, Bq, D), lambda bh, iq, ik: (bh, iq, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec((1, Bk, D), lambda bh, iq, ik: (kvi(bh), ik, 0),
                            memory_space=pltpu.VMEM)
-    kmask_spec = pl.BlockSpec((1, Bk), lambda bh, iq, ik: (bh // H, ik),
+    kmask_spec = pl.BlockSpec((1, 1, Bk), lambda bh, iq, ik: (bh // H, 0, ik),
                               memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, Bq), lambda bh, iq, ik: (bh, iq),
+    row_spec = pl.BlockSpec((1, 1, Bq), lambda bh, iq, ik: (bh, 0, iq),
                             memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -347,10 +351,10 @@ def _bwd_call(q, k, v, kv_mask, q_off, k_off, o, lse, do, dlse,
     kv_spec2 = pl.BlockSpec((1, Bk, D), lambda bhkv, ik, inner: (bhkv, ik, 0),
                             memory_space=pltpu.VMEM)
     kmask_spec2 = pl.BlockSpec(
-        (1, Bk), lambda bhkv, ik, inner: (bhkv // H_kv, ik),
+        (1, 1, Bk), lambda bhkv, ik, inner: (bhkv // H_kv, 0, ik),
         memory_space=pltpu.VMEM)
     row_spec2 = pl.BlockSpec(
-        (1, Bq), lambda bhkv, ik, inner: (bh_of(bhkv, inner), inner % nq),
+        (1, 1, Bq), lambda bhkv, ik, inner: (bh_of(bhkv, inner), 0, inner % nq),
         memory_space=pltpu.VMEM)
 
     dk, dv = pl.pallas_call(
@@ -447,7 +451,8 @@ def flash_attention(
 
     kv_mask = jnp.ones((B, Tk), jnp.float32) if k_valid is None \
         else k_valid.astype(jnp.float32)
-    kv_mask = jnp.pad(kv_mask, ((0, 0), (0, Tkp - Tk)))
+    # singleton middle dim: see the mosaic block-rule note in _fwd_call
+    kv_mask = jnp.pad(kv_mask, ((0, 0), (0, Tkp - Tk)))[:, None, :]
 
     q_off = jnp.asarray(q_offset, jnp.int32).reshape(1)
     k_off = jnp.asarray(k_offset, jnp.int32).reshape(1)

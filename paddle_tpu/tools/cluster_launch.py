@@ -99,7 +99,11 @@ def main(argv=None) -> int:
     # dies at startup the others would block forever, so kill the survivors
     # as soon as any process exits nonzero
     import time
-    procs = [subprocess.Popen(c) for c in cmds]
+    # stdin=DEVNULL: concurrent `ssh -tt` processes would otherwise fight
+    # over the launcher's tty (raw mode + competing reads swallow Ctrl-C,
+    # defeating the KeyboardInterrupt teardown below); the doubled -t still
+    # allocates the remote pty that HUPs the workers on disconnect
+    procs = [subprocess.Popen(c, stdin=subprocess.DEVNULL) for c in cmds]
     deadline = time.monotonic() + args.timeout if args.timeout > 0 else None
     rc = 0
     try:

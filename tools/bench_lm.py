@@ -1,0 +1,175 @@
+"""Transformer-LM performance sweep on TPU (VERDICT r3 item 3).
+
+The LM family (demo/model_zoo/transformer_lm.py) exercises every round-3
+kernel: rotary attention with the dense/flash/blockwise auto-selection,
+layer_norm, GELU, the compiled decode loop.  This tool measures, per
+sequence length:
+
+  * train tokens/sec + MFU (scan-staged batches, same measurement shape
+    as bench.py) for each requested attn_impl — the dense-vs-flash
+    crossover table PERF.md needs,
+  * greedy decode tokens/sec via graph/lm_decode (fixed-iteration,
+    median +- IQR across reps — the variance-controlled decode
+    measurement VERDICT r3 item 2 asks for).
+
+One JSON line per measurement.  Token budget per batch is held constant
+across lengths (batch = tokens_per_batch / seq_len) so every row saturates
+the chip with the same work.
+
+Usage:
+  python tools/bench_lm.py --lens 512,1024,4096 --impls auto,dense
+  python tools/bench_lm.py --dim 512 --layers 8 --heads 8 --vocab 32000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mfu(tr, batch, tokens_per_sec: float, tokens_per_batch: int,
+         dtype: str) -> float:
+    # bench.py's MFU is per-(samples/sec, batch) but the ratio is identical
+    # for (tokens/sec, tokens/batch) — share one implementation
+    from bench import _step_mfu
+    return _step_mfu(tr, batch, tokens_per_sec, tokens_per_batch, dtype)
+
+
+def bench_train(args, seq_len: int, impl: str) -> dict:
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    batch = max(1, args.tokens_per_batch // seq_len)
+    cfg = parse_config(
+        "demo/model_zoo/transformer_lm.py",
+        f"vocab={args.vocab},dim={args.dim},layers={args.layers},"
+        f"heads={args.heads},batch_size={batch},"
+        f"compute_dtype={args.dtype},attn_impl={impl}")
+    tr = Trainer(cfg, seed=1)
+
+    rng = np.random.default_rng(0)
+    full = np.full((batch,), seq_len, np.int32)
+    batches = []
+    for _ in range(2 + args.iters):
+        tok = rng.integers(2, args.vocab, (batch, seq_len)).astype(np.int32)
+        nxt = rng.integers(2, args.vocab, (batch, seq_len)).astype(np.int32)
+        batches.append({"tokens": Argument(ids=tok, lengths=full),
+                        "next_tokens": Argument(ids=nxt, lengths=full)})
+    stats = tr.benchmark(iter(batches), warmup=2, iters=args.iters,
+                         scan=True)
+    sps = stats["samples_per_sec"]
+    tps = sps * seq_len
+    return {
+        "bench": "lm_train", "impl": impl, "seq_len": seq_len,
+        "batch": batch, "dim": args.dim, "layers": args.layers,
+        "tokens_per_sec": round(tps, 1),
+        "samples_per_sec": round(sps, 2),
+        "mfu": round(_mfu(tr, batches[0], tps, batch * seq_len,
+                          args.dtype), 4),
+    }
+
+
+def bench_decode(args, context: int) -> dict:
+    """Greedy decode throughput: median +- IQR over fixed-size reps (the
+    whole decode is one jitted scan; per-call dispatch jitter demands a
+    robust statistic, not one stopwatch pass)."""
+    import numpy as np
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.graph.lm_decode import lm_generate
+    from paddle_tpu.trainer.trainer import Trainer
+
+    batch = max(1, args.decode_batch)
+    prompt = max(1, context - args.max_new)
+    cfg = parse_config(
+        "demo/model_zoo/transformer_lm.py",
+        f"vocab={args.vocab},dim={args.dim},layers={args.layers},"
+        f"heads={args.heads},batch_size={batch},"
+        f"compute_dtype={args.dtype}")
+    tr = Trainer(cfg, seed=1)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(2, args.vocab, (batch, prompt)).astype(np.int32)
+    toks, _ = lm_generate(tr.executor, tr.params, ids, max_new=args.max_new)
+    np.asarray(toks)                                   # compile + warmup
+    times = []
+    for _ in range(args.decode_reps):
+        t0 = time.perf_counter()
+        toks, _ = lm_generate(tr.executor, tr.params, ids,
+                              max_new=args.max_new)
+        np.asarray(toks)
+        times.append(time.perf_counter() - t0)
+    times = np.asarray(times)
+    q1, med, q3 = np.percentile(times, [25, 50, 75])
+    n_tok = batch * args.max_new
+    return {
+        "bench": "lm_decode", "context": context, "batch": batch,
+        "max_new": args.max_new,
+        "tokens_per_sec_median": round(n_tok / med, 1),
+        "tokens_per_sec_iqr": [round(n_tok / q3, 1), round(n_tok / q1, 1)],
+        "reps": args.decode_reps,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lens", default="512,1024,4096")
+    ap.add_argument("--impls", default="auto,dense")
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--tokens-per-batch", type=int, default=32768)
+    ap.add_argument("--decode", action="store_true", default=True)
+    ap.add_argument("--no-decode", dest="decode", action="store_false")
+    ap.add_argument("--decode-batch", type=int, default=32)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--decode-reps", type=int, default=20)
+    args = ap.parse_args()
+
+    lens = [int(x) for x in args.lens.split(",") if x]
+    impls = [x.strip() for x in args.impls.split(",") if x.strip()]
+    ok = True
+    for seq_len in lens:
+        for impl in impls:
+            try:
+                print(json.dumps(bench_train(args, seq_len, impl)),
+                      flush=True)
+            except Exception as e:                      # noqa: BLE001
+                ok = False
+                print(json.dumps({
+                    "bench": "lm_train", "impl": impl, "seq_len": seq_len,
+                    "error": f"{type(e).__name__}: {str(e)[:300]}"}),
+                    flush=True)
+    if args.decode:
+        for context in lens:
+            if context > 2048:
+                print(json.dumps({
+                    "bench": "lm_decode", "context": context,
+                    "skipped": "O(T^2) whole-prefix re-forward decode; "
+                               "KV-cache variant not yet landed"}),
+                    flush=True)
+                continue
+            try:
+                print(json.dumps(bench_decode(args, context)), flush=True)
+            except Exception as e:                      # noqa: BLE001
+                ok = False
+                print(json.dumps({
+                    "bench": "lm_decode", "context": context,
+                    "error": f"{type(e).__name__}: {str(e)[:300]}"}),
+                    flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

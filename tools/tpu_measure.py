@@ -99,15 +99,19 @@ def main() -> int:
         return 1
 
     py = sys.executable
+    # cheap/high-information first: the tunnel can die mid-queue (it did in
+    # rounds 2-4; in r2 and r4 the wedge began DURING the seq2seq bench),
+    # so kernel parity + micro-benches land before the big configs
     steps = [
         ("parity", [py, "tools/tpu_parity.py"], 900, {}),
+        ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
         ("attn_bench",
          [py, "tools/bench_attention.py", "--lens", "512,1024,2048,4096,16384",
           "--iters", "10"], 1500, {}),
         ("attn_bench_f32",
          [py, "tools/bench_attention.py", "--lens", "512,1024,4096",
           "--iters", "10", "--dtype", "float32"], 900, {}),
-        ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
+        ("bench_lm", [py, "tools/bench_lm.py"], 2400, {}),
         ("bench_quick", [py, "bench.py"], 1500,
          {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
         ("bench_full", [py, "bench.py"], 2400,
