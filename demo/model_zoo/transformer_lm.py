@@ -24,6 +24,7 @@ ffn_mult = get_config_arg("ffn_mult", int, 4)
 batch_size = get_config_arg("batch_size", int, 16)
 compute_dtype = get_config_arg("compute_dtype", str, "")
 attn_impl = get_config_arg("attn_impl", str, "auto")  # auto/dense/flash/blockwise/ring
+block_k_min = get_config_arg("block_k_min", int, 0)   # 0 = default crossover
 
 define_py_data_sources2(
     train_list="demo/model_zoo/lm_train.list", test_list=None,
@@ -50,6 +51,7 @@ for i in range(n_layers):
         attn_in, size=dim, num_heads=n_heads, causal=True, use_rope=True,
         num_kv_heads=n_kv_heads or None, window=window or None,
         attn_impl=attn_impl if attn_impl != "auto" else None,
+        block_k_min=block_k_min or None,
         name=f"blk{i}_attn")
     h = addto_layer(input=[h, attn], act=LinearActivation(),
                     name=f"blk{i}_res1", bias_attr=False)

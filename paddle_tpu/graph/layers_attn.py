@@ -45,6 +45,15 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
     num_heads = int(cfg.attrs["num_heads"])
     causal = bool(cfg.attrs.get("causal", False))
 
+    cache = ctx.state_in.get(cfg.name)
+    if isinstance(cache, dict) and "k" in cache:
+        # incremental decode against a KV cache (lm_decode use_cache path):
+        # the input carries only NEW tokens; per-row positions come from the
+        # cache, so caches ride the same state threading as BN moving stats
+        assert causal, f"layer {cfg.name!r}: KV-cache decode requires causal"
+        return _cached_step(ctx, cfg, q_arg, w_q, w_k, w_v, w_o, num_heads,
+                            cache)
+
     q_valid = q_arg.mask()
     k_valid = k_arg.mask()
 
@@ -101,6 +110,67 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
         use_rope=bool(cfg.attrs.get("use_rope", False)),
         rope_theta=float(cfg.attrs.get("rope_theta", 10000.0)))
     return finish_layer(ctx, cfg, out, like=q_arg)
+
+
+def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
+                 w_q, w_k, w_v, w_o, num_heads: int,
+                 cache: dict) -> Argument:
+    """One incremental self-attention call: project the new tokens, fold
+    them into this layer's KV cache, attend causally on global positions.
+    Emits the updated cache through ctx.state_out."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_attention
+    from paddle_tpu.ops.attention import (blockwise_attention,
+                                          cached_attention_step,
+                                          dot_product_attention, rope)
+
+    x = x_arg.value                                   # [B, Tn, model_dim]
+    B, Tn, _ = x.shape
+    model_dim = w_q.shape[1]
+    Dh = model_dim // num_heads
+    h_kv = int(cfg.attrs.get("num_kv_heads", 0) or num_heads)
+    pos = cache["pos"]
+    q = (x @ w_q).reshape(B, Tn, num_heads, Dh)
+    k = (x @ w_k).reshape(B, Tn, h_kv, Dh)
+    v = (x @ w_v).reshape(B, Tn, h_kv, Dh)
+    if bool(cfg.attrs.get("use_rope", False)):
+        qpos = pos[:, None] + jnp.arange(Tn)[None, :]
+        theta = float(cfg.attrs.get("rope_theta", 10000.0))
+        q, k = rope(q, qpos, theta), rope(k, qpos, theta)
+    n_new = (x_arg.lengths.astype(jnp.int32) if x_arg.lengths is not None
+             else jnp.full((B,), Tn, jnp.int32))
+    window = (int(cfg.attrs["window"]) if "window" in cfg.attrs else None)
+    if Tn > 1:
+        # prefill contract: a multi-token cached call starts from an EMPTY
+        # cache (lm_decode feeds the whole prompt once), so attention over
+        # the cache degenerates to plain causal self-attention — run it
+        # through the impl-selected kernel (flash for long prompts) rather
+        # than cached_attention_step, whose O(Tn*Tmax) dense scores and
+        # one-hot scatter would defeat the cache at exactly the long
+        # contexts it exists for; k/v land in the cache as a static slice
+        valid = (jnp.arange(Tn)[None, :] < n_new[:, None])
+        if pallas_attention.supported() and \
+                Tn >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
+            attn = pallas_attention.flash_attention
+        elif Tn >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
+            attn = blockwise_attention
+        else:
+            attn = dot_product_attention
+        out = attn(q, k, v, q_valid=valid, k_valid=valid, causal=True,
+                   **({} if window is None else {"window": window}))
+        ck = cache["k"].at[:, :Tn].set(k.astype(cache["k"].dtype))
+        cv = cache["v"].at[:, :Tn].set(v.astype(cache["v"].dtype))
+        newpos = pos + n_new
+    else:
+        out, ck, cv, newpos = cached_attention_step(
+            q, k, v, cache["k"], cache["v"], pos, n_new, window=window)
+    ctx.state_out[cfg.name] = {"k": ck, "v": cv, "pos": newpos}
+    o = out.reshape(B, Tn, model_dim) @ w_o
+    bias = ctx.bias_of(cfg)
+    if bias is not None:
+        o = o + bias
+    return finish_layer(ctx, cfg, o, like=x_arg)
 
 
 @register_layer("additive_attention_step")

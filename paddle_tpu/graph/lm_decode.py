@@ -9,11 +9,15 @@ fixed-size token buffer — each step runs the full forward on the padded
 prefix (masked by the running length), reads the next-token logits at the
 last valid position, and samples greedy / temperature / top-k.
 
-Re-design note: a per-layer KV cache would make each step O(T) instead of
-O(T^2); at the classic benchmark scales the whole-prefix re-forward is
-one fused program XLA pipelines well, and it needs zero layer-level
-support — the cacheized variant is a later optimization, not a
-correctness feature.
+Two decode modes:
+  * whole-prefix re-forward (default) — each step runs the full forward on
+    the padded buffer; O(T^2) total but zero layer-level support needed,
+    and at short contexts it is one fused program XLA pipelines well.
+  * `use_cache=True` — per-layer KV caches (init_kv_caches) ride the
+    executor's state channel (the same threading as BN moving stats); each
+    step runs the stack on ONE new token per row against the caches
+    (ops/attention.py:cached_attention_step) — O(T) per token, the
+    long-context decode path.
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ def lm_generate(
     top_k: int = 0,               # 0 = full distribution
     eos_id: int = -1,             # -1 = never stop early
     rng: Optional[Array] = None,
+    use_cache: bool = False,      # O(T) per-token decode via KV caches
 ):
     """Returns (tokens [B, P+max_new], lengths [B]) — the prompt plus up to
     max_new sampled tokens per row (rows stop growing at eos_id).
@@ -75,28 +80,23 @@ def lm_generate(
 
     buf0 = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt_ids)
 
-    def step(carry, key):
-        buf, lengths, done = carry
-        feed = {input_name: Argument(ids=buf, lengths=lengths)}
-        outputs, _, _ = executor.forward(params, feed, None, TEST, None)
-        logits = outputs[logits_name].value          # [B, total, V]
-        last = jnp.take_along_axis(
-            logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    def pick_next(last, key):
         last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
             if _is_probs(model, logits_name) else last.astype(jnp.float32)
         if temperature <= 0.0:
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-        else:
-            scaled = last / temperature
-            if top_k > 0:
-                # exact k-best support via top_k (ref pattern:
-                # graph/generator.py beam candidate selection): scatter the
-                # k values back to -inf elsewhere so ties at the kth value
-                # can never widen the candidate set
-                vals, idxs = jax.lax.top_k(scaled, top_k)
-                scaled = jnp.full_like(scaled, -jnp.inf).at[
-                    jnp.arange(scaled.shape[0])[:, None], idxs].set(vals)
-            nxt = jax.random.categorical(key, scaled).astype(jnp.int32)
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)
+        scaled = last / temperature
+        if top_k > 0:
+            # exact k-best support via top_k (ref pattern:
+            # graph/generator.py beam candidate selection): scatter the
+            # k values back to -inf elsewhere so ties at the kth value
+            # can never widen the candidate set
+            vals, idxs = jax.lax.top_k(scaled, top_k)
+            scaled = jnp.full_like(scaled, -jnp.inf).at[
+                jnp.arange(scaled.shape[0])[:, None], idxs].set(vals)
+        return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+    def advance(buf, lengths, done, nxt):
         # frozen rows keep their buffer and length
         write_pos = jnp.clip(lengths, 0, total - 1)
         new_buf = buf.at[jnp.arange(B), write_pos].set(
@@ -104,12 +104,79 @@ def lm_generate(
         new_len = jnp.where(done, lengths, jnp.minimum(lengths + 1, total))
         new_done = jnp.logical_or(done, jnp.logical_or(
             nxt == eos_id, new_len >= total))
-        return (new_buf, new_len, new_done), None
+        return new_buf, new_len, new_done
 
+    if max_new == 0:
+        return buf0, prompt_lengths
     keys = jax.random.split(rng, max_new)
+
+    if use_cache:
+        # O(total) per token: prefill the per-layer KV caches on the padded
+        # prompt once, then each step runs the stack on ONE new token per
+        # row, threading the caches through the executor's state channel
+        state = init_kv_caches(executor, B, total)
+        outputs, _, state = executor.forward(
+            params, {input_name: Argument(ids=prompt_ids,
+                                          lengths=prompt_lengths)},
+            state, TEST, None)
+        logits = outputs[logits_name].value          # [B, P, V]
+        last = jnp.take_along_axis(
+            logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        nxt = pick_next(last, keys[0])
+        buf, lengths, done = advance(buf0, prompt_lengths,
+                                     jnp.zeros((B,), bool), nxt)
+
+        def step_cached(carry, key):
+            buf, lengths, done, state = carry
+            tok = buf[jnp.arange(B), jnp.clip(lengths - 1, 0, total - 1)]
+            feed = {input_name: Argument(ids=tok[:, None],
+                                         lengths=jnp.ones((B,), jnp.int32))}
+            outputs, _, state = executor.forward(params, feed, state, TEST,
+                                                 None)
+            nxt = pick_next(outputs[logits_name].value[:, 0, :], key)
+            buf, lengths, done = advance(buf, lengths, done, nxt)
+            return (buf, lengths, done, state), None
+
+        (buf, lengths, _, _), _ = jax.lax.scan(
+            step_cached, (buf, lengths, done, state), keys[1:])
+        return buf, lengths
+
+    def step(carry, key):
+        buf, lengths, done = carry
+        feed = {input_name: Argument(ids=buf, lengths=lengths)}
+        outputs, _, _ = executor.forward(params, feed, None, TEST, None)
+        logits = outputs[logits_name].value          # [B, total, V]
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        nxt = pick_next(last, key)
+        return advance(buf, lengths, done, nxt), None
+
     (buf, lengths, _), _ = jax.lax.scan(
         step, (buf0, prompt_lengths, jnp.zeros((B,), bool)), keys)
     return buf, lengths
+
+
+def init_kv_caches(executor: GraphExecutor, batch: int, total: int) -> dict:
+    """Zeroed per-attention-layer KV caches sized for `total` positions.
+    Passing this dict as `state` to executor.forward flips every causal
+    multi_head_attention layer into its incremental cached path
+    (graph/layers_attn.py:_cached_step)."""
+    dtype = jnp.dtype(executor.compute_dtype) if executor.compute_dtype \
+        else jnp.float32
+    state: dict = {}
+    for l in executor.model.layers:
+        if l.type != "multi_head_attention":
+            continue
+        heads = int(l.attrs["num_heads"])
+        h_kv = int(l.attrs.get("num_kv_heads", 0) or heads)
+        dh = int(l.size) // heads
+        state[l.name] = {
+            "k": jnp.zeros((batch, total, h_kv, dh), dtype),
+            "v": jnp.zeros((batch, total, h_kv, dh), dtype),
+            "pos": jnp.zeros((batch,), jnp.int32),
+        }
+    assert state, "model has no multi_head_attention layers to cache"
+    return state
 
 
 def _is_probs(model, logits_name: str) -> bool:

@@ -367,6 +367,64 @@ def multi_head_attention(
     return out
 
 
+def cached_attention_step(
+    q_new: Array,          # [B, Tn, H, D] new-token queries
+    k_new: Array,          # [B, Tn, H_kv, D]
+    v_new: Array,          # [B, Tn, H_kv, D]
+    cache_k: Array,        # [B, Tmax, H_kv, D]
+    cache_v: Array,        # [B, Tmax, H_kv, D]
+    pos: Array,            # [B] int32 — tokens already resident per row
+    n_new: Array,          # [B] int32 — valid new tokens this call (<= Tn)
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+) -> tuple[Array, Array, Array, Array]:
+    """Incremental causal attention against a fixed-size KV cache — the
+    O(T)-per-token decode step (the reference's closest analog is the
+    recurrent generator's carried state, RecurrentGradientMachine
+    generation; transformers have no recurrence, so the cache IS the
+    carried state).
+
+    Row b's new tokens land at cache positions pos[b]..pos[b]+Tn-1 (rows
+    advance independently — prompts have ragged lengths).  Writes use a
+    one-hot batched matmul rather than per-row dynamic slices: static
+    shapes, MXU-friendly, and scan/jit-stable.  Slots past pos+n_new hold
+    garbage from padded prefill calls; causality (k_pos <= q_pos) already
+    excludes them for every valid query, and the next call overwrites
+    them.  Returns (out [B,Tn,H,D], new_cache_k, new_cache_v, new_pos).
+    """
+    B, Tn, H, D = q_new.shape
+    Tmax = cache_k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    t = jnp.arange(Tmax)
+    i = jnp.arange(Tn)
+    # [B, Tmax, Tn] one-hot: slot t receives new token i of row b
+    sel = (t[None, :, None] ==
+           (pos[:, None, None] + i[None, None, :])).astype(cache_k.dtype)
+    keep = 1.0 - jnp.max(sel, axis=2)                       # [B, Tmax]
+
+    def scatter(cache, new):
+        upd = jnp.einsum("bti,bihd->bthd", sel, new.astype(cache.dtype))
+        return cache * keep[:, :, None, None] + upd
+
+    ck, cv = scatter(cache_k, k_new), scatter(cache_v, v_new)
+
+    qpos = pos[:, None] + i[None, :]                        # [B, Tn] global
+    mask = t[None, None, :] <= qpos[:, :, None]             # causal, global
+    if window is not None:
+        mask = jnp.logical_and(mask,
+                               t[None, None, :] > qpos[:, :, None] - window)
+
+    k_full, v_full = _expand_kv_heads(ck, cv, H)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q_new, k_full) * scale
+    from paddle_tpu.utils.dtypes import promote_compute
+    s = promote_compute(s)
+    s = jnp.where(mask[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_full.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+    return out, ck, cv, pos + n_new
+
+
 def additive_attention_step(
     dec_state: Array,      # [B, Ds] decoder state for THIS timestep
     w: Array,              # [Ds, D] state transform

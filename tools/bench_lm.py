@@ -77,10 +77,11 @@ def bench_train(args, seq_len: int, impl: str) -> dict:
     }
 
 
-def bench_decode(args, context: int) -> dict:
+def bench_decode(args, context: int, use_cache: bool) -> dict:
     """Greedy decode throughput: median +- IQR over fixed-size reps (the
     whole decode is one jitted scan; per-call dispatch jitter demands a
-    robust statistic, not one stopwatch pass)."""
+    robust statistic, not one stopwatch pass).  use_cache measures the
+    O(T)-per-token KV-cache path vs the whole-prefix re-forward."""
     import numpy as np
 
     from paddle_tpu.config.parser import parse_config
@@ -98,13 +99,13 @@ def bench_decode(args, context: int) -> dict:
 
     rng = np.random.default_rng(0)
     ids = rng.integers(2, args.vocab, (batch, prompt)).astype(np.int32)
-    toks, _ = lm_generate(tr.executor, tr.params, ids, max_new=args.max_new)
+    kw = dict(max_new=args.max_new, use_cache=use_cache)
+    toks, _ = lm_generate(tr.executor, tr.params, ids, **kw)
     np.asarray(toks)                                   # compile + warmup
     times = []
     for _ in range(args.decode_reps):
         t0 = time.perf_counter()
-        toks, _ = lm_generate(tr.executor, tr.params, ids,
-                              max_new=args.max_new)
+        toks, _ = lm_generate(tr.executor, tr.params, ids, **kw)
         np.asarray(toks)
         times.append(time.perf_counter() - t0)
     times = np.asarray(times)
@@ -112,7 +113,7 @@ def bench_decode(args, context: int) -> dict:
     n_tok = batch * args.max_new
     return {
         "bench": "lm_decode", "context": context, "batch": batch,
-        "max_new": args.max_new,
+        "max_new": args.max_new, "kv_cache": use_cache,
         "tokens_per_sec_median": round(n_tok / med, 1),
         "tokens_per_sec_iqr": [round(n_tok / q3, 1), round(n_tok / q1, 1)],
         "reps": args.decode_reps,
@@ -153,21 +154,25 @@ def main() -> int:
                     flush=True)
     if args.decode:
         for context in lens:
-            if context > 2048:
-                print(json.dumps({
-                    "bench": "lm_decode", "context": context,
-                    "skipped": "O(T^2) whole-prefix re-forward decode; "
-                               "KV-cache variant not yet landed"}),
-                    flush=True)
-                continue
-            try:
-                print(json.dumps(bench_decode(args, context)), flush=True)
-            except Exception as e:                      # noqa: BLE001
-                ok = False
-                print(json.dumps({
-                    "bench": "lm_decode", "context": context,
-                    "error": f"{type(e).__name__}: {str(e)[:300]}"}),
-                    flush=True)
+            for use_cache in (True, False):
+                if context > 2048 and not use_cache:
+                    print(json.dumps({
+                        "bench": "lm_decode", "context": context,
+                        "kv_cache": False,
+                        "skipped": "O(T^2) whole-prefix re-forward at this "
+                                   "length; measured via the KV-cache path"}),
+                        flush=True)
+                    continue
+                try:
+                    print(json.dumps(bench_decode(args, context, use_cache)),
+                          flush=True)
+                except Exception as e:                  # noqa: BLE001
+                    ok = False
+                    print(json.dumps({
+                        "bench": "lm_decode", "context": context,
+                        "kv_cache": use_cache,
+                        "error": f"{type(e).__name__}: {str(e)[:300]}"}),
+                        flush=True)
     return 0 if ok else 1
 
 
