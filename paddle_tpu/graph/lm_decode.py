@@ -34,6 +34,34 @@ from paddle_tpu.parameter.argument import Argument
 Array = jax.Array
 
 
+def _resolve_io_names(model, input_name, logits_name):
+    """Default input = first data layer; default logits = last non-cost,
+    non-validation layer (shared by lm_generate / lm_beam_generate)."""
+    if input_name is None:
+        input_name = model.input_layer_names[0]
+    if logits_name is None:
+        from paddle_tpu.graph.registry import (cost_layer_types,
+                                               validation_layer_types)
+        skip = cost_layer_types | validation_layer_types | {"data"}
+        logits_name = [l.name for l in model.layers if l.type not in skip][-1]
+    return input_name, logits_name
+
+
+def _prefill(executor, params, input_name, logits_name, prompt_ids,
+             prompt_lengths, total):
+    """Fill fresh KV caches with one forward over the padded prompt; return
+    (state, last-valid-position logits [B, V])."""
+    state = init_kv_caches(executor, prompt_ids.shape[0], total)
+    outputs, _, state = executor.forward(
+        params, {input_name: Argument(ids=prompt_ids,
+                                      lengths=prompt_lengths)},
+        state, TEST, None)
+    logits = outputs[logits_name].value
+    last = jnp.take_along_axis(
+        logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    return state, last
+
+
 def lm_generate(
     executor: GraphExecutor,
     params: dict[str, Array],
@@ -59,14 +87,8 @@ def lm_generate(
     non-cost layer.
     """
     model = executor.model
-    if input_name is None:
-        input_name = model.input_layer_names[0]
-    if logits_name is None:
-        from paddle_tpu.graph.registry import (cost_layer_types,
-                                               validation_layer_types)
-        skip = cost_layer_types | validation_layer_types | {"data"}
-        non_cost = [l.name for l in model.layers if l.type not in skip]
-        logits_name = non_cost[-1]
+    input_name, logits_name = _resolve_io_names(model, input_name,
+                                                logits_name)
 
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     B, P = prompt_ids.shape
@@ -114,14 +136,8 @@ def lm_generate(
         # O(total) per token: prefill the per-layer KV caches on the padded
         # prompt once, then each step runs the stack on ONE new token per
         # row, threading the caches through the executor's state channel
-        state = init_kv_caches(executor, B, total)
-        outputs, _, state = executor.forward(
-            params, {input_name: Argument(ids=prompt_ids,
-                                          lengths=prompt_lengths)},
-            state, TEST, None)
-        logits = outputs[logits_name].value          # [B, P, V]
-        last = jnp.take_along_axis(
-            logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        state, last = _prefill(executor, params, input_name, logits_name,
+                               prompt_ids, prompt_lengths, total)
         nxt = pick_next(last, keys[0])
         buf, lengths, done = advance(buf0, prompt_lengths,
                                      jnp.zeros((B,), bool), nxt)
@@ -186,3 +202,116 @@ def _is_probs(model, logits_name: str) -> bool:
         if l.name == logits_name:
             return l.active_type in ("softmax", "sequence_softmax")
     return False
+
+
+def lm_beam_generate(
+    executor: GraphExecutor,
+    params: dict[str, Array],
+    prompt_ids,                   # [B, P] int32 prompt tokens
+    prompt_lengths=None,          # [B] valid prompt lengths (default: P)
+    beam_size: int = 4,
+    max_new: int = 32,
+    *,
+    input_name: Optional[str] = None,
+    logits_name: Optional[str] = None,
+    eos_id: int = -1,             # -1 = never finish early
+):
+    """Beam search for the LM family — the generation story the reference
+    gives recurrent models (RecurrentGradientMachine::beamSearch,
+    graph/generator.py here) extended to full-attention models, built on
+    the KV-cache decode path: caches are prefilled once per source row,
+    tiled to B*beam, and REORDERED by beam parent at every step (the cache
+    gather is the TPU-native analog of the reference's per-Path state
+    copying).
+
+    Scoring is the plain sum of token log-probabilities (the reference's
+    Path::logProb accumulation); a beam that emits `eos_id` is frozen —
+    its only continuation is eos at logprob 0.  Returns
+    (tokens [B, beam, P+max_new], lengths [B, beam], scores [B, beam]),
+    beams sorted best-first per row.
+    """
+    model = executor.model
+    input_name, logits_name = _resolve_io_names(model, input_name,
+                                                logits_name)
+
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, P = prompt_ids.shape
+    K = beam_size
+    total = P + max_new
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((B,), P, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+
+    def logprobs_of(raw):                              # [N, V] -> log p
+        raw = raw.astype(jnp.float32)
+        if _is_probs(model, logits_name):
+            return jnp.log(jnp.maximum(raw, 1e-30))
+        return jax.nn.log_softmax(raw, axis=-1)
+
+    if max_new == 0:
+        buf = jnp.zeros((B, K, total), jnp.int32).at[:, :, :P].set(
+            prompt_ids[:, None, :])
+        return (buf, jnp.repeat(prompt_lengths[:, None], K, 1),
+                jnp.zeros((B, K), jnp.float32))
+
+    # ---- prefill ONCE per source row, then tile caches to B*K ----
+    state, last = _prefill(executor, params, input_name, logits_name,
+                           prompt_ids, prompt_lengths, total)
+    lp0 = logprobs_of(last)                            # [B, V]
+    V = lp0.shape[-1]
+    state = jax.tree.map(lambda x: jnp.repeat(x, K, axis=0), state)
+
+    # first expansion: top-K tokens of the last prompt position seed the
+    # beams (all beams share the prompt, so expanding every beam would
+    # produce K duplicates of the same K tokens)
+    scores, tok0 = jax.lax.top_k(lp0, K)               # [B, K] each
+    buf = jnp.zeros((B, K, total), jnp.int32).at[:, :, :P].set(
+        prompt_ids[:, None, :])
+    lengths = jnp.repeat(prompt_lengths[:, None], K, axis=1)  # [B, K]
+    bi, ki = jnp.arange(B)[:, None], jnp.arange(K)[None, :]
+    buf = buf.at[bi, ki, lengths].set(tok0)
+    lengths = lengths + 1
+    done = (tok0 == eos_id)
+
+    def step(carry, _):
+        buf, lengths, scores, done, state = carry
+        tok = buf.reshape(B * K, total)[
+            jnp.arange(B * K),
+            jnp.clip(lengths.reshape(B * K) - 1, 0, total - 1)]
+        feed = {input_name: Argument(ids=tok[:, None],
+                                     lengths=jnp.ones((B * K,), jnp.int32))}
+        outputs, _, state = executor.forward(params, feed, state, TEST, None)
+        lp = logprobs_of(outputs[logits_name].value[:, 0, :]) \
+            .reshape(B, K, V)
+        # frozen beams: eos continues at logprob 0, everything else -inf
+        frozen = jnp.full((V,), -jnp.inf).at[jnp.maximum(eos_id, 0)].set(0.0)
+        lp = jnp.where(done[:, :, None], frozen[None, None, :], lp)
+        cand = scores[:, :, None] + lp                 # [B, K, V]
+        scores, flat = jax.lax.top_k(cand.reshape(B, K * V), K)
+        parent, tok_new = flat // V, (flat % V).astype(jnp.int32)  # [B, K]
+
+        # reorder beams by parent: token buffers, lengths, done, KV caches
+        buf = jnp.take_along_axis(buf, parent[:, :, None], axis=1)
+        lengths = jnp.take_along_axis(lengths, parent, axis=1)
+        done = jnp.take_along_axis(done, parent, axis=1)
+
+        def reorder(x):                                # [B*K, ...] leaves
+            xk = x.reshape(B, K, *x.shape[1:])
+            idx = parent.reshape(B, K, *([1] * (x.ndim - 1)))
+            return jnp.take_along_axis(xk, idx, axis=1) \
+                .reshape(B * K, *x.shape[1:])
+
+        state = jax.tree.map(reorder, state)
+
+        write = jnp.where(done, buf[bi, ki, jnp.clip(lengths, 0, total - 1)],
+                          tok_new)
+        buf = buf.at[bi, ki, jnp.clip(lengths, 0, total - 1)].set(write)
+        lengths = jnp.where(done, lengths, jnp.minimum(lengths + 1, total))
+        done = jnp.logical_or(done, tok_new == eos_id)
+        return (buf, lengths, scores, done, state), None
+
+    (buf, lengths, scores, _, _), _ = jax.lax.scan(
+        step, (buf, lengths, scores, done, state), None, length=max_new - 1)
+    # top_k keeps each row's beams sorted best-first already
+    return buf, lengths, scores

@@ -103,3 +103,76 @@ def test_cached_step_op_matches_dense():
         np.testing.assert_allclose(np.asarray(o2[b, 0]),
                                    np.asarray(want[0, n]),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_beam1_equals_greedy_cached():
+    from paddle_tpu.graph.lm_decode import lm_beam_generate
+
+    tr = _make("vocab=61,dim=32,layers=2,heads=4,batch_size=4")
+    ids, lens = _prompts(4, 8, 61, seed=5)
+    g_t, g_l = lm_generate(tr.executor, tr.params, ids, prompt_lengths=lens,
+                           max_new=6, use_cache=True)
+    b_t, b_l, _ = lm_beam_generate(tr.executor, tr.params, ids,
+                                   prompt_lengths=lens, beam_size=1,
+                                   max_new=6)
+    np.testing.assert_array_equal(np.asarray(g_l), np.asarray(b_l)[:, 0])
+    gt, bt = np.asarray(g_t), np.asarray(b_t)
+    for b, n in enumerate(np.asarray(g_l)):
+        np.testing.assert_array_equal(gt[b, :n], bt[b, 0, :n])
+
+
+def test_beam_scores_match_teacher_forcing():
+    """Every returned hypothesis's score must equal the sum of stepwise
+    token log-probs recomputed by teacher-forcing the whole sequence
+    through the (uncached) model — validates cache reordering, positions,
+    and score bookkeeping in one shot.  Also: scores sorted best-first and
+    hypotheses within a row distinct."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.graph.context import TEST
+    from paddle_tpu.graph.lm_decode import lm_beam_generate
+    from paddle_tpu.parameter.argument import Argument
+
+    tr = _make("vocab=23,dim=24,layers=2,heads=2,batch_size=3")
+    ids, lens = _prompts(3, 6, 23, seed=9)
+    K, max_new = 3, 4
+    toks, out_lens, scores = lm_beam_generate(
+        tr.executor, tr.params, ids, prompt_lengths=lens, beam_size=K,
+        max_new=max_new)
+    toks, out_lens, scores = (np.asarray(toks), np.asarray(out_lens),
+                              np.asarray(scores))
+    assert (np.diff(scores, axis=1) <= 1e-5).all(), scores
+
+    for b in range(3):
+        hyps = {tuple(toks[b, k, :out_lens[b, k]]) for k in range(K)}
+        assert len(hyps) == K, f"row {b}: duplicate hypotheses"
+        for k in range(K):
+            n, p = int(out_lens[b, k]), int(lens[b])
+            feed = {"tokens": Argument(
+                ids=jnp.asarray(toks[b, k][None, :n]),
+                lengths=jnp.full((1,), n, jnp.int32))}
+            outputs, _, _ = tr.executor.forward(tr.params, feed, None, TEST,
+                                                None)
+            probs = np.asarray(outputs["lm_head"].value)[0]   # [n, V]
+            lp = np.log(np.maximum(probs.astype(np.float64), 1e-30))
+            want = sum(lp[t - 1, toks[b, k, t]] for t in range(p, n))
+            np.testing.assert_allclose(scores[b, k], want, rtol=2e-4,
+                                       atol=2e-4)
+
+
+def test_beam_eos_freezes():
+    from paddle_tpu.graph.lm_decode import lm_beam_generate
+
+    tr = _make("vocab=13,dim=16,layers=1,heads=2,batch_size=2")
+    ids, lens = _prompts(2, 5, 13, seed=11)
+    toks, out_lens, scores = lm_beam_generate(
+        tr.executor, tr.params, ids, prompt_lengths=lens, beam_size=4,
+        max_new=6, eos_id=3)
+    toks, out_lens = np.asarray(toks), np.asarray(out_lens)
+    assert np.isfinite(np.asarray(scores)).all()
+    # a beam that emitted eos must have stopped growing there
+    for b in range(2):
+        for k in range(4):
+            seq = toks[b, k, int(lens[b]):int(out_lens[b, k])]
+            inner_eos = (seq[:-1] == 3) if len(seq) > 1 else np.array([])
+            assert not inner_eos.any(), (b, k, seq)
