@@ -104,12 +104,88 @@ def additive_cases():
     return cases
 
 
+def rnn_cases():
+    """Pallas LSTM/GRU vs the lax.scan reference, fwd + grads, on device —
+    these kernels have never run on real TPU either (VERDICT r3 item 1).
+    Both paths compute fp32 internally; tolerance covers MXU pass-order
+    differences between the kernel's per-step matmul and the scan's."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_rnn, rnn
+
+    cases = []
+    shapes = [
+        (4, 6, 8),        # tiny/unaligned
+        (64, 30, 512),    # the sentiment-bench shape
+        (5, 7, 24),       # everything unaligned
+    ]
+    rng = np.random.default_rng(7)
+    for B, T, D in shapes:
+        def run_lstm(B=B, T=T, D=D):
+            x4 = jnp.asarray(rng.standard_normal((B, T, 4 * D)) * 0.5,
+                             jnp.float32)
+            w = jnp.asarray(rng.standard_normal((D, 4 * D)) * 0.2,
+                            jnp.float32)
+            lens = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
+            z = jnp.zeros((B, D), jnp.float32)
+            peeps = jnp.zeros((3, D), jnp.float32)
+
+            def fused(x4, w):
+                hs, hl, cl = pallas_rnn.lstm_fused(
+                    x4, lens, w, peeps, z, z, active_type="tanh",
+                    gate_active_type="sigmoid", state_active_type="tanh",
+                    reverse=False)
+                return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl)
+
+            def ref(x4, w):
+                hs, hl, cl = rnn.lstm_scan(x4, lens, w, None, reverse=False)
+                return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl)
+
+            lf, gf = jax.value_and_grad(fused, argnums=(0, 1))(x4, w)
+            lr, gr = jax.value_and_grad(ref, argnums=(0, 1))(x4, w)
+            np.testing.assert_allclose(float(lf), float(lr), rtol=2e-2)
+            for a, b in zip(gf, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=5e-2, atol=5e-2)
+
+        def run_gru(B=B, T=T, D=D):
+            x3 = jnp.asarray(rng.standard_normal((B, T, 3 * D)) * 0.5,
+                             jnp.float32)
+            wg = jnp.asarray(rng.standard_normal((D, 2 * D)) * 0.2,
+                             jnp.float32)
+            wc = jnp.asarray(rng.standard_normal((D, D)) * 0.2, jnp.float32)
+            lens = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
+            z = jnp.zeros((B, D), jnp.float32)
+
+            def fused(x3, wg, wc):
+                hs, hl = pallas_rnn.gru_fused(
+                    x3, lens, wg, wc, z, active_type="tanh",
+                    gate_active_type="sigmoid", reverse=False)
+                return jnp.sum(hs * hs) + jnp.sum(hl)
+
+            def ref(x3, wg, wc):
+                hs, hl = rnn.gru_scan(x3, lens, wg, wc, None, reverse=False)
+                return jnp.sum(hs * hs) + jnp.sum(hl)
+
+            lf, gf = jax.value_and_grad(fused, argnums=(0, 1, 2))(x3, wg, wc)
+            lr, gr = jax.value_and_grad(ref, argnums=(0, 1, 2))(x3, wg, wc)
+            np.testing.assert_allclose(float(lf), float(lr), rtol=2e-2)
+            for a, b in zip(gf, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=5e-2, atol=5e-2)
+
+        cases.append((f"lstm_B{B}_T{T}_D{D}", run_lstm))
+        cases.append((f"gru_B{B}_T{T}_D{D}", run_gru))
+    return cases
+
+
 def main() -> int:
     dev = jax.devices()[0]
     print(json.dumps({"platform": dev.platform,
                       "device_kind": dev.device_kind}), flush=True)
     ok = True
-    for name, fn in flash_cases() + additive_cases():
+    for name, fn in flash_cases() + additive_cases() + rnn_cases():
         ok &= _case(name, fn)
     print(json.dumps({"all_ok": bool(ok)}), flush=True)
     return 0 if ok else 1
