@@ -7,22 +7,26 @@ output persisted immediately — a mid-session tunnel death keeps
 everything already measured.  Priorities (VERDICT round 2):
 
   1. backend health probe
-  2. pallas on-device parity (tools/tpu_parity.py — kernels never ran on
-     real TPU; cheapest, unblocks trusting everything else)
-  3. attention micro-bench across lengths (tools/bench_attention.py) —
-     evidence for the layer auto-selection crossover
+  2. flash + additive on-device parity (tools/tpu_parity.py
+     --only=flash,additive) — cheapest, unblocks trusting everything else
+  3. pallas LSTM/GRU on-device parity (--only=lstm,gru, its own step so
+     slow flash compiles can't starve it of queue budget)
   4. additive-attention kernel vs jnp (tools/bench_additive.py) —
      evidence for the decoder-step routing default
-  5. quick bench (vgg + seq2seq) -> PERF_LOG.jsonl snapshot
-  6. full 5-config bench -> PERF_LOG.jsonl snapshot
+  5. attention micro-bench across lengths (tools/bench_attention.py) —
+     evidence for the layer auto-selection crossover (bf16 + fp32 passes)
+  6. transformer-LM train MFU + decode tokens/s per context length
+     (tools/bench_lm.py)
+  7. quick bench (vgg + seq2seq) -> PERF_LOG.jsonl snapshot
+  8. full 6-config bench -> PERF_LOG.jsonl snapshot
 
 Results land under MEASURE/<step>.out (+ PERF_LOG.jsonl via bench.py).
 The parent process never imports jax (a wedged tunnel blocks any backend
 init forever).
 
 Usage: python tools/tpu_measure.py [--skip=parity,attn_bench_f32]
-(step names: parity, attn_bench, attn_bench_f32, additive_bench,
-bench_quick, bench_full)
+(step names: parity, parity_rnn, attn_bench, attn_bench_f32,
+additive_bench, bench_lm, bench_quick, bench_full)
 """
 
 from __future__ import annotations
@@ -103,7 +107,10 @@ def main() -> int:
     # rounds 2-4; in r2 and r4 the wedge began DURING the seq2seq bench),
     # so kernel parity + micro-benches land before the big configs
     steps = [
-        ("parity", [py, "tools/tpu_parity.py"], 900, {}),
+        ("parity", [py, "tools/tpu_parity.py", "--only=flash,additive"],
+         2700, {}),
+        ("parity_rnn", [py, "tools/tpu_parity.py", "--only=lstm,gru"],
+         1800, {}),
         ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
         ("attn_bench",
          [py, "tools/bench_attention.py", "--lens", "512,1024,2048,4096,16384",
@@ -121,7 +128,7 @@ def main() -> int:
         if name in skip:
             continue
         ok = run_step(name, argv, to, env)
-        if not ok and not health(45):
+        if not ok and not health(90):
             # a failed step + dead tunnel: stop burning the remaining
             # steps' timeouts against a wedged backend (everything
             # measured so far is already persisted under MEASURE/)
