@@ -212,7 +212,10 @@ def blockwise_attention(
     # blockwise is O(T) memory.  Recomputing the tile in backward is the
     # standard flash-attention trade and keeps train-mode long context
     # viable on the portable (non-pallas) path too.
-    @jax.checkpoint
+    # prevent_cse=False: CSE prevention is unnecessary for a scan body
+    # (the scan barrier already keeps fwd/bwd apart) and only blocks XLA
+    # optimizations
+    @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(acc, xs):
         i = xs["i"]
         k_pos = i * block_k + jnp.arange(block_k)
