@@ -430,10 +430,15 @@ class OptimizationConfig(_Serializable):
     # (layers annotated device=N); 0 = one microbatch per pipeline stage
     pipeline_micro_batches: int = 0
     # 'gpipe' (all-forward then autodiff backward; in-flight activations
-    # grow with the microbatch count) or '1f1b' (one-forward-one-backward
+    # grow with the microbatch count), '1f1b' (one-forward-one-backward
     # with per-stage recompute; in-flight boundary carriers capped at the
-    # stage count — the schedule for microbatch counts >> stages)
+    # stage count — the schedule for microbatch counts >> stages), or
+    # 'interleaved' (1F1B over virtual stages: annotate device=0..S*v-1,
+    # chunks placed round-robin so each device hosts v non-contiguous
+    # chunks — the warmup bubble shrinks ~v-fold)
     pipeline_schedule: str = "gpipe"
+    # virtual stages per device for pipeline_schedule='interleaved'
+    pipeline_virtual_stages: int = 1
     # ZeRO-1: shard optimizer slot buffers over the data axis (the pserver
     # design where each server updates 1/N of every parameter — here XLA
     # keeps the update sharded and gathers only the fresh params)

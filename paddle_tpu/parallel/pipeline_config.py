@@ -149,6 +149,177 @@ def _stage_io(model: ModelConfig, stages):
     return payloads
 
 
+@dataclasses.dataclass(frozen=True)
+class _Table:
+    """Static pipeline schedule, compiled per (S, v, M) by greedy
+    dependency simulation — every per-(device, tick) decision is a table
+    entry the SPMD scan body just looks up.  All arrays are int32 [S, T];
+    run flags are 0/1, slot/deposit entries index the stash buffers with
+    -1 = none and slot 0 reserved as an all-zeros slot (chunk 0's forward
+    input, last chunk's backward output-cotangent)."""
+    T: int
+    n_fslots: int
+    n_bslots: int
+    f_dep: np.ndarray        # slot to deposit the fwd-ring arrival into
+    f_run: np.ndarray
+    f_chunk: np.ndarray
+    f_m: np.ndarray
+    f_slot: np.ndarray       # input-carrier slot the F op reads
+    f_bank: np.ndarray       # 1 iff this F op produces the cost
+    b_dep: np.ndarray        # slot to deposit the bwd-ring arrival into
+    b_run: np.ndarray
+    b_chunk: np.ndarray
+    b_m: np.ndarray
+    b_slot: np.ndarray       # cotangent slot the B op reads
+    b_fslot: np.ndarray      # input-carrier slot the B op recomputes from
+    n_ops: int               # scheduled ops (for bubble accounting)
+
+
+def _compile_schedule(S: int, v: int, M: int,
+                      fwd_only: bool = False) -> _Table:
+    """Greedy list scheduler for (interleaved) 1F1B over C = S*v chunks,
+    chunk c resident on device c % S (round-robin, so every chunk->chunk
+    boundary is one +1 ring hop, wrapping S-1 -> 0 between virtual-stage
+    groups).
+
+    Constraints simulated exactly as the scan body executes them:
+      * per device per tick: at most one forward op and one backward op
+        (the body's two legs);
+      * F(c, m) needs F(c-1, m)'s output, which travels one ppermute hop:
+        available from tick t_F(c-1, m) + 1 (chunk 0 reads the feed);
+      * B(c, m) needs B(c+1, m)'s carrier cotangent (one hop, so tick
+        t_B(c+1, m) + 1) and the stashed input of F(c, m) (its own tick,
+        so a last-chunk F and its B may share a tick: the F leg runs
+        first);
+      * priorities: forward leg takes the deepest ready chunk (drives the
+        loss out and keeps later devices fed), backward the oldest
+        microbatch — together they reproduce classic 1F1B order at v=1.
+
+    Any dependency-valid order is exact (the computation is pure
+    dataflow); the greedy choice only shapes the bubble, which
+    schedule_info() reports from the table rather than a formula."""
+    C = S * v
+    INF = 1 << 30
+    f_left = {(c, m) for c in range(C) for m in range(M)}
+    b_left = set() if fwd_only else set(f_left)
+    arr_f = {(0, m): 0 for m in range(M)}   # input availability ticks
+    arr_b: dict = {}
+    tF: dict = {}
+    tB: dict = {}
+    rows: list = []
+    t = 0
+    while f_left or b_left:
+        tick_f: list = [None] * S
+        tick_b: list = [None] * S
+        for s in range(S):
+            cand = [(c, m) for (c, m) in f_left
+                    if c % S == s and arr_f.get((c, m), INF) <= t]
+            if cand:
+                c, m = max(cand, key=lambda cm: (cm[0], -cm[1]))
+                tick_f[s] = (c, m)
+                f_left.remove((c, m))
+                tF[(c, m)] = t
+                if c < C - 1:
+                    arr_f[(c + 1, m)] = t + 1
+                else:
+                    arr_b[(c, m)] = t        # cost cotangent seeds in place
+        for s in range(S):
+            cand = [(c, m) for (c, m) in b_left
+                    if c % S == s and tF.get((c, m), INF) <= t
+                    and arr_b.get((c, m), INF) <= t]
+            if cand:
+                c, m = min(cand, key=lambda cm: (cm[1], -cm[0]))
+                tick_b[s] = (c, m)
+                b_left.remove((c, m))
+                tB[(c, m)] = t
+                if c > 0:
+                    arr_b[(c - 1, m)] = t + 1
+        rows.append((tick_f, tick_b))
+        t += 1
+        assert t < 4 * (C + 2) * (M + 2), "schedule simulation diverged"
+    T = t
+
+    # interval slot allocation per device (slot 0 = reserved zeros)
+    def allocate(intervals):
+        """intervals: {(c, m): (device, start, end)} -> slots, n_slots."""
+        n_slots = 1
+        slots: dict = {}
+        per_dev: dict = {}
+        for key_, (dev, a, b) in sorted(intervals.items(),
+                                        key=lambda kv: kv[1][1]):
+            busy = per_dev.setdefault(dev, [])
+            sid = None
+            for cand_id in range(1, n_slots + 1):
+                if all(not (a <= e and s_ <= b)
+                       for (s_, e, used) in busy if used == cand_id):
+                    sid = cand_id
+                    break
+            n_slots = max(n_slots, sid + 1)
+            busy.append((a, b, sid))
+            slots[key_] = sid
+        return slots, n_slots
+
+    f_iv = {}
+    for (c, m), tf in tF.items():
+        end = tf if fwd_only else tB[(c, m)]
+        if c == 0:
+            continue                     # feed-fed: reads the zero slot
+        f_iv[(c, m)] = (c % S, arr_f[(c, m)], end)
+    f_slots, n_fslots = allocate(f_iv)
+    b_iv = {}
+    if not fwd_only:
+        for (c, m), tb in tB.items():
+            if c == C - 1:
+                continue                 # cost-seeded: reads the zero slot
+            b_iv[(c, m)] = (c % S, arr_b[(c, m)], tb)
+    b_slots, n_bslots = allocate(b_iv)
+
+    z = lambda: np.zeros((S, T), np.int32)
+    mone = lambda: np.full((S, T), -1, np.int32)
+    tbl = _Table(T=T, n_fslots=n_fslots, n_bslots=n_bslots,
+                 f_dep=mone(), f_run=z(), f_chunk=z(), f_m=z(),
+                 f_slot=z(), f_bank=z(),
+                 b_dep=mone(), b_run=z(), b_chunk=z(), b_m=z(),
+                 b_slot=z(), b_fslot=z(),
+                 n_ops=len(tF) + len(tB))
+    for (c, m), sid in f_slots.items():
+        tbl.f_dep[c % S, arr_f[(c, m)]] = sid
+    for (c, m), sid in b_slots.items():
+        tbl.b_dep[c % S, arr_b[(c, m)]] = sid
+    for t_, (tick_f, tick_b) in enumerate(rows):
+        for s in range(S):
+            if tick_f[s] is not None:
+                c, m = tick_f[s]
+                tbl.f_run[s, t_] = 1
+                tbl.f_chunk[s, t_] = c
+                tbl.f_m[s, t_] = m
+                tbl.f_slot[s, t_] = f_slots.get((c, m), 0)
+                tbl.f_bank[s, t_] = int(c == C - 1)
+            if tick_b[s] is not None:
+                c, m = tick_b[s]
+                tbl.b_run[s, t_] = 1
+                tbl.b_chunk[s, t_] = c
+                tbl.b_m[s, t_] = m
+                tbl.b_slot[s, t_] = b_slots.get((c, m), 0)
+                tbl.b_fslot[s, t_] = f_slots.get((c, m), 0)
+    return tbl
+
+
+def _vjp_branch(f):
+    """Backward twin of a forward stage branch: recompute the stage under
+    jax.vjp from its stashed input carrier.  The cotangents stack across
+    lax.switch because every branch returns the same (out[mb, width],
+    cost[mb]) shapes.  Shared by the 1F1B and interleaved hand-scheduled
+    backwards — one definition so they can never diverge."""
+    def bwd(p, stash_in, feed_mb, key, d_out, d_cost):
+        (_, _), vjp_fn = jax.vjp(
+            lambda pp, rr: f(pp, rr, feed_mb, key), p, stash_in)
+        d_p, d_recv = vjp_fn((d_out, d_cost))
+        return d_p, d_recv
+
+    return bwd
+
+
 class PipelineExecutor:
     """GraphExecutor-compatible loss() that runs the config as a GPipe
     pipeline over the mesh's `pipe` axis.  Drop-in for Trainer: same
@@ -156,34 +327,59 @@ class PipelineExecutor:
     loss(params, feed, state, mode, rng) signature."""
 
     def __init__(self, model: ModelConfig, mesh, n_micro: int = 0,
-                 compute_dtype: str = "", schedule: str = "gpipe"):
+                 compute_dtype: str = "", schedule: str = "gpipe",
+                 virtual_stages: int = 1):
         self.model = model
         self.mesh = mesh
         self.n_stages = axis_size(mesh, PIPE_AXIS)
         assert self.n_stages > 1, \
             "PipelineExecutor needs a pipe mesh axis of size > 1"
         self.n_micro = n_micro or self.n_stages
-        assert schedule in ("gpipe", "1f1b"), (
-            f"unknown pipeline_schedule {schedule!r}; use 'gpipe' or '1f1b'")
+        assert schedule in ("gpipe", "1f1b", "interleaved"), (
+            f"unknown pipeline_schedule {schedule!r}; use 'gpipe', '1f1b' "
+            f"or 'interleaved'")
+        assert virtual_stages >= 1, (
+            f"pipeline_virtual_stages must be >= 1, got {virtual_stages}")
+        assert virtual_stages == 1 or schedule == "interleaved", (
+            "pipeline_virtual_stages > 1 needs "
+            "pipeline_schedule='interleaved'")
         self.schedule = schedule
-        self.inner, self.stages = split_stages(model, self.n_stages)
+        self.virtual_stages = virtual_stages
+        # 'interleaved': the graph splits into C = S*v chunks (annotate
+        # device=0..C-1), chunk c resident on device c % S — each device
+        # hosts v non-contiguous chunks, shrinking the warmup bubble
+        self.n_chunks = self.n_stages * virtual_stages
+        self.inner, self.stages = split_stages(model, self.n_chunks)
         self.inner.mesh = None        # stage bodies run mesh-local
         self.inner.compute_dtype = compute_dtype
         self.payload_names = _stage_io(model, self.stages)
         self._spec_cache: dict = {}
 
     def schedule_info(self) -> dict:
-        """Bubble/memory accounting for the active schedule.  Both schedules
-        share the bubble fraction (S-1)/(M+S-1) per direction; 1F1B's win is
-        the in-flight boundary-carrier cap: S instead of M."""
+        """Bubble/memory accounting for the active schedule.  gpipe/1f1b
+        share the bubble fraction (S-1)/(M+S-1) per direction; 1F1B's win
+        is the in-flight boundary-carrier cap (S instead of M), and
+        'interleaved' reports its simulated table: v virtual stages cut
+        the warmup bubble roughly v-fold at equal M."""
         S, M = self.n_stages, self.n_micro
-        return {
+        info = {
             "schedule": self.schedule,
             "stages": S,
             "micro_batches": M,
             "bubble_fraction": (S - 1) / (M + S - 1),
             "in_flight_carriers": S if self.schedule == "1f1b" else M,
         }
+        if self.schedule == "interleaved":
+            tbl = _compile_schedule(S, self.virtual_stages, M)
+            info.update({
+                "virtual_stages": self.virtual_stages,
+                "ticks": tbl.T,
+                "bubble_fraction": 1.0 - tbl.n_ops / (2 * S * tbl.T),
+                # live carrier/cotangent slots, excluding the two reserved
+                # all-zeros slots (ids 0) that never hold data
+                "in_flight_carriers": (tbl.n_fslots - 1) + (tbl.n_bslots - 1),
+            })
+        return info
 
     @property
     def compute_dtype(self) -> str:
@@ -307,9 +503,10 @@ class PipelineExecutor:
     def _stage_branches(self, specs, width: int, mb: int, mode: str):
         """Per-stage body functions with one UNIFORM signature
         (p, recv[mb,width], feed_mb, key) -> (out[mb,width], cost[mb]) —
-        uniformity is what lets lax.switch host S heterogeneous stages,
-        and (for 1F1B) what makes per-stage jax.vjp cotangents stackable."""
-        S = self.n_stages
+        uniformity is what lets lax.switch host the heterogeneous stage
+        (or virtual-stage chunk) bodies, and (for the hand-scheduled
+        backwards) what makes per-stage jax.vjp cotangents stackable."""
+        S = len(self.stages)             # chunks when interleaved
         model, inner = self.model, self.inner
 
         def make_branch(s: int):
@@ -364,6 +561,8 @@ class PipelineExecutor:
     # -- the pipelined loss ----------------------------------------------
     def loss(self, params, feed, state=None, mode: str = TRAIN, rng=None):
         assert not state, "pipeline executor carries no layer state"
+        if self.schedule == "interleaved":
+            return self._table_loss(params, feed, mode, rng)
         S, M = self.n_stages, self.n_micro
         params, feed, B, mb, specs, width, rng = self._prologue(
             params, feed, rng)
@@ -434,29 +633,15 @@ class PipelineExecutor:
         Returns (loss, grads) w.r.t. `params` — the Trainer calls this
         instead of wrapping loss() in jax.value_and_grad.
         """
+        if self.schedule == "interleaved":
+            return self._table_loss_and_grad(params, feed, mode, rng)
         raw_dtypes = {k: v.dtype for k, v in params.items()}
         S, M = self.n_stages, self.n_micro
         params, feed, B, mb, specs, width, rng = self._prologue(
             params, feed, rng)
 
         fwd_branches = self._stage_branches(specs, width, mb, mode)
-
-        def make_bwd(s: int):
-            f = fwd_branches[s]
-
-            def bwd(p, stash_in, feed_mb, key, d_out, d_cost):
-                # recompute the stage forward under vjp from its stashed
-                # input carrier; the cotangents are stackable across the
-                # lax.switch because every branch returns the same
-                # (out[mb,width], cost[mb]) shapes
-                (_, _), vjp_fn = jax.vjp(
-                    lambda pp, rr: f(pp, rr, feed_mb, key), p, stash_in)
-                d_p, d_recv = vjp_fn((d_out, d_cost))
-                return d_p, d_recv
-
-            return bwd
-
-        bwd_branches = [make_bwd(s) for s in range(S)]
+        bwd_branches = [_vjp_branch(f) for f in fwd_branches]
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
         bwd_perm = [(i, i - 1) for i in range(1, S)]
         # grads accumulate in >= fp32 regardless of the compute dtype —
@@ -555,3 +740,156 @@ class PipelineExecutor:
         # to the raw parameter dtypes, as autodiff's cast-transpose would
         grads = {k: g.astype(raw_dtypes[k]) for k, g in grads.items()}
         return total, grads
+
+    # -- interleaved virtual stages: table-driven schedule ---------------
+    def _table_run(self, params, feed, mode, rng, fwd_only: bool):
+        """Execute the compiled interleaved schedule: one scan body serves
+        both training (fwd_only=False: both legs, returns (loss, grads))
+        and test/eval (fwd_only=True: forward leg only, returns loss).
+        Each device hosts its v chunks' branches behind one lax.switch;
+        stash slots (compile-time interval-allocated) buffer carriers and
+        cotangents whose consumer isn't scheduled just-in-time; chunk
+        round-robin makes EVERY chunk boundary a +1 ring hop (wrapping
+        S-1 -> 0 between virtual-stage groups)."""
+        raw_dtypes = {k: v.dtype for k, v in params.items()}
+        M, C, S = self.n_micro, self.n_chunks, self.n_stages
+        params, feed, B, mb, specs, width, rng = self._prologue(
+            params, feed, rng)
+        fwd_branches = self._stage_branches(specs, width, mb, mode)
+        bwd_branches = None if fwd_only else \
+            [_vjp_branch(f) for f in fwd_branches]
+        tbl = _compile_schedule(S, self.virtual_stages, M,
+                                fwd_only=fwd_only)
+        jt = {f.name: jnp.asarray(getattr(tbl, f.name))
+              for f in dataclasses.fields(_Table)
+              if isinstance(getattr(tbl, f.name), np.ndarray)}
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+        gacc0 = None if fwd_only else {
+            k: jnp.zeros(v.shape,
+                         jnp.promote_types(v.dtype, jnp.float32)
+                         if jnp.issubdtype(v.dtype, jnp.floating)
+                         else v.dtype)
+            for k, v in params.items()}
+
+        def local(p, feed_loc, key):
+            stage = lax.axis_index(PIPE_AXIS)
+
+            def feed_at(m_idx):
+                return jax.tree.map(
+                    lambda x: lax.dynamic_slice_in_dim(x, m_idx * mb, mb),
+                    feed_loc)
+
+            def tick(carry, t):
+                if fwd_only:
+                    recv_f, fstash, loss_buf = carry
+                else:
+                    recv_f, recv_b, fstash, bstash, loss_buf, gacc = carry
+                # deposits first: a just-in-time consumer reads its slot
+                # the same tick the wire value lands (idle ticks park the
+                # wire in the dump slot -- the last index, never read)
+                fd = jt["f_dep"][stage, t]
+                fstash = lax.dynamic_update_index_in_dim(
+                    fstash, recv_f, jnp.where(fd >= 0, fd, tbl.n_fslots), 0)
+                if not fwd_only:
+                    bd = jt["b_dep"][stage, t]
+                    bstash = lax.dynamic_update_index_in_dim(
+                        bstash, recv_b,
+                        jnp.where(bd >= 0, bd, tbl.n_bslots), 0)
+
+                # -- forward leg
+                fc, fm = jt["f_chunk"][stage, t], jt["f_m"][stage, t]
+                fs = jt["f_slot"][stage, t]
+                key_f = jax.random.fold_in(key, fm * C + fc)
+
+                def run_f(_):
+                    return lax.switch(
+                        fc, fwd_branches, p,
+                        lax.dynamic_index_in_dim(fstash, fs, 0, False),
+                        feed_at(fm), key_f)
+
+                def skip_f(_):
+                    return (jnp.zeros((mb, width), jnp.float32),
+                            jnp.zeros((mb,), jnp.float32))
+
+                out_f, cost = lax.cond(jt["f_run"][stage, t] == 1,
+                                       run_f, skip_f, None)
+                banked = lax.dynamic_update_index_in_dim(
+                    loss_buf, cost[None], fm, axis=0)
+                loss_buf = jnp.where(jt["f_bank"][stage, t] == 1,
+                                     banked, loss_buf)
+                recv_f = lax.ppermute(out_f, PIPE_AXIS, fwd_perm)
+                if fwd_only:
+                    return (recv_f, fstash, loss_buf), None
+
+                # -- backward leg (after F: a last-chunk F and its B may
+                # share a tick)
+                bc, bm = jt["b_chunk"][stage, t], jt["b_m"][stage, t]
+                bs, bf = jt["b_slot"][stage, t], jt["b_fslot"][stage, t]
+                key_b = jax.random.fold_in(key, bm * C + bc)
+                d_cost = jnp.ones((mb,), jnp.float32)
+
+                def run_b(gacc_in):
+                    d_p, d_recv = lax.switch(
+                        bc, bwd_branches, p,
+                        lax.dynamic_index_in_dim(fstash, bf, 0, False),
+                        feed_at(bm), key_b,
+                        lax.dynamic_index_in_dim(bstash, bs, 0, False),
+                        d_cost)
+                    return jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), gacc_in, d_p), \
+                        d_recv
+
+                def skip_b(gacc_in):
+                    return gacc_in, jnp.zeros((mb, width), jnp.float32)
+
+                gacc, d_recv = lax.cond(jt["b_run"][stage, t] == 1,
+                                        run_b, skip_b, gacc)
+                recv_b = lax.ppermute(d_recv, PIPE_AXIS, bwd_perm)
+                return (recv_f, recv_b, fstash, bstash, loss_buf, gacc), None
+
+            zeros_wire = jnp.zeros((mb, width), jnp.float32)
+            fstash0 = jnp.zeros((tbl.n_fslots + 1, mb, width), jnp.float32)
+            loss0 = jnp.zeros((M, mb), jnp.float32)
+            if fwd_only:
+                carry0 = (zeros_wire, fstash0, loss0)
+            else:
+                carry0 = (zeros_wire, zeros_wire, fstash0,
+                          jnp.zeros((tbl.n_bslots + 1, mb, width),
+                                    jnp.float32),
+                          loss0, gacc0)
+            carry, _ = lax.scan(tick, carry0, jnp.arange(tbl.T))
+            # only the device hosting the last chunk banks real costs
+            loss_buf = carry[2] if fwd_only else carry[4]
+            local_sum = jnp.sum(loss_buf)
+            total = lax.psum(lax.psum(local_sum, PIPE_AXIS), DATA_AXIS)
+            if fwd_only:
+                return total / B
+            grads = jax.tree.map(
+                lambda g: lax.psum(lax.psum(g, PIPE_AXIS), DATA_AXIS) / B,
+                carry[5])
+            return total / B, grads
+
+        from jax.sharding import PartitionSpec as P
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P()),
+            out_specs=P() if fwd_only else (P(), P()),
+            check_vma=False)
+        if fwd_only:
+            return fn(params, feed, rng)
+        total, grads = fn(params, feed, rng)
+        # cast back to the raw parameter dtypes, as autodiff's
+        # cast-transpose would
+        grads = {k: g.astype(raw_dtypes[k]) for k, g in grads.items()}
+        return total, grads
+
+    def _table_loss(self, params, feed, mode: str = TRAIN, rng=None):
+        """Forward-only (test/eval) execution of the interleaved table."""
+        total = self._table_run(params, feed, mode, rng, fwd_only=True)
+        return total, ({}, {}, {})
+
+    def _table_loss_and_grad(self, params, feed, mode: str = TRAIN,
+                             rng=None):
+        """Interleaved 1F1B training: both legs of the compiled table."""
+        return self._table_run(params, feed, mode, rng, fwd_only=False)

@@ -91,7 +91,8 @@ class Trainer:
             self.executor = PipelineExecutor(
                 self.model, mesh,
                 n_micro=self.opt.pipeline_micro_batches, compute_dtype=cdt,
-                schedule=self.opt.pipeline_schedule or "gpipe")
+                schedule=self.opt.pipeline_schedule or "gpipe",
+                virtual_stages=self.opt.pipeline_virtual_stages or 1)
         else:
             self.executor = GraphExecutor(self.model, mesh=mesh,
                                           compute_dtype=cdt)
@@ -235,10 +236,11 @@ class Trainer:
                 outputs = dict(outputs)
                 for n, g in probe_grads.items():
                     outputs["__grad__" + n] = Argument(value=g)
-            elif getattr(executor, "schedule", None) == "1f1b":
-                # hand-scheduled pipeline backward (1F1B with per-stage
-                # recompute) — the executor returns grads itself instead of
-                # sitting behind jax.value_and_grad
+            elif getattr(executor, "schedule", None) in ("1f1b",
+                                                         "interleaved"):
+                # hand-scheduled pipeline backward (1F1B, plain or over
+                # interleaved virtual stages) — the executor returns grads
+                # itself instead of sitting behind jax.value_and_grad
                 loss, grads = executor.loss_and_grad(params, batch,
                                                      TRAIN, rng)
                 outputs, costs, new_net = {}, {}, net_state
@@ -587,7 +589,8 @@ class Trainer:
             # jit once: every perturbed evaluation reuses the same executable
             loss_fn = jax.jit(lambda p: self.executor.loss(
                 p, batch, self.net_state, TEST, rng)[0])
-            if getattr(self.executor, "schedule", None) == "1f1b":
+            if getattr(self.executor, "schedule", None) in ("1f1b",
+                                                            "interleaved"):
                 # audit the grads TRAINING actually uses: the hand-
                 # scheduled loss_and_grad backward, not the autodiff of
                 # loss() that only the gpipe schedule trains with

@@ -500,3 +500,67 @@ def test_pipeline_transformer_blocks():
             np.testing.assert_allclose(
                 pp_[name], p1[name], rtol=3e-4, atol=2e-5,
                 err_msg=f"param {name!r} diverged ({schedule})")
+
+
+def _mlp_conf_interleaved(n_chunks, v, n_micro=4):
+    def conf():
+        _mlp_conf(n_chunks)()
+        from paddle_tpu.dsl.base import current_context
+        opt = current_context().opt
+        opt.pipeline_schedule = "interleaved"
+        opt.pipeline_virtual_stages = v
+        opt.pipeline_micro_batches = n_micro
+    return conf
+
+
+def test_interleaved_matches_unpipelined():
+    """Interleaved 1F1B (v=2 virtual stages on a 2-device pipe axis: 4
+    chunks round-robin, device 0 hosts chunks 0+2, device 1 hosts 1+3) —
+    same exactness bar as every other schedule: losses AND final params
+    equal un-pipelined training.  Also drives the forward-only table
+    (executor.loss) and the schedule accounting."""
+    batches = _batches(8, np.random.default_rng(21))
+    conf = _mlp_conf_interleaved(4, v=2, n_micro=4)
+    l1, p1, tr1 = _train(conf, None, batches)
+    mesh = make_mesh(data=4, pipe=2)
+    li, pi, tr = _train(conf, mesh, batches)
+    assert tr.executor.schedule == "interleaved"
+    info = tr.executor.schedule_info()
+    assert info["virtual_stages"] == 2
+    C, M = 4, 4
+    # the simulated schedule must beat the depth-C 1F1B lockstep formula
+    assert info["ticks"] <= 2 * (M + C - 1), info
+    np.testing.assert_allclose(li, l1, rtol=2e-4, atol=1e-6,
+                               err_msg="interleaved loss trajectory diverged")
+    for name in p1:
+        np.testing.assert_allclose(
+            pi[name], p1[name], rtol=3e-4, atol=2e-5,
+            err_msg=f"param {name!r} diverged (interleaved)")
+    # forward-only table (test/eval path) matches the unpipelined loss
+    import jax
+    from paddle_tpu.graph.context import TEST
+    b = batches[0]
+    lu = float(tr1.executor.loss(tr1.params, b, None, TEST, None)[0])
+    lp = float(jax.jit(lambda p: tr.executor.loss(
+        p, b, None, TEST, None)[0])(tr.params))
+    assert abs(lp - lu) < 1e-4, (lp, lu)
+
+
+def test_interleaved_v1_matches_1f1b():
+    """v=1 interleaved is plain 1F1B expressed as a schedule table — the
+    two implementations must produce identical trajectories."""
+    batches = _batches(6, np.random.default_rng(22))
+    mesh = make_mesh(data=2, pipe=4)
+
+    def conf_1f1b():
+        _mlp_conf(4)()
+        from paddle_tpu.dsl.base import current_context
+        current_context().opt.pipeline_schedule = "1f1b"
+        current_context().opt.pipeline_micro_batches = 4
+    lf, pf, _ = _train(conf_1f1b, mesh, batches)
+    li, pi, tr = _train(_mlp_conf_interleaved(4, v=1, n_micro=4), mesh,
+                        batches)
+    assert tr.executor.n_chunks == 4
+    np.testing.assert_allclose(li, lf, rtol=1e-5, atol=1e-7)
+    for name in pf:
+        np.testing.assert_allclose(pi[name], pf[name], rtol=1e-5, atol=1e-6)
