@@ -305,6 +305,23 @@ def _compile_schedule(S: int, v: int, M: int,
     return tbl
 
 
+def _grad_acc_init(params):
+    """Zero gradient accumulators: >= fp32 for floating params regardless
+    of the compute dtype — the same semantics autodiff's cast-transpose
+    gives the GPipe path.  Shared by the 1F1B and interleaved backwards."""
+    return {k: jnp.zeros(v.shape,
+                         jnp.promote_types(v.dtype, jnp.float32)
+                         if jnp.issubdtype(v.dtype, jnp.floating)
+                         else v.dtype)
+            for k, v in params.items()}
+
+
+def _cast_grads_back(grads, raw_dtypes):
+    """Grads are w.r.t. the prepared (compute-dtype) params; cast back to
+    the raw parameter dtypes, as autodiff's cast-transpose would."""
+    return {k: g.astype(raw_dtypes[k]) for k, g in grads.items()}
+
+
 def _vjp_branch(f):
     """Backward twin of a forward stage branch: recompute the stage under
     jax.vjp from its stashed input carrier.  The cotangents stack across
@@ -644,13 +661,7 @@ class PipelineExecutor:
         bwd_branches = [_vjp_branch(f) for f in fwd_branches]
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
         bwd_perm = [(i, i - 1) for i in range(1, S)]
-        # grads accumulate in >= fp32 regardless of the compute dtype —
-        # the same semantics autodiff's cast-transpose gives the GPipe path
-        gacc0 = {k: jnp.zeros(v.shape,
-                              jnp.promote_types(v.dtype, jnp.float32)
-                              if jnp.issubdtype(v.dtype, jnp.floating)
-                              else v.dtype)
-                 for k, v in params.items()}
+        gacc0 = _grad_acc_init(params)
 
         def local(p, feed_loc, key):
             stage = lax.axis_index(PIPE_AXIS)
@@ -736,10 +747,7 @@ class PipelineExecutor:
             in_specs=(P(), P(DATA_AXIS), P()), out_specs=(P(), P()),
             check_vma=False)
         total, grads = fn(params, feed, rng)
-        # grads are w.r.t. the prepared (compute-dtype) params; cast back
-        # to the raw parameter dtypes, as autodiff's cast-transpose would
-        grads = {k: g.astype(raw_dtypes[k]) for k, g in grads.items()}
-        return total, grads
+        return total, _cast_grads_back(grads, raw_dtypes)
 
     # -- interleaved virtual stages: table-driven schedule ---------------
     def _table_run(self, params, feed, mode, rng, fwd_only: bool):
@@ -751,7 +759,8 @@ class PipelineExecutor:
         cotangents whose consumer isn't scheduled just-in-time; chunk
         round-robin makes EVERY chunk boundary a +1 ring hop (wrapping
         S-1 -> 0 between virtual-stage groups)."""
-        raw_dtypes = {k: v.dtype for k, v in params.items()}
+        raw_dtypes = None if fwd_only else \
+            {k: v.dtype for k, v in params.items()}
         M, C, S = self.n_micro, self.n_chunks, self.n_stages
         params, feed, B, mb, specs, width, rng = self._prologue(
             params, feed, rng)
@@ -765,12 +774,7 @@ class PipelineExecutor:
               if isinstance(getattr(tbl, f.name), np.ndarray)}
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
-        gacc0 = None if fwd_only else {
-            k: jnp.zeros(v.shape,
-                         jnp.promote_types(v.dtype, jnp.float32)
-                         if jnp.issubdtype(v.dtype, jnp.floating)
-                         else v.dtype)
-            for k, v in params.items()}
+        gacc0 = None if fwd_only else _grad_acc_init(params)
 
         def local(p, feed_loc, key):
             stage = lax.axis_index(PIPE_AXIS)
@@ -879,10 +883,7 @@ class PipelineExecutor:
         if fwd_only:
             return fn(params, feed, rng)
         total, grads = fn(params, feed, rng)
-        # cast back to the raw parameter dtypes, as autodiff's
-        # cast-transpose would
-        grads = {k: g.astype(raw_dtypes[k]) for k, g in grads.items()}
-        return total, grads
+        return total, _cast_grads_back(grads, raw_dtypes)
 
     def _table_loss(self, params, feed, mode: str = TRAIN, rng=None):
         """Forward-only (test/eval) execution of the interleaved table."""
