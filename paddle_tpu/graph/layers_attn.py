@@ -30,8 +30,12 @@ from paddle_tpu.ops.attention import (
 )
 from paddle_tpu.parameter.argument import Argument
 
-# beyond this many key positions, prefer the O(T)-memory blockwise kernel
-_BLOCKWISE_MIN_KEYS = 1024
+# beyond this many key positions, prefer the O(T)-memory flash/blockwise
+# path.  Measured on v5e (MEASURE/attn_bench, round 4, B4 H8 D64 bf16
+# fwd+bwd): dense wins below 2k keys (0.033 vs 0.036 ms at 1024),
+# blockwise ties at 2048 (0.028 vs 0.030) and dense OOMs by 16k — so the
+# crossover sits at 2048; override per layer with block_k_min
+_BLOCKWISE_MIN_KEYS = 2048
 
 
 @register_layer("multi_head_attention")
