@@ -7,7 +7,7 @@ attention models have no recurrent group to unroll, so this provides the
 matching TPU-native decode loop: ONE compiled `lax.scan` over a
 fixed-size token buffer — each step runs the full forward on the padded
 prefix (masked by the running length), reads the next-token logits at the
-last valid position, and samples greedy / temperature / top-k.
+last valid position, and samples greedy / temperature / top-k / top-p.
 
 Two decode modes:
   * whole-prefix re-forward (default) — each step runs the full forward on
@@ -32,6 +32,24 @@ from paddle_tpu.graph.context import TEST
 from paddle_tpu.parameter.argument import Argument
 
 Array = jax.Array
+
+
+def nucleus_filter(scaled: Array, top_p: float) -> Array:
+    """Top-p (nucleus) cut on [B, V] logits: keep the smallest
+    probability-sorted prefix whose cumulative mass reaches top_p (the
+    first token AT the threshold stays in — the standard formulation),
+    -inf elsewhere.  Kept support is EXACT: indices are scattered back
+    from the sorted order, so logit ties at the cutoff can never widen
+    the set (same discipline as the top-k branch in lm_generate)."""
+    if not 0.0 < top_p < 1.0:
+        return scaled
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]            # desc
+    srt = jnp.take_along_axis(scaled, order, axis=-1)
+    probs = jax.nn.softmax(srt, axis=-1)
+    keep = jnp.cumsum(probs, axis=-1) - probs < top_p        # n_keep >= 1
+    return jnp.full_like(scaled, -jnp.inf).at[
+        jnp.arange(scaled.shape[0])[:, None], order].set(
+        jnp.where(keep, srt, -jnp.inf))
 
 
 def _resolve_io_names(model, input_name, logits_name):
@@ -73,6 +91,8 @@ def lm_generate(
     logits_name: Optional[str] = None,
     temperature: float = 0.0,     # 0 = greedy
     top_k: int = 0,               # 0 = full distribution
+    top_p: float = 0.0,           # 0 = no nucleus cut; else keep the
+                                  # smallest prefix with cum. prob >= top_p
     eos_id: int = -1,             # -1 = never stop early
     rng: Optional[Array] = None,
     use_cache: bool = False,      # O(T) per-token decode via KV caches
@@ -116,6 +136,7 @@ def lm_generate(
             vals, idxs = jax.lax.top_k(scaled, top_k)
             scaled = jnp.full_like(scaled, -jnp.inf).at[
                 jnp.arange(scaled.shape[0])[:, None], idxs].set(vals)
+        scaled = nucleus_filter(scaled, top_p)
         return jax.random.categorical(key, scaled).astype(jnp.int32)
 
     def advance(buf, lengths, done, nxt):

@@ -132,3 +132,44 @@ def test_lm_generate_greedy_and_sampled():
                           rng=jax.random.PRNGKey(1))
     np.testing.assert_array_equal(np.asarray(out3)[:, :12],
                                   np.asarray(out[:, :12]))  # top_k=1 == greedy
+
+    # nucleus sampling: a vanishing top_p keeps only the argmax token
+    # (== greedy), and top_p=1.0 disables the cut (== full sampling,
+    # exact by the gate — no float-rounding knife edge)
+    out4, _ = lm_generate(tr.executor, tr.params, prompt, max_new=4,
+                          temperature=0.8, top_p=1e-9,
+                          rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(out4)[:, :12],
+                                  np.asarray(out[:, :12]))
+    full, _ = lm_generate(tr.executor, tr.params, prompt, max_new=4,
+                          temperature=0.8, rng=jax.random.PRNGKey(2))
+    nuc, _ = lm_generate(tr.executor, tr.params, prompt, max_new=4,
+                         temperature=0.8, top_p=1.0,
+                         rng=jax.random.PRNGKey(2))
+    np.testing.assert_array_equal(np.asarray(nuc), np.asarray(full))
+
+
+def test_nucleus_filter_exact_support():
+    """nucleus_filter keeps exactly the smallest cum-prob prefix — the
+    first token AT the threshold stays, logit ties at the cutoff cannot
+    widen the set, and an argmax-only cut survives."""
+    import jax.numpy as jnp
+    from paddle_tpu.graph.lm_decode import nucleus_filter
+
+    # probs [0.4, 0.3, 0.2, 0.1] -> top_p=0.5 keeps exactly two tokens
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+    out = np.asarray(nucleus_filter(logits, 0.5))
+    assert np.isfinite(out[0, :2]).all() and np.isneginf(out[0, 2:]).all()
+
+    # exact tie at the cutoff: [2.0, 2.0, 0.0] with a tiny top_p must keep
+    # ONE of the tied tokens, not both
+    tied = jnp.asarray([[2.0, 2.0, 0.0]], jnp.float32)
+    out = np.asarray(nucleus_filter(tied, 0.3))
+    assert np.sum(np.isfinite(out)) == 1, out
+
+    # vanishing top_p -> argmax only; gate disables at 0 and 1
+    out = np.asarray(nucleus_filter(logits, 1e-9))
+    assert np.sum(np.isfinite(out)) == 1 and np.isfinite(out[0, 0])
+    for p in (0.0, 1.0):
+        np.testing.assert_array_equal(
+            np.asarray(nucleus_filter(logits, p)), np.asarray(logits))
