@@ -326,12 +326,9 @@ def bench_lm(dtype: str) -> dict:
     dispatch jitter demands a robust statistic).  The full per-length /
     per-impl sweep lives in tools/bench_lm.py; this is the compact record
     for the driver's BENCH capture."""
-    import time
-
     import numpy as np
 
     from paddle_tpu.config.parser import parse_config
-    from paddle_tpu.graph.lm_decode import lm_generate
     from paddle_tpu.parameter.argument import Argument
     from paddle_tpu.trainer.trainer import Trainer
 
@@ -363,16 +360,10 @@ def bench_lm(dtype: str) -> dict:
     max_new = int(os.environ.get("BENCH_LM_MAX_NEW", "64"))
     reps = int(os.environ.get("BENCH_LM_DECODE_REPS", "5"))
     ids = rng.integers(2, vocab, (dec_b, seqlen - max_new)).astype(np.int32)
-    toks, _ = lm_generate(tr.executor, tr.params, ids, max_new=max_new,
-                          use_cache=True)
-    np.asarray(toks)                                   # compile + warmup
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        toks, _ = lm_generate(tr.executor, tr.params, ids, max_new=max_new,
-                              use_cache=True)
-        np.asarray(toks)
-        times.append(time.perf_counter() - t0)
+    # the one shared timing loop — tools/bench_lm.py's per-context sweep
+    # uses the identical methodology
+    from tools.bench_lm import time_decode
+    times = time_decode(tr, ids, max_new, use_cache=True, reps=reps)
     decode_tps = dec_b * max_new / float(np.median(times))
 
     return {

@@ -154,10 +154,23 @@ def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
         # one-hot scatter would defeat the cache at exactly the long
         # contexts it exists for; k/v land in the cache as a static slice
         valid = (jnp.arange(Tn)[None, :] < n_new[:, None])
-        if pallas_attention.supported() and \
-                Tn >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
+        # honor an explicit attn_impl like the regular forward does (a
+        # config pinned to dense — e.g. to sidestep a pallas issue or for
+        # a dense-vs-flash bench — must not silently get flash prefill);
+        # 'ring' has no cached-decode analog, so it falls through to the
+        # local auto-selection
+        impl = str(cfg.attrs.get("attn_impl", "auto"))
+        long_prompt = Tn >= int(cfg.attrs.get("block_k_min",
+                                              _BLOCKWISE_MIN_KEYS))
+        if impl == "flash":
             attn = pallas_attention.flash_attention
-        elif Tn >= int(cfg.attrs.get("block_k_min", _BLOCKWISE_MIN_KEYS)):
+        elif impl == "blockwise":
+            attn = blockwise_attention
+        elif impl == "dense":
+            attn = dot_product_attention
+        elif long_prompt and pallas_attention.supported():
+            attn = pallas_attention.flash_attention
+        elif long_prompt:
             attn = blockwise_attention
         else:
             attn = dot_product_attention

@@ -122,6 +122,12 @@ def lm_generate(
 
     buf0 = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt_ids)
 
+    if temperature <= 0.0 and (top_k > 0 or top_p > 0.0):
+        raise ValueError(
+            f"top_k={top_k}/top_p={top_p} need temperature > 0 — "
+            f"temperature=0 means greedy argmax, which would silently "
+            f"ignore them")
+
     def pick_next(last, key):
         last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
             if _is_probs(model, logits_name) else last.astype(jnp.float32)

@@ -138,6 +138,11 @@ def _auc_final(cfg, acc):
     return {"auc": auc}
 
 
+# "auc" is a convenience alias: the reference registers ONLY
+# "last-column-auc" (= AucEvaluator(-1), Evaluator.cpp:857; the DSL's
+# auc_evaluator emits that type too), so last-column scoring IS the
+# reference behavior — for the common 2-column softmax output it is
+# column 1, the positive class
 register_evaluator("auc", "last-column-auc")((_auc_batch, _auc_final))
 
 

@@ -173,3 +173,18 @@ def test_nucleus_filter_exact_support():
     for p in (0.0, 1.0):
         np.testing.assert_array_equal(
             np.asarray(nucleus_filter(logits, p)), np.asarray(logits))
+
+
+def test_sampling_knobs_need_temperature():
+    """top_k/top_p with the default temperature=0 (greedy) would be
+    silently ignored — lm_generate must reject the combination."""
+    import pytest
+    from paddle_tpu.graph.lm_decode import lm_generate
+
+    cfg = parse_config(CFG, "dim=32,layers=1,heads=2,vocab=32,batch_size=4")
+    tr = Trainer(cfg, seed=0)
+    prompt = np.zeros((2, 4), np.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        lm_generate(tr.executor, tr.params, prompt, max_new=2, top_p=0.9)
+    with pytest.raises(ValueError, match="temperature"):
+        lm_generate(tr.executor, tr.params, prompt, max_new=2, top_k=5)

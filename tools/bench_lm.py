@@ -77,6 +77,30 @@ def bench_train(args, seq_len: int, impl: str) -> dict:
     }
 
 
+def time_decode(tr, ids, max_new: int, use_cache: bool, reps: int):
+    """Compile + warm up one lm_generate call, then time `reps` identical
+    calls; returns the per-call seconds as an np.ndarray.  The ONE decode
+    timing loop — bench.py's compact record and the per-context sweep
+    below both call it, so methodology (warmup, sync-on-host-read) can
+    never drift between the two recorded numbers."""
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.graph.lm_decode import lm_generate
+
+    kw = dict(max_new=max_new, use_cache=use_cache)
+    toks, _ = lm_generate(tr.executor, tr.params, ids, **kw)
+    np.asarray(toks)                                   # compile + warmup
+    times = []
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        toks, _ = lm_generate(tr.executor, tr.params, ids, **kw)
+        np.asarray(toks)
+        times.append(_time.perf_counter() - t0)
+    return np.asarray(times)
+
+
 def bench_decode(args, context: int, use_cache: bool) -> dict:
     """Greedy decode throughput: median +- IQR over fixed-size reps (the
     whole decode is one jitted scan; per-call dispatch jitter demands a
@@ -99,16 +123,7 @@ def bench_decode(args, context: int, use_cache: bool) -> dict:
 
     rng = np.random.default_rng(0)
     ids = rng.integers(2, args.vocab, (batch, prompt)).astype(np.int32)
-    kw = dict(max_new=args.max_new, use_cache=use_cache)
-    toks, _ = lm_generate(tr.executor, tr.params, ids, **kw)
-    np.asarray(toks)                                   # compile + warmup
-    times = []
-    for _ in range(args.decode_reps):
-        t0 = time.perf_counter()
-        toks, _ = lm_generate(tr.executor, tr.params, ids, **kw)
-        np.asarray(toks)
-        times.append(time.perf_counter() - t0)
-    times = np.asarray(times)
+    times = time_decode(tr, ids, args.max_new, use_cache, args.decode_reps)
     q1, med, q3 = np.percentile(times, [25, 50, 75])
     n_tok = batch * args.max_new
     return {
