@@ -583,14 +583,17 @@ def main() -> None:
         out["platform"] = health.get("platform", "?")
         out["device_kind"] = health.get("device_kind", "?")
 
+    # seq2seq goes LAST: its bench is where the tunnel wedged in rounds 2
+    # AND 4 (PERF_LOG 2026-07-31T01:20), so everything else must already
+    # be in the record when it runs
     extras = []
-    if os.environ.get("BENCH_SKIP_S2S", "0") != "1":
-        extras.append("seq2seq")
     if os.environ.get("BENCH_SKIP_LM", "0") != "1":
         extras.append("lm")
     if os.environ.get("BENCH_EXTENDED", "1") != "0":
         # the three remaining BASELINE.md configs (BENCH_EXTENDED=0 skips)
         extras += ["mnist", "sentiment", "recommendation"]
+    if os.environ.get("BENCH_SKIP_S2S", "0") != "1":
+        extras.append("seq2seq")
     for key in extras:
         if degraded:
             # the backend just failed the headline twice — spawning more

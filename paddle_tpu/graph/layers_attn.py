@@ -160,9 +160,18 @@ def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
         # 'ring' has no cached-decode analog, so it falls through to the
         # local auto-selection
         impl = str(cfg.attrs.get("attn_impl", "auto"))
+        if impl not in ("auto", "ring", "flash", "blockwise", "dense"):
+            raise ValueError(
+                f"layer {cfg.name!r}: unknown attn_impl {impl!r} "
+                f"(expected auto/ring/flash/blockwise/dense)")
         long_prompt = Tn >= int(cfg.attrs.get("block_k_min",
                                               _BLOCKWISE_MIN_KEYS))
         if impl == "flash":
+            if not pallas_attention.supported():
+                raise ValueError(
+                    f"layer {cfg.name!r}: attn_impl=flash needs a TPU "
+                    f"backend (or PADDLE_TPU_PALLAS_INTERPRET=1 for "
+                    f"interpret-mode tests)")
             attn = pallas_attention.flash_attention
         elif impl == "blockwise":
             attn = blockwise_attention

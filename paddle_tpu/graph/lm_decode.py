@@ -122,7 +122,9 @@ def lm_generate(
 
     buf0 = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt_ids)
 
-    if temperature <= 0.0 and (top_k > 0 or top_p > 0.0):
+    # only reject knob values that would actually change sampling (the
+    # same effective ranges the sampler uses: top_p in (0,1), top_k > 0)
+    if temperature <= 0.0 and (top_k > 0 or 0.0 < top_p < 1.0):
         raise ValueError(
             f"top_k={top_k}/top_p={top_p} need temperature > 0 — "
             f"temperature=0 means greedy argmax, which would silently "

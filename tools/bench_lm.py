@@ -27,7 +27,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -109,7 +108,6 @@ def bench_decode(args, context: int, use_cache: bool) -> dict:
     import numpy as np
 
     from paddle_tpu.config.parser import parse_config
-    from paddle_tpu.graph.lm_decode import lm_generate
     from paddle_tpu.trainer.trainer import Trainer
 
     batch = max(1, args.decode_batch)

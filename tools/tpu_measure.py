@@ -8,17 +8,19 @@ everything already measured.  Priorities (VERDICT round 2):
 
   1. backend health probe
   2. flash + additive on-device parity (tools/tpu_parity.py
-     --only=flash,additive) — cheapest, unblocks trusting everything else
-  3. pallas LSTM/GRU on-device parity (--only=lstm,gru, its own step so
-     slow flash compiles can't starve it of queue budget)
+     --only=flash,additive) — VERDICT priority 1, the only unproven
+     kernels; per-case output persists if the window dies mid-run
+  3. quick bench (vgg + lm + seq2seq-last) -> PERF_LOG.jsonl snapshot —
+     the north-star record, early because healthy windows are short
   4. additive-attention kernel vs jnp (tools/bench_additive.py) —
      evidence for the decoder-step routing default
-  5. attention micro-bench across lengths (tools/bench_attention.py) —
-     evidence for the layer auto-selection crossover (bf16 + fp32 passes)
-  6. transformer-LM train MFU + decode tokens/s per context length
+  5. transformer-LM train MFU + decode tokens/s per context length
      (tools/bench_lm.py)
-  7. quick bench (vgg + seq2seq) -> PERF_LOG.jsonl snapshot
-  8. full 6-config bench -> PERF_LOG.jsonl snapshot
+  6. attention micro-bench across lengths, bf16 (tools/bench_attention.py)
+     — evidence for the layer auto-selection crossover
+  7. pallas LSTM/GRU on-device parity (--only=lstm,gru)
+  8. attention micro-bench fp32 pass
+  9. full 6-config bench -> PERF_LOG.jsonl snapshot (seq2seq last inside)
 
 Results land under MEASURE/<step>.out (+ PERF_LOG.jsonl via bench.py).
 The parent process never imports jax (a wedged tunnel blocks any backend
@@ -103,24 +105,27 @@ def main() -> int:
         return 1
 
     py = sys.executable
-    # cheap/high-information first: the tunnel can die mid-queue (it did in
-    # rounds 2-4; in r2 and r4 the wedge began DURING the seq2seq bench),
-    # so kernel parity + micro-benches land before the big configs
+    # Ordered by marginal value per healthy-tunnel minute.  Healthy windows
+    # have been SHORT (r4: ~22 min), and the tunnel wedged DURING the
+    # seq2seq bench in both r2 and r4 — so: flash parity first (VERDICT
+    # priority 1, the only unproven kernels; partial output persists if
+    # the window dies mid-case), then the full bench record with seq2seq
+    # ordered last inside bench.py, then the sweeps.
     steps = [
         ("parity", [py, "tools/tpu_parity.py", "--only=flash,additive"],
          2700, {}),
-        ("parity_rnn", [py, "tools/tpu_parity.py", "--only=lstm,gru"],
-         1800, {}),
+        ("bench_quick", [py, "bench.py"], 1500,
+         {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
         ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
+        ("bench_lm", [py, "tools/bench_lm.py"], 2400, {}),
         ("attn_bench",
          [py, "tools/bench_attention.py", "--lens", "512,1024,2048,4096,16384",
           "--iters", "10"], 1500, {}),
+        ("parity_rnn", [py, "tools/tpu_parity.py", "--only=lstm,gru"],
+         1800, {}),
         ("attn_bench_f32",
          [py, "tools/bench_attention.py", "--lens", "512,1024,4096",
           "--iters", "10", "--dtype", "float32"], 900, {}),
-        ("bench_lm", [py, "tools/bench_lm.py"], 2400, {}),
-        ("bench_quick", [py, "bench.py"], 1500,
-         {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
         ("bench_full", [py, "bench.py"], 2400,
          {"BENCH_TIME_BUDGET_S": "2100"}),
     ]
