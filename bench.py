@@ -175,6 +175,23 @@ def bench_seq2seq(dtype: str) -> dict:
     stats = tr.benchmark(iter(batches), warmup=2, iters=iters, scan=True)
     train_sps = stats["samples_per_sec"]
 
+    # bank the train measurement NOW: the tunnel wedged during the decode
+    # half of this bench in rounds 2 AND 4, and the spawner takes the LAST
+    # BENCH_JSON line from a killed child's partial output — so a decode
+    # wedge must not take the already-measured train number with it
+    partial = {
+        "metric": "wmt14_seq2seq_train_samples_per_sec_per_chip",
+        "value": round(train_sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": _baseline_ratio(train_sps, "wmt14_seq2seq"),
+        "vs_era_gpu": _era_gpu_ratio(train_sps, "wmt14_seq2seq"),
+        "mfu": round(_step_mfu(tr, batches[0], train_sps, batch_size,
+                               dtype), 4),
+        "beam_decode": "pending (wedge-risk phase; superseded by the "
+                       "final record if decode completes)",
+    }
+    print("BENCH_JSON:" + json.dumps(partial), flush=True)
+
     # beam decode tokens/sec: compiled beam search over the trained params
     beam = int(os.environ.get("BENCH_S2S_BEAM", "3"))
     max_len = int(os.environ.get("BENCH_S2S_MAXLEN", "30"))
@@ -389,7 +406,11 @@ BENCHES = {
 
 
 def _child(name: str) -> None:
-    """Run ONE bench in this (child) process; print exactly one JSON line.
+    """Run ONE bench in this (child) process; print its result as a
+    BENCH_JSON line.  A bench may print interim BENCH_JSON lines as
+    phases complete (seq2seq banks its train number before the
+    wedge-risk decode) — the parent takes the LAST line, so interim
+    lines only matter when the child is killed mid-phase.
 
     Exceptions become {"error": ...} — the child always exits 0 so the
     parent distinguishes "bench failed" (JSON with error) from "backend
