@@ -176,10 +176,11 @@ def bench_seq2seq(dtype: str) -> dict:
     train_sps = stats["samples_per_sec"]
 
     # bank the train measurement NOW: the tunnel wedged during the decode
-    # half of this bench in rounds 2 AND 4, and the spawner takes the LAST
+    # half of this bench in rounds 2 AND 4, and _spawn recovers the LAST
     # BENCH_JSON line from a killed child's partial output — so a decode
-    # wedge must not take the already-measured train number with it
-    partial = {
+    # wedge must not take the already-measured train number with it.
+    # Built once; the decode fields extend this same dict at the end.
+    record = {
         "metric": "wmt14_seq2seq_train_samples_per_sec_per_chip",
         "value": round(train_sps, 2),
         "unit": "samples/sec/chip",
@@ -187,10 +188,11 @@ def bench_seq2seq(dtype: str) -> dict:
         "vs_era_gpu": _era_gpu_ratio(train_sps, "wmt14_seq2seq"),
         "mfu": round(_step_mfu(tr, batches[0], train_sps, batch_size,
                                dtype), 4),
-        "beam_decode": "pending (wedge-risk phase; superseded by the "
-                       "final record if decode completes)",
     }
-    print("BENCH_JSON:" + json.dumps(partial), flush=True)
+    print("BENCH_JSON:" + json.dumps(
+        dict(record, beam_decode="pending (wedge-risk phase; superseded "
+                                 "by the final record if decode "
+                                 "completes)")), flush=True)
 
     # beam decode tokens/sec: compiled beam search over the trained params
     beam = int(os.environ.get("BENCH_S2S_BEAM", "3"))
@@ -220,17 +222,12 @@ def bench_seq2seq(dtype: str) -> dict:
     n_tokens = int(np.asarray(seqs).shape[0]) * max_len
     q1, med, q3 = np.percentile(times, [25, 50, 75])
 
-    return {
-        "metric": "wmt14_seq2seq_train_samples_per_sec_per_chip",
-        "value": round(train_sps, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": _baseline_ratio(train_sps, "wmt14_seq2seq"),
-        "vs_era_gpu": _era_gpu_ratio(train_sps, "wmt14_seq2seq"),
-        "mfu": round(_step_mfu(tr, batches[0], train_sps, batch_size, dtype), 4),
+    record.update({
         "beam_decode_tokens_per_sec": round(n_tokens / med, 2),
         "beam_decode_tokens_per_sec_iqr": [round(n_tokens / q3, 2),
                                            round(n_tokens / q1, 2)],
-    }
+    })
+    return record
 
 
 def bench_mnist(dtype: str) -> dict:
@@ -465,6 +462,18 @@ def _spawn(name: str, timeout_s: float) -> dict:
         [sys.executable, os.path.abspath(__file__), "--bench", name],
         timeout_s)
     if rc is None:
+        # a killed child may have banked interim BENCH_JSON lines before
+        # the wedge (seq2seq prints its train record before the decode
+        # phase) — recover the last one instead of losing the measurement
+        for line in reversed((stdout or "").splitlines()):
+            if line.startswith("BENCH_JSON:"):
+                try:
+                    result = json.loads(line[len("BENCH_JSON:"):])
+                except ValueError:
+                    break
+                result["partial"] = (f"child killed after {timeout_s:.0f}s "
+                                     f"(backend wedged?); interim record")
+                return result
         return {"error": f"timeout after {timeout_s:.0f}s (backend wedged?)"}
     for line in reversed((stdout or "").splitlines()):
         if line.startswith("BENCH_JSON:"):

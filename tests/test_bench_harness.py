@@ -91,3 +91,24 @@ def test_spawn_reports_timeout_as_error():
     rc, out, err = bench._run_group(
         [sys.executable, "-c", "import time; time.sleep(30)"], 1.5)
     assert rc is None                         # timed out, group killed
+
+
+def test_spawn_recovers_interim_record_on_timeout(monkeypatch):
+    """A child killed mid-phase (the seq2seq decode wedge) must yield its
+    last banked BENCH_JSON line, marked partial — not a bare timeout."""
+    bench = _load_bench()
+    interim = {"metric": "wmt14_seq2seq_train_samples_per_sec_per_chip",
+               "value": 123.0, "beam_decode": "pending"}
+    stdout = ("noise\nBENCH_JSON:" + json.dumps(interim) +
+              "\nmore noise after the bank\n")
+    monkeypatch.setattr(bench, "_run_group",
+                        lambda argv, t: (None, stdout, ""))
+    out = bench._spawn("seq2seq", 900)
+    assert out["value"] == 123.0
+    assert "partial" in out and "error" not in out
+
+    # no banked line -> the plain timeout error as before
+    monkeypatch.setattr(bench, "_run_group",
+                        lambda argv, t: (None, "no json here", ""))
+    out = bench._spawn("seq2seq", 900)
+    assert "error" in out and "timeout" in out["error"]
