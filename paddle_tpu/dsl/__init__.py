@@ -15,6 +15,10 @@ from paddle_tpu.dsl.layers import *  # noqa: F401,F403
 from paddle_tpu.dsl.optimizers import *  # noqa: F401,F403
 from paddle_tpu.dsl.networks import *  # noqa: F401,F403
 from paddle_tpu.dsl.evaluators import *  # noqa: F401,F403
+from paddle_tpu.dsl.default_decorators import (  # noqa: F401
+    wrap_act_default, wrap_bias_attr_default, wrap_name_default,
+    wrap_param_attr_default, wrap_param_default,
+)
 from paddle_tpu.dsl.data_sources import (  # noqa: F401
     define_multi_py_data_sources2, define_ptsh_data_sources,
     define_py_data_sources2,

@@ -14,7 +14,7 @@ __all__ = [
     "rank_auc_evaluator", "seq_classification_error_evaluator",
     "maxid_printer_evaluator", "seqtext_printer_evaluator",
     "classification_error_printer_evaluator", "gradient_printer_evaluator",
-    "maxframe_printer_evaluator",
+    "maxframe_printer_evaluator", "evaluator_base",
 ]
 
 
@@ -133,3 +133,18 @@ def maxframe_printer_evaluator(input: LayerOutput, name=None) -> None:
     """Print each sequence's value-maximizing frame (ref: Evaluator.cpp
     MaxFramePrinter)."""
     _add("max_frame_printer", [input], name)
+
+
+def evaluator_base(input, type: str, label: Optional[LayerOutput] = None,
+                   weight: Optional[LayerOutput] = None,
+                   name: Optional[str] = None, **extra) -> None:
+    """Generic evaluator constructor (ref: evaluators.py evaluator_base:60)
+    — the escape hatch for evaluator types without a dedicated helper:
+    assembles [input, label, weight] in the reference's argument order and
+    passes every remaining kwarg onto the EvaluatorConfig."""
+    inputs = [input] if isinstance(input, LayerOutput) else list(input)
+    if label is not None:
+        inputs.append(label)
+    if weight is not None:
+        inputs.append(weight)
+    _add(type, inputs, name, **extra)

@@ -58,9 +58,11 @@ __all__ = [
     "huber_cost", "sum_cost", "auc_validation", "pnpair_validation",
     "crf_layer", "crf_decoding_layer", "ctc_layer", "nce_layer", "hsigmoid",
     "recurrent_group", "memory", "StaticInput", "SubsequenceInput",
-    "GeneratedInput", "beam_search", "sub_network",
+    "GeneratedInput", "BaseGeneratedInput", "beam_search", "sub_network",
     "get_output_layer",
     "LayerOutput",
+    "AggregateLevel", "ExpandLevel", "LayerType", "out_prod_layer",
+    "sum_to_one_norm_layer",
 ]
 
 
@@ -482,7 +484,14 @@ def dropout_layer(input: LayerOutput, dropout_rate: float, name=None) -> LayerOu
 def pooling_layer(input: LayerOutput, pooling_type: Optional[BasePoolingType] = None,
                   name=None, bias_attr=False, agg_level: str = "to_no_sequence",
                   layer_attr=None) -> LayerOutput:
-    """Sequence pooling (ref: layers.py pooling_layer; SequencePoolLayer.cpp)."""
+    """Sequence pooling (ref: layers.py pooling_layer; SequencePoolLayer.cpp).
+
+    agg_level only matters for NESTED (sub-sequence) inputs:
+    AggregateLevel.EACH_TIMESTEP ('non-seq', the reference's default —
+    this function's own default behaves the same) pools over ALL
+    timesteps ignoring sub boundaries; AggregateLevel.EACH_SEQUENCE
+    ('seq') pools each sub-sequence to one vector, giving a sequence
+    output."""
     pt = pooling_type or MaxPooling()
     extra: dict[str, Any] = {}
     type_ = pt.name
@@ -490,28 +499,40 @@ def pooling_layer(input: LayerOutput, pooling_type: Optional[BasePoolingType] = 
         extra["average_strategy"] = getattr(pt, "strategy", "average")
     if getattr(pt, "select_first", False):
         extra["select_first"] = True
+    if agg_level in ("non-seq", "seq"):      # the AggregateLevel constants
+        extra["trans_type"] = agg_level      # the schema field for levels
     out = _simple_layer(type_, [input], input.size, name=name, bias_attr=bias_attr,
                         layer_attr=layer_attr, cfg_extra=extra, prefix="pool")
-    out.seq_level = max(input.seq_level - 1, 0)
+    out.seq_level = 0 if agg_level == "non-seq" \
+        else max(input.seq_level - 1, 0)
     return out
 
 
 def last_seq(input: LayerOutput, name=None, agg_level: str = "to_no_sequence",
              layer_attr=None) -> LayerOutput:
-    """(ref: layers.py last_seq; SequenceLastInstanceLayer.cpp)."""
+    """(ref: layers.py last_seq; SequenceLastInstanceLayer.cpp).
+    agg_level as in pooling_layer (nested inputs only)."""
+    extra = ({"trans_type": agg_level}
+             if agg_level in ("non-seq", "seq") else None)
     out = _simple_layer("seqlastins", [input], input.size, name=name,
-                        layer_attr=layer_attr, prefix="seqlastins")
-    out.seq_level = max(input.seq_level - 1, 0)
+                        layer_attr=layer_attr, cfg_extra=extra,
+                        prefix="seqlastins")
+    out.seq_level = 0 if agg_level == "non-seq" \
+        else max(input.seq_level - 1, 0)
     return out
 
 
 def first_seq(input: LayerOutput, name=None, agg_level: str = "to_no_sequence",
               layer_attr=None) -> LayerOutput:
-    """(ref: layers.py first_seq)."""
+    """(ref: layers.py first_seq).  agg_level as in pooling_layer."""
+    extra: dict[str, Any] = {"select_first": True}
+    if agg_level in ("non-seq", "seq"):
+        extra["trans_type"] = agg_level
     out = _simple_layer("seqlastins", [input], input.size, name=name,
-                        layer_attr=layer_attr, cfg_extra={"select_first": True},
+                        layer_attr=layer_attr, cfg_extra=extra,
                         prefix="seqfirstins")
-    out.seq_level = max(input.seq_level - 1, 0)
+    out.seq_level = 0 if agg_level == "non-seq" \
+        else max(input.seq_level - 1, 0)
     return out
 
 
@@ -1464,7 +1485,13 @@ class SubsequenceInput:
         self.input = input
 
 
-class GeneratedInput:
+class BaseGeneratedInput:
+    """Base of generation feedback inputs (ref: layers.py
+    BaseGeneratedInput:2939) — user code subclasses it to customize the
+    feedback path of beam search."""
+
+
+class GeneratedInput(BaseGeneratedInput):
     """Feedback input for generation: embedding of the previously generated
     token (ref: layers.py GeneratedInput)."""
 
@@ -1651,3 +1678,67 @@ def get_output_layer(input: LayerOutput, arg_name: str = "", name=None) -> Layer
     """(ref: GetOutputLayer.cpp)."""
     return _simple_layer("get_output", [input], input.size, name=name,
                          prefix="get_output")
+
+
+# ---------------------------------------------------------------------------
+# reference compat surface: level constants, type-name registry, bases
+# ---------------------------------------------------------------------------
+
+class AggregateLevel:
+    """Pooling aggregation level constants (ref: layers.py
+    AggregateLevel:204) — EACH_TIMESTEP pools a sequence to one vector,
+    EACH_SEQUENCE pools a nested sequence to one vector per sub-sequence."""
+    EACH_TIMESTEP = "non-seq"
+    EACH_SEQUENCE = "seq"
+
+
+class ExpandLevel:
+    """Expansion level constants (ref: layers.py ExpandLevel:1292)."""
+    FROM_TIMESTEP = AggregateLevel.EACH_TIMESTEP
+    FROM_SEQUENCE = AggregateLevel.EACH_SEQUENCE
+
+
+class LayerType:
+    """Registered layer type-name constants (ref: layers.py LayerType:112).
+    The authoritative registry is graph/registry.py; this mirror exists for
+    configs that reference LayerType.X symbolically."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    POOL_LAYER = "pool"
+    BATCH_NORM_LAYER = "batch_norm"
+    CONV_LAYER = "exconv"
+    CONCAT_LAYER = "concat"
+    ADDTO_LAYER = "addto"
+    EMBEDDING_LAYER = "embedding"
+    COST = "multi-class-cross-entropy"
+
+    @classmethod
+    def is_layer_type(cls, type_name: str) -> bool:
+        """True for any of this class's constants (the reference's
+        semantics) or any registered graph layer type."""
+        consts = {v for k, v in vars(cls).items()
+                  if k.isupper() and isinstance(v, str)}
+        if type_name in consts:
+            return True
+        from paddle_tpu.graph.registry import layer_registry
+        return type_name in layer_registry
+
+
+def out_prod_layer(input1: LayerOutput, input2: LayerOutput, name=None,
+                   layer_attr=None) -> LayerOutput:
+    """Flattened outer product of two vectors (ref: layers.py
+    out_prod_layer; OuterProdLayer.cpp)."""
+    return _simple_layer("out_prod", [input1, input2],
+                         input1.size * input2.size, name=name,
+                         layer_attr=layer_attr, prefix="out_prod")
+
+
+def sum_to_one_norm_layer(input: LayerOutput, name=None,
+                          layer_attr=None) -> LayerOutput:
+    """Row-normalize to sum 1 (ref: layers.py sum_to_one_norm_layer;
+    SumToOneNormLayer.cpp)."""
+    return _simple_layer("sum_to_one_norm", [input], input.size, name=name,
+                         layer_attr=layer_attr, prefix="sum_to_one_norm")

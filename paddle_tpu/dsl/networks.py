@@ -17,9 +17,9 @@ from paddle_tpu.dsl.base import LayerOutput, current_context
 from paddle_tpu.dsl.layers import (
     StaticInput, batch_norm_layer, concat_layer, context_projection,
     dropout_layer, expand_layer, fc_layer, first_seq, full_matrix_projection,
-    grumemory, img_cmrnorm_layer, img_conv_layer, img_pool_layer, last_seq,
-    lstmemory, memory, mixed_layer, pooling_layer, recurrent_group,
-    tensor_layer,
+    gru_step_layer, grumemory, identity_projection, img_cmrnorm_layer,
+    img_conv_layer, img_pool_layer, last_seq, lstm_step_layer, lstmemory,
+    memory, mixed_layer, pooling_layer, recurrent_group, tensor_layer,
 )
 from paddle_tpu.dsl.poolings import MaxPooling
 
@@ -27,6 +27,8 @@ __all__ = [
     "simple_img_conv_pool", "img_conv_group", "small_vgg", "vgg_16_network",
     "simple_lstm", "sequence_conv_pool", "lstmemory_group", "simple_gru", "gru_group",
     "bidirectional_lstm", "simple_attention", "inputs", "outputs",
+    "lstmemory_unit", "gru_unit", "simple_gru2", "bidirectional_gru",
+    "img_conv_bn_pool", "text_conv_pool",
 ]
 
 
@@ -209,7 +211,6 @@ def gru_group(input: LayerOutput, size: Optional[int] = None,
               gru_bias_attr=None, act=None, gate_act=None,
               gru_layer_attr=None) -> LayerOutput:
     """GRU as an explicit recurrent_group (ref: networks.py gru_group)."""
-    from paddle_tpu.dsl.layers import gru_step_layer
     size = size or input.size // 3
     name = name or current_context().unique_name("gru_group")
 
@@ -312,3 +313,143 @@ def outputs(*layers) -> None:
     for l in layers:
         if l.name not in ctx.model.output_layer_names:
             ctx.model.output_layer_names.append(l.name)
+
+
+def lstmemory_unit(input: LayerOutput, name: Optional[str] = None,
+                   size: Optional[int] = None, param_attr=None, act=None,
+                   gate_act=None, state_act=None, mixed_bias_attr=None,
+                   lstm_bias_attr=None, mixed_layer_attr=None,
+                   lstm_layer_attr=None,
+                   get_output_layer_attr=None) -> LayerOutput:
+    """One LSTM time step for use INSIDE a user recurrent_group (ref:
+    networks.py lstmemory_unit:616) — not itself recurrent; typical use
+    is attention decoders that need the per-step state visible.
+
+    The reference contract: `input` is ALREADY projected to 4*size (the
+    input-to-hidden matmuls are hoisted out of the unit for speed —
+    ref networks.py:749-754), so it enters via identity_projection and
+    only the recurrent out_mem projection holds parameters.  The cell
+    state is published under `{name}_state` (the reference exposes it
+    with a get_output_layer of that name; our lstm_step_layer publishes
+    the state there directly, so get_output_layer_attr has nothing left
+    to configure)."""
+    if size is None:
+        assert input.size % 4 == 0, (
+            "lstmemory_unit expects its input pre-projected to 4*size "
+            "(ref contract); add a mixed/fc projection before it")
+        size = input.size // 4
+    name = name or current_context().unique_name("lstmemory_unit")
+    out_mem = memory(name=name, size=size)
+    state_mem = memory(name=f"{name}_state", size=size)
+    with mixed_layer(name=f"{name}_input_recurrent", size=size * 4,
+                     act=LinearActivation(), bias_attr=mixed_bias_attr,
+                     layer_attr=mixed_layer_attr) as m:
+        m += identity_projection(input)
+        m += full_matrix_projection(out_mem, size=size * 4,
+                                    param_attr=param_attr)
+    return lstm_step_layer(
+        input=m, state=state_mem, size=size, bias_attr=lstm_bias_attr,
+        act=act, gate_act=gate_act, state_act=state_act, name=name,
+        state_name=f"{name}_state", layer_attr=lstm_layer_attr)
+
+
+def gru_unit(input: LayerOutput, size: Optional[int] = None,
+             name: Optional[str] = None, gru_bias_attr=None, act=None,
+             gate_act=None, gru_layer_attr=None) -> LayerOutput:
+    """One GRU time step for use INSIDE a user recurrent_group (ref:
+    networks.py gru_unit:821)."""
+    if size is None:
+        assert input.size % 3 == 0
+        size = input.size // 3
+    name = name or current_context().unique_name("gru_unit")
+    out_mem = memory(name=name, size=size)
+    return gru_step_layer(input=input, output_mem=out_mem, size=size,
+                          bias_attr=gru_bias_attr, act=act,
+                          gate_act=gate_act, name=name,
+                          layer_attr=gru_layer_attr)
+
+
+def simple_gru2(input: LayerOutput, size: int, name: Optional[str] = None,
+                reverse: bool = False, mixed_param_attr=None,
+                mixed_bias_attr=None, gru_param_attr=None,
+                gru_bias_attr=None, act=None, gate_act=None,
+                mixed_layer_attr=None, gru_cell_attr=None) -> LayerOutput:
+    """simple_gru via the fused grumemory cell (ref: networks.py
+    simple_gru2:1019 — 'faster than simple_gru', which builds an explicit
+    step group; here both compile to the same pallas/scan kernel)."""
+    name = name or current_context().unique_name("simple_gru2")
+    proj = fc_layer(input=input, size=size * 3, act=LinearActivation(),
+                    bias_attr=mixed_bias_attr, param_attr=mixed_param_attr,
+                    name=f"{name}_transform", layer_attr=mixed_layer_attr)
+    return grumemory(input=proj, name=name, reverse=reverse,
+                     bias_attr=gru_bias_attr, param_attr=gru_param_attr,
+                     act=act, gate_act=gate_act, layer_attr=gru_cell_attr)
+
+
+def bidirectional_gru(input: LayerOutput, size: int,
+                      name: Optional[str] = None, return_seq: bool = False,
+                      last_seq_attr=None, first_seq_attr=None,
+                      concat_attr=None, concat_act=None,
+                      **kwargs) -> LayerOutput:
+    """Forward + backward simple_gru2, concatenated (ref: networks.py
+    bidirectional_gru:1081): the full sequences when return_seq, else the
+    two end-of-scan summaries (position-aligned reverse scan puts the
+    backward summary at position 0).  Per-direction knobs use the
+    reference's fwd_/bwd_ kwarg prefixes; anything else unknown errors
+    rather than silently vanishing."""
+    name = name or current_context().unique_name("bidirectional_gru")
+    fwd_kw = {k[len("fwd_"):]: v for k, v in kwargs.items()
+              if k.startswith("fwd_")}
+    bwd_kw = {k[len("bwd_"):]: v for k, v in kwargs.items()
+              if k.startswith("bwd_")}
+    unknown = [k for k in kwargs
+               if not (k.startswith("fwd_") or k.startswith("bwd_"))]
+    if unknown:
+        raise TypeError(
+            f"bidirectional_gru got unexpected kwargs {unknown}; "
+            f"per-direction options take fwd_/bwd_ prefixes")
+    fwd = simple_gru2(input=input, size=size, name=f"{name}_fwd",
+                      reverse=False, **fwd_kw)
+    bwd = simple_gru2(input=input, size=size, name=f"{name}_bwd",
+                      reverse=True, **bwd_kw)
+    if return_seq:
+        return concat_layer(input=[fwd, bwd], name=name, act=concat_act,
+                            layer_attr=concat_attr)
+    fwd_end = last_seq(input=fwd, name=f"{name}_fwd_end",
+                       layer_attr=last_seq_attr)
+    bwd_end = first_seq(input=bwd, name=f"{name}_bwd_end",
+                        layer_attr=first_seq_attr)
+    return concat_layer(input=[fwd_end, bwd_end], name=name, act=concat_act,
+                        layer_attr=concat_attr)
+
+
+def img_conv_bn_pool(input: LayerOutput, filter_size: int, num_filters: int,
+                     pool_size: int, name: Optional[str] = None,
+                     pool_type=None, act=None, groups: int = 1,
+                     conv_stride: int = 1, conv_padding: int = 0,
+                     conv_bias_attr=None, num_channel: Optional[int] = None,
+                     conv_param_attr=None, shared_bias: bool = True,
+                     conv_layer_attr=None, bn_param_attr=None,
+                     bn_bias_attr=None, bn_layer_attr=None,
+                     pool_stride: int = 1, pool_padding: int = 0,
+                     pool_layer_attr=None) -> LayerOutput:
+    """conv -> batch_norm -> pool composite (ref: networks.py
+    img_conv_bn_pool:232) — the linear-activation conv feeds BN, which
+    carries the nonlinearity."""
+    name = name or current_context().unique_name("img_conv_bn_pool")
+    conv = img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel, act=LinearActivation(), groups=groups,
+        stride=conv_stride, padding=conv_padding, bias_attr=conv_bias_attr,
+        param_attr=conv_param_attr, shared_biases=shared_bias,
+        name=f"{name}_conv", layer_attr=conv_layer_attr)
+    bn = batch_norm_layer(input=conv, act=act, name=f"{name}_bn",
+                          bias_attr=bn_bias_attr, param_attr=bn_param_attr,
+                          layer_attr=bn_layer_attr)
+    return img_pool_layer(input=bn, pool_size=pool_size, name=f"{name}_pool",
+                          pool_type=pool_type, stride=pool_stride,
+                          padding=pool_padding, layer_attr=pool_layer_attr)
+
+
+# ref: networks.py:137 — text_conv_pool IS sequence_conv_pool by another name
+text_conv_pool = sequence_conv_pool

@@ -12,10 +12,20 @@ __all__ = [
     "AdaGradOptimizer", "DecayedAdaGradOptimizer", "AdaDeltaOptimizer",
     "RMSPropOptimizer", "L2Regularization", "L1Regularization",
     "GradientClippingThreshold", "ModelAverage",
+    "Optimizer", "BaseRegularization", "BaseSGDOptimizer",
 ]
 
 
-class BaseSGDOptimizer:
+class Optimizer:
+    """Root of the settings-applying hierarchy (ref: optimizers.py
+    Optimizer:28) — exists so user isinstance checks from reference-era
+    configs keep working."""
+
+    def apply(self, opt) -> None:   # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class BaseSGDOptimizer(Optimizer):
     learning_method = "momentum"
 
     def apply(self, opt) -> None:
@@ -106,7 +116,11 @@ class RMSPropOptimizer(BaseSGDOptimizer):
         opt.ada_epsilon = self.epsilon
 
 
-class L2Regularization:
+class BaseRegularization(Optimizer):
+    """(ref: optimizers.py BaseRegularization:294)."""
+
+
+class L2Regularization(BaseRegularization):
     def __init__(self, rate: float):
         self.rate = rate
 
@@ -114,7 +128,7 @@ class L2Regularization:
         opt.l2_weight = self.rate
 
 
-class L1Regularization:
+class L1Regularization(BaseRegularization):
     def __init__(self, rate: float):
         self.rate = rate
 
@@ -122,7 +136,7 @@ class L1Regularization:
         opt.l1_weight = self.rate
 
 
-class GradientClippingThreshold:
+class GradientClippingThreshold(Optimizer):
     def __init__(self, threshold: float):
         self.threshold = threshold
 
@@ -130,7 +144,7 @@ class GradientClippingThreshold:
         opt.gradient_clipping_threshold = self.threshold
 
 
-class ModelAverage:
+class ModelAverage(Optimizer):
     def __init__(self, average_window: float, max_average_window: Optional[int] = None,
                  do_average_in_cpu: bool = False):
         self.average_window = average_window

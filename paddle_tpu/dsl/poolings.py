@@ -4,7 +4,7 @@ from __future__ import annotations
 
 __all__ = ["BasePoolingType", "MaxPooling", "AvgPooling", "SumPooling",
            "SquareRootNPooling", "LastPooling", "FirstPooling",
-           "MaxWithIdPooling"]
+           "MaxWithIdPooling", "CudnnMaxPooling", "CudnnAvgPooling"]
 
 
 class BasePoolingType:
@@ -41,3 +41,10 @@ class LastPooling(BasePoolingType):
 class FirstPooling(BasePoolingType):
     name = "seqlastins"
     select_first = True
+
+
+# cuDNN-dispatch aliases (ref: poolings.py CudnnMaxPooling/CudnnAvgPooling)
+# — the CPU-vs-cuDNN dispatch distinction is meaningless under XLA; the
+# math is identical, so these are pure aliases
+CudnnMaxPooling = MaxPooling
+CudnnAvgPooling = AvgPooling

@@ -55,9 +55,34 @@ def _save_state(ctx: ForwardContext, cfg: LayerConfig, **states) -> None:
 # pooling over time
 # ---------------------------------------------------------------------------
 
+def _per_sub(cfg, x) -> bool:
+    """Whether a nested ([B,S,T,D]) input pools PER SUB-SEQUENCE (output a
+    [B,S,D] sequence) instead of over all valid tokens (output [B,D]).
+
+    The all-token reduction is the default and matches the reference's
+    default AggregateLevel.EACH_TIMESTEP; an explicit agg_level='seq'
+    (AggregateLevel.EACH_SEQUENCE, carried in LayerConfig.trans_type)
+    selects the per-sub form (ref: SequencePoolLayer.cpp sequence-level
+    dispatch, which CHECKs hasSubseq for the 'seq' level — mirrored
+    here)."""
+    if cfg.trans_type == "seq":
+        if x.sub_lengths is None:
+            raise ValueError(
+                f"layer {cfg.name!r}: agg_level=AggregateLevel."
+                f"EACH_SEQUENCE needs a NESTED (sub-sequence) input; "
+                f"this input is a plain sequence — drop agg_level or "
+                f"feed sub_lengths")
+        return True
+    return False
+
+
 @register_layer("max")
 def max_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
+    if _per_sub(cfg, x):
+        out = seqops.nested_pool_max_per_sub(x.value, x.lengths,
+                                             x.sub_lengths)
+        return finish_layer(ctx, cfg, out, lengths=x.lengths)
     if x.sub_lengths is not None:
         out = seqops.nested_pool_max(x.value, x.lengths, x.sub_lengths)
     else:
@@ -68,6 +93,11 @@ def max_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 @register_layer("average")
 def average_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
+    if _per_sub(cfg, x):
+        out = seqops.nested_pool_avg_per_sub(x.value, x.lengths,
+                                             x.sub_lengths,
+                                             cfg.average_strategy)
+        return finish_layer(ctx, cfg, out, lengths=x.lengths)
     if x.sub_lengths is not None:
         out = seqops.nested_pool_avg(x.value, x.lengths, x.sub_lengths,
                                      cfg.average_strategy)
@@ -79,6 +109,11 @@ def average_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
 @register_layer("seqlastins")
 def seq_last_ins_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     x = ctx.get_input(cfg, 0)
+    if _per_sub(cfg, x):
+        out = seqops.nested_pool_edge_per_sub(x.value, x.lengths,
+                                              x.sub_lengths,
+                                              bool(cfg.select_first))
+        return finish_layer(ctx, cfg, out, lengths=x.lengths)
     if x.sub_lengths is not None:
         pool = (seqops.nested_pool_first if cfg.select_first
                 else seqops.nested_pool_last)

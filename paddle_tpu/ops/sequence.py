@@ -229,3 +229,46 @@ def seq_reverse(x: Array, lengths: Array) -> Array:
     idx = jnp.where(valid, src, t)
     out = jnp.take_along_axis(x, idx.reshape(B, T, *([1] * (x.ndim - 2))), axis=1)
     return jnp.where(valid.reshape(B, T, *([1] * (x.ndim - 2))), out, x)
+
+
+def _sub_valid(lengths: Array, sub_lengths: Array) -> Array:
+    """[B, S] validity of each sub-sequence row: s < lengths[b] and the
+    sub-sequence is non-empty."""
+    S = sub_lengths.shape[1]
+    return (jnp.arange(S)[None, :] < lengths[:, None]) & (sub_lengths > 0)
+
+
+def nested_pool_max_per_sub(x: Array, lengths: Array,
+                            sub_lengths: Array) -> Array:
+    """Per-sub-sequence max: [B,S,T,D] -> [B,S,D] (the reference's
+    AggregateLevel.EACH_SEQUENCE pooling); invalid/empty subs -> 0."""
+    T = x.shape[2]
+    t_valid = (jnp.arange(T)[None, None, :] <
+               sub_lengths[:, :, None])[..., None]
+    neg = jnp.finfo(x.dtype).min
+    out = jnp.max(jnp.where(t_valid, x, neg), axis=2)
+    return jnp.where(_sub_valid(lengths, sub_lengths)[..., None], out, 0.0)
+
+
+def nested_pool_avg_per_sub(x: Array, lengths: Array, sub_lengths: Array,
+                            strategy: str = "average") -> Array:
+    """Per-sub-sequence mean/sum/sqrt-n: [B,S,T,D] -> [B,S,D]."""
+    T = x.shape[2]
+    t_valid = (jnp.arange(T)[None, None, :] <
+               sub_lengths[:, :, None]).astype(x.dtype)[..., None]
+    total = jnp.sum(x * t_valid, axis=2)
+    if strategy != "sum":
+        n = jnp.maximum(sub_lengths, 1).astype(x.dtype)[..., None]
+        total = total / (jnp.sqrt(n) if strategy == "squarerootn" else n)
+    return jnp.where(_sub_valid(lengths, sub_lengths)[..., None], total, 0.0)
+
+
+def nested_pool_edge_per_sub(x: Array, lengths: Array, sub_lengths: Array,
+                             first: bool) -> Array:
+    """Per-sub-sequence first/last valid token: [B,S,T,D] -> [B,S,D]."""
+    if first:
+        out = x[:, :, 0]
+    else:
+        idx = jnp.maximum(sub_lengths - 1, 0)[:, :, None, None]
+        out = jnp.take_along_axis(x, idx, axis=2)[:, :, 0]
+    return jnp.where(_sub_valid(lengths, sub_lengths)[..., None], out, 0.0)
