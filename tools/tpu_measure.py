@@ -14,13 +14,15 @@ everything already measured.  Priorities (VERDICT round 2):
      the north-star record, early because healthy windows are short
   4. additive-attention kernel vs jnp (tools/bench_additive.py) —
      evidence for the decoder-step routing default
-  5. transformer-LM train MFU + decode tokens/s per context length
+  5. pallas LSTM/GRU kernels vs lax.scan (tools/bench_rnn.py) — the
+     RNN routing evidence
+  6. transformer-LM train MFU + decode tokens/s per context length
      (tools/bench_lm.py)
-  6. attention micro-bench across lengths, bf16 (tools/bench_attention.py)
+  7. attention micro-bench across lengths, bf16 (tools/bench_attention.py)
      — evidence for the layer auto-selection crossover
-  7. pallas LSTM/GRU on-device parity (--only=lstm,gru)
-  8. attention micro-bench fp32 pass
-  9. full 6-config bench -> PERF_LOG.jsonl snapshot (seq2seq last inside)
+  8. pallas LSTM/GRU on-device parity (--only=lstm,gru)
+  9. attention micro-bench fp32 pass
+  10. full 6-config bench -> PERF_LOG.jsonl snapshot (seq2seq last inside)
 
 Results land under MEASURE/<step>.out (+ PERF_LOG.jsonl via bench.py).
 The parent process never imports jax (a wedged tunnel blocks any backend
@@ -28,7 +30,7 @@ init forever).
 
 Usage: python tools/tpu_measure.py [--skip=parity,attn_bench_f32]
 (step names: parity, parity_rnn, attn_bench, attn_bench_f32,
-additive_bench, bench_lm, bench_quick, bench_full)
+additive_bench, rnn_bench, bench_lm, bench_quick, bench_full)
 """
 
 from __future__ import annotations
@@ -117,6 +119,7 @@ def main() -> int:
         ("bench_quick", [py, "bench.py"], 1500,
          {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
         ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
+        ("rnn_bench", [py, "tools/bench_rnn.py"], 1200, {}),
         ("bench_lm", [py, "tools/bench_lm.py"], 2400, {}),
         ("attn_bench",
          [py, "tools/bench_attention.py", "--lens", "512,1024,2048,4096,16384",
