@@ -1,0 +1,105 @@
+"""Degenerate-shape robustness: zero-length sequences in a batch, B=1,
+T=1, minimal beams — the edges real data pipelines produce (last ragged
+batch, empty documents) and real frameworks break on.  Everything must
+stay finite and exception-free (ref: the reference's empty-sequence
+handling in SequenceToBatch and Argument::checkSubset)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.trainer.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def mixed_model():
+    def conf():
+        from paddle_tpu.dsl import (
+            AdamOptimizer, ParamAttr, SoftmaxActivation, classification_cost,
+            concat_layer, data_layer, embedding_layer, fc_layer, last_seq,
+            layer_norm_layer, multi_head_attention_layer, pooling_layer,
+            settings, simple_gru,
+        )
+        from paddle_tpu.dsl.poolings import AvgPooling
+        settings(batch_size=4, learning_rate=1e-3,
+                 learning_method=AdamOptimizer())
+        w = data_layer(name="w", size=16)
+        emb = embedding_layer(input=w, size=8,
+                              param_attr=ParamAttr(initial_std=0.1))
+        g = simple_gru(input=emb, size=8)
+        att = multi_head_attention_layer(layer_norm_layer(input=emb),
+                                         size=8, num_heads=2, causal=True)
+        feats = concat_layer(input=[
+            pooling_layer(input=g, pooling_type=AvgPooling()),
+            last_seq(input=att)])
+        out = fc_layer(input=feats, size=3, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=3))
+
+    return Trainer(parse_config_callable(conf), seed=0)
+
+
+@pytest.mark.parametrize("name,B,T,lens", [
+    ("zero_len_row", 4, 5, [5, 0, 3, 1]),
+    ("all_zero_len", 4, 5, [0, 0, 0, 0]),
+    ("B1_T1", 1, 1, [1]),
+    ("T1_with_zero", 4, 1, [1, 1, 0, 1]),
+])
+def test_train_survives(mixed_model, name, B, T, lens):
+    rng = np.random.default_rng(0)
+    b = {"w": Argument(ids=rng.integers(0, 16, (B, T)).astype(np.int32),
+                       lengths=np.asarray(lens, np.int32)),
+         "y": Argument(ids=rng.integers(0, 3, B).astype(np.int32))}
+    loss = float(mixed_model.train_one_batch(b))
+    assert np.isfinite(loss), (name, loss)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from paddle_tpu.config.parser import parse_config
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=32,dim=16,layers=1,heads=2,batch_size=4")
+    return Trainer(cfg, seed=0)
+
+
+@pytest.mark.parametrize("B,P,lens,max_new", [
+    (1, 1, [1], 1),           # singleton everything
+    (3, 4, [1, 4, 2], 5),     # ragged prompts incl. length 1
+    (2, 3, [3, 2], 0),        # nothing to generate
+])
+def test_decode_cache_parity_on_edges(lm, B, P, lens, max_new):
+    from paddle_tpu.graph.lm_decode import lm_generate
+    prompt = np.ones((B, P), np.int32)
+    lens = np.asarray(lens, np.int32)
+    t1, l1 = lm_generate(lm.executor, lm.params, prompt,
+                         prompt_lengths=lens, max_new=max_new,
+                         use_cache=True)
+    t2, l2 = lm_generate(lm.executor, lm.params, prompt,
+                         prompt_lengths=lens, max_new=max_new)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_beam_minimal(lm):
+    from paddle_tpu.graph.lm_decode import lm_beam_generate
+    toks, lens, scores = lm_beam_generate(
+        lm.executor, lm.params, np.ones((1, 1), np.int32), max_new=1,
+        beam_size=1)
+    assert np.asarray(toks).shape == (1, 1, 2)
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_nested_ops_with_empty_subsequences():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import sequence as seqops
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3, 4, 5)),
+                    jnp.float32)
+    lens = jnp.asarray([0, 2], jnp.int32)          # row 0: NO sub-seqs
+    subs = jnp.asarray([[0, 0, 0], [0, 3, 0]], jnp.int32)  # empty first sub
+    for fn in (seqops.nested_pool_max, seqops.nested_pool_last,
+               seqops.nested_pool_first):
+        assert np.isfinite(np.asarray(fn(x, lens, subs))).all(), fn.__name__
+    v = np.asarray(seqops.nested_pool_max_per_sub(x, lens, subs))
+    assert np.isfinite(v).all()
+    assert float(np.abs(v[0]).max()) == 0.0        # fully-invalid row -> 0
