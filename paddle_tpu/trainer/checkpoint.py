@@ -134,9 +134,12 @@ def save_checkpoint(
     net_state: Optional[dict] = None,
     config_json: Optional[str] = None,
     keep_last: int = 0,
+    rng=None,
 ) -> str:
     """Write pass-%05d/{model.npz, trainer_config.json}
-    (ref: ParamUtil::saveParametersOnePass)."""
+    (ref: ParamUtil::saveParametersOnePass).  `rng` is the trainer's
+    PRNG key: persisting it makes resume EXACT for stochastic models
+    too (dropout streams continue where the uninterrupted run would)."""
     d = pass_dir(save_dir, pass_id)
     os.makedirs(d, exist_ok=True)
     flat = _flatten(params, "params")
@@ -144,6 +147,8 @@ def save_checkpoint(
         flat.update(_flatten(opt_state, "opt"))
     if net_state is not None:
         flat.update(_flatten(net_state, "net"))
+    if rng is not None:
+        flat["rng"] = np.asarray(rng)
     np.savez(os.path.join(d, "model.npz"), **flat)
     if config_json is not None:
         with open(os.path.join(d, "trainer_config.json"), "w") as f:
@@ -204,6 +209,8 @@ def load_checkpoint(path: str) -> dict[str, Any]:
                if k.startswith(prefix + SEP)}
         trees[prefix] = _unflatten_dicts(sub)
     out: dict[str, Any] = dict(trees)
+    if "rng" in flat:
+        out["rng"] = flat["rng"]
     base = os.path.basename(os.path.dirname(npz))
     m = re.match(r"pass-(\d{5})$", base)
     if m:

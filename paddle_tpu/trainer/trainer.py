@@ -770,7 +770,8 @@ class Trainer:
         # silently skip training pass 0)
         return ckpt.save_checkpoint(
             save_dir, self.pass_id - 1, params, opt_state, net_state,
-            config_json=self.config.to_json(), keep_last=keep_last)
+            config_json=self.config.to_json(), keep_last=keep_last,
+            rng=np.asarray(self.rng))
 
     def load(self, path: str) -> None:
         """(ref: ParamUtil::loadParameters / --init_model_path)."""
@@ -799,6 +800,10 @@ class Trainer:
             self.opt_state = _merge_state(tmpl, data["opt"])
         if data.get("net"):
             self.net_state = jax.tree.map(jnp.asarray, data["net"])
+        if data.get("rng") is not None:
+            # continue the PRNG stream where the saving run left it —
+            # resume is then exact for stochastic (dropout) models too
+            self.rng = jnp.asarray(data["rng"])
         if self.mesh is not None:
             # restore mesh placement (incl. ZeRO-1 slot sharding) — the
             # loaded host arrays would otherwise train replicated, silently
