@@ -1,9 +1,23 @@
-"""Synthetic token-stream provider for the transformer LM demo: sequences
-from a repeated-motif language so next-token prediction is learnable."""
+"""Token-stream provider for the transformer LM demo.
+
+Two modes per file-list entry:
+  * an existing file -> BYTE-LEVEL language modeling over its contents
+    (byte b maps to id b+2; 0=pad, 1=BOS — the zero-dependency tokenizer
+    every byte-LM demo uses, wants vocab >= 258) — point lm_train.list
+    at any text corpus and train for real;
+  * a missing path (the stock `lm_train.list` placeholder) -> the
+    synthetic repeated-motif language, so the demo and its tests run
+    hermetically with no data download.
+"""
+
+import os
 
 import numpy as np
 
 from paddle_tpu.data.provider import integer_value_sequence, provider
+
+_BOS = 1
+_BYTE_OFF = 2
 
 
 def _init(settings, file_list, **kw):
@@ -11,22 +25,46 @@ def _init(settings, file_list, **kw):
     PyDataProvider2 init_hook pattern — providers that depend on a
     dictionary size learn it at initialize() time)."""
     vocab = int(kw.get("vocab", 256))
-    settings.args = vocab
+    settings.args = {"vocab": vocab,
+                     "seq_len": int(kw.get("seq_len", 33))}
     settings.slots = {"tokens": integer_value_sequence(vocab),
                       "next_tokens": integer_value_sequence(vocab)}
+
+
+def _synthetic(vocab, seq_len):
+    rng = np.random.default_rng(7)
+    motifs = [rng.integers(2, vocab, rng.integers(3, 8)).tolist()
+              for _ in range(8)]
+    for _ in range(256):
+        seq = [_BOS]
+        while len(seq) < seq_len:
+            seq += motifs[int(rng.integers(0, len(motifs)))]
+        seq = seq[:seq_len]
+        yield {"tokens": seq[:-1], "next_tokens": seq[1:]}
+
+
+def _byte_stream(filename, vocab, seq_len):
+    data = np.frombuffer(open(filename, "rb").read(), np.uint8)
+    # clip into the table so a small-vocab config still runs (ids beyond
+    # vocab-1 collapse onto the last row rather than crashing the gather)
+    ids = np.minimum(data.astype(np.int64) + _BYTE_OFF, vocab - 1)
+    stride = seq_len - 1
+    for start in range(0, max(len(ids) - 1, 1), stride):
+        body = ids[start:start + stride].tolist()
+        if not body:
+            break
+        seq = [_BOS] + body
+        yield {"tokens": seq[:-1], "next_tokens": seq[1:]}
 
 
 @provider(input_types={"tokens": integer_value_sequence(256),
                        "next_tokens": integer_value_sequence(256)},
           should_shuffle=True, init_hook=_init)
 def process(settings, filename):
-    vocab = settings.args if isinstance(settings.args, int) else 256
-    rng = np.random.default_rng(7)
-    motifs = [rng.integers(2, vocab, rng.integers(3, 8)).tolist()
-              for _ in range(8)]
-    for _ in range(256):
-        seq = [1]                                    # BOS
-        while len(seq) < 33:
-            seq += motifs[int(rng.integers(0, len(motifs)))]
-        seq = seq[:33]
-        yield {"tokens": seq[:-1], "next_tokens": seq[1:]}
+    args = settings.args if isinstance(settings.args, dict) else {}
+    vocab = int(args.get("vocab", 256))
+    seq_len = int(args.get("seq_len", 33))
+    if filename and os.path.exists(filename):
+        yield from _byte_stream(filename, vocab, seq_len)
+    else:
+        yield from _synthetic(vocab, seq_len)

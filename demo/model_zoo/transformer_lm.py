@@ -8,6 +8,10 @@ Train (causal next-token loss on token sequences):
     python -m paddle_tpu train --config=demo/model_zoo/transformer_lm.py \
         --config_args=vocab=32000,dim=512,layers=8,heads=8
 
+Real data: put text-file paths in demo/model_zoo/lm_train.list and the
+provider trains BYTE-LEVEL on their contents (vocab >= 258); the stock
+placeholder list keeps the hermetic synthetic motif stream.
+
 Long sequences scale over a mesh `seq` axis (ring attention) and the
 batch over `data`:  tr = Trainer(cfg, mesh=make_mesh(data=2, seq=4)).
 """
