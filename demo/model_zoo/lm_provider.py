@@ -44,10 +44,17 @@ def _synthetic(vocab, seq_len):
 
 
 def _byte_stream(filename, vocab, seq_len):
-    data = np.frombuffer(open(filename, "rb").read(), np.uint8)
+    data = np.fromfile(filename, np.uint8)
     # clip into the table so a small-vocab config still runs (ids beyond
     # vocab-1 collapse onto the last row rather than crashing the gather)
     ids = np.minimum(data.astype(np.int64) + _BYTE_OFF, vocab - 1)
+    clipped = int((data.astype(np.int64) + _BYTE_OFF >= vocab).sum())
+    if clipped:
+        import logging
+        logging.getLogger("paddle_tpu").warning(
+            "lm_provider: %d bytes of %s clip onto token id %d — byte "
+            "mode wants vocab >= 258 (config arg vocab=)",
+            clipped, filename, vocab - 1)
     stride = seq_len - 1
     for start in range(0, max(len(ids) - 1, 1), stride):
         body = ids[start:start + stride].tolist()
@@ -66,5 +73,12 @@ def process(settings, filename):
     seq_len = int(args.get("seq_len", 33))
     if filename and os.path.exists(filename):
         yield from _byte_stream(filename, vocab, seq_len)
-    else:
+    elif filename == "dummy":
+        # the stock lm_train.list placeholder: hermetic synthetic stream
         yield from _synthetic(vocab, seq_len)
+    else:
+        # any OTHER missing path is a typo'd corpus, not a request for
+        # toy data — silently training on motifs would mask it
+        raise FileNotFoundError(
+            f"lm_provider: {filename!r} does not exist (use the stock "
+            f"'dummy' entry for the synthetic stream)")
