@@ -27,7 +27,7 @@ window = get_config_arg("window", int, 0)             # 0 = full attention
 ffn_mult = get_config_arg("ffn_mult", int, 4)
 batch_size = get_config_arg("batch_size", int, 16)
 compute_dtype = get_config_arg("compute_dtype", str, "")
-attn_impl = get_config_arg("attn_impl", str, "auto")  # auto/dense/flash/blockwise/ring
+attn_impl = get_config_arg("attn_impl", str, "auto")  # auto/dense/flash/blockwise/ring/ulysses
 block_k_min = get_config_arg("block_k_min", int, 0)   # 0 = default crossover
 
 define_py_data_sources2(

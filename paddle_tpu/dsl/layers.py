@@ -985,7 +985,10 @@ def multi_head_attention_layer(
     capability (the reference's closest analog is the additive-attention
     composite simple_attention, ref: networks.py:1257).  Self-attention when
     key/value are omitted.  Picks dense/flash(pallas)/blockwise/ring
-    automatically (graph/layers_attn.py; attn_impl forces one); with a `seq`
+    automatically (graph/layers_attn.py; attn_impl forces one of
+    dense/flash/blockwise/ring/ulysses — 'ulysses' is the all-to-all
+    context-parallel layout, needing a `seq` mesh axis and
+    num_heads % seq_axis == 0); with a `seq`
     mesh axis the sequence is context-parallel via ring attention
     (parallel/context.py).
 
@@ -1025,7 +1028,7 @@ def multi_head_attention_layer(
         cfg.attrs["block_k"] = block_k
     if block_k_min is not None:      # min key length to leave the dense path
         cfg.attrs["block_k_min"] = block_k_min
-    if attn_impl is not None:        # force dense/flash/blockwise/ring
+    if attn_impl is not None:  # dense/flash/blockwise/ring/ulysses
         cfg.attrs["attn_impl"] = attn_impl
     if num_kv_heads is not None:     # grouped-query attention
         cfg.attrs["num_kv_heads"] = num_kv_heads

@@ -12,7 +12,11 @@ long-context attention with three execution paths picked automatically:
     portable long-sequence fallback; ops/attention.py:blockwise_attention),
   * ring    — context parallelism when the executor's mesh has a `seq` axis
     of size > 1: each device holds a sequence shard and K/V rotate around
-    the ICI ring (parallel/context.py:ring_attention_sharded).
+    the ICI ring (parallel/context.py:ring_attention_sharded),
+  * ulysses — the all-to-all context-parallel alternative (explicit
+    attn_impl='ulysses'): tokens reshard to heads, local full-sequence
+    attention, reshard back (parallel/context.py:ulysses_attention_sharded)
+    — prefer when heads >= the seq-axis size.
 """
 
 from __future__ import annotations
@@ -65,12 +69,14 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
 
     mesh = ctx.mesh
     from paddle_tpu.ops import pallas_attention
-    from paddle_tpu.parallel.context import ring_attn_fn, seq_axis_size
+    from paddle_tpu.parallel.context import (ring_attn_fn, seq_axis_size,
+                                             ulysses_attn_fn)
     impl = str(cfg.attrs.get("attn_impl", "auto"))
-    if impl not in ("auto", "ring", "flash", "blockwise", "dense"):
+    if impl not in ("auto", "ring", "ulysses", "flash", "blockwise",
+                    "dense"):
         raise ValueError(
             f"layer {cfg.name!r}: unknown attn_impl {impl!r} "
-            f"(expected auto/ring/flash/blockwise/dense)")
+            f"(expected auto/ring/ulysses/flash/blockwise/dense)")
     if impl == "auto":
         if mesh is not None and seq_axis_size(mesh) > 1:
             impl = "ring"
@@ -79,13 +85,19 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
             impl = "flash" if pallas_attention.supported() else "blockwise"
         else:
             impl = "dense"
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         if mesh is None or seq_axis_size(mesh) < 2:
             raise ValueError(
-                f"layer {cfg.name!r}: attn_impl='ring' needs the executor "
+                f"layer {cfg.name!r}: attn_impl={impl!r} needs the executor "
                 f"mesh to have a `seq` axis of size >= 2 (got "
                 f"{'no mesh' if mesh is None else dict(zip(mesh.axis_names, mesh.devices.shape))})")
-        attn_fn = ring_attn_fn(mesh)
+        attn_fn = (ulysses_attn_fn(
+                       mesh,
+                       block_k=(int(cfg.attrs["block_k"])
+                                if "block_k" in cfg.attrs else None),
+                       block_k_min=(int(cfg.attrs["block_k_min"])
+                                    if "block_k_min" in cfg.attrs else None))
+                   if impl == "ulysses" else ring_attn_fn(mesh))
     elif impl == "flash":
         if not pallas_attention.supported():
             raise ValueError(
@@ -157,13 +169,14 @@ def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
         # honor an explicit attn_impl like the regular forward does (a
         # config pinned to dense — e.g. to sidestep a pallas issue or for
         # a dense-vs-flash bench — must not silently get flash prefill);
-        # 'ring' has no cached-decode analog, so it falls through to the
-        # local auto-selection
+        # 'ring'/'ulysses' have no cached-decode analog, so they fall
+        # through to the local auto-selection
         impl = str(cfg.attrs.get("attn_impl", "auto"))
-        if impl not in ("auto", "ring", "flash", "blockwise", "dense"):
+        if impl not in ("auto", "ring", "ulysses", "flash", "blockwise",
+                        "dense"):
             raise ValueError(
                 f"layer {cfg.name!r}: unknown attn_impl {impl!r} "
-                f"(expected auto/ring/flash/blockwise/dense)")
+                f"(expected auto/ring/ulysses/flash/blockwise/dense)")
         long_prompt = Tn >= int(cfg.attrs.get("block_k_min",
                                               _BLOCKWISE_MIN_KEYS))
         if impl == "flash":

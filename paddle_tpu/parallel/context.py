@@ -48,6 +48,27 @@ def shard_sequence(mesh: Mesh, x: Array) -> Array:
     return global_put(x, NamedSharding(mesh, P(*spec)))
 
 
+def _sharded_ctx_call(mesh, wrapped, q, k, v, q_valid, k_valid,
+                      use_flash: bool):
+    """Shared shard_map scaffolding for the context-parallel entries:
+    batch on `data`, tokens on `seq`, optional masks threaded with
+    placeholder args (shard_map needs every arg speced).  check_vma stays
+    ON for the pure-jnp paths, where it validates the collective
+    plumbing; pallas_call outputs carry no varying-mesh-axes annotation,
+    so the flash path must opt out."""
+    d = _data_axis(mesh)
+    qkv_spec = P(d, SEQ_AXIS, None, None)
+    val_spec = P(d, SEQ_AXIS)
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    args = [q, k, v]
+    for m in (q_valid, k_valid):
+        in_specs.append(val_spec if m is not None else P())
+        args.append(m if m is not None else jnp.zeros((), q.dtype))
+    fn = shard_map(wrapped, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=qkv_spec, check_vma=not use_flash)
+    return fn(*args)
+
+
 def ring_attention_sharded(
     mesh: Mesh,
     q: Array, k: Array, v: Array,          # [B, T, H, Dh], T % seq_axis == 0
@@ -60,37 +81,19 @@ def ring_attention_sharded(
     """Context-parallel attention over the mesh: batch sharded on `data`,
     time sharded on `seq`, ring over the seq axis.  Works under an outer
     jit — shard_map composes with the surrounding compiled step."""
-    d = _data_axis(mesh)
-    qkv_spec = P(d, SEQ_AXIS, None, None)
-    val_spec = P(d, SEQ_AXIS)
-
-    # resolve the flash choice HERE (outside shard_map) so the vma check
-    # stays on for the pure-jnp ring, where it still validates the
-    # ppermute/accumulator plumbing; pallas_call outputs carry no
-    # varying-mesh-axes annotation, so the flash path must opt out
+    # resolve the flash choice OUTSIDE shard_map (see _sharded_ctx_call)
     from paddle_tpu.ops import pallas_attention
     use_flash = pallas_attention.supported()
-
-    def local(q, k, v, q_valid, k_valid):
-        return ring_attention(q, k, v, SEQ_AXIS, q_valid=q_valid,
-                              k_valid=k_valid, causal=causal, scale=scale,
-                              use_flash=use_flash, window=window)
-
-    in_specs = [qkv_spec, qkv_spec, qkv_spec]
-    args = [q, k, v]
-    # shard_map needs every arg speced; thread optional masks only if present
-    for m in (q_valid, k_valid):
-        in_specs.append(val_spec if m is not None else P())
-        args.append(m if m is not None else jnp.zeros((), q.dtype))
 
     def wrapped(q, k, v, qm, km):
         qv = qm if q_valid is not None else None
         kv = km if k_valid is not None else None
-        return local(q, k, v, qv, kv)
+        return ring_attention(q, k, v, SEQ_AXIS, q_valid=qv, k_valid=kv,
+                              causal=causal, scale=scale,
+                              use_flash=use_flash, window=window)
 
-    fn = shard_map(wrapped, mesh=mesh, in_specs=tuple(in_specs),
-                   out_specs=qkv_spec, check_vma=not use_flash)
-    return fn(*args)
+    return _sharded_ctx_call(mesh, wrapped, q, k, v, q_valid, k_valid,
+                             use_flash)
 
 
 def ring_attn_fn(mesh: Mesh, causal_default: bool = False):
@@ -101,4 +104,99 @@ def ring_attn_fn(mesh: Mesh, causal_default: bool = False):
         return ring_attention_sharded(mesh, q, k, v, q_valid=q_valid,
                                       k_valid=k_valid, causal=causal,
                                       scale=scale, window=window)
+    return fn
+
+
+def ulysses_attention_sharded(
+    mesh: Mesh,
+    q: Array, k: Array, v: Array,          # [B, T, H, Dh], T % seq_axis == 0
+    q_valid: Optional[Array] = None,       # [B, T]
+    k_valid: Optional[Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    block_k: Optional[int] = None,
+    block_k_min: Optional[int] = None,
+) -> Array:
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses design) —
+    the OTHER standard context-parallel layout beside the ring:
+
+      tokens sharded [B, T/P, H, D]
+        --all_to_all-->  heads sharded [B, T, H/P, D]
+        --local full-sequence attention (flash on TPU)-->
+        --all_to_all-->  tokens sharded [B, T/P, H, D]
+
+    Two activation exchanges per layer instead of the ring's P-1 K/V
+    rotations: communication is O(T*H*D/P) regardless of P, and the
+    attention itself is a plain full-sequence call (any impl, no
+    online-softmax combine).  Prefer it when heads >= the seq-axis size
+    and ICI all-to-all bandwidth is plentiful; prefer the ring when
+    per-device memory for the full [B, T, H/P] sequence is the binding
+    constraint or H < P.  Requires H (and kv heads) % seq_axis == 0.
+    """
+    Pseq = axis_size(mesh, SEQ_AXIS)
+    H, H_kv = q.shape[2], k.shape[2]
+    assert H % Pseq == 0, (
+        f"ulysses needs num_heads {H} divisible by the seq axis ({Pseq})")
+    assert H_kv % Pseq == 0, (
+        f"ulysses needs num_kv_heads {H_kv} divisible by the seq axis "
+        f"({Pseq}); use attn_impl='ring' for narrower GQA")
+    import functools
+
+    from paddle_tpu.ops import pallas_attention
+    from paddle_tpu.ops.attention import (blockwise_attention,
+                                          dot_product_attention)
+    use_flash = pallas_attention.supported()
+    T = q.shape[1]
+    if block_k_min is None:
+        # the ONE measured dense/blockwise crossover constant
+        from paddle_tpu.graph.layers_attn import _BLOCKWISE_MIN_KEYS
+        block_k_min = _BLOCKWISE_MIN_KEYS
+    if use_flash:
+        attn = (functools.partial(pallas_attention.flash_attention,
+                                  block_k=block_k)
+                if block_k else pallas_attention.flash_attention)
+    elif T >= block_k_min:
+        attn = (functools.partial(blockwise_attention, block_k=block_k)
+                if block_k else blockwise_attention)
+    else:
+        attn = dot_product_attention
+
+    def wrapped(q, k, v, qm, km):
+        # token-shard -> head-shard: split heads (axis 2) over the seq
+        # axis, concatenate token shards (axis 1) — tiled all_to_all
+        # preserves the device order, so tokens land in GLOBAL order
+        def a2a_fwd(x):
+            return jax.lax.all_to_all(x, SEQ_AXIS, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+        qvg = (jax.lax.all_gather(qm, SEQ_AXIS, axis=1, tiled=True)
+               if q_valid is not None else None)
+        kvg = (jax.lax.all_gather(km, SEQ_AXIS, axis=1, tiled=True)
+               if k_valid is not None else None)
+        out = attn(qg, kg, vg, q_valid=qvg, k_valid=kvg, causal=causal,
+                   **({"scale": scale} if scale is not None else {}),
+                   **({"window": window} if window is not None else {}))
+        # head-shard -> token-shard
+        return jax.lax.all_to_all(out, SEQ_AXIS, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    return _sharded_ctx_call(mesh, wrapped, q, k, v, q_valid, k_valid,
+                             use_flash)
+
+
+def ulysses_attn_fn(mesh: Mesh, causal_default: bool = False,
+                    block_k: Optional[int] = None,
+                    block_k_min: Optional[int] = None):
+    """An `attn_fn` for ops.attention.multi_head_attention that routes
+    through the all-to-all resharding. Signature matches
+    dot_product_attention."""
+    def fn(q, k, v, q_valid=None, k_valid=None, causal=causal_default,
+           scale=None, window=None):
+        return ulysses_attention_sharded(mesh, q, k, v, q_valid=q_valid,
+                                         k_valid=k_valid, causal=causal,
+                                         scale=scale, window=window,
+                                         block_k=block_k,
+                                         block_k_min=block_k_min)
     return fn
