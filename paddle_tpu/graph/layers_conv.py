@@ -356,6 +356,17 @@ def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
         mean = state["mean"].reshape(stat_shape)
         var = state["var"].reshape(stat_shape)
         new_state = state
+        if cfg.use_global_stats is True and ctx.state_in.get(cfg.name) is None:
+            # explicitly-frozen BN with no stats to carry is a PURE function
+            # (fixed mean-0/var-1 affine): registering no state keeps it
+            # usable under config-driven pipeline parallelism, whose stage
+            # ring has no mutable-state channel (parallel/pipeline_config).
+            # Loaded/carried stats (fine-tune-frozen BN) still round-trip
+            # through state_out below.
+            return finish_layer(
+                ctx, cfg, _bn_normalize(v4, mean, var, scale, bias,
+                                        stat_shape, eps).reshape(v.shape)
+                .astype(v.dtype), like=x, nhwc=img)
     else:
         # statistics in >= float32 (promote bf16/f16 under mixed precision;
         # keep f64 in f64 for the grad-check tests)
@@ -370,13 +381,19 @@ def batch_norm_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
             "count": state["count"] + 1,
         }
     ctx.state_out[cfg.name] = new_state
+    return finish_layer(
+        ctx, cfg, _bn_normalize(v4, mean, var, scale, bias, stat_shape,
+                                eps).reshape(v.shape).astype(v.dtype),
+        like=x, nhwc=img)
+
+
+def _bn_normalize(v4, mean, var, scale, bias, stat_shape, eps):
     stat_dt = mean.dtype
     normed = (v4.astype(stat_dt) - mean) / jnp.sqrt(var + eps)
     normed = normed * scale.reshape(stat_shape).astype(stat_dt)
     if bias is not None:
         normed = normed + bias.reshape(stat_shape).astype(stat_dt)
-    return finish_layer(ctx, cfg, normed.reshape(v.shape).astype(v.dtype),
-                        like=x, nhwc=img)
+    return normed
 
 
 @register_layer("data_norm")

@@ -458,9 +458,13 @@ class PipelineExecutor:
             params_sds, mb_feed)
         assert not state, (
             f"layers with mutable state {sorted(state)} are not supported "
-            f"under pipeline parallelism yet (batch-norm moving stats would "
-            f"need per-stage state routing); train this config without "
-            f"device= annotations or swap BN for a stateless norm")
+            f"under pipeline parallelism (batch-norm moving stats would "
+            f"need per-stage state routing, and per-microbatch stats would "
+            f"change the training numerics vs the un-pipelined oracle). "
+            f"Supported pattern: freeze the stats with "
+            f"batch_norm_layer(..., use_global_stats=True) — explicitly-"
+            f"frozen BN is stateless and pipelines exactly; or train this "
+            f"config without device= annotations")
         specs = []
         for names in self.payload_names:
             row = []

@@ -23,33 +23,57 @@ def _load_bench():
     return mod
 
 
-def test_last_known_good_picks_newest_complete(tmp_path):
+def test_assemble_lkg_stitches_per_config_records(tmp_path):
+    """The round-5 short-window queue banks ONE config per PERF_LOG record
+    (bench.py + BENCH_ONLY); the assembler must stitch the newest
+    occurrence of every part — whether nested under a full run or its own
+    top-level record — each stamped measured_at, with errored/skipped
+    parts never advertised as known-good."""
     bench = _load_bench()
+    M = bench._METRIC_OF
     log = tmp_path / "PERF_LOG.jsonl"
     rows = [
         {"ts": "2026-07-29T10:00:00+00:00",
-         "record": {"value": 100.0, "vs_baseline": 2.0,
-                    "seq2seq": {"value": 5.0}}},
-        {"ts": "2026-07-30T10:00:00+00:00",
-         "record": {"value": 200.0, "vs_baseline": 4.0,
-                    "seq2seq": {"error": "timeout after 900s"},
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0,
+                    "platform": "tpu",
+                    "seq2seq": {"metric": M["seq2seq"], "value": 5.0},
                     "mnist": {"skipped": "budget"},
-                    "sentiment": {"value": 9.0}}},
+                    "lm": {"error": "timeout"}}},
+        # newer per-config records (the BENCH_ONLY queue shape)
+        {"ts": "2026-07-30T10:00:00+00:00",
+         "record": {"metric": M["sentiment"], "value": 9.0,
+                    "vs_baseline": 1.0,
+                    "measured_at": "2026-07-30T10:00:00+00:00"}},
         {"ts": "2026-07-30T11:00:00+00:00",
-         "record": {"error": "boom", "value": 0.0}},   # errored: not LKG
+         "record": {"metric": M["vgg"], "value": 200.0, "vs_baseline": 4.0,
+                    "platform": "tpu", "device_kind": "TPU v5 lite",
+                    "measured_at": "2026-07-30T11:00:00+00:00"}},
+        # decode-phase record merges into the seq2seq part
+        {"ts": "2026-07-30T12:00:00+00:00",
+         "record": {"metric": "wmt14_seq2seq_beam_decode_tokens_per_sec",
+                    "value": 60000.0, "beam_decode_tokens_per_sec": 60000.0,
+                    "measured_at": "2026-07-30T12:00:00+00:00"}},
+        {"ts": "2026-07-30T13:00:00+00:00",
+         "record": {"metric": M["vgg"], "error": "boom", "value": 0.0}},
         "not json at all",
     ]
     log.write_text("\n".join(r if isinstance(r, str) else json.dumps(r)
                              for r in rows) + "\n")
     bench._PERF_LOG = str(log)
 
-    lkg = bench._last_known_good()
-    assert lkg["ts"] == "2026-07-30T10:00:00+00:00"
-    rec = lkg["record"]
-    assert rec["value"] == 200.0
-    # errored/skipped extras must NOT be advertised as known-good
-    assert "seq2seq" not in rec and "mnist" not in rec
-    assert rec["sentiment"] == {"value": 9.0}
+    out = bench._assemble_lkg()
+    assert out["value"] == 200.0                      # newest valid headline
+    assert out["measured_at"] == "2026-07-30T11:00:00+00:00"
+    assert out["platform"] == "tpu"                   # provenance preserved
+    assert out["sentiment"]["value"] == 9.0
+    # errored/skipped parts must NOT be advertised as known-good
+    assert "mnist" not in out and "lm" not in out
+    # seq2seq train came from the old full run; decode merged from the
+    # newer phase-isolated record
+    assert out["seq2seq"]["value"] == 5.0
+    assert out["seq2seq"]["beam_decode_tokens_per_sec"] == 60000.0
+    assert out["seq2seq"]["beam_decode_measured_at"] == \
+        "2026-07-30T12:00:00+00:00"
 
 
 def test_degraded_record_merges_lkg(tmp_path):
@@ -80,10 +104,11 @@ def test_degraded_record_without_lkg(tmp_path):
 def test_append_perf_log_roundtrip(tmp_path):
     bench = _load_bench()
     bench._PERF_LOG = str(tmp_path / "PERF_LOG.jsonl")
-    bench._append_perf_log({"metric": "m", "value": 7.0, "vs_baseline": 1.1})
-    lkg = bench._last_known_good()
-    assert lkg["record"]["value"] == 7.0
-    assert "T" in lkg["ts"]                   # ISO timestamp
+    bench._append_perf_log({"metric": bench._METRIC_OF["vgg"], "value": 7.0,
+                            "vs_baseline": 1.1})
+    out = bench._assemble_lkg()
+    assert out["value"] == 7.0
+    assert "T" in out["measured_at"]          # ISO timestamp (from log ts)
 
 
 def test_spawn_reports_timeout_as_error():

@@ -1,40 +1,36 @@
 """One-shot TPU measurement session — run the moment the tunnel is up.
 
-The axon TPU tunnel dies unpredictably (it killed the round-2 bench
-record), so every pending on-hardware measurement is queued here in
-priority order, each in its OWN subprocess under a hard timeout with its
-output persisted immediately — a mid-session tunnel death keeps
-everything already measured.  Priorities (VERDICT round 2):
+The axon TPU tunnel dies unpredictably and healthy windows are SHORT
+(~20 min observed r4), so every pending on-hardware measurement is queued
+here in priority order, each in its OWN subprocess under a hard timeout
+with its output persisted immediately — a mid-session tunnel death keeps
+everything already measured.  Round-5 refit (VERDICT r4 item 1):
 
-  1. backend health probe
-  2. flash + additive on-device parity (tools/tpu_parity.py
-     --only=flash,additive) — VERDICT priority 1, the only unproven
-     kernels; per-case output persists if the window dies mid-run
-  3. quick bench (vgg + lm + seq2seq-last) -> PERF_LOG.jsonl snapshot —
-     the north-star record, early because healthy windows are short
-  4. additive-attention kernel vs jnp (tools/bench_additive.py) —
-     evidence for the decoder-step routing default
-  5. pallas LSTM/GRU kernels vs lax.scan (tools/bench_rnn.py) — the
-     RNN routing evidence
-  6. transformer-LM train MFU + decode tokens/s per context length
-     (tools/bench_lm.py)
-  7. attention micro-bench across lengths, bf16 (tools/bench_attention.py)
-     — evidence for the layer auto-selection crossover
-  8. pallas LSTM/GRU on-device parity (--only=lstm,gru)
-  9. attention micro-bench fp32 pass
-  10. full 6-config bench -> PERF_LOG.jsonl snapshot (seq2seq last inside)
+- ONE CONFIG PER STEP: each BASELINE config is its own `bench.py`
+  invocation (BENCH_ONLY=...) that banks its own PERF_LOG.jsonl record;
+  bench.py's assembler stitches them into a complete record at driver
+  time, so a window only ever needs to afford the next step, not the
+  whole matrix.
+- SKIP WHAT'S BANKED: parity cases already green in the ledger under the
+  current code hash are skipped (tools/tpu_parity.py --skip-passed);
+  bench steps whose metric has a PERF_LOG record fresher than
+  --fresh-hours (default 6) and micro-bench steps whose MEASURE/*.out is
+  rc=0 and fresher are skipped — so the poller's repeated reruns are
+  incremental across windows.
+- seq2seq is LAST and phase-split (train / decode-only / full): the
+  tunnel wedged inside this bench in rounds 2 AND 4 and nobody knows
+  which half — the step that wedges IS the bisect evidence.
 
 Results land under MEASURE/<step>.out (+ PERF_LOG.jsonl via bench.py).
 The parent process never imports jax (a wedged tunnel blocks any backend
 init forever).
 
-Usage: python tools/tpu_measure.py [--skip=parity,attn_bench_f32]
-(step names: parity, parity_rnn, attn_bench, attn_bench_f32,
-additive_bench, rnn_bench, bench_lm, bench_quick, bench_full)
+Usage: python tools/tpu_measure.py [--skip=step1,step2] [--fresh-hours=6]
 """
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import signal
@@ -44,6 +40,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "MEASURE")
+sys.path.insert(0, REPO)
+
+from bench import _METRIC_OF  # noqa: E402  (stdlib-only import)
 
 
 def run_step(name: str, argv: list[str], timeout_s: float,
@@ -93,8 +92,78 @@ def health(timeout_s: float = 90) -> bool:
     return ok
 
 
+# ---------------------------------------------------------------------------
+# freshness checks (all stdlib; never import jax here)
+# ---------------------------------------------------------------------------
+
+def _age_hours(ts_iso: str) -> float:
+    try:
+        ts = datetime.datetime.fromisoformat(ts_iso)
+        now = datetime.datetime.now(ts.tzinfo or datetime.timezone.utc)
+        return (now - ts).total_seconds() / 3600.0
+    except ValueError:
+        return 1e9
+
+
+def _metric_fresh(metric: str, hours: float, need_field: str = "") -> str:
+    """Non-empty reason iff PERF_LOG has a fresh enough record carrying
+    `metric` (top-level or nested part), optionally requiring a field."""
+    try:
+        with open(os.path.join(REPO, "PERF_LOG.jsonl")) as f:
+            lines = f.readlines()
+    except OSError:
+        return ""
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        r = rec.get("record")
+        if not isinstance(r, dict):
+            continue
+        parts = [r] + [v for v in r.values() if isinstance(v, dict)]
+        for p in parts:
+            if p.get("metric") == metric and p.get("value") and \
+                    "error" not in p and \
+                    (not need_field or need_field in p):
+                age = _age_hours(p.get("measured_at") or rec.get("ts", ""))
+                if age < hours:
+                    return f"fresh PERF_LOG record (age {age:.1f}h)"
+    return ""
+
+
+def _out_fresh(step: str, hours: float) -> str:
+    path = os.path.join(OUT, f"{step}.out")
+    try:
+        with open(path) as f:
+            first = f.readline()
+        if not first.startswith("# rc=0"):
+            return ""
+        age = (time.time() - os.path.getmtime(path)) / 3600.0
+        return f"fresh rc=0 output (age {age:.1f}h)" if age < hours else ""
+    except OSError:
+        return ""
+
+
+def _parity_pending(only: str) -> int:
+    """How many parity cases are NOT yet green under the current code hash —
+    computed by tpu_parity --list itself (the same _ledger_passed replay
+    that --skip-passed uses, so this can never disagree with the actual
+    skipping).  -1 when the listing fails (then just run the step)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "tools/tpu_parity.py", "--list",
+             f"--only={only}"],
+            timeout=120, capture_output=True, text=True, cwd=REPO)
+        listing = json.loads(p.stdout.strip().splitlines()[-1])
+        return len(listing["pending"])
+    except Exception:
+        return -1
+
+
 def main() -> int:
     skip: set[str] = set()
+    fresh_hours = 6.0
     args = list(sys.argv[1:])
     while args:
         a = args.pop(0)
@@ -102,38 +171,103 @@ def main() -> int:
             skip |= set(a.split("=", 1)[1].split(","))
         elif a == "--skip" and args:
             skip |= set(args.pop(0).split(","))
+        elif a.startswith("--fresh-hours="):
+            fresh_hours = float(a.split("=", 1)[1])
     if not health():
         print(json.dumps({"fatal": "TPU not healthy; nothing run"}))
         return 1
 
     py = sys.executable
-    # Ordered by marginal value per healthy-tunnel minute.  Healthy windows
-    # have been SHORT (r4: ~22 min), and the tunnel wedged DURING the
-    # seq2seq bench in both r2 and r4 — so: flash parity first (VERDICT
-    # priority 1, the only unproven kernels; partial output persists if
-    # the window dies mid-case), then the full bench record with seq2seq
-    # ordered last inside bench.py, then the sweeps.
+    fh = fresh_hours
+
+    def bench_env(only, budget, extra=None):
+        env = {"BENCH_ONLY": only, "BENCH_TIME_BUDGET_S": str(budget)}
+        env.update(extra or {})
+        return env
+
+    # Ordered by marginal value per healthy-tunnel minute (VERDICT r4
+    # items 1-7).  done() returning a non-empty reason skips the step.
+    #  (name, argv, timeout_s, env, done)
     steps = [
-        ("parity", [py, "tools/tpu_parity.py", "--only=flash,additive"],
-         2700, {}),
-        ("bench_quick", [py, "bench.py"], 1500,
-         {"BENCH_EXTENDED": "0", "BENCH_TIME_BUDGET_S": "1200"}),
-        ("additive_bench", [py, "tools/bench_additive.py"], 900, {}),
-        ("rnn_bench", [py, "tools/bench_rnn.py"], 1200, {}),
-        ("bench_lm", [py, "tools/bench_lm.py"], 2400, {}),
+        # (a) flash+additive parity — the fp32 precision fix and the
+        # remaining Mosaic-risk shapes have never been verified on device
+        ("parity",
+         [py, "tools/tpu_parity.py", "--only=flash,additive",
+          "--skip-passed"], 1500, {},
+         lambda: "all cases green in ledger"
+         if _parity_pending("flash,additive") == 0 else ""),
+        # (b) headline + the three never-benched BASELINE configs + LM
+        ("bench_vgg", [py, "bench.py"], 760, bench_env("vgg", 700),
+         lambda: _metric_fresh(_METRIC_OF["vgg"], fh)),
+        ("bench_sentiment", [py, "bench.py"], 660,
+         bench_env("sentiment", 600),
+         lambda: _metric_fresh(_METRIC_OF["sentiment"], fh)),
+        ("bench_mnist", [py, "bench.py"], 560, bench_env("mnist", 500),
+         lambda: _metric_fresh(_METRIC_OF["mnist"], fh)),
+        ("bench_recommendation", [py, "bench.py"], 660,
+         bench_env("recommendation", 600),
+         lambda: _metric_fresh(_METRIC_OF["recommendation"], fh)),
+        ("bench_lm_record", [py, "bench.py"], 900, bench_env("lm", 840),
+         lambda: _metric_fresh(_METRIC_OF["lm"], fh)),
+        # (c) the VGG regression evidence: xplane profile banked on disk
+        ("profile_vgg", [py, "tools/profile_vgg.py"], 700, {},
+         lambda: _out_fresh("profile_vgg", fh)),
+        # (d) RNN kernels: zero hardware executions before this round
+        ("parity_rnn",
+         [py, "tools/tpu_parity.py", "--only=lstm,gru", "--skip-passed"],
+         1500, {},
+         lambda: "all cases green in ledger"
+         if _parity_pending("lstm,gru") == 0 else ""),
+        ("rnn_bench", [py, "tools/bench_rnn.py"], 900, {},
+         lambda: _out_fresh("rnn_bench", fh)),
+        # (e) sweeps: attention crossover (dispatch-proof timing), LM
+        # context sweep, additive kernel re-check
         ("attn_bench",
-         [py, "tools/bench_attention.py", "--lens", "512,1024,2048,4096,16384",
-          "--iters", "10"], 1500, {}),
-        ("parity_rnn", [py, "tools/tpu_parity.py", "--only=lstm,gru"],
-         1800, {}),
+         [py, "tools/bench_attention.py",
+          "--lens", "512,1024,2048,4096,8192,16384"], 1200, {},
+         lambda: _out_fresh("attn_bench", fh)),
+        ("bench_lm", [py, "tools/bench_lm.py"], 1500, {},
+         lambda: _out_fresh("bench_lm", fh)),
+        ("additive_bench", [py, "tools/bench_additive.py"], 400, {},
+         lambda: _out_fresh("additive_bench", fh)),
         ("attn_bench_f32",
          [py, "tools/bench_attention.py", "--lens", "512,1024,4096",
-          "--iters", "10", "--dtype", "float32"], 900, {}),
+          "--dtype", "float32"], 700, {},
+         lambda: _out_fresh("attn_bench_f32", fh)),
+        # (f) seq2seq LAST, phase-split: whichever step wedges bisects the
+        # r2/r4 tunnel wedge (train scan vs beam program)
+        ("s2s_train", [py, "bench.py"], 760,
+         bench_env("seq2seq", 700, {"BENCH_S2S_PHASE": "train"}),
+         lambda: _metric_fresh(_METRIC_OF["seq2seq"], fh)),
+        ("s2s_decode", [py, "bench.py"], 760,
+         bench_env("seq2seq", 700, {"BENCH_S2S_PHASE": "decode"}),
+         lambda: _metric_fresh("wmt14_seq2seq_beam_decode_tokens_per_sec",
+                               fh)),
+        # satisfied EITHER by one combined record OR by both phase-split
+        # records being fresh (bench.py's _assemble_lkg merges the decode
+        # part into the train part) — the wedge-prone full bench must not
+        # re-run when its halves just banked
+        ("s2s_full", [py, "bench.py"], 1000,
+         bench_env("seq2seq", 940),
+         lambda: _metric_fresh(_METRIC_OF["seq2seq"], fh,
+                               need_field="beam_decode_tokens_per_sec")
+         or (_metric_fresh(_METRIC_OF["seq2seq"], fh)
+             and _metric_fresh("wmt14_seq2seq_beam_decode_tokens_per_sec",
+                               fh)
+             and "train+decode phase records both fresh")),
+        # (g) one complete single-record run, only if something above
+        # left a config stale
         ("bench_full", [py, "bench.py"], 2400,
-         {"BENCH_TIME_BUDGET_S": "2100"}),
+         {"BENCH_TIME_BUDGET_S": "2100"},
+         lambda: "all six metrics fresh"
+         if all(_metric_fresh(m, fh) for m in _METRIC_OF.values()) else ""),
     ]
-    for name, argv, to, env in steps:
+    for name, argv, to, env, done in steps:
         if name in skip:
+            continue
+        reason = done()
+        if reason:
+            print(json.dumps({"step": name, "skipped": reason}), flush=True)
             continue
         ok = run_step(name, argv, to, env)
         if not ok and not health(90):
