@@ -602,17 +602,22 @@ def _assemble_lkg() -> dict | None:
         if top is not None and (part is None or
                                 str(top["measured_at"]) > str(part.get("measured_at", ""))):
             part = top
-        if key == "seq2seq" and part is not None and \
-                "beam_decode_tokens_per_sec" not in part:
+        if key == "seq2seq" and (part is None or
+                                 "beam_decode_tokens_per_sec" not in part):
             # decode is measured by its own phase-isolated step — merge the
-            # newest decode-only record into the train part
+            # newest decode-only record into the train part (or surface it
+            # alone when the train phase never banked: a measured number
+            # must not vanish from the fallback)
             dec = newest_toplevel("wmt14_seq2seq_beam_decode_tokens_per_sec")
             if dec is not None:
-                for f in ("beam_decode_tokens_per_sec",
-                          "beam_decode_tokens_per_sec_iqr"):
-                    if f in dec:
-                        part[f] = dec[f]
-                part["beam_decode_measured_at"] = dec["measured_at"]
+                if part is None:
+                    part = dec
+                else:
+                    for f in ("beam_decode_tokens_per_sec",
+                              "beam_decode_tokens_per_sec_iqr"):
+                        if f in dec:
+                            part[f] = dec[f]
+                    part["beam_decode_measured_at"] = dec["measured_at"]
         if part is not None:
             out[key] = part
             found_any = True

@@ -76,6 +76,27 @@ def test_assemble_lkg_stitches_per_config_records(tmp_path):
         "2026-07-30T12:00:00+00:00"
 
 
+def test_assemble_lkg_decode_only_survives_missing_train(tmp_path):
+    """s2s_decode can bank while s2s_train wedges — the measured decode
+    number must still surface in the assembled fallback."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-07-30T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-07-30T12:00:00+00:00",
+         "record": {"metric": "wmt14_seq2seq_beam_decode_tokens_per_sec",
+                    "value": 61000.0,
+                    "beam_decode_tokens_per_sec": 61000.0,
+                    "measured_at": "2026-07-30T12:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["seq2seq"]["beam_decode_tokens_per_sec"] == 61000.0
+
+
 def test_degraded_record_merges_lkg(tmp_path):
     bench = _load_bench()
     log = tmp_path / "PERF_LOG.jsonl"

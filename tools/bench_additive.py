@@ -8,7 +8,7 @@ shape: the step runs T_dec times inside lax.scan with a dummy carry, fwd
 + bwd, bf16 by default.
 
 Usage: python tools/bench_additive.py [--batch 64] [--enc-len 30]
-       [--dec-len 30] [--dim 512] [--iters 20] [--dtype bfloat16]
+       [--dec-len 30] [--dim 512] [--reps 3] [--dtype bfloat16]
 Prints one JSON line per implementation.
 """
 
@@ -18,7 +18,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -27,39 +26,45 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench_impl(name, step_fn, args, dec_len, iters):
-    dec0, w, v, proj, seq, mask = args
+def bench_impl(name, step_fn, args, dec_len, reps):
+    """Times the full fwd+bwd decoder-scan step with the dispatch-proof
+    chained-scan harness (tools/_scan_bench.py) — the r4 numbers from the
+    old block_until_ready loop were physically impossible (0.028 ms for
+    ~10 GFLOP of work) and are superseded."""
+    from _scan_bench import fold, scan_length, timed_chain
 
-    @jax.jit
-    def train_step(dec0, w, v, proj, seq, mask):
+    dec0, w, v, proj, seq, mask = args
+    B, T, D = proj.shape
+
+    def train_step(carry):
+        w, v, proj, seq = carry
+
         # grads w.r.t. proj/seq too: in real training the encoder states
         # are computed from trained params, and their per-step [B, T, D]
         # cotangent accumulation is the bandwidth-heavy half of backward —
         # eliding it would bias the kernel-routing decision
         def loss(w, v, proj, seq):
-            def body(carry, _):
-                ctxv = step_fn(carry, w, v, proj, seq, mask)
+            def body(c, _):
+                ctxv = step_fn(c, w, v, proj, seq, mask)
                 # small mixing matmul stands in for the GRU: the carry must
                 # depend on the context so the scan is sequential like the
                 # real decoder
-                new = jnp.tanh(ctxv @ w[: ctxv.shape[-1], : carry.shape[-1]]
-                               + carry)
+                new = jnp.tanh(ctxv @ w[: ctxv.shape[-1], : c.shape[-1]]
+                               + c)
                 return new, jnp.sum(ctxv.astype(jnp.float32))
             _, outs = jax.lax.scan(body, dec0, None, length=dec_len)
             return jnp.sum(outs)
         l, g = jax.value_and_grad(loss, argnums=(0, 1, 2, 3))(w, v, proj, seq)
-        return l, g
+        return fold(carry, g), l
 
-    l, g = train_step(dec0, w, v, proj, seq, mask)    # compile + warmup
-    jax.block_until_ready((l, g))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        l, g = train_step(dec0, w, v, proj, seq, mask)
-    jax.block_until_ready((l, g))
-    dt = (time.perf_counter() - t0) / iters
-    B = dec0.shape[0]
-    return {"impl": name, "ms_per_step": round(dt * 1e3, 3),
-            "samples_per_sec": round(B / dt, 1)}
+    # fwd ~ dec_len * (two [B,D]x[D,D] matmuls + score/context reads);
+    # bwd ~2.5x — coarse, only sizes the scan
+    est = 3.5 * dec_len * (4 * B * D * D + 6 * B * T * D)
+    n_steps = scan_length(est)
+    dt = timed_chain(train_step, (w, v, proj, seq), n_steps, reps)
+    return {"impl": name, "n_steps": n_steps,
+            "ms_per_step": round(dt * 1e3, 3),
+            "samples_per_sec": round(dec0.shape[0] / dt, 1)}
 
 
 def main():
@@ -68,7 +73,7 @@ def main():
     ap.add_argument("--enc-len", type=int, default=30)
     ap.add_argument("--dec-len", type=int, default=30)
     ap.add_argument("--dim", type=int, default=512)
-    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--dtype", default="bfloat16")
     args = ap.parse_args()
 
@@ -93,7 +98,7 @@ def main():
     for name, fn in impls.items():
         try:
             res = bench_impl(name, fn, (dec0, w, v, proj, seq, mask),
-                             args.dec_len, args.iters)
+                             args.dec_len, args.reps)
             print(json.dumps(res))
         except Exception as e:
             print(json.dumps({"impl": name,

@@ -33,7 +33,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -49,36 +48,20 @@ def _est_step_flops(B, T, H, D):
 
 
 def bench_impl(fn, q, k, v, n_steps, reps):
-    @functools.partial(jax.jit, static_argnums=3)
-    def many(q, k, v, n):
-        def body(carry, _):
-            q, k, v = carry
+    """One fwd+bwd attention step, timed with the shared dispatch-proof
+    chained-scan harness (tools/_scan_bench.py) — all micro-benches use
+    the same methodology so a harness fix can't leave one diverged."""
+    from _scan_bench import fold, timed_chain
 
-            def loss(q, k, v):
-                return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
-            l, (dq, dk, dv) = jax.value_and_grad(
-                loss, argnums=(0, 1, 2))(q, k, v)
-            # next iteration's inputs depend on this one's gradients: XLA
-            # cannot elide, dedup, or reorder the repeats; the eps-scaled
-            # add is elementwise noise vs the attention work
-            eps = jnp.asarray(1e-30, q.dtype)
-            return (q + eps * dq, k + eps * dk, v + eps * dv), l
-        (qf, kf, vf), ls = jax.lax.scan(body, (q, k, v), None, length=n)
-        return jnp.sum(qf.astype(jnp.float32)) + jnp.sum(ls)
+    def step(carry):
+        q, k, v = carry
 
-    # compile + warmup with the REAL n_steps program: n is static, so a
-    # throwaway n=2 warmup would leave the n_steps compile inside the
-    # first timed rep (~75s/program through the tunnel)
-    float(many(q, k, v, n_steps))
-    # timed: one dispatch of the n_steps-long scan per rep; float() is a
-    # host read of the result, the only completion barrier the tunnel
-    # has been observed to honor
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        float(many(q, k, v, n_steps))
-        times.append(time.perf_counter() - t0)
-    return min(times) / n_steps
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return fold(carry, g), l
+
+    return timed_chain(step, (q, k, v), n_steps, reps)
 
 
 def main():
@@ -105,7 +88,7 @@ def main():
         impls["flash"] = pallas_attention.flash_attention
 
     rng = np.random.default_rng(0)
-    assumed_flops = 80e12   # ~40% MFU on v5e: only sizes the scan length
+    from _scan_bench import scan_length
     try:
         from bench import _chip_peak_tflops
         peak = _chip_peak_tflops(args.dtype) * 1e12   # dtype + device aware
@@ -117,8 +100,7 @@ def main():
         k = jnp.asarray(rng.normal(size=shape), dt)
         v = jnp.asarray(rng.normal(size=shape), dt)
         est = _est_step_flops(args.batch, T, args.heads, args.dim)
-        n_steps = int(np.clip((args.target_ms / 1e3) * assumed_flops / est,
-                              4, 1024))
+        n_steps = scan_length(est, target_ms=args.target_ms)
         for name, fn in impls.items():
             try:
                 sec = bench_impl(fn, q, k, v, n_steps, args.reps)

@@ -15,7 +15,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,14 +23,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _time(fn, args, iters):
-    out = fn(*args)
-    jax.block_until_ready(out)                      # compile + warmup
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+def _time(loss, argnums, args, reps, est_flops):
+    """Dispatch-proof timing (tools/_scan_bench.py): the grads of `loss`
+    w.r.t. `argnums` chain into the next iteration's inputs inside one
+    jitted scan — the old per-call loop + block_until_ready reported
+    dispatch latency, not compute, through the axon tunnel."""
+    from _scan_bench import fold, scan_length, timed_chain
+
+    def step(carry):
+        l, g = jax.value_and_grad(loss, argnums=argnums)(*carry)
+        return fold(carry, g), l
+
+    return timed_chain(step, tuple(args), scan_length(est_flops), reps)
 
 
 def bench_cell(cell: str, impl: str, B: int, T: int, D: int,
@@ -76,8 +79,9 @@ def _bench_cell(cell: str, impl: str, B: int, T: int, D: int,
             def loss(x, w):
                 hs, hl, cl = rnn.lstm_scan(x, lens, w, None)
                 return jnp.sum(hs * hs) + jnp.sum(hl * cl)
-        step = jax.jit(jax.grad(loss, argnums=(0, 1)))
-        dt = _time(step, (x, w), iters)
+        # fwd: T recurrent [B,D]x[D,4D] matmuls; bwd ~2.5x
+        est = 3.5 * T * 2 * B * D * 4 * D
+        dt = _time(loss, (0, 1), (x, w), iters, est)
     else:
         x = jnp.asarray(rng.standard_normal((B, T, 3 * D)) * 0.5,
                         jnp.float32)
@@ -94,8 +98,8 @@ def _bench_cell(cell: str, impl: str, B: int, T: int, D: int,
             def loss(x, wg, wc):
                 hs, hl = rnn.gru_scan(x, lens, wg, wc, None)
                 return jnp.sum(hs * hs) + jnp.sum(hl)
-        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        dt = _time(step, (x, wg, wc), iters)
+        est = 3.5 * T * 2 * B * D * 3 * D
+        dt = _time(loss, (0, 1, 2), (x, wg, wc), iters, est)
 
     return {"bench": "rnn", "cell": cell, "impl": impl,
             "B": B, "T": T, "D": D,
@@ -105,7 +109,8 @@ def _bench_cell(cell: str, impl: str, B: int, T: int, D: int,
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=3,
+                    help="timed reps of the scanned region")
     ap.add_argument("--shapes", default="64,30,512;16,8,64;8,512,256")
     ap.add_argument("--cells", default="lstm,gru")
     args = ap.parse_args()

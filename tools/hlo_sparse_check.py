@@ -83,18 +83,16 @@ def main() -> int:
     except OSError:
         pass
 
-    # inventory every collective op in the optimized module
+    # inventory every collective op in the optimized module, including the
+    # async forms (all-gather-start/-done — the standard TPU lowering);
+    # -done lines are skipped so async pairs count once
     colls: dict[str, int] = {}
     gathers = []
     for ln in hlo.splitlines():
-        m = re.search(r"= \S+ (all-gather|all-reduce|reduce-scatter|"
-                      r"all-to-all|collective-permute)\(", ln)
-        if not m:
-            m = re.search(r"(all-gather|all-reduce|reduce-scatter|"
-                          r"all-to-all|collective-permute)\(", ln)
-            if not m or "-start(" in ln or "-done(" in ln:
-                if not m:
-                    continue
+        m = re.search(r"(all-gather|all-reduce|reduce-scatter|"
+                      r"all-to-all|collective-permute)(-start|-done)?\(", ln)
+        if not m or m.group(2) == "-done":
+            continue
         op = m.group(1)
         colls[op] = colls.get(op, 0) + 1
         if op == "all-gather":
