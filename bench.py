@@ -39,7 +39,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
-_PERF_LOG = os.path.join(_REPO, "PERF_LOG.jsonl")
+# BENCH_PERF_LOG redirects the evidence log — tools/tpu_measure.py's
+# --rehearse mode points it at a scratch dir so CPU dry-runs can never
+# poison the real last-known-good record
+_PERF_LOG = os.environ.get("BENCH_PERF_LOG") or \
+    os.path.join(_REPO, "PERF_LOG.jsonl")
 
 def _chip_peak_tflops(dtype: str) -> float:
     import jax

@@ -6,7 +6,9 @@ Measures fwd+bwd training-step time at the shapes that matter:
 the sentiment bench (B64 T30-ish D512-class hidden) plus a small and a
 long-sequence point.  Prints one JSON line per (cell, impl, shape).
 
-Usage: python tools/bench_rnn.py [--iters 20] [--shapes B,T,D;B,T,D;...]
+Usage: python tools/bench_rnn.py [--iters 3] [--shapes B,T,D;B,T,D;...]
+(--iters = timed reps of the single-dispatch ~250ms scanned region, not
+per-call loop iterations)
 """
 
 from __future__ import annotations

@@ -77,6 +77,9 @@ def run_step(name: str, argv: list[str], timeout_s: float,
     return rc == 0
 
 
+_REHEARSE = False   # --rehearse: CPU dry-run of the whole queue (tiny shapes)
+
+
 def health(timeout_s: float = 90) -> bool:
     code = ("import jax; d = jax.devices()[0]; "
             "print('HEALTH', d.platform, d.device_kind)")
@@ -85,7 +88,8 @@ def health(timeout_s: float = 90) -> bool:
                            capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         return False
-    ok = "HEALTH tpu" in (p.stdout or "")
+    ok = "HEALTH tpu" in (p.stdout or "") or \
+        (_REHEARSE and "HEALTH cpu" in (p.stdout or ""))
     print(json.dumps({"step": "health", "ok": ok,
                       "detail": (p.stdout or p.stderr or "")[-200:].strip()}),
           flush=True)
@@ -109,7 +113,9 @@ def _metric_fresh(metric: str, hours: float, need_field: str = "") -> str:
     """Non-empty reason iff PERF_LOG has a fresh enough record carrying
     `metric` (top-level or nested part), optionally requiring a field."""
     try:
-        with open(os.path.join(REPO, "PERF_LOG.jsonl")) as f:
+        path = os.environ.get("BENCH_PERF_LOG") or \
+            os.path.join(REPO, "PERF_LOG.jsonl")
+        with open(path) as f:
             lines = f.readlines()
     except OSError:
         return ""
@@ -145,7 +151,7 @@ def _out_fresh(step: str, hours: float) -> str:
         return ""
 
 
-def _parity_pending(only: str) -> int:
+def _parity_pending(only: str, ledger: str) -> int:
     """How many parity cases are NOT yet green under the current code hash —
     computed by tpu_parity --list itself (the same _ledger_passed replay
     that --skip-passed uses, so this can never disagree with the actual
@@ -153,7 +159,7 @@ def _parity_pending(only: str) -> int:
     try:
         p = subprocess.run(
             [sys.executable, "tools/tpu_parity.py", "--list",
-             f"--only={only}"],
+             f"--only={only}", f"--ledger={ledger}"],
             timeout=120, capture_output=True, text=True, cwd=REPO)
         listing = json.loads(p.stdout.strip().splitlines()[-1])
         return len(listing["pending"])
@@ -161,7 +167,30 @@ def _parity_pending(only: str) -> int:
         return -1
 
 
+# tiny-shape overrides for --rehearse: the whole queue runs end-to-end on
+# the host CPU in minutes, validating orchestration (spawning, ledger,
+# freshness skips, output layout) so a real tunnel window is never the
+# first time the pipeline executes
+_REHEARSE_ENV = {
+    "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+    "PADDLE_TPU_PALLAS_INTERPRET": "1", "BENCH_DTYPE": "float32",
+    "BENCH_BATCH_SIZE": "16", "BENCH_ITERS": "2",
+    "BENCH_S2S_VOCAB": "200", "BENCH_S2S_HIDDEN": "32",
+    "BENCH_S2S_BATCH": "4", "BENCH_S2S_LEN": "6", "BENCH_S2S_ITERS": "2",
+    "BENCH_S2S_MAXLEN": "6", "BENCH_S2S_DECODE_REPS": "2",
+    "BENCH_MNIST_BATCH": "16", "BENCH_MNIST_ITERS": "2",
+    "BENCH_SENT_VOCAB": "500", "BENCH_SENT_BATCH": "8",
+    "BENCH_SENT_LEN": "12", "BENCH_SENT_ITERS": "2",
+    "BENCH_REC_BATCH": "32", "BENCH_REC_ITERS": "2",
+    "BENCH_LM_VOCAB": "500", "BENCH_LM_DIM": "32", "BENCH_LM_LAYERS": "2",
+    "BENCH_LM_HEADS": "2", "BENCH_LM_LEN": "32", "BENCH_LM_BATCH": "4",
+    "BENCH_LM_ITERS": "2", "BENCH_LM_DECODE_BATCH": "2",
+    "BENCH_LM_MAX_NEW": "8", "BENCH_LM_DECODE_REPS": "2",
+}
+
+
 def main() -> int:
+    global OUT, _REHEARSE
     skip: set[str] = set()
     fresh_hours = 6.0
     args = list(sys.argv[1:])
@@ -173,17 +202,49 @@ def main() -> int:
             skip |= set(args.pop(0).split(","))
         elif a.startswith("--fresh-hours="):
             fresh_hours = float(a.split("=", 1)[1])
+        elif a == "--rehearse":
+            _REHEARSE = True
+    if _REHEARSE:
+        OUT = os.path.join(REPO, "MEASURE_REHEARSAL")
+        os.environ.update(_REHEARSE_ENV)
+        os.environ["BENCH_PERF_LOG"] = os.path.join(OUT, "PERF_LOG.jsonl")
+        os.makedirs(OUT, exist_ok=True)
     if not health():
         print(json.dumps({"fatal": "TPU not healthy; nothing run"}))
         return 1
 
     py = sys.executable
     fh = fresh_hours
+    ledger = os.path.join(OUT, "parity_ledger.jsonl")
 
     def bench_env(only, budget, extra=None):
         env = {"BENCH_ONLY": only, "BENCH_TIME_BUDGET_S": str(budget)}
         env.update(extra or {})
         return env
+
+    # sweep-tool argvs: tiny shapes under --rehearse, the real matrix on
+    # hardware
+    if _REHEARSE:
+        attn_args = ["--lens", "128", "--batch", "1", "--heads", "2",
+                     "--target-ms", "5", "--reps", "1"]
+        attn_f32_args = attn_args + ["--dtype", "float32"]
+        lm_args = ["--lens", "32", "--impls", "auto", "--vocab", "300",
+                   "--dim", "32", "--layers", "2", "--heads", "2",
+                   "--dtype", "float32", "--iters", "2",
+                   "--tokens-per-batch", "128", "--decode-batch", "2",
+                   "--max-new", "8", "--decode-reps", "2"]
+        rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
+        additive_args = ["--batch", "8", "--enc-len", "8", "--dec-len", "4",
+                         "--dim", "32", "--reps", "1", "--dtype", "float32"]
+        profile_args = ["--iters", "2", "--batch", "16",
+                        "--outdir", os.path.join(OUT, "xplane_vgg")]
+    else:
+        attn_args = ["--lens", "512,1024,2048,4096,8192,16384"]
+        attn_f32_args = ["--lens", "512,1024,4096", "--dtype", "float32"]
+        lm_args = []
+        rnn_args = []
+        additive_args = []
+        profile_args = []
 
     # Ordered by marginal value per healthy-tunnel minute (VERDICT r4
     # items 1-7).  done() returning a non-empty reason skips the step.
@@ -193,9 +254,9 @@ def main() -> int:
         # remaining Mosaic-risk shapes have never been verified on device
         ("parity",
          [py, "tools/tpu_parity.py", "--only=flash,additive",
-          "--skip-passed"], 1500, {},
+          "--skip-passed", f"--ledger={ledger}"], 1500, {},
          lambda: "all cases green in ledger"
-         if _parity_pending("flash,additive") == 0 else ""),
+         if _parity_pending("flash,additive", ledger) == 0 else ""),
         # (b) headline + the three never-benched BASELINE configs + LM
         ("bench_vgg", [py, "bench.py"], 760, bench_env("vgg", 700),
          lambda: _metric_fresh(_METRIC_OF["vgg"], fh)),
@@ -210,29 +271,29 @@ def main() -> int:
         ("bench_lm_record", [py, "bench.py"], 900, bench_env("lm", 840),
          lambda: _metric_fresh(_METRIC_OF["lm"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
-        ("profile_vgg", [py, "tools/profile_vgg.py"], 700, {},
+        ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
+         700, {},
          lambda: _out_fresh("profile_vgg", fh)),
         # (d) RNN kernels: zero hardware executions before this round
         ("parity_rnn",
-         [py, "tools/tpu_parity.py", "--only=lstm,gru", "--skip-passed"],
-         1500, {},
+         [py, "tools/tpu_parity.py", "--only=lstm,gru", "--skip-passed",
+          f"--ledger={ledger}"], 1500, {},
          lambda: "all cases green in ledger"
-         if _parity_pending("lstm,gru") == 0 else ""),
-        ("rnn_bench", [py, "tools/bench_rnn.py"], 900, {},
+         if _parity_pending("lstm,gru", ledger) == 0 else ""),
+        ("rnn_bench", [py, "tools/bench_rnn.py"] + rnn_args, 900, {},
          lambda: _out_fresh("rnn_bench", fh)),
         # (e) sweeps: attention crossover (dispatch-proof timing), LM
         # context sweep, additive kernel re-check
         ("attn_bench",
-         [py, "tools/bench_attention.py",
-          "--lens", "512,1024,2048,4096,8192,16384"], 1200, {},
+         [py, "tools/bench_attention.py"] + attn_args, 1200, {},
          lambda: _out_fresh("attn_bench", fh)),
-        ("bench_lm", [py, "tools/bench_lm.py"], 1500, {},
+        ("bench_lm", [py, "tools/bench_lm.py"] + lm_args, 1500, {},
          lambda: _out_fresh("bench_lm", fh)),
-        ("additive_bench", [py, "tools/bench_additive.py"], 400, {},
+        ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
+         400, {},
          lambda: _out_fresh("additive_bench", fh)),
         ("attn_bench_f32",
-         [py, "tools/bench_attention.py", "--lens", "512,1024,4096",
-          "--dtype", "float32"], 700, {},
+         [py, "tools/bench_attention.py"] + attn_f32_args, 700, {},
          lambda: _out_fresh("attn_bench_f32", fh)),
         # (f) seq2seq LAST, phase-split: whichever step wedges bisects the
         # r2/r4 tunnel wedge (train scan vs beam program)

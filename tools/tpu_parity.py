@@ -219,7 +219,15 @@ def rnn_cases():
     """Pallas LSTM/GRU vs the lax.scan reference, fwd + grads, on device —
     these kernels have never run on real TPU either (VERDICT r3 item 1).
     Both paths compute fp32 internally; tolerance covers MXU pass-order
-    differences between the kernel's per-step matmul and the scan's."""
+    differences between the kernel's per-step matmul and the scan's.
+
+    Recurrent weights are 1/sqrt(D)-scaled (standard init): a fixed 0.2
+    std at D=512 puts the backward recurrence in an exploding-gradient
+    regime (per-step gain > 1) where fp32 op-ordering differences amplify
+    exponentially and NO two fp32 implementations agree — adjudicated r5
+    with an f64 oracle: at std 0.2 the fp32 SCAN itself missed the f64
+    truth by the same margin as the kernel (7.2 vs 9.1 abs), while at
+    1/sqrt(D) kernel-vs-scan agree to 5e-6."""
     import jax
     import jax.numpy as jnp
 
@@ -236,7 +244,7 @@ def rnn_cases():
             rng = np.random.default_rng(300 + j)
             x4 = jnp.asarray(rng.standard_normal((B, T, 4 * D)) * 0.5,
                              jnp.float32)
-            w = jnp.asarray(rng.standard_normal((D, 4 * D)) * 0.2,
+            w = jnp.asarray(rng.standard_normal((D, 4 * D)) * D ** -0.5,
                             jnp.float32)
             lens = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
             z = jnp.zeros((B, D), jnp.float32)
@@ -265,9 +273,10 @@ def rnn_cases():
             rng = np.random.default_rng(400 + j)
             x3 = jnp.asarray(rng.standard_normal((B, T, 3 * D)) * 0.5,
                              jnp.float32)
-            wg = jnp.asarray(rng.standard_normal((D, 2 * D)) * 0.2,
+            wg = jnp.asarray(rng.standard_normal((D, 2 * D)) * D ** -0.5,
                              jnp.float32)
-            wc = jnp.asarray(rng.standard_normal((D, D)) * 0.2, jnp.float32)
+            wc = jnp.asarray(rng.standard_normal((D, D)) * D ** -0.5,
+                             jnp.float32)
             lens = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
             z = jnp.zeros((B, D), jnp.float32)
 
