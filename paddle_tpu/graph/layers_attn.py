@@ -42,6 +42,21 @@ from paddle_tpu.parameter.argument import Argument
 _BLOCKWISE_MIN_KEYS = 2048
 
 
+def _flash_blocks(cfg: LayerConfig) -> dict:
+    """Flash-kernel block sizes: per-layer attrs win; else the env-tuned
+    defaults (PADDLE_TPU_FLASH_BLOCK_Q/K — written from
+    tools/tune_flash.py's on-device sweep); else the kernel's 128x128.
+    Used by BOTH the training path and the cached-decode prefill, so a
+    tuned configuration applies everywhere flash runs."""
+    import os
+    return {
+        "block_q": int(cfg.attrs.get(
+            "block_q", os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", 128))),
+        "block_k": int(cfg.attrs.get(
+            "block_k", os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", 128))),
+    }
+
+
 @register_layer("multi_head_attention")
 def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """inputs: [query, key, value, (query again carrying the out-proj param)];
@@ -105,9 +120,8 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
                 f"(or PADDLE_TPU_PALLAS_INTERPRET=1 to opt into the slow "
                 f"interpret mode); current backend is "
                 f"{jax.default_backend()!r}")
-        attn_fn = functools.partial(
-            pallas_attention.flash_attention,
-            block_k=int(cfg.attrs.get("block_k", 128)))
+        attn_fn = functools.partial(pallas_attention.flash_attention,
+                                    **_flash_blocks(cfg))
     elif impl == "blockwise":
         attn_fn = functools.partial(
             blockwise_attention, block_k=int(cfg.attrs.get("block_k", 512)))
@@ -134,6 +148,8 @@ def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
     """One incremental self-attention call: project the new tokens, fold
     them into this layer's KV cache, attend causally on global positions.
     Emits the updated cache through ctx.state_out."""
+    import functools
+
     import jax.numpy as jnp
 
     from paddle_tpu.ops import pallas_attention
@@ -185,13 +201,15 @@ def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
                     f"layer {cfg.name!r}: attn_impl=flash needs a TPU "
                     f"backend (or PADDLE_TPU_PALLAS_INTERPRET=1 for "
                     f"interpret-mode tests)")
-            attn = pallas_attention.flash_attention
+            attn = functools.partial(pallas_attention.flash_attention,
+                                     **_flash_blocks(cfg))
         elif impl == "blockwise":
             attn = blockwise_attention
         elif impl == "dense":
             attn = dot_product_attention
         elif long_prompt and pallas_attention.supported():
-            attn = pallas_attention.flash_attention
+            attn = functools.partial(pallas_attention.flash_attention,
+                                     **_flash_blocks(cfg))
         elif long_prompt:
             attn = blockwise_attention
         else:

@@ -232,6 +232,53 @@ class TestMHALayer:
         loss = tr.train_one_batch(self._batch())
         assert np.isfinite(loss)
 
+    def test_layer_flash_block_sizes_attrs_beat_env(self, monkeypatch):
+        """The flash branch forwards block_q/block_k to the kernel in BOTH
+        the training path and the cached-decode prefill: per-layer attrs
+        win over the PADDLE_TPU_FLASH_BLOCK_Q/K env defaults (written from
+        tools/tune_flash.py's on-device sweep), which beat the 128x128
+        kernel default."""
+        from paddle_tpu.config.parser import parse_config
+        from paddle_tpu.graph.lm_decode import lm_generate
+        from paddle_tpu.ops import pallas_attention
+        from paddle_tpu.trainer.trainer import Trainer
+
+        monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+        monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_Q", "256")
+        monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_K", "512")
+        seen = {}
+        real = pallas_attention.flash_attention
+
+        def spy(*a, **kw):
+            seen.update({k: kw.get(k) for k in ("block_q", "block_k")})
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pallas_attention, "flash_attention", spy)
+        cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                           "dim=32,layers=1,heads=2,vocab=64,batch_size=2,"
+                           "attn_impl=flash")
+        tr = Trainer(cfg, seed=0)
+        tr.train_one_batch(next(tr.train_batches()))
+        assert seen == {"block_q": 256, "block_k": 512}   # env defaults
+
+        # cached-decode prefill takes the same tuned sizes (it is the
+        # long-context case tuning targets)
+        seen.clear()
+        toks, _ = lm_generate(tr.executor, tr.params,
+                              np.ones((1, 4), np.int32), max_new=2,
+                              use_cache=True)
+        assert seen == {"block_q": 256, "block_k": 512}
+
+        # per-layer attrs beat the env defaults
+        seen.clear()
+        for layer in cfg.model_config.layers:
+            if layer.type == "multi_head_attention":
+                layer.attrs["block_q"] = 128
+                layer.attrs["block_k"] = 128
+        tr2 = Trainer(cfg, seed=0)
+        tr2.train_one_batch(next(tr2.train_batches()))
+        assert seen == {"block_q": 128, "block_k": 128}
+
     def test_ring_path_matches_single_device(self):
         """Same params, same batch: seq-parallel mesh loss == local loss."""
         from paddle_tpu.parallel.mesh import make_mesh

@@ -49,6 +49,13 @@ def timed_chain(step, carry0, n_steps: int, reps: int = 3) -> float:
     return min(times) / n_steps
 
 
+def attn_step_flops(B: int, T: int, H: int, D: int) -> float:
+    """fwd (QK^T + PV = 4*B*H*T^2*D) + bwd (~2.5x fwd) — shared by the
+    attention bench and the flash tuner so their scan regions are sized
+    identically; coarse on purpose (it only sizes the region)."""
+    return 3.5 * 4 * B * H * T * T * D
+
+
 def scan_length(est_step_flops: float, target_ms: float = 250.0,
                 assumed_flops: float = 80e12,
                 lo: int = 4, hi: int = 1024) -> int:

@@ -41,12 +41,6 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _est_step_flops(B, T, H, D):
-    """fwd (QK^T + PV = 4*B*H*T^2*D) + bwd (~2.5x fwd) — only used to pick
-    the scan length, so a coarse model is fine."""
-    return 3.5 * 4 * B * H * T * T * D
-
-
 def bench_impl(fn, q, k, v, n_steps, reps):
     """One fwd+bwd attention step, timed with the shared dispatch-proof
     chained-scan harness (tools/_scan_bench.py) — all micro-benches use
@@ -88,6 +82,7 @@ def main():
         impls["flash"] = pallas_attention.flash_attention
 
     rng = np.random.default_rng(0)
+    from _scan_bench import attn_step_flops as _est_step_flops
     from _scan_bench import scan_length
     try:
         from bench import _chip_peak_tflops

@@ -234,6 +234,8 @@ def main() -> int:
                    "--tokens-per-batch", "128", "--decode-batch", "2",
                    "--max-new", "8", "--decode-reps", "2"]
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
+        tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
+                     "--heads", "2", "--target-ms", "5", "--reps", "1"]
         additive_args = ["--batch", "8", "--enc-len", "8", "--dec-len", "4",
                          "--dim", "32", "--reps", "1", "--dtype", "float32"]
         profile_args = ["--iters", "2", "--batch", "16",
@@ -245,6 +247,7 @@ def main() -> int:
         rnn_args = []
         additive_args = []
         profile_args = []
+        tune_args = []
 
     # Ordered by marginal value per healthy-tunnel minute (VERDICT r4
     # items 1-7).  done() returning a non-empty reason skips the step.
@@ -292,6 +295,8 @@ def main() -> int:
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
+        ("tune_flash", [py, "tools/tune_flash.py"] + tune_args, 1200, {},
+         lambda: _out_fresh("tune_flash", fh)),
         ("attn_bench_f32",
          [py, "tools/bench_attention.py"] + attn_f32_args, 700, {},
          lambda: _out_fresh("attn_bench_f32", fh)),
