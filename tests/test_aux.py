@@ -50,6 +50,66 @@ class TestCheckGrad:
         # fp32 central differences: ~1e-2 noise floor (the CLI job uses 2e-2)
         assert worst < 2e-2, f"gradient check failed: {errors}"
 
+    def test_wrong_gradient_is_flagged(self):
+        """The noise-aware denominator must not make the check vacuous: a
+        corrupted analytic gradient of visible magnitude still flags."""
+        import jax.numpy as jnp
+        tr = Trainer(_small_config(), seed=0)
+        params = {"w": jnp.asarray([0.5, -0.3], jnp.float32)}
+
+        def loss_fn(p):
+            return jnp.sum(p["w"] ** 2)
+        good = {"w": jnp.asarray([1.0, -0.6], jnp.float32)}   # d(w^2) = 2w
+        bad = {"w": jnp.asarray([2.0, -0.6], jnp.float32)}    # w0 doubled
+        e_good = tr._check_gradient_inner(loss_fn, good, 1e-3, 2, params)
+        e_bad = tr._check_gradient_inner(loss_fn, bad, 1e-3, 2, params)
+        assert e_good["w"] < 2e-2, e_good
+        assert e_bad["w"] > 0.3, e_bad
+
+    def test_kink_entries_are_skipped_in_refine(self):
+        """FD across a ReLU-style |x| kink measures the subgradient
+        average, not the one-sided analytic derivative — the f64 refine
+        pass detects the fwd/bwd one-sided mismatch (after an epsilon-
+        shrink retry) and skips the entry instead of reporting a spurious
+        failure (the VGG configs' fc-bias entries hit exactly this)."""
+        import jax
+        import jax.numpy as jnp
+        tr = Trainer(_small_config(), seed=0)
+        with jax.enable_x64():
+            params = {"w": jnp.asarray([0.0, 0.5], jnp.float64)}
+
+            def loss_fn(p):
+                return jnp.abs(p["w"][0]) + p["w"][1] ** 2
+            # the kink sits EXACTLY at w0=0, so even the shrunk epsilon
+            # straddles it; analytic reports the one-sided 1.0 (or 0 —
+            # either way FD measures ~0 and would flag spuriously); w1's
+            # gradient is exact
+            grads = {"w": jnp.asarray([1.0, 1.0], jnp.float64)}
+            errs = tr._check_gradient_inner(loss_fn, grads, 1e-3, 2, params,
+                                            None, detect_kinks=True)
+            assert errs["w"] < 2e-2, errs
+            # without kink detection the same entry reports a large error
+            errs_raw = tr._check_gradient_inner(loss_fn, grads, 1e-3, 2,
+                                                params)
+            assert errs_raw["w"] > 0.3, errs_raw
+
+    def test_two_stage_refine_end_to_end(self):
+        """check_gradient's fp32-screen -> f64-refine flow: forcing every
+        parameter through the refine (threshold -1) exercises enable_x64,
+        the dtype round-trip, and the subset stream alignment — refined
+        errors must stay under the CLI bar and cover every parameter."""
+        tr = Trainer(_small_config(), seed=0)
+        errors = tr.check_gradient(_batch(), epsilon=1e-3, max_entries=2,
+                                   refine_threshold=-1.0)
+        assert errors and max(errors.values()) < 2e-2, errors
+        # subset alignment: refining exactly one parameter probes the same
+        # entries the full pass samples, so its error stays consistent
+        one = sorted(errors)[0]
+        sub = tr._checkgrad_pass(_batch(), 1e-3, 2, x64=True, names=[one],
+                                 detect_kinks=True)
+        assert set(sub) == {one}
+        assert abs(sub[one] - errors[one]) < 2e-2, (sub, errors[one])
+
 
 class TestParamStats:
     def test_stats_shape(self):
