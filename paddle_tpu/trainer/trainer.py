@@ -499,7 +499,13 @@ class Trainer:
     def test(self, batches: Optional[Iterator] = None) -> dict[str, float]:
         """(ref: Tester::testOnePeriod)."""
         if batches is None:
-            assert self.config.test_data_config is not None
+            if self.config.test_data_config is None:
+                raise ValueError(
+                    "test needs a test data source, but this config "
+                    "declares none — add define_py_data_sources2("
+                    "test_list=...) to the config, or pass batches= "
+                    "explicitly (ref: --job=test requires a test source, "
+                    "TrainerMain.cpp)")
             batches = self._feeder(self.config.test_data_config, False).batches()
         params = self.updater.averaged_params(self.params, self.opt_state)
         acc = self.evaluators.new_accumulator()

@@ -69,6 +69,11 @@ def main(argv=None) -> int:
             trainer.train(num_passes=FLAGS.num_passes, log_period=FLAGS.log_period,
                           save_dir=FLAGS.save_dir or None)
         elif job == "test":
+            if trainer.config.test_data_config is None:
+                log.error("--job=test: this config declares no test data "
+                          "source — add define_py_data_sources2("
+                          "test_list=...) (ref: TrainerMain.cpp)")
+                return 2
             stats = trainer.test()
             log.info("test result: %s", stats)
         elif job == "time":
