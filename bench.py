@@ -721,10 +721,20 @@ def main() -> None:
         # only stamp fresh measurements — a merged last-known-good record
         # keeps the platform fields + measured_at of the run that measured it
         import datetime
+        import subprocess
         out["platform"] = health.get("platform", "?")
         out["device_kind"] = health.get("device_kind", "?")
         out["measured_at"] = datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds")
+        try:
+            # --dirty: a measurement from an uncommitted tree must not be
+            # attributed to the last commit's exact code
+            out["rev"] = subprocess.run(
+                ["git", "describe", "--always", "--dirty", "--abbrev=7"],
+                cwd=_REPO, capture_output=True, text=True,
+                timeout=10).stdout.strip() or "?"
+        except Exception:
+            out["rev"] = "?"
 
     # seq2seq goes LAST: its bench is where the tunnel wedged in rounds 2
     # AND 4 (PERF_LOG 2026-07-31T01:20), so everything else must already
