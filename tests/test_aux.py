@@ -196,6 +196,21 @@ class TestTrainerMainJobs:
              "--config=demo/introduction/trainer_config.py", *extra],
             capture_output=True, text=True, timeout=300, cwd=repo, env=env)
 
+    def test_exit_code_contract(self):
+        """CLI exit codes: 0 = job ran and passed, 1 = job ran and failed,
+        2 = usage/config error — wrapper scripts rely on the distinction
+        (the reference's paddle_trainer behaved the same way)."""
+        ok = self._run("--job=train", "--num_passes=1", "--save_dir=")
+        assert ok.returncode == 0, ok.stderr[-500:]
+        usage = self._run("--job=no_such_job")
+        assert usage.returncode == 2, (usage.returncode, usage.stderr[-300:])
+        # --job=test on a config with no test source is a CONFIG error (2),
+        # not a test failure (1)
+        no_src = self._run("--job=test")
+        assert no_src.returncode == 2, (no_src.returncode,
+                                        no_src.stderr[-300:])
+        assert "test data source" in no_src.stderr
+
     def test_checkgrad_job(self):
         out = self._run("--job=checkgrad")
         assert out.returncode == 0, out.stderr[-2000:]
