@@ -44,7 +44,16 @@ def main(argv=None) -> int:
         # offending computation uncompiled and raises at the bad primitive
         jax.config.update("jax_debug_nans", True)
 
-    config = parse_config(FLAGS.config, FLAGS.config_args)
+    try:
+        config = parse_config(FLAGS.config, FLAGS.config_args)
+    except Exception as e:   # noqa: BLE001 — configs run arbitrary user code
+        # ANY failure while parsing/executing the config file is a usage
+        # error (exit 2), not a job failure (exit 1) — wrapper scripts
+        # branch on the distinction; exc_info keeps the config-side
+        # traceback visible so the offending statement is findable
+        log.error("failed to parse config %s: %s: %s", FLAGS.config,
+                  type(e).__name__, e, exc_info=True)
+        return 2
     log.info("parsed config %s: %d layers, %d parameters", FLAGS.config,
              len(config.model_config.layers), len(config.model_config.parameters))
     mesh = mesh_from_flag(FLAGS.mesh_shape) if FLAGS.mesh_shape else None
@@ -85,11 +94,12 @@ def main(argv=None) -> int:
             if batch is None:
                 log.error("checkgrad: data source produced no batches")
                 return 2
-            errors = trainer.check_gradient(batch)
+            errors = trainer.check_gradient(
+                batch, refine_threshold=FLAGS.checkgrad_bar)
             worst = max(errors.values(), default=0.0)
             log.info("checkgrad: %d parameters, worst max_rel_err=%.3e",
                      len(errors), worst)
-            if worst > 0.02:
+            if worst > FLAGS.checkgrad_bar:
                 log.error("gradient check FAILED")
                 return 1
         else:

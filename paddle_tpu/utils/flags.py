@@ -121,6 +121,8 @@ define_flag("init_model_path", "", "path to initial model checkpoint")
 define_flag("config", "", "trainer config python file")
 define_flag("config_args", "", "comma-separated key=value passed to the config")
 define_flag("job", "train", "train | test | checkgrad | time")
+define_flag("checkgrad_bar", 0.02, "max relative error --job=checkgrad "
+            "accepts before failing (exit 1)")
 define_flag("show_parameter_stats_period", 0, "dump parameter stats every N batches")
 define_flag("beam_size", 1, "beam width for sequence generation")
 define_flag("mesh_shape", "", "device mesh, e.g. 'data:8' or 'data:4,model:2'")

@@ -210,6 +210,20 @@ class TestTrainerMainJobs:
         assert no_src.returncode == 2, (no_src.returncode,
                                         no_src.stderr[-300:])
         assert "test data source" in no_src.stderr
+        # an unparseable config is also a usage error, not a crash (rc 1
+        # via traceback was the old behavior); FLAGS.parse is
+        # last-occurrence-wins, so _run's extra --config overrides the
+        # helper's default
+        bad_cfg = self._run("--config=definitely/not/there.py")
+        assert bad_cfg.returncode == 2, (bad_cfg.returncode,
+                                         bad_cfg.stderr[-300:])
+        assert "failed to parse config" in bad_cfg.stderr
+        # exit 1 = the job RAN and failed: an impossibly strict checkgrad
+        # bar fails on fp32 rounding alone
+        strict = self._run("--job=checkgrad", "--checkgrad_bar=1e-14")
+        assert strict.returncode == 1, (strict.returncode,
+                                        strict.stderr[-300:])
+        assert "FAILED" in strict.stderr
 
     def test_checkgrad_job(self):
         out = self._run("--job=checkgrad")
