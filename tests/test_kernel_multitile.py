@@ -1,0 +1,197 @@
+"""Multi-lane-tile and unaligned-shape sweeps for the pallas kernels.
+
+The round-5 rehearsal found the parity matrix thin exactly where the
+round-4 hardware failures lived: shapes whose blocks span MULTIPLE
+(8/16, 128) TPU tiles.  These interpret-mode sweeps pin the kernels'
+math at those shapes (Mosaic lowering is separately validated on device
+by tools/tpu_parity.py's ledger queue):
+
+- flash attention at head dim > 128 (two+ lane tiles), incl. GQA,
+  sliding window, and unaligned D;
+- LSTM/GRU time-grid kernels at multi-tile / unaligned D and reverse
+  (weights 1/sqrt(D)-scaled — a fixed large std puts the backward
+  recurrence in an exploding-gradient regime where NO two fp32
+  implementations agree; adjudicated r5 with an f64 oracle);
+- the additive-attention kernel at mixed wide dims, with the bf16
+  gradient compared against the jnp-bf16 formulation (like-for-like:
+  vs an fp32 oracle BOTH paths carry the same ~2.7%-of-scale input-
+  rounding error, measured identical to the last bit).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    # per-test only (monkeypatch restores): a module-level env set would
+    # leak interpret mode into every other test via collection-time import
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+
+
+class TestFlashMultiTile:
+    @pytest.mark.parametrize("B,T,H,D,dt,causal,window,Hkv", [
+        (2, 256, 2, 256, jnp.float32, True, None, None),
+        (1, 384, 2, 192, jnp.float32, False, None, None),   # unaligned D
+        (2, 256, 4, 256, jnp.float32, True, 64, None),      # window
+        (2, 256, 4, 256, jnp.float32, True, None, 2),       # GQA
+    ])
+    def test_matches_dense(self, B, T, H, D, dt, causal, window, Hkv):
+        from paddle_tpu.ops import pallas_attention
+        from paddle_tpu.ops.attention import dot_product_attention
+
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+        kv = (B, T, Hkv or H, D)
+        k = jnp.asarray(rng.normal(size=kv), dt)
+        v = jnp.asarray(rng.normal(size=kv), dt)
+        got = pallas_attention.flash_attention(q, k, v, causal=causal,
+                                               window=window)
+        with jax.default_matmul_precision("highest"):
+            want = dot_product_attention(q, k, v, causal=causal,
+                                         window=window)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=2e-5, atol=2e-5)
+        g1 = jax.grad(lambda q: jnp.sum(pallas_attention.flash_attention(
+            q, k, v, causal=causal, window=window).astype(jnp.float32)))(q)
+        with jax.default_matmul_precision("highest"):
+            g2 = jax.grad(lambda q: jnp.sum(dot_product_attention(
+                q, k, v, causal=causal, window=window)
+                .astype(jnp.float32)))(q)
+        np.testing.assert_allclose(np.asarray(g1, np.float32),
+                                   np.asarray(g2, np.float32),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestRnnMultiTile:
+    @pytest.mark.parametrize("cell,B,T,D,reverse", [
+        ("lstm", 4, 12, 640, False),
+        ("gru", 4, 12, 640, False),
+        ("lstm", 4, 24, 384, True),
+        ("gru", 4, 24, 384, True),
+    ])
+    def test_matches_scan(self, cell, B, T, D, reverse, monkeypatch):
+        from paddle_tpu.ops import pallas_rnn, rnn
+
+        rng = np.random.default_rng(11)
+        lens = jnp.asarray(rng.integers(1, T + 1, B), jnp.int32)
+        z = jnp.zeros((B, D), jnp.float32)
+
+        def forced_scan(fn, *args):
+            monkeypatch.setenv("PADDLE_TPU_PALLAS", "0")
+            try:
+                return fn(*args)
+            finally:
+                monkeypatch.setenv("PADDLE_TPU_PALLAS", "1")
+
+        if cell == "lstm":
+            x = jnp.asarray(rng.standard_normal((B, T, 4 * D)) * 0.5,
+                            jnp.float32)
+            w = jnp.asarray(rng.standard_normal((D, 4 * D)) * D ** -0.5,
+                            jnp.float32)
+            peeps = jnp.zeros((3, D), jnp.float32)
+
+            def fused(x, w):
+                hs, hl, cl = pallas_rnn.lstm_fused(
+                    x, lens, w, peeps, z, z, active_type="tanh",
+                    gate_active_type="sigmoid", state_active_type="tanh",
+                    reverse=reverse)
+                return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl)
+
+            def ref(x, w):
+                hs, hl, cl = rnn.lstm_scan(x, lens, w, None,
+                                           reverse=reverse)
+                return jnp.sum(hs * hs) + jnp.sum(hl) + jnp.sum(cl * cl)
+
+            lf, gf = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
+            lr, gr = forced_scan(
+                jax.value_and_grad(ref, argnums=(0, 1)), x, w)
+        else:
+            x = jnp.asarray(rng.standard_normal((B, T, 3 * D)) * 0.5,
+                            jnp.float32)
+            wg = jnp.asarray(rng.standard_normal((D, 2 * D)) * D ** -0.5,
+                             jnp.float32)
+            wc = jnp.asarray(rng.standard_normal((D, D)) * D ** -0.5,
+                             jnp.float32)
+
+            def fused(x, wg, wc):
+                hs, hl = pallas_rnn.gru_fused(
+                    x, lens, wg, wc, z, active_type="tanh",
+                    gate_active_type="sigmoid", reverse=reverse)
+                return jnp.sum(hs * hs) + jnp.sum(hl)
+
+            def ref(x, wg, wc):
+                hs, hl = rnn.gru_scan(x, lens, wg, wc, None,
+                                      reverse=reverse)
+                return jnp.sum(hs * hs) + jnp.sum(hl)
+
+            lf, gf = jax.value_and_grad(fused, argnums=(0, 1, 2))(x, wg, wc)
+            lr, gr = forced_scan(
+                jax.value_and_grad(ref, argnums=(0, 1, 2)), x, wg, wc)
+        np.testing.assert_allclose(float(lf), float(lr), rtol=1e-4)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
+
+class TestAdditiveWide:
+    def test_bf16_grad_error_matches_jnp_formulation(self):
+        """Like-for-like bar: against an fp32 oracle the kernel's bf16
+        gradient error must be no worse than the jnp-bf16 formulation's —
+        the error is the bf16 INPUT rounding, not the kernel (measured
+        bitwise-identical in round 5)."""
+        from paddle_tpu.ops import pallas_additive
+        from paddle_tpu.ops.attention import additive_attention_step as ref
+
+        B, T, Ds, D, Dv = 16, 40, 512, 512, 512
+        dt = jnp.bfloat16
+        rng = np.random.default_rng(3)
+        dec = jnp.asarray(rng.normal(size=(B, Ds)), dt)
+        w = jnp.asarray(rng.normal(size=(Ds, D)) * 0.1, dt)
+        v = jnp.asarray(rng.normal(size=(D,)), dt)
+        proj = jnp.asarray(rng.normal(size=(B, T, D)), dt)
+        seq = jnp.asarray(rng.normal(size=(B, T, Dv)), dt)
+        lens = rng.integers(1, T + 1, B).astype(np.int32)
+        mask = jnp.arange(T)[None, :] < jnp.asarray(lens)[:, None]
+
+        def gk(p):
+            return jnp.sum(pallas_additive.additive_attention_step(
+                dec, w, v, p, seq, mask).astype(jnp.float32))
+
+        def gj(p):
+            return jnp.sum(ref(dec, w, v, p, seq, mask)
+                           .astype(jnp.float32))
+
+        with jax.default_matmul_precision("highest"):
+            g32 = np.asarray(jax.grad(lambda p: jnp.sum(ref(
+                *(a.astype(jnp.float32) for a in (dec, w, v)), p,
+                seq.astype(jnp.float32), mask)))(
+                proj.astype(jnp.float32)))
+        ek = np.abs(np.asarray(jax.grad(gk)(proj), np.float32) - g32).max()
+        ej = np.abs(np.asarray(jax.grad(gj)(proj), np.float32) - g32).max()
+        assert ek <= ej * 1.5 + 1e-6, (ek, ej)
+
+    def test_unaligned_wide_fp32(self):
+        from paddle_tpu.ops import pallas_additive
+        from paddle_tpu.ops.attention import additive_attention_step as ref
+
+        B, T, Ds, D, Dv = 3, 130, 257, 129, 255
+        rng = np.random.default_rng(5)
+        dec = jnp.asarray(rng.normal(size=(B, Ds)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(Ds, D)) * 0.1, jnp.float32)
+        v = jnp.asarray(rng.normal(size=(D,)), jnp.float32)
+        proj = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+        seq = jnp.asarray(rng.normal(size=(B, T, Dv)), jnp.float32)
+        lens = rng.integers(1, T + 1, B).astype(np.int32)
+        mask = jnp.arange(T)[None, :] < jnp.asarray(lens)[:, None]
+        got = pallas_additive.additive_attention_step(dec, w, v, proj, seq,
+                                                      mask)
+        with jax.default_matmul_precision("highest"):
+            want = ref(dec, w, v, proj, seq, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
