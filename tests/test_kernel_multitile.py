@@ -29,8 +29,14 @@ pytestmark = pytest.mark.slow
 @pytest.fixture(autouse=True)
 def _interpret(monkeypatch):
     # per-test only (monkeypatch restores): a module-level env set would
-    # leak interpret mode into every other test via collection-time import
+    # leak interpret mode into every other test via collection-time import.
+    # PADDLE_TPU_PALLAS=1 pins the kernel path even if the ambient env
+    # carries the =0 debugging switch — these tests exist to exercise the
+    # kernels, and must not silently green on the jnp fallback.
     monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("PADDLE_TPU_PALLAS", "1")
+    from paddle_tpu.ops import pallas_attention
+    assert pallas_attention.supported()
 
 
 class TestFlashMultiTile:
