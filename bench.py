@@ -560,6 +560,29 @@ def _perf_log_records() -> list[dict]:
     return out
 
 
+def _ts_newer(a, b) -> bool:
+    """True if timestamp `a` is strictly newer than `b`.  measured_at
+    values mix formats across PERF_LOG eras (aware '+00:00', 'Z'-suffixed,
+    naive rec-ts fallbacks), where lexicographic comparison can rank a
+    stale part above a newer one (e.g. any non-UTC offset) — so ISO-parse
+    both sides (naive = UTC) and string-compare only when either side does
+    not parse (ADVICE r5)."""
+    import datetime
+
+    def parse(x):
+        s = str(x)
+        d = datetime.datetime.fromisoformat(
+            s[:-1] + "+00:00" if s.endswith("Z") else s)
+        if d.tzinfo is None:
+            d = d.replace(tzinfo=datetime.timezone.utc)
+        return d
+
+    try:
+        return parse(a) > parse(b)
+    except ValueError:
+        return str(a) > str(b)
+
+
 def _assemble_lkg() -> dict | None:
     """Per-part last-known-good: for the headline and EVERY extra, the
     newest PERF_LOG occurrence — whether it was measured in a full run
@@ -604,7 +627,8 @@ def _assemble_lkg() -> dict | None:
         # (b) ...or newest per-config top-level record
         top = newest_toplevel(_METRIC_OF[key])
         if top is not None and (part is None or
-                                str(top["measured_at"]) > str(part.get("measured_at", ""))):
+                                _ts_newer(top["measured_at"],
+                                          part.get("measured_at", ""))):
             part = top
         if key == "seq2seq" and (part is None or
                                  "beam_decode_tokens_per_sec" not in part):

@@ -161,6 +161,86 @@ def make_batch(samples: list, types: list[InputType], names: list[str],
     return out
 
 
+class DeviceDoubleBuffer:
+    """Device-resident double buffering: a background thread runs
+    `place_fn` (typically stack + `jax.device_put`, or `shard_batch` /
+    `stage_stacked_batch` under a mesh) on each item ONE AHEAD of the
+    consumer, so host->device staging of batch/k-group i+1 overlaps the
+    device computation of i and H2D transfer leaves the dispatch critical
+    path (ref: gserver/dataproviders/DataProvider.h DoubleBuffer:260 —
+    the reference overlapped batch ASSEMBLY; device staging is the analog
+    one level further down).
+
+    `timer`, when given, is a zero-arg callable returning a context
+    manager (e.g. ``BarrierTimer.time_h2d``) wrapping each place_fn call,
+    which makes the overlap observable in the barrier windows.  `depth`
+    bounds how many staged items may be alive ahead of the consumer (the
+    thread stages at most depth+1 items beyond the one being consumed).
+    Exceptions from the producer or place_fn re-raise in the consumer.
+
+    A consumer that stops iterating early (an exception mid-pass) must
+    call `close()` — otherwise the producer thread would sit blocked on
+    the bounded queue forever, pinning its staged device buffers; the
+    trainer's fused loop closes in a finally block."""
+
+    def __init__(self, items: Iterator, place_fn, timer=None, depth: int = 1):
+        self._q: queue.Queue = queue.Queue(maxsize=max(int(depth), 1))
+        self._end = object()
+        self._stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that gives up once close() was called; returns
+            False when the buffer is shut down."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def work():
+            try:
+                for item in items:
+                    if self._stop.is_set():
+                        return
+                    if timer is not None:
+                        with timer():
+                            staged = place_fn(item)
+                    else:
+                        staged = place_fn(item)
+                    if not put(staged):
+                        return
+                put(self._end)
+            except BaseException as e:   # propagate to the consumer
+                put(e)
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Release the producer thread and drop staged items (idempotent)."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._end:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.close()
+
+
 class DataFeeder:
     """Batches a provider's samples for one or more passes."""
 
@@ -290,3 +370,9 @@ class DataFeeder:
             if isinstance(item, BaseException):
                 raise item
             yield item
+
+    def device_batches(self, place_fn, timer=None) -> Iterator:
+        """Batches staged onto device one ahead of the consumer (assembly
+        prefetch + the H2D DoubleBuffer; see DeviceDoubleBuffer)."""
+        return iter(DeviceDoubleBuffer(self.prefetched_batches(), place_fn,
+                                       timer=timer))

@@ -89,6 +89,13 @@ class ShardFeeder:
     # the native loader already prefetches in its C++ thread
     prefetched_batches = batches
 
+    def device_batches(self, place_fn, timer=None) -> Iterator[dict]:
+        """Batches staged onto device one ahead of the consumer: the native
+        loader's C++ thread overlaps batch ASSEMBLY; this adds the H2D
+        staging overlap on top (same contract as DataFeeder.device_batches)."""
+        from paddle_tpu.data.feeder import DeviceDoubleBuffer
+        return iter(DeviceDoubleBuffer(self.batches(), place_fn, timer=timer))
+
     def close(self) -> None:
         if self._loader is not None:
             self._loader.close()

@@ -259,7 +259,8 @@ def ring_attention(
     B, Tl, H, D = q.shape
     if scale is None:
         scale = D ** -0.5
-    n = lax.axis_size(axis_name)
+    from paddle_tpu.utils.jax_compat import axis_size
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 

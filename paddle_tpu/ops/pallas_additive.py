@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.utils.jax_compat import pallas_tpu_compiler_params
+
 Array = jax.Array
 
 _NEG_INF = -1e30
@@ -153,7 +155,7 @@ def _fwd_pallas(u, v, enc_proj, enc_seq, lengths):
             pltpu.VMEM((bB, 128), jnp.float32),   # running sum (lane 0)
             pltpu.VMEM((bB, Dvp), jnp.float32),   # context accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(u, v, enc_proj, enc_seq, len_col)

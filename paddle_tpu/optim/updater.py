@@ -124,7 +124,14 @@ class ParameterUpdater:
         """One training-step update; pure, call under jit.  With
         num_batches_per_send_parameter = N > 1, gradients accumulate and
         the optimizer applies once per N batches on their mean — identical
-        math to training on the N batches concatenated."""
+        math to training on the N batches concatenated.
+
+        Scan-fusion contract (trainer --steps_per_dispatch > 1 hosts this
+        whole function inside a lax.scan body): the returned (params,
+        state) pytrees must keep the INPUT structure and shapes — the
+        accumulate-or-apply branch below is a lax.cond, never a Python
+        if, so a window boundary inside a fused k-group stays a single
+        compiled program and the k=1 trajectory is reproduced exactly."""
         N = self.accum_n
         if N == 1:
             return self._apply(params, grads, state, batch_size)

@@ -49,6 +49,13 @@ class BarrierTimer:
     def __init__(self, window: int = 500):
         self.dispatch_s: deque[float] = deque(maxlen=window)
         self.sync_s: deque[float] = deque(maxlen=window)
+        # fused-dispatch (--steps_per_dispatch > 1) windows: h2d is the
+        # background thread's stack+device_put of one k-group, scan the
+        # host time to enqueue one k-step lax.scan.  Overlap is observable
+        # as h2d percentiles staying flat while scan windows absorb the
+        # whole step budget (see PERF.md "steps_per_dispatch").
+        self.h2d_s: deque[float] = deque(maxlen=window)
+        self.scan_s: deque[float] = deque(maxlen=window)
         self._t_enter: Optional[float] = None
 
     # -- recording --------------------------------------------------------
@@ -60,6 +67,15 @@ class BarrierTimer:
         """Context manager timing one host<-device drain (the barrier)."""
         return _Timed(self.sync_s)
 
+    def time_h2d(self):
+        """Context manager timing one k-group host->device staging (runs on
+        the prefetch thread — overlaps the current scan)."""
+        return _Timed(self.h2d_s)
+
+    def time_scan(self):
+        """Context manager timing one fused k-step scan dispatch."""
+        return _Timed(self.scan_s)
+
     # -- reporting --------------------------------------------------------
     def local_summary(self) -> dict[str, dict[str, float]]:
         out = {}
@@ -67,6 +83,10 @@ class BarrierTimer:
             out["dispatch"] = _pct(self.dispatch_s)
         if self.sync_s:
             out["sync"] = _pct(self.sync_s)
+        if self.h2d_s:
+            out["h2d"] = _pct(self.h2d_s)
+        if self.scan_s:
+            out["scan"] = _pct(self.scan_s)
         return out
 
     def straggler_summary(self) -> Optional[dict[str, float]]:

@@ -23,9 +23,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from paddle_tpu.ops.attention import ring_attention
+from paddle_tpu.utils.jax_compat import shard_map
 from paddle_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, axis_size
 
 Array = jax.Array

@@ -173,6 +173,27 @@ def shard_train_objects(mesh: Mesh, model: ModelConfig, params: dict,
     return out_params, opt_state
 
 
+def stage_stacked_batch(mesh: Mesh, stacked):
+    """Device-stage a k-group: a pytree of [k, B, ...] arrays (k batches
+    stacked along a leading step axis) placed with the STEP axis replicated
+    and the batch axis sharded over `data` — each scanned step then sees
+    exactly what `shard_batch` gives the per-batch path.  Multi-process:
+    every process stages its OWN k local batches and the global array
+    concatenates them along the batch dim (device_put cannot target
+    non-addressable devices)."""
+    sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    multiproc = jax.process_count() > 1
+
+    def place(x):
+        if not (hasattr(x, "ndim") and x.ndim >= 2):
+            return x
+        if multiproc:
+            return jax.make_array_from_process_local_data(sh, np.asarray(x))
+        return jax.device_put(x, sh)
+
+    return jax.tree.map(place, stacked)
+
+
 def shard_batch(mesh: Mesh, batch: dict[str, Argument]) -> dict[str, Argument]:
     """Shard every array's leading (batch) dim over the data axis — the analog
     of MultiGradientMachine slicing inArgs per thread (ref: .h:330-340).

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, axis_size
+from paddle_tpu.utils.jax_compat import shard_map
 
 Array = jax.Array
 
@@ -116,8 +117,8 @@ def pipeline_apply(
 
     # batch sharded over data (true dp x pp), stages over pipe
     in_specs = (P(PIPE_AXIS), P(DATA_AXIS))
-    fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
-                       out_specs=P(DATA_AXIS), check_vma=False)
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
+                   out_specs=P(DATA_AXIS), check_vma=False)
     return fn(stacked_params, x)
 
 

@@ -31,9 +31,12 @@ Re-design notes (vs parallel/pipeline.py's uniform-stage library path):
   backward pipeline schedule that the reference hand-builds with
   inter-thread gradient copies.
 
-Not supported under pp (asserted with clear errors): stateful layers
-(batch-norm moving stats) and generation; evaluators whose input layers
-live inside the pipeline are skipped at the Trainer level.
+Not supported under pp (asserted with clear errors): MUTABLE layer state
+(training-mode batch-norm moving stats, prev_batch_state recurrences) and
+generation; evaluators whose input layers live inside the pipeline are
+skipped at the Trainer level.  Frozen BN (use_global_stats=True) IS
+supported, fresh-init or fine-tuning from loaded moving stats — the
+loaded stats are constants of the stage bodies (_check_frozen_state).
 """
 
 from __future__ import annotations
@@ -52,6 +55,7 @@ from paddle_tpu.graph.context import ForwardContext, TRAIN
 from paddle_tpu.graph.registry import get_layer_fn
 from paddle_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, axis_size
 from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.utils.jax_compat import shard_map
 
 Array = jax.Array
 
@@ -327,10 +331,12 @@ def _vjp_branch(f):
     jax.vjp from its stashed input carrier.  The cotangents stack across
     lax.switch because every branch returns the same (out[mb, width],
     cost[mb]) shapes.  Shared by the 1F1B and interleaved hand-scheduled
-    backwards — one definition so they can never diverge."""
-    def bwd(p, stash_in, feed_mb, key, d_out, d_cost):
+    backwards — one definition so they can never diverge.  `frz` (frozen
+    BN stats) is a constant of the recompute — never differentiated."""
+    def bwd(p, stash_in, feed_mb, key, d_out, d_cost, frz):
         (_, _), vjp_fn = jax.vjp(
-            lambda pp, rr: f(pp, rr, feed_mb, key), p, stash_in)
+            lambda pp, rr: f(pp, rr, feed_mb, key,
+                             jax.lax.stop_gradient(frz)), p, stash_in)
         d_p, d_recv = vjp_fn((d_out, d_cost))
         return d_p, d_recv
 
@@ -432,8 +438,37 @@ class PipelineExecutor:
         un-pipelined on this host's devices."""
         return self.inner.forward(*a, **kw)
 
+    # -- frozen layer state ----------------------------------------------
+    @property
+    def _frozen_state_names(self) -> set:
+        """Layers whose carried state is CONSTANT during training —
+        explicitly-frozen batch norm (use_global_stats=True): its moving
+        stats are read, never written, so loaded checkpoint stats can be
+        embedded into the stage computation as graph constants and the
+        frozen-fine-tune pattern pipelines exactly."""
+        return {l.name for l in self.model.layers
+                if l.use_global_stats is True}
+
+    def _check_frozen_state(self, state) -> dict:
+        """Validate that every net_state entry belongs to a frozen-BN
+        layer; genuinely MUTABLE state (training-mode BN moving stats,
+        prev_batch_state recurrences) cannot ride the stage ring."""
+        state = dict(state or {})
+        mutable = sorted(set(state) - self._frozen_state_names)
+        assert not mutable, (
+            f"layers with mutable state {mutable} are not supported under "
+            f"pipeline parallelism (per-microbatch stat updates would "
+            f"change the training numerics vs the un-pipelined oracle, "
+            f"and the stage ring has no mutable-state channel).  Freeze "
+            f"the stats with batch_norm_layer(..., use_global_stats=True) "
+            f"— frozen BN pipelines exactly, fresh-init or with loaded "
+            f"moving stats (they are embedded as constants); or train "
+            f"this config without device= annotations")
+        return state
+
     # -- boundary specs ---------------------------------------------------
-    def _boundary_specs(self, feed: dict[str, Argument], mb: int):
+    def _boundary_specs(self, feed: dict[str, Argument], mb: int,
+                        state=None):
         """Derive each boundary's carrier layout by shape-tracing the full
         graph on a microbatch-shaped feed.  Static per batch signature."""
         sig = tuple(sorted(
@@ -452,19 +487,14 @@ class PipelineExecutor:
         mb_feed = jax.tree.map(slice_leaf, feed)
         params_sds = {p.name: jax.ShapeDtypeStruct(tuple(p.dims), jnp.float32)
                       for p in self.model.parameters}
-        outs, costs, state = jax.eval_shape(
-            lambda p, f: self.inner.forward(p, f, None, TRAIN,
+        outs, costs, state_out = jax.eval_shape(
+            lambda p, f: self.inner.forward(p, f, state, TRAIN,
                                             jax.random.PRNGKey(0)),
             params_sds, mb_feed)
-        assert not state, (
-            f"layers with mutable state {sorted(state)} are not supported "
-            f"under pipeline parallelism (batch-norm moving stats would "
-            f"need per-stage state routing, and per-microbatch stats would "
-            f"change the training numerics vs the un-pipelined oracle). "
-            f"Supported pattern: freeze the stats with "
-            f"batch_norm_layer(..., use_global_stats=True) — explicitly-"
-            f"frozen BN is stateless and pipelines exactly; or train this "
-            f"config without device= annotations")
+        # scoped to GENUINELY mutable state: frozen-BN entries (loaded
+        # moving stats round-tripping through state_out unchanged) are
+        # constants and pipeline exactly (ADVICE r5)
+        self._check_frozen_state(state_out)
         specs = []
         for names in self.payload_names:
             row = []
@@ -535,9 +565,11 @@ class PipelineExecutor:
             in_row = specs[s - 1] if s > 0 else []
             out_row = specs[s] if s < S - 1 else []
 
-            def branch(p, recv, feed_mb, key):
+            def branch(p, recv, feed_mb, key, frz):
+                # frz: frozen-BN moving stats (use_global_stats=True),
+                # loaded from a checkpoint — constants of the stage body
                 ctx = ForwardContext(model=model, params=p, mode=mode,
-                                     rng=key)
+                                     rng=key, state_in=frz)
                 for n, a in feed_mb.items():
                     ctx.outputs[n] = a
                 ctx.outputs.update(self._unpack(in_row, recv, mb))
@@ -562,7 +594,7 @@ class PipelineExecutor:
 
         return [make_branch(s) for s in range(S)]
 
-    def _prologue(self, params, feed, rng):
+    def _prologue(self, params, feed, rng, state=None):
         """Shared entry for both schedules: prepare, microbatch sizing,
         boundary specs, rng default.  One place so the divisibility rule
         and spec derivation can never diverge between GPipe and 1F1B."""
@@ -574,24 +606,24 @@ class PipelineExecutor:
             f"batch {B} not divisible by {M} microbatches x {n_data} data "
             f"shards")
         mb = B // (M * n_data)
-        specs, width = self._boundary_specs(feed, mb)
+        specs, width = self._boundary_specs(feed, mb, state)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         return params, feed, B, mb, specs, width, rng
 
     # -- the pipelined loss ----------------------------------------------
     def loss(self, params, feed, state=None, mode: str = TRAIN, rng=None):
-        assert not state, "pipeline executor carries no layer state"
+        frozen = self._check_frozen_state(state)
         if self.schedule == "interleaved":
-            return self._table_loss(params, feed, mode, rng)
+            return self._table_loss(params, feed, mode, rng, state=frozen)
         S, M = self.n_stages, self.n_micro
         params, feed, B, mb, specs, width, rng = self._prologue(
-            params, feed, rng)
+            params, feed, rng, state=frozen)
 
         branches = self._stage_branches(specs, width, mb, mode)
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
 
-        def local(p, feed_loc, key):
+        def local(p, feed_loc, key, frz):
             stage = lax.axis_index(PIPE_AXIS)
 
             def tick(carry, t):
@@ -604,7 +636,7 @@ class PipelineExecutor:
                 # per-(microbatch, stage) rng stream for dropout etc.
                 key_t = jax.random.fold_in(key, m_idx * S + stage)
                 out, cost = lax.switch(stage, branches, p, recv, feed_mb,
-                                       key_t)
+                                       key_t, frz)
                 j = t - (S - 1)
                 banked = lax.dynamic_update_index_in_dim(
                     loss_buf, cost[None], jnp.maximum(j, 0), axis=0)
@@ -622,15 +654,16 @@ class PipelineExecutor:
             return total / B
 
         from jax.sharding import PartitionSpec as P
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P()), out_specs=P(),
+            in_specs=(P(), P(DATA_AXIS), P(), P()), out_specs=P(),
             check_vma=False)
-        total = fn(params, feed, rng)
+        total = fn(params, feed, rng, frozen)
         return total, ({}, {}, {})
 
     # -- 1F1B: hand-scheduled forward/backward --------------------------
-    def loss_and_grad(self, params, feed, mode: str = TRAIN, rng=None):
+    def loss_and_grad(self, params, feed, mode: str = TRAIN, rng=None,
+                      state=None):
         """One-forward-one-backward schedule (pipeline_schedule='1f1b').
 
         GPipe above runs ALL forwards then lets autodiff transpose the
@@ -655,11 +688,13 @@ class PipelineExecutor:
         instead of wrapping loss() in jax.value_and_grad.
         """
         if self.schedule == "interleaved":
-            return self._table_loss_and_grad(params, feed, mode, rng)
+            return self._table_loss_and_grad(params, feed, mode, rng,
+                                             state=state)
+        frozen = self._check_frozen_state(state)
         raw_dtypes = {k: v.dtype for k, v in params.items()}
         S, M = self.n_stages, self.n_micro
         params, feed, B, mb, specs, width, rng = self._prologue(
-            params, feed, rng)
+            params, feed, rng, state=frozen)
 
         fwd_branches = self._stage_branches(specs, width, mb, mode)
         bwd_branches = [_vjp_branch(f) for f in fwd_branches]
@@ -667,7 +702,7 @@ class PipelineExecutor:
         bwd_perm = [(i, i - 1) for i in range(1, S)]
         gacc0 = _grad_acc_init(params)
 
-        def local(p, feed_loc, key):
+        def local(p, feed_loc, key, frz):
             stage = lax.axis_index(PIPE_AXIS)
             T = 2 * (M + S - 1)
 
@@ -687,7 +722,7 @@ class PipelineExecutor:
 
                 def run_f(_):
                     return lax.switch(stage, fwd_branches, p, recv_f,
-                                      feed_at(m_f), key_f)
+                                      feed_at(m_f), key_f, frz)
 
                 def skip_f(_):
                     return (jnp.zeros((mb, width), jnp.float32),
@@ -716,7 +751,7 @@ class PipelineExecutor:
                 def run_b(gacc_in):
                     d_p, d_recv = lax.switch(
                         stage, bwd_branches, p, stash[m_b % S],
-                        feed_at(m_b), key_b, recv_b, d_cost)
+                        feed_at(m_b), key_b, recv_b, d_cost, frz)
                     return jax.tree.map(
                         lambda a, g: a + g.astype(a.dtype), gacc_in, d_p), \
                         d_recv
@@ -746,15 +781,16 @@ class PipelineExecutor:
             return total / B, grads
 
         from jax.sharding import PartitionSpec as P
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P()), out_specs=(P(), P()),
+            in_specs=(P(), P(DATA_AXIS), P(), P()), out_specs=(P(), P()),
             check_vma=False)
-        total, grads = fn(params, feed, rng)
+        total, grads = fn(params, feed, rng, frozen)
         return total, _cast_grads_back(grads, raw_dtypes)
 
     # -- interleaved virtual stages: table-driven schedule ---------------
-    def _table_run(self, params, feed, mode, rng, fwd_only: bool):
+    def _table_run(self, params, feed, mode, rng, fwd_only: bool,
+                   state=None):
         """Execute the compiled interleaved schedule: one scan body serves
         both training (fwd_only=False: both legs, returns (loss, grads))
         and test/eval (fwd_only=True: forward leg only, returns loss).
@@ -763,11 +799,12 @@ class PipelineExecutor:
         cotangents whose consumer isn't scheduled just-in-time; chunk
         round-robin makes EVERY chunk boundary a +1 ring hop (wrapping
         S-1 -> 0 between virtual-stage groups)."""
+        frozen = self._check_frozen_state(state)
         raw_dtypes = None if fwd_only else \
             {k: v.dtype for k, v in params.items()}
         M, C, S = self.n_micro, self.n_chunks, self.n_stages
         params, feed, B, mb, specs, width, rng = self._prologue(
-            params, feed, rng)
+            params, feed, rng, state=frozen)
         fwd_branches = self._stage_branches(specs, width, mb, mode)
         bwd_branches = None if fwd_only else \
             [_vjp_branch(f) for f in fwd_branches]
@@ -780,7 +817,7 @@ class PipelineExecutor:
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
         gacc0 = None if fwd_only else _grad_acc_init(params)
 
-        def local(p, feed_loc, key):
+        def local(p, feed_loc, key, frz):
             stage = lax.axis_index(PIPE_AXIS)
 
             def feed_at(m_idx):
@@ -814,7 +851,7 @@ class PipelineExecutor:
                     return lax.switch(
                         fc, fwd_branches, p,
                         lax.dynamic_index_in_dim(fstash, fs, 0, False),
-                        feed_at(fm), key_f)
+                        feed_at(fm), key_f, frz)
 
                 def skip_f(_):
                     return (jnp.zeros((mb, width), jnp.float32),
@@ -843,7 +880,7 @@ class PipelineExecutor:
                         lax.dynamic_index_in_dim(fstash, bf, 0, False),
                         feed_at(bm), key_b,
                         lax.dynamic_index_in_dim(bstash, bs, 0, False),
-                        d_cost)
+                        d_cost, frz)
                     return jax.tree.map(
                         lambda a, g: a + g.astype(a.dtype), gacc_in, d_p), \
                         d_recv
@@ -879,22 +916,25 @@ class PipelineExecutor:
             return total / B, grads
 
         from jax.sharding import PartitionSpec as P
-        fn = jax.shard_map(
+        fn = shard_map(
             local, mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P()),
+            in_specs=(P(), P(DATA_AXIS), P(), P()),
             out_specs=P() if fwd_only else (P(), P()),
             check_vma=False)
         if fwd_only:
-            return fn(params, feed, rng)
-        total, grads = fn(params, feed, rng)
+            return fn(params, feed, rng, frozen)
+        total, grads = fn(params, feed, rng, frozen)
         return total, _cast_grads_back(grads, raw_dtypes)
 
-    def _table_loss(self, params, feed, mode: str = TRAIN, rng=None):
+    def _table_loss(self, params, feed, mode: str = TRAIN, rng=None,
+                    state=None):
         """Forward-only (test/eval) execution of the interleaved table."""
-        total = self._table_run(params, feed, mode, rng, fwd_only=True)
+        total = self._table_run(params, feed, mode, rng, fwd_only=True,
+                                state=state)
         return total, ({}, {}, {})
 
     def _table_loss_and_grad(self, params, feed, mode: str = TRAIN,
-                             rng=None):
+                             rng=None, state=None):
         """Interleaved 1F1B training: both legs of the compiled table."""
-        return self._table_run(params, feed, mode, rng, fwd_only=False)
+        return self._table_run(params, feed, mode, rng, fwd_only=False,
+                               state=state)

@@ -71,8 +71,8 @@ def sharded_embedding_lookup(mesh: Mesh, table: Array, ids: Array,
     """Explicit sharded lookup: shard `table` rows over `axis`, replicate
     `ids`, one psum over ICI.  Differentiable; the table gradient is
     computed shard-locally."""
-    from jax import shard_map
     from paddle_tpu.parallel.mesh import MODEL_AXIS
+    from paddle_tpu.utils.jax_compat import shard_map
     axis = axis or MODEL_AXIS
 
     fn = shard_map(

@@ -715,6 +715,21 @@ class EvaluatorSet:
     def new_accumulator(self) -> dict:
         return {}
 
+    def accumulate_stacked(self, acc: dict, stacked: dict, n: int) -> dict:
+        """Fold a fused dispatch's per-step partials (each leaf stacked
+        [n, ...] along a leading step axis by the k-step lax.scan) into the
+        accumulator — ONE device fetch for the whole group, then the same
+        per-step float64 additions, in the same order, as n separate
+        `accumulate` calls: the fused path's evaluator results stay
+        bit-identical to the per-batch loop's."""
+        if not stacked:
+            return acc
+        host = jax.tree.map(np.asarray, jax.device_get(stacked))
+        for i in range(n):
+            acc = self.accumulate(
+                acc, jax.tree.map(lambda a: a[i], host))
+        return acc
+
     def accumulate(self, acc: dict, partials: dict) -> dict:
         for name, parts in partials.items():
             if name not in acc:

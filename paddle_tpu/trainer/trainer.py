@@ -127,6 +127,15 @@ class Trainer:
             self.zero_stage = 0
         self._train_step_fn = self._build_train_step_fn()
         self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
+        self._fused_step = self._build_fused_step()
+        # benchmark twin: same scanned step, losses only (no [iters, ...]
+        # evaluator/host buffers stacked on device)
+        self._fused_step_losses = self._build_fused_step(
+            collect_outputs=False)
+        # fused-dispatch oracles: tests assert exactly ceil(n/k) compiled
+        # scan executions for n same-signature batches
+        self._n_fused_dispatches = 0
+        self._settled_sigs: set = set()
         self._test_step = self._build_test_step()
         # device-side losses buffered between host syncs (VERDICT: the
         # reference pays a per-batch cost check but not an XLA pipeline
@@ -240,9 +249,12 @@ class Trainer:
                                                          "interleaved"):
                 # hand-scheduled pipeline backward (1F1B, plain or over
                 # interleaved virtual stages) — the executor returns grads
-                # itself instead of sitting behind jax.value_and_grad
+                # itself instead of sitting behind jax.value_and_grad;
+                # net_state may carry loaded frozen-BN stats (embedded as
+                # stage-body constants, never updated)
                 loss, grads = executor.loss_and_grad(params, batch,
-                                                     TRAIN, rng)
+                                                     TRAIN, rng,
+                                                     state=net_state)
                 outputs, costs, new_net = {}, {}, net_state
                 grads = constrain_grads(grads)
             else:
@@ -264,6 +276,52 @@ class Trainer:
             return new_params, new_opt, new_net, loss, partials, host_out
 
         return train_step
+
+    def _build_fused_step(self, collect_outputs: bool = True):
+        """Jitted k-step fused dispatch: `lax.scan` of the IDENTICAL
+        per-batch train step over k batches stacked on a leading step axis,
+        with pre-split per-step rng keys — one Python dispatch and one XLA
+        program launch for k optimizer updates (the whole-loop-compilation
+        execution model of arXiv:1810.09868).  Per-step losses, evaluator
+        partials and host fetches come back stacked along the step axis so
+        every host-side contract (the `_drain_losses` nonfinite check, the
+        float64 evaluator accumulation, host evaluators) replays unchanged
+        and the trajectory is bit-identical to the per-batch loop.  Used by
+        train_one_pass(steps_per_dispatch=k) and benchmark(scan=True) —
+        the benchmark's scan mode IS the production path.
+
+        The scan length is the stacked leading dim: each distinct
+        (k, batch-signature) pair compiles once, like the per-batch step
+        compiles per length bucket.  grad_accum (num_batches_per_send_
+        parameter > 1) needs no special casing: the accumulate-or-apply
+        lax.cond lives inside the per-batch step and scans unchanged.
+
+        collect_outputs=False drops the per-step partials/host fetches
+        from the scan outputs — the benchmark scans HUNDREDS of steps in
+        one dispatch and consumes only losses, so stacking [iters, ...]
+        evaluator/host buffers (e.g. printer-evaluator layer outputs)
+        would burn HBM for nothing.  The training path (small k) keeps
+        them."""
+        from jax import lax
+
+        step_fn = self._train_step_fn
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def fused_step(params, opt_state, net_state, stacked, keys):
+            def body(carry, xs):
+                p, o, n = carry
+                batch, key = xs
+                p, o, n, loss, partials, host_out = step_fn(p, o, n, batch,
+                                                            key)
+                if not collect_outputs:
+                    partials, host_out = {}, {}
+                return (p, o, n), (loss, partials, host_out)
+
+            (p, o, n), (losses, partials, host_outs) = lax.scan(
+                body, (params, opt_state, net_state), (stacked, keys))
+            return p, o, n, losses, partials, host_outs
+
+        return fused_step
 
     def _build_test_step(self):
         executor, evaluators = self.executor, self.evaluators
@@ -320,35 +378,73 @@ class Trainer:
         return feeder.prefetched_batches()
 
     # -- loops ------------------------------------------------------------
-    def _dispatch_step(self, batch: dict[str, Argument]):
+    def _batch_signature(self, batch: dict[str, Argument]) -> tuple:
+        """Shape/dtype signature of a batch plus the net_state structure —
+        the retrace key of the compiled step.  The per-batch path uses it
+        to keep compile time out of the barrier windows; the fused path
+        (steps_per_dispatch > 1) groups consecutive same-signature batches
+        by it (a length-bucketed feeder emits few distinct signatures)."""
+        return (str(jax.tree.map(
+                    lambda a: (jnp.shape(a), str(jnp.result_type(a))), batch)),
+                str(jax.tree_util.tree_structure(self.net_state)))
+
+    def _seen_sigs(self) -> set:
+        seen = getattr(self, "_dispatch_sigs", None)
+        if seen is None:
+            seen = self._dispatch_sigs = set()
+        return seen
+
+    def _dispatch_step(self, batch: dict[str, Argument], key=None):
         """Dispatch one compiled train step (async — no host sync); returns
-        (loss, partials, host_out) device values."""
+        (loss, partials, host_out) device values.  `key` overrides the
+        internal rng split with a pre-split per-step key (the fused path's
+        settling dispatch must consume the key already drawn for batch 0)."""
         if self.mesh is not None:
             from paddle_tpu.parallel.dp import shard_batch
             batch = shard_batch(self.mesh, batch)
-        self.rng, sub = jax.random.split(self.rng)
-        self._last_rng = sub
+        if key is None:
+            self.rng, key = jax.random.split(self.rng)
+        self._last_rng = key
         # any UNSEEN (batch-shape, net_state-structure) signature likely
         # retraces+recompiles — seconds of XLA work, not queue backpressure;
         # keep those dispatches out of the barrier timing windows (this
         # covers the first batch, every new length bucket, and the
         # net_state pytree change after batch 1)
-        sig = (str(jax.tree.map(lambda a: (jnp.shape(a), str(jnp.result_type(a))), batch)),
-               str(jax.tree_util.tree_structure(self.net_state)))
-        seen = getattr(self, "_dispatch_sigs", None)
-        if seen is None:
-            seen = self._dispatch_sigs = set()
+        sig = self._batch_signature(batch)
+        seen = self._seen_sigs()
         if sig in seen:
             with self.barrier_stat.time_dispatch():
                 (self.params, self.opt_state, new_net, loss, partials, host_out) = \
-                    self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
+                    self._train_step(self.params, self.opt_state, self.net_state, batch, key)
         else:
             seen.add(sig)
             (self.params, self.opt_state, new_net, loss, partials, host_out) = \
-                self._train_step(self.params, self.opt_state, self.net_state, batch, sub)
+                self._train_step(self.params, self.opt_state, self.net_state, batch, key)
         if new_net:
             self.net_state = new_net
         return loss, partials, host_out
+
+    def _dispatch_fused(self, staged, keys, sig: tuple):
+        """Dispatch ONE compiled k-step scan over a staged same-signature
+        group (async); returns stacked (losses, partials, host_outs).  The
+        first dispatch of a (k, signature) pair compiles — kept out of the
+        `scan` barrier window like _dispatch_step's first-seen logic."""
+        self._last_rng = keys[-1]
+        fsig = ("fused", int(keys.shape[0]), sig)
+        seen = self._seen_sigs()
+        if fsig in seen:
+            with self.barrier_stat.time_scan():
+                out = self._fused_step(self.params, self.opt_state,
+                                       self.net_state, staged, keys)
+        else:
+            seen.add(fsig)
+            out = self._fused_step(self.params, self.opt_state,
+                                   self.net_state, staged, keys)
+        (self.params, self.opt_state, new_net, losses, partials, host_outs) = out
+        if new_net:
+            self.net_state = new_net
+        self._n_fused_dispatches += 1
+        return losses, partials, host_outs
 
     def _validate_batch(self, batch: dict[str, Argument]) -> None:
         """Clear errors for the common feed mistakes BEFORE tracing: a
@@ -406,6 +502,12 @@ class Trainer:
             if not hasattr(self, "_host_acc") or self._host_acc is None:
                 self._host_acc = self.evaluators.new_host_state()
             self.evaluators.host_update(self._host_acc, host_out)
+        return self._account_loss(loss, batch)
+
+    def _account_loss(self, loss, batch: dict[str, Argument]):
+        """Per-step loss bookkeeping shared by the per-batch and fused
+        loops: under --detect_nan fetch+check immediately; otherwise buffer
+        the device scalar and bulk-drain every nonfinite_check_period."""
         if FLAGS.detect_nan:
             loss_f = float(loss)
             if not np.isfinite(loss_f):
@@ -442,16 +544,39 @@ class Trainer:
         return float(losses.sum())
 
     def train_one_pass(self, batches: Optional[Iterator] = None,
-                       log_period: int = 0) -> dict[str, float]:
-        """(ref: Trainer::trainOnePass)."""
+                       log_period: int = 0,
+                       steps_per_dispatch: Optional[int] = None
+                       ) -> dict[str, float]:
+        """(ref: Trainer::trainOnePass).
+
+        steps_per_dispatch=k > 1 (default: --steps_per_dispatch) runs the
+        pass through the fused dispatch path: consecutive same-signature
+        batches stack into k-groups, each executed as ONE compiled k-step
+        lax.scan while a background thread device-stages the NEXT group
+        (see _train_one_pass_fused).  Trajectory, evaluator results and
+        the nonfinite-check contract are identical to the k=1 loop."""
         t0 = time.time()
         self._acc = self.evaluators.new_accumulator()
         self._host_acc = self.evaluators.new_host_state() if \
             self.evaluators.host_configs else None
-        self._drained_cost, n_batches, n_samples = 0.0, 0, 0
+        self._drained_cost = 0.0
         self._loss_buf.clear()
         if batches is None:
             batches = self.train_batches()
+        k = int(FLAGS.steps_per_dispatch if steps_per_dispatch is None
+                else steps_per_dispatch)
+        if k > 1 and FLAGS.detect_nan:
+            # --detect_nan promises PER-BATCH halting + localisation with
+            # the failing step's rng/params; a fused group would apply the
+            # remaining k-1 updates before the check and replay diagnosis
+            # with the group's last key.  Debug mode wins over dispatch
+            # overhead: fall back to the per-batch loop.
+            log.warning("--detect_nan forces steps_per_dispatch=1 "
+                        "(per-batch nonfinite localisation)")
+            k = 1
+        if k > 1:
+            return self._train_one_pass_fused(batches, log_period, k, t0)
+        n_batches, n_samples = 0, 0
         stats_period = FLAGS.show_parameter_stats_period
         for batch in batches:
             with global_stat.time("trainOneBatch"):
@@ -459,14 +584,21 @@ class Trainer:
             n_batches += 1
             n_samples += _batch_size(batch)
             if log_period and n_batches % log_period == 0:
-                self._drained_cost += self._drain_losses()
-                log.info("pass %d batch %d: cost=%.5f %s", self.pass_id, n_batches,
-                         self._drained_cost / n_batches,
-                         _fmt(self.evaluators.finalize(self._acc)))
-                if self.mesh is not None:
-                    log.info("barrier: %s", self.barrier_stat.render())
+                self._log_progress(n_batches)
             if stats_period and n_batches % stats_period == 0:
                 self.log_param_stats()
+        return self._finish_pass_stats(t0, n_batches, n_samples)
+
+    def _log_progress(self, n_batches: int) -> None:
+        self._drained_cost += self._drain_losses()
+        log.info("pass %d batch %d: cost=%.5f %s", self.pass_id, n_batches,
+                 self._drained_cost / n_batches,
+                 _fmt(self.evaluators.finalize(self._acc)))
+        if self.mesh is not None:
+            log.info("barrier: %s", self.barrier_stat.render())
+
+    def _finish_pass_stats(self, t0: float, n_batches: int,
+                           n_samples: int) -> dict[str, float]:
         self._drained_cost += self._drain_losses()
         total_cost = self._drained_cost
         self.opt_state = self.updater.finish_pass(self.opt_state)
@@ -480,6 +612,141 @@ class Trainer:
         log.info("pass %d done: %s", self.pass_id, _fmt(stats))
         self.pass_id += 1
         return stats
+
+    # -- fused k-step dispatch (--steps_per_dispatch) ---------------------
+    def _net_state_settled(self, batch: dict[str, Argument], key) -> bool:
+        """True if dispatching `batch` cannot change the net_state pytree
+        STRUCTURE.  A stateful model (training-mode batch norm) grows its
+        state on the first-ever dispatch; a lax.scan carry must be
+        structure-stable, so the fused path routes that one batch through
+        the per-batch step first — exactly what the k=1 loop's batch 0
+        does.  Shape-level tracing only (jax.eval_shape); cached per batch
+        signature."""
+        sig = self._batch_signature(batch)
+        if sig in self._settled_sigs:
+            return True
+        try:
+            out = jax.eval_shape(self._train_step_fn, self.params,
+                                 self.opt_state, self.net_state, batch, key)
+        except Exception:
+            return False     # conservatively settle via a per-batch dispatch
+        new_net = out[2]
+        settled = (not new_net) or (
+            jax.tree_util.tree_structure(new_net)
+            == jax.tree_util.tree_structure(self.net_state))
+        if settled:
+            self._settled_sigs.add(sig)
+        return settled
+
+    def _stage_group(self, group):
+        """DeviceDoubleBuffer place_fn: stack a same-signature k-group on a
+        leading step axis and move it to device (batch dim sharded over
+        `data` under a mesh) — runs on the prefetch thread, so the H2D
+        transfer of group i+1 overlaps the scan of group i."""
+        host_batches, keys, sig = group
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_batches)
+        if self.mesh is not None:
+            from paddle_tpu.parallel.dp import stage_stacked_batch
+            stacked = stage_stacked_batch(self.mesh, stacked)
+        else:
+            stacked = jax.device_put(stacked)
+        return stacked, jnp.stack(keys), host_batches, sig
+
+    def _train_one_pass_fused(self, batches: Iterator, log_period: int,
+                              k: int, t0: float) -> dict[str, float]:
+        """Fused pass body: k train steps per compiled dispatch + device
+        double-buffered input staging.
+
+        Parity with the k=1 loop is exact, not approximate:
+          - batches group by the _batch_signature length-bucket key but
+            only CONSECUTIVE same-signature batches fuse (a group flushes
+            early on signature change), so optimizer updates apply in
+            arrival order;
+          - per-step rng keys are pre-split from self.rng in arrival
+            order — step i consumes the very key the k=1 loop would;
+          - grad_accum (optim/updater.py) rides inside the scanned step;
+          - per-step losses come back stacked and feed the same
+            _loss_buf/_drain_losses cadence, and evaluator partials
+            accumulate per step in the same float64 order.
+        Dispatch count for n same-signature batches is exactly ceil(n/k)
+        (+1 per-batch settling dispatch for stateful models, mirroring the
+        k=1 loop's structure-changing first batch)."""
+        from paddle_tpu.data.feeder import DeviceDoubleBuffer
+        stats_period = FLAGS.show_parameter_stats_period
+        n_batches, n_samples = 0, 0
+
+        def host_groups():
+            pending: list = []
+            keys: list = []
+            sig = None
+            for batch in batches:
+                self._validate_batch(batch)
+                if self.sparse_stats is not None:
+                    self.sparse_stats.probe_batch(batch)
+                s = self._batch_signature(batch)
+                if pending and (s != sig or len(pending) == k):
+                    yield pending, keys, sig
+                    pending, keys = [], []
+                sig = s
+                self.rng, sub = jax.random.split(self.rng)
+                pending.append(batch)
+                keys.append(sub)
+            if pending:
+                yield pending, keys, sig
+
+        groups = host_groups()
+        first = next(groups, None)
+        if first is None:
+            return self._finish_pass_stats(t0, 0, 0)
+        if not self._net_state_settled(first[0][0], first[1][0]):
+            b0, key0 = first[0][0], first[1][0]
+            with global_stat.time("trainOneBatch"):
+                loss, partials, host_out = self._dispatch_step(b0, key=key0)
+                self._acc = self.evaluators.accumulate(self._acc, partials)
+                if self._host_acc is not None:
+                    self.evaluators.host_update(self._host_acc, host_out)
+                self._account_loss(loss, b0)
+            n_batches += 1
+            n_samples += _batch_size(b0)
+            first = (first[0][1:], first[1][1:], first[2])
+
+        def chain():
+            if first[0]:
+                yield first
+            yield from groups
+
+        staged = DeviceDoubleBuffer(chain(), self._stage_group,
+                                    timer=self.barrier_stat.time_h2d)
+        try:
+            for stacked, keys, host_batches, sig in staged:
+                j = len(host_batches)
+                with global_stat.time("trainKSteps"):
+                    losses, partials, host_outs = self._dispatch_fused(
+                        stacked, keys, sig)
+                self._acc = self.evaluators.accumulate_stacked(
+                    self._acc, partials, j)
+                if self._host_acc is not None and host_outs:
+                    host_np = jax.tree.map(np.asarray,
+                                           jax.device_get(host_outs))
+                    for i in range(j):
+                        self.evaluators.host_update(
+                            self._host_acc,
+                            jax.tree.map(lambda a: a[i], host_np))
+                for i in range(j):
+                    self._account_loss(losses[i], host_batches[i])
+                n_batches += j
+                n_samples += sum(_batch_size(b) for b in host_batches)
+                if log_period and (n_batches // log_period) != \
+                        ((n_batches - j) // log_period):
+                    self._log_progress(n_batches)
+                if stats_period and (n_batches // stats_period) != \
+                        ((n_batches - j) // stats_period):
+                    self.log_param_stats()
+        finally:
+            # a mid-pass exception (nonfinite drain, feed validation) must
+            # not leave the producer thread blocked holding staged groups
+            staged.close()
+        return self._finish_pass_stats(t0, n_batches, n_samples)
 
     def train(self, num_passes: int = 1, log_period: int = 100,
               save_dir: Optional[str] = None, keep_last: int = 0) -> list[dict]:
@@ -620,7 +887,8 @@ class Trainer:
         saved_dtype = self.executor.compute_dtype
         self.executor.compute_dtype = ""
         try:
-            with (jax.enable_x64() if x64 else contextlib.nullcontext()):
+            from paddle_tpu.utils.jax_compat import enable_x64
+            with (enable_x64() if x64 else contextlib.nullcontext()):
                 if x64:
                     def to_f64(x):
                         x = jnp.asarray(np.asarray(jax.device_get(x)))
@@ -739,15 +1007,20 @@ class Trainer:
                     float(np.finfo(flat.dtype).eps) / (2 * eps_i)
                 denom = max(abs(numeric), abs(gflat[i]), 100.0 * noise, 1e-8)
                 worst = max(worst, abs(numeric - gflat[i]) / denom)
-            errors[name] = worst
             if detect_kinks and n_validated == 0 and n_kink > 0:
-                # "cannot validate" must be visible — every sampled entry
-                # sat exactly on a non-smooth point, so the 0.0 above means
-                # unadjudicated, not clean
+                # every sampled entry sat exactly on a non-smooth point —
+                # the refine pass ADJUDICATED NOTHING.  Omit the key so
+                # check_gradient's errors.update() keeps the fp32 screen's
+                # flagged value (a flagged-but-unadjudicated parameter must
+                # still fail the --job=checkgrad exit-code contract, not
+                # exit 0 on a silent 0.0; ADVICE r5)
                 log.warning(
                     "checkgrad %s: 0 of %d sampled entries validated (all "
-                    "straddle non-smooth points) — result inconclusive for "
-                    "this parameter", name, n_kink)
+                    "straddle non-smooth points) — inconclusive; the fp32 "
+                    "screen's flagged error stands for this parameter",
+                    name, n_kink)
+                continue
+            errors[name] = worst
             log.info("checkgrad %s: max_rel_err=%.3e", name, worst)
         return errors
 
@@ -762,9 +1035,11 @@ class Trainer:
 
         scan=True stages all batches in device memory and runs the SAME
         per-batch training step inside one `lax.scan` — a single dispatch
-        for the whole run.  This is the TPU-native shape of a production
-        input pipeline (data prefetched to HBM ahead of compute) and
-        measures pure device throughput.
+        for the whole run, via the PRODUCTION fused-dispatch path
+        (_build_fused_step, what train_one_pass(steps_per_dispatch=k)
+        executes).  This is the TPU-native shape of a production input
+        pipeline (data prefetched to HBM ahead of compute) and measures
+        pure device throughput.
 
         Every step's loss is checked finite after the final sync (a mid-run
         divergence fails the benchmark rather than being silently timed).
@@ -800,10 +1075,11 @@ class Trainer:
                 "batches": len(batch_list) - warmup}
 
     def _benchmark_scan(self, batch_list: list, warmup: int, n_samples: int) -> dict:
-        """Scan-of-steps benchmark body: one XLA dispatch for all iters."""
-        from jax import lax
-
-        step_fn = self._train_step_fn
+        """Scan-of-steps benchmark body: one XLA dispatch for all iters —
+        DELEGATES to the production fused-dispatch program (_build_fused_
+        step), so the benchmark measures exactly what train_one_pass(
+        steps_per_dispatch=k) executes: same scanned step, same pre-split
+        per-step key contract, same staging layout."""
         iters = len(batch_list) - warmup
         assert iters > 0, "need at least one timed iteration"
         # stage on device, stacked along a leading step axis; on a mesh the
@@ -811,58 +1087,39 @@ class Trainer:
         # _dispatch_step's shard_batch does per step
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batch_list[warmup:])
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            from paddle_tpu.parallel.dp import DATA_AXIS
-            sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
-            multiproc = jax.process_count() > 1
-
-            def place(x):
-                if not (hasattr(x, "ndim") and x.ndim >= 2):
-                    return x
-                if multiproc:
-                    # each process stages its OWN batches; the global
-                    # staged array concatenates them along the batch dim
-                    return jax.make_array_from_process_local_data(
-                        sh, np.asarray(x))
-                return jax.device_put(x, sh)
-            stacked = jax.tree.map(place, stacked)
+            from paddle_tpu.parallel.dp import stage_stacked_batch
+            stacked = stage_stacked_batch(self.mesh, stacked)
         else:
             stacked = jax.device_put(stacked)
         jax.block_until_ready([a.value if a.value is not None else a.ids
                                for a in stacked.values()])
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def multi_step(params, opt_state, net_state, stacked, rng):
-            keys = jax.random.split(rng, iters)
-
-            def body(carry, xs):
-                p, o, n = carry
-                batch, key = xs
-                p, o, n, loss, _, _ = step_fn(p, o, n, batch, key)
-                return (p, o, n), loss
-
-            (p, o, n), losses = lax.scan(
-                body, (params, opt_state, net_state), (stacked, keys))
-            return p, o, n, losses
-
         for b in batch_list[:warmup]:
             self._dispatch_step(b)
         jax.block_until_ready(self.params)
-        self.rng, sub = jax.random.split(self.rng)
-        # compile outside the timed region
-        compiled = multi_step.lower(
-            self.params, self.opt_state, self.net_state, stacked, sub).compile()
-        # one untimed warmup EXECUTION: forces the staged batches' host->
-        # device transfers to actually complete (block_until_ready on the
-        # experimental axon plugin can return early; only a device->host
-        # fetch is a true sync point) and settles donation buffers
-        self.params, self.opt_state, self.net_state, losses = compiled(
-            self.params, self.opt_state, self.net_state, stacked, sub)
-        np.asarray(jax.device_get(losses))
+        keys = []
+        for _ in range(iters):
+            self.rng, sub = jax.random.split(self.rng)
+            keys.append(sub)
+        keys = jnp.stack(keys)
+
+        def run():
+            (self.params, self.opt_state, new_net, losses, _, _) = \
+                self._fused_step_losses(self.params, self.opt_state,
+                                        self.net_state, stacked, keys)
+            if new_net:
+                self.net_state = new_net
+            return losses
+
+        # one untimed warmup EXECUTION (which also compiles): forces the
+        # staged batches' host->device transfers to actually complete
+        # (block_until_ready on the experimental axon plugin can return
+        # early; only a device->host fetch is a true sync point) and
+        # settles donation buffers
+        np.asarray(jax.device_get(run()))
 
         t0 = time.time()
-        self.params, self.opt_state, self.net_state, losses = compiled(
-            self.params, self.opt_state, self.net_state, stacked, sub)
+        losses = run()
         # the loss fetch is the honest end-of-run sync point
         lo = np.asarray(jax.device_get(losses))
         dt = time.time() - t0

@@ -26,7 +26,7 @@ def main(argv=None) -> int:
         print("usage: python -m paddle_tpu.trainer_main --config=<config.py> "
               "[--job=train|test|checkgrad|time] [--num_passes=N] "
               "[--save_dir=DIR] [--config_args=k=v,...] [--mesh_shape=data:8] "
-              "[--detect_nan] [--profile_dir=DIR] "
+              "[--steps_per_dispatch=K] [--detect_nan] [--profile_dir=DIR] "
               "[--show_parameter_stats_period=N]", file=sys.stderr)
         return 2
 

@@ -134,6 +134,13 @@ define_flag("detect_nan", False, "trap FP anomalies (jax_debug_nans; "
 define_flag("nonfinite_check_period", 100, "without --detect_nan, losses "
             "buffer on device and are bulk-checked every N batches (keeps "
             "dispatch pipelined — no per-batch host sync)")
+define_flag("steps_per_dispatch", 1, "fuse k consecutive same-shape train "
+            "steps into ONE compiled lax.scan dispatch (k>1 amortizes "
+            "per-step Python dispatch overhead k-fold and overlaps the "
+            "next group's host->device staging with the current scan; "
+            "batches group by their padded-shape signature and a group "
+            "flushes early when the shape changes, so the update order — "
+            "and the training trajectory — is identical to k=1)")
 define_flag("prev_batch_state", False, "truncated-BPTT continuation: "
             "forward recurrent layers start from the previous batch's final "
             "hidden state instead of zeros (ref: RecurrentLayer.cpp "

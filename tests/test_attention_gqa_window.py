@@ -78,7 +78,7 @@ def test_single_device_impls_match_oracle(causal, window, H, H_kv):
 def test_ring_gqa_window_matches_oracle(use_flash, monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from paddle_tpu.utils.jax_compat import shard_map
 
     from paddle_tpu.parallel.mesh import make_mesh
 

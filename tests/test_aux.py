@@ -72,10 +72,12 @@ class TestCheckGrad:
         pass detects the fwd/bwd one-sided mismatch (after an epsilon-
         shrink retry) and skips the entry instead of reporting a spurious
         failure (the VGG configs' fc-bias entries hit exactly this)."""
-        import jax
+        import jax  # noqa: F401
         import jax.numpy as jnp
+
+        from paddle_tpu.utils import jax_compat
         tr = Trainer(_small_config(), seed=0)
-        with jax.enable_x64():
+        with jax_compat.enable_x64():
             params = {"w": jnp.asarray([0.0, 0.5], jnp.float64)}
 
             def loss_fn(p):
@@ -92,6 +94,49 @@ class TestCheckGrad:
             errs_raw = tr._check_gradient_inner(loss_fn, grads, 1e-3, 2,
                                                 params)
             assert errs_raw["w"] > 0.3, errs_raw
+
+    def test_all_kink_parameter_keeps_fp32_flag(self):
+        """ADVICE r5 regression: when EVERY sampled entry of a flagged
+        parameter straddles a kink (a zero-init bias feeding ReLU), the
+        f64 refine adjudicates nothing — it must OMIT the key (so
+        check_gradient keeps the fp32 screen's flagged error and
+        --job=checkgrad still exits 1), not record 0.0 and mask the
+        flag."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import jax_compat
+        tr = Trainer(_small_config(), seed=0)
+        with jax_compat.enable_x64():
+            # both entries sit EXACTLY on |x| kinks: nothing can validate
+            params = {"w": jnp.asarray([0.0, 0.0], jnp.float64)}
+
+            def loss_fn(p):
+                return jnp.sum(jnp.abs(p["w"]))
+
+            grads = {"w": jnp.asarray([1.0, 1.0], jnp.float64)}
+            errs = tr._check_gradient_inner(loss_fn, grads, 1e-3, 2, params,
+                                            None, detect_kinks=True)
+        assert "w" not in errs, (
+            f"unadjudicated parameter must not report a (clean-looking) "
+            f"error: {errs}")
+
+        # merge level: the fp32 screen's flagged value survives the
+        # inconclusive refine, so the exit-code contract still fails
+        tr2 = Trainer(_small_config(), seed=0)
+        passes = []
+
+        def fake_pass(batch, epsilon, max_entries, x64, names=None,
+                      detect_kinks=False):
+            passes.append(x64)
+            return {} if x64 else {"w": 0.5}
+
+        tr2._checkgrad_pass = fake_pass
+        import jax
+        if jax.default_backend() == "cpu":
+            errors = tr2.check_gradient(_batch(), refine_threshold=0.02)
+            assert passes == [False, True]
+            assert errors["w"] == 0.5, (
+                f"flagged-but-unadjudicated error was overwritten: {errors}")
 
     def test_two_stage_refine_end_to_end(self):
         """check_gradient's fp32-screen -> f64-refine flow: forcing every

@@ -28,6 +28,20 @@ def test_percentiles_and_render():
     assert bt.straggler_summary() is None
 
 
+def test_fused_windows_render():
+    """The steps_per_dispatch windows (h2d staging on the prefetch thread,
+    k-step scan enqueue) surface in the summary + render line."""
+    bt = BarrierTimer(window=100)
+    with bt.time_h2d():
+        pass
+    with bt.time_scan():
+        pass
+    s = bt.local_summary()
+    assert "h2d" in s and "scan" in s
+    line = bt.render()
+    assert "h2d" in line and "scan" in line
+
+
 def test_timed_context_managers():
     bt = BarrierTimer()
     with bt.time_dispatch():

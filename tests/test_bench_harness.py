@@ -97,6 +97,48 @@ def test_assemble_lkg_decode_only_survives_missing_train(tmp_path):
     assert out["seq2seq"]["beam_decode_tokens_per_sec"] == 61000.0
 
 
+def test_ts_newer_parses_before_comparing():
+    """ADVICE r5 regression: measured_at ordering must ISO-parse, not
+    string-compare — a non-UTC offset (or naive-vs-aware mix) can rank a
+    STALE timestamp above a newer one lexicographically."""
+    bench = _load_bench()
+    # 15:00+05:00 == 10:00Z, OLDER than 11:00Z — but string-wise "15" > "11"
+    assert not bench._ts_newer("2026-07-30T15:00:00+05:00",
+                               "2026-07-30T11:00:00+00:00")
+    assert bench._ts_newer("2026-07-30T11:00:00+00:00",
+                           "2026-07-30T15:00:00+05:00")
+    # 'Z' suffix and naive (assumed UTC) both parse
+    assert bench._ts_newer("2026-07-30T11:00:00Z", "2026-07-30T10:59:59")
+    # unparseable falls back to the string compare (empty = oldest)
+    assert bench._ts_newer("2026-07-30T11:00:00+00:00", "")
+    assert not bench._ts_newer("", "2026-07-30T11:00:00+00:00")
+
+
+def test_assemble_lkg_orders_mixed_timestamp_formats(tmp_path):
+    """A per-config top-level record measured at 11:00Z must supersede a
+    nested part stamped 15:00+05:00 (= 10:00Z): the lexicographic compare
+    picked the stale nested part here (ADVICE r5)."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-07-30T12:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0,
+                    "measured_at": "2026-07-30T12:00:00+00:00",
+                    "mnist": {"metric": M["mnist"], "value": 111.0,
+                              "measured_at": "2026-07-30T15:00:00+05:00"}}},
+        {"ts": "2026-07-30T11:00:00+00:00",
+         "record": {"metric": M["mnist"], "value": 222.0,
+                    "vs_baseline": 1.0,
+                    "measured_at": "2026-07-30T11:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["mnist"]["value"] == 222.0, (
+        "stale +05:00-stamped part selected over the newer UTC record")
+
+
 def test_degraded_record_merges_lkg(tmp_path):
     bench = _load_bench()
     log = tmp_path / "PERF_LOG.jsonl"

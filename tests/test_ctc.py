@@ -13,6 +13,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from paddle_tpu.utils import jax_compat
 import jax.numpy as jnp
 
 from paddle_tpu.ops.ctc import ctc_loss
@@ -74,7 +76,7 @@ def test_matches_torch_ctc():
 def test_grad_finite_differences():
     rng = np.random.default_rng(2)
     B, T, C, L = 2, 5, 4, 2
-    with jax.enable_x64():
+    with jax_compat.enable_x64():
         logits = jnp.asarray(rng.normal(size=(B, T, C)), jnp.float64)
         in_lens = jnp.asarray([5, 4], jnp.int32)
         labels = jnp.asarray([[1, 2], [3, 0]], jnp.int32)

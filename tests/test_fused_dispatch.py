@@ -1,0 +1,323 @@
+"""Fused k-step scan dispatch (--steps_per_dispatch) oracles.
+
+Three contracts, all CPU-verifiable:
+  1. PARITY — train_one_pass(steps_per_dispatch=k) is bit-exact (fp32)
+     with the k=1 loop: same losses, same parameters, same evaluator
+     results, on an RNN config whose batches span two length buckets
+     (so groups must flush on signature change to preserve update order),
+     including under gradient accumulation.
+  2. DISPATCH COUNT — n same-signature batches execute in exactly
+     ceil(n/k) compiled scan dispatches, each carrying k batches (the
+     last possibly fewer), with ZERO per-batch step dispatches.
+  3. PREFETCH OVERLAP — the DeviceDoubleBuffer stages item i+1 while the
+     consumer still holds item i, and propagates producer errors.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config_callable
+from paddle_tpu.data.feeder import DeviceDoubleBuffer, make_batch
+from paddle_tpu.data.provider import integer_value, integer_value_sequence
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.trainer.trainer import Trainer
+
+B, VOCAB, NCLS = 4, 10, 3
+
+
+def _rnn_conf():
+    from paddle_tpu.dsl import (
+        MomentumOptimizer, SoftmaxActivation, classification_cost,
+        data_layer, embedding_layer, fc_layer, last_seq, settings,
+    )
+    from paddle_tpu.dsl.recurrent_units import GatedRecurrentLayerGroup
+
+    settings(batch_size=B, learning_rate=0.1,
+             learning_method=MomentumOptimizer(momentum=0.9))
+    data = data_layer(name="word", size=VOCAB)
+    emb = embedding_layer(input=data, size=8)
+    from paddle_tpu.dsl import full_matrix_projection
+    gru = GatedRecurrentLayerGroup(name="gru_u", size=8,
+                                   inputs=[full_matrix_projection(input=emb)])
+    out = fc_layer(input=last_seq(input=gru), size=NCLS,
+                   act=SoftmaxActivation())
+    classification_cost(input=out, label=data_layer(name="label", size=NCLS))
+
+
+def _accum_conf():
+    """Same net with gradient accumulation (window of 2): the
+    accumulate-or-apply lax.cond must scan unchanged inside a k-group."""
+    from paddle_tpu.dsl import (
+        MomentumOptimizer, SoftmaxActivation, classification_cost,
+        data_layer, embedding_layer, fc_layer, full_matrix_projection,
+        last_seq, settings,
+    )
+    from paddle_tpu.dsl.recurrent_units import GatedRecurrentLayerGroup
+
+    settings(batch_size=B, learning_rate=0.1,
+             learning_method=MomentumOptimizer(momentum=0.9),
+             num_batches_per_send_parameter=2)
+    data = data_layer(name="word", size=VOCAB)
+    emb = embedding_layer(input=data, size=8)
+    gru = GatedRecurrentLayerGroup(name="gru_u", size=8,
+                                   inputs=[full_matrix_projection(input=emb)])
+    out = fc_layer(input=last_seq(input=gru), size=NCLS,
+                   act=SoftmaxActivation())
+    classification_cost(input=out, label=data_layer(name="label", size=NCLS))
+
+
+def _bucketed_batches(n_batches=8, seed=0):
+    """Batches alternating between two length buckets (pad 8 vs pad 16):
+    the fused grouper must flush on every signature change to keep the
+    update order identical to the per-batch loop."""
+    rng = np.random.default_rng(seed)
+    types = [integer_value_sequence(VOCAB), integer_value(NCLS)]
+    out = []
+    for i in range(n_batches):
+        # bucket A: lengths 3..8 (pads to 8); bucket B: 9..16 (pads to 16)
+        lo, hi = (3, 8) if (i // 2) % 2 == 0 else (9, 16)
+        samples = []
+        for _ in range(B):
+            L = int(rng.integers(lo, hi + 1))
+            samples.append((rng.integers(0, VOCAB, L).tolist(),
+                            int(rng.integers(0, NCLS))))
+        out.append(make_batch(samples, types, ["word", "label"]))
+    return out
+
+
+def _params(tr):
+    return {k: np.asarray(v) for k, v in tr.params.items()}
+
+
+def _strip(stats):
+    return {k: v for k, v in stats.items()
+            if k not in ("seconds", "samples_per_sec")}
+
+
+@pytest.mark.parametrize("conf", [_rnn_conf, _accum_conf],
+                         ids=["plain", "grad_accum"])
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_scan_vs_loop_parity(conf, k):
+    """train_one_pass(steps_per_dispatch=k) reproduces the k=1 loop
+    bit-exactly: losses (total cost), parameters, and evaluator results,
+    on length-bucketed RNN batches."""
+    batches = _bucketed_batches()
+    ref = Trainer(parse_config_callable(conf), seed=11)
+    ref_stats = ref.train_one_pass(batches=iter(batches),
+                                   steps_per_dispatch=1)
+    tr = Trainer(parse_config_callable(conf), seed=11)
+    stats = tr.train_one_pass(batches=iter(batches), steps_per_dispatch=k)
+
+    assert _strip(stats) == _strip(ref_stats)
+    pr, pf = _params(ref), _params(tr)
+    for name in pr:
+        np.testing.assert_array_equal(
+            pr[name], pf[name],
+            err_msg=f"param {name!r} diverged at steps_per_dispatch={k}")
+    # the rng stream advanced identically (pre-split per-step keys)
+    np.testing.assert_array_equal(np.asarray(ref.rng), np.asarray(tr.rng))
+
+
+def test_two_passes_stay_exact():
+    """The fused path must leave every carried state (rng, optimizer
+    slots, grad-accum window reset at finish_pass) exactly as the k=1
+    loop does — a second pass stays bit-identical too."""
+    batches = _bucketed_batches()
+    ref = Trainer(parse_config_callable(_accum_conf), seed=5)
+    tr = Trainer(parse_config_callable(_accum_conf), seed=5)
+    for _ in range(2):
+        ref.train_one_pass(batches=iter(batches), steps_per_dispatch=1)
+        tr.train_one_pass(batches=iter(batches), steps_per_dispatch=3)
+    pr, pf = _params(ref), _params(tr)
+    for name in pr:
+        np.testing.assert_array_equal(pr[name], pf[name])
+
+
+def test_dispatch_count_is_ceil_n_over_k():
+    """7 same-signature batches at k=3 -> exactly ceil(7/3)=3 compiled
+    scan executions carrying [3, 3, 1] batches, and ZERO per-batch step
+    dispatches (the per-step Python dispatch overhead is what the fusion
+    removes)."""
+    rng = np.random.default_rng(2)
+    types = [integer_value_sequence(VOCAB), integer_value(NCLS)]
+    batches = []
+    for _ in range(7):
+        samples = [(rng.integers(0, VOCAB, 6).tolist(),
+                    int(rng.integers(0, NCLS))) for _ in range(B)]
+        batches.append(make_batch(samples, types, ["word", "label"]))
+
+    tr = Trainer(parse_config_callable(_rnn_conf), seed=1)
+    fused_sizes = []
+    per_batch = []
+    orig_fused, orig_step = tr._fused_step, tr._train_step
+
+    def counting_fused(p, o, n, stacked, keys):
+        fused_sizes.append(int(keys.shape[0]))
+        return orig_fused(p, o, n, stacked, keys)
+
+    def counting_step(*a):
+        per_batch.append(1)
+        return orig_step(*a)
+
+    tr._fused_step = counting_fused
+    tr._train_step = counting_step
+    stats = tr.train_one_pass(batches=iter(batches), steps_per_dispatch=3)
+
+    assert fused_sizes == [3, 3, 1], fused_sizes
+    assert per_batch == [], "per-batch step dispatched in fused mode"
+    assert tr._n_fused_dispatches == 3
+    assert stats["batches"] == 7
+    # the h2d window filled from the prefetch thread: staging is observable
+    assert len(tr.barrier_stat.h2d_s) == 3
+
+
+def test_stateful_model_settles_then_fuses():
+    """A stateful model (training-mode batch norm) grows net_state on its
+    first dispatch; the fused path routes exactly that one batch through
+    the per-batch step (as k=1's batch 0 does), then scans — and stays
+    bit-exact."""
+    def conf():
+        from paddle_tpu.dsl import (
+            MomentumOptimizer, SoftmaxActivation, TanhActivation,
+            batch_norm_layer, classification_cost, data_layer, fc_layer,
+            settings,
+        )
+        settings(batch_size=8, learning_rate=0.05,
+                 learning_method=MomentumOptimizer(momentum=0.9))
+        x = data_layer(name="x", size=6)
+        h = fc_layer(input=x, size=10, act=TanhActivation())
+        h = batch_norm_layer(input=h)
+        out = fc_layer(input=h, size=NCLS, act=SoftmaxActivation())
+        classification_cost(input=out, label=data_layer(name="y", size=NCLS))
+
+    rng = np.random.default_rng(0)
+    batches = [{"x": Argument(value=rng.normal(size=(8, 6)).astype(np.float32)),
+                "y": Argument(ids=rng.integers(0, NCLS, 8).astype(np.int32))}
+               for _ in range(5)]
+    ref = Trainer(parse_config_callable(conf), seed=7)
+    ref.train_one_pass(batches=iter(batches), steps_per_dispatch=1)
+    tr = Trainer(parse_config_callable(conf), seed=7)
+    per_batch = []
+    orig_step = tr._train_step
+
+    def counting_step(*a):
+        per_batch.append(1)
+        return orig_step(*a)
+
+    tr._train_step = counting_step
+    tr.train_one_pass(batches=iter(batches), steps_per_dispatch=2)
+    assert len(per_batch) == 1, "exactly one settling dispatch expected"
+    pr, pf = _params(ref), _params(tr)
+    for name in pr:
+        np.testing.assert_array_equal(pr[name], pf[name])
+    import jax
+    ns_ref = jax.tree.map(np.asarray, ref.net_state)
+    ns_tr = jax.tree.map(np.asarray, tr.net_state)
+    for lname in ns_ref:
+        for stat in ns_ref[lname]:
+            np.testing.assert_array_equal(ns_ref[lname][stat],
+                                          ns_tr[lname][stat])
+
+
+# -- DeviceDoubleBuffer ------------------------------------------------------
+
+def test_device_double_buffer_overlaps_staging():
+    """While the consumer holds item i, the background thread must already
+    be staging item i+1 — that overlap is the whole point of the device
+    double buffer."""
+    staged = [threading.Event() for _ in range(3)]
+
+    def place(i):
+        staged[i].set()
+        return i
+
+    buf = DeviceDoubleBuffer(iter(range(3)), place)
+    it = iter(buf)
+    assert next(it) == 0
+    # consumer still "computing" on item 0: item 1 must stage meanwhile
+    assert staged[1].wait(timeout=10.0), \
+        "item 1 was not prefetched while item 0 was being consumed"
+    assert list(it) == [1, 2]
+
+
+def test_device_double_buffer_propagates_errors():
+    def items():
+        yield 1
+        raise ValueError("provider died")
+
+    buf = DeviceDoubleBuffer(items(), lambda x: x)
+    with pytest.raises(ValueError, match="provider died"):
+        list(buf)
+
+
+def test_device_double_buffer_close_releases_producer():
+    """An abandoning consumer (mid-pass exception) must not leave the
+    producer thread blocked on the bounded queue holding staged items:
+    close() releases it."""
+    produced = []
+
+    def items():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    buf = DeviceDoubleBuffer(items(), lambda x: x)
+    it = iter(buf)
+    assert next(it) == 0
+    buf.close()
+    assert not buf._thread.is_alive(), "producer thread still blocked"
+    assert len(produced) < 100, "producer ran the whole source after close"
+
+
+def test_device_double_buffer_times_staging():
+    ticks = []
+
+    class _Ctx:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            ticks.append(1)
+            return False
+
+    buf = DeviceDoubleBuffer(iter(range(4)), lambda x: x, timer=_Ctx)
+    assert list(buf) == [0, 1, 2, 3]
+    assert len(ticks) == 4
+
+
+def test_feeder_device_batches_stages_to_device():
+    """DataFeeder.device_batches: batches from a real @provider flow
+    through the background double buffer with place_fn applied — the
+    feeder-level H2D staging surface (ShardFeeder shares the contract)."""
+    import jax
+
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.data.provider import (
+        dense_vector, integer_value as iv, provider,
+    )
+
+    @provider(input_types={"x": dense_vector(4), "y": iv(NCLS)})
+    def proc(settings, filename):
+        rng = np.random.default_rng(0)
+        for _ in range(12):
+            yield {"x": rng.normal(size=(4,)).astype(np.float32),
+                   "y": int(rng.integers(0, NCLS))}
+
+    proc.initialize(["f0"])
+    feeder = DataFeeder(proc, ["f0"], input_names=["x", "y"], batch_size=4,
+                        shuffle=False, drop_last=False)
+    placed = []
+
+    def place(batch):
+        placed.append(1)
+        return jax.device_put(batch)
+
+    got = list(feeder.device_batches(place))
+    assert len(got) == 3 and len(placed) == 3
+    assert all(isinstance(b["x"].value, jax.Array) for b in got)
+    # values survive the staging round-trip
+    ref = list(feeder.batches())
+    np.testing.assert_array_equal(np.asarray(got[0]["x"].value),
+                                  ref[0]["x"].value)

@@ -5,6 +5,8 @@ compare autodiff grads against central differences.
 """
 
 import jax
+
+from paddle_tpu.utils import jax_compat
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -22,7 +24,7 @@ pytestmark = pytest.mark.slow  # heavy: excluded from the fast gate (pytest -m "
 def fd_check(cfg, feed, seed=0, eps=1e-5, rtol=1e-3, atol=1e-6, n_coords=6):
     """Central-difference check in float64 (float32 FD noise would swamp the
     comparison — the reference uses double throughout its checkers)."""
-    with jax.enable_x64():
+    with jax_compat.enable_x64():
         ex = GraphExecutor(cfg.model_config)
         params = ex.init_params(jax.random.PRNGKey(seed))
         params = {k: jnp.asarray(v, jnp.float64) for k, v in params.items()}

@@ -165,7 +165,7 @@ class TestRingFlash:
         import functools
 
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from paddle_tpu.utils.jax_compat import shard_map
 
         from paddle_tpu.ops.attention import ring_attention
         from paddle_tpu.parallel.mesh import make_mesh

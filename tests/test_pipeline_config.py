@@ -163,6 +163,49 @@ def test_pipeline_frozen_bn_matches_unpipelined():
         np.testing.assert_allclose(pp[name], p1[name], rtol=3e-4, atol=2e-5)
 
 
+def test_pipeline_frozen_bn_with_loaded_stats_matches_unpipelined():
+    """ADVICE r5 regression: the frozen-fine-tune pattern the
+    use_global_stats=True message advertises must actually WORK — a
+    checkpoint's BN moving stats (non-trivial mean/var, registered in
+    net_state) are embedded into the stage bodies as constants, and the
+    pipelined run matches the un-pipelined oracle using the same stats."""
+    import jax.numpy as jnp
+
+    conf = _bn_conf(True)
+
+    def with_stats(tr):
+        bn = [l.name for l in tr.model.layers if l.type == "batch_norm"][0]
+        tr.net_state = {bn: {
+            "mean": jnp.asarray(rng.normal(size=(32,)).astype(np.float32)),
+            "var": jnp.asarray((rng.random(32) + 0.5).astype(np.float32)),
+            "count": jnp.asarray(3.0, jnp.float32)}}
+        return tr
+
+    rng = np.random.default_rng(7)      # regenerate identical stats
+    batches = _batches(6, rng)
+    tr1 = with_stats(Trainer(parse_config_callable(conf), seed=1))
+    l1 = np.asarray([float(tr1.train_one_batch(b)) for b in batches])
+    p1 = {k: np.asarray(jax.device_get(v)) for k, v in tr1.params.items()}
+
+    rng = np.random.default_rng(7)
+    batches = _batches(6, rng)
+    mesh = make_mesh(data=2, pipe=2, devices=jax.devices()[:4])
+    trp = with_stats(Trainer(parse_config_callable(conf), seed=1, mesh=mesh))
+    from paddle_tpu.parallel.pipeline_config import PipelineExecutor
+    assert isinstance(trp.executor, PipelineExecutor)
+    lp = np.asarray([float(trp.train_one_batch(b)) for b in batches])
+    pp = {k: np.asarray(jax.device_get(v)) for k, v in trp.params.items()}
+
+    np.testing.assert_allclose(lp, l1, rtol=2e-4, atol=1e-6)
+    for name in p1:
+        np.testing.assert_allclose(pp[name], p1[name], rtol=3e-4, atol=2e-5)
+    # and the error for GENUINELY mutable state stays scoped + actionable
+    with pytest.raises(AssertionError, match="mutable state"):
+        _train(_bn_conf(None), make_mesh(data=1, pipe=2,
+                                         devices=jax.devices()[:2]),
+               _batches(1, np.random.default_rng(5)))
+
+
 def test_pipeline_sequence_boundary():
     """A sequence activation (value + lengths) crossing a stage boundary:
     embedding + masked pooling on stage 0, classifier on stage 1 — the

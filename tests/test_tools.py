@@ -22,6 +22,42 @@ def _config():
     return parse_config_callable(conf)
 
 
+def test_hlo_gather_detector_anchors_to_shapes():
+    """ADVICE r5 regression for tools/hlo_sparse_check.py:113: the table
+    all-gather verdict must anchor to parsed operand/result shapes and
+    the gathered dimension — a row count appearing elsewhere in the line
+    (replica_groups, channel ids, a feature-dim activation gather) must
+    not trip the exit-2 verdict; real table materializations (direct or
+    grouped [rows/n, n, D] form) must."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.hlo_sparse_check import gather_spans_table
+
+    tables = [((3952, 64), 0), ((6040, 64), 0), ((512, 256), 0)]
+    # feature-dim activation gather whose WIDTH equals a table row count
+    act = ("%ag = f32[64,256]{0,1} all-gather(f32[64,32]{0,1} %c), "
+           "channel_id=6, replica_groups=[1,8]<=[8], dimensions={1}")
+    assert not gather_spans_table(act, [((256, 256), 0)] + tables)
+    # row count only inside replica_groups / channel id
+    noise = ("%ag2 = f32[64,10]{1,0} all-gather(f32[8,10]{1,0} %x), "
+             "channel_id=3952, replica_groups=[1,3952]<=[3952], "
+             "dimensions={0}")
+    assert not gather_spans_table(noise, tables)
+    # coincidentally table-shaped result gathered along the UNSHARDED dim
+    other_dim = ("%ag3 = f32[512,256]{1,0} all-gather(f32[512,32]{1,0} %x), "
+                 "replica_groups=[1,8]<=[8], dimensions={1}")
+    assert not gather_spans_table(other_dim, tables)
+    # genuine: the table reassembled directly...
+    direct = ("%ag4 = f32[3952,64]{1,0} all-gather(f32[494,64]{1,0} %s), "
+              "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}")
+    assert gather_spans_table(direct, tables)
+    # ...or in GSPMD's grouped [rows/n, n, D] lowering (bitcast follows)
+    grouped = ("%ag5 = f32[64,8,256]{1,0,2} all-gather(f32[64,1,256]"
+               "{1,0,2} %p), channel_id=9, replica_groups=[1,8]<=[8], "
+               "dimensions={1}")
+    assert gather_spans_table(grouped, tables)
+
+
 def test_merge_model_roundtrip(tmp_path):
     import jax
 

@@ -31,6 +31,62 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+_SHAPE_RE = re.compile(r"[a-z0-9]+\[([0-9,]+)\]")
+
+
+def gather_spans_table(line: str, tables) -> bool:
+    """True iff an all-gather HLO line MATERIALIZES a sharded table: some
+    operand/result tensor shape equals the table's full shape, gathered
+    along the table's sharded axis.
+
+    Substring-matching a row count anywhere in the line false-positives on
+    unrelated collectives that merely carry the number — a logits/feature-
+    dimension activation gather, a replica_groups entry, a channel id
+    (ADVICE r5).  So: parse the `dtype[d0,d1,...]` shape tokens BEFORE the
+    attribute tail (replica_groups=... onward contains bracketed iota
+    lists that are not shapes), and flag only when a token's FULL dim
+    tuple equals a table shape — the signature of GSPMD reassembling the
+    whole table — and the `dimensions={d}` gather axis is that table's
+    sharded axis (a coincidentally table-shaped tensor gathered along an
+    unsharded dim stays clean).
+
+    GSPMD's grouped lowering may gather into an UNMERGED form — e.g.
+    [rows/8, 8, D] (shard axis inserted next to the sharded dim, bitcast
+    to [rows, D] afterwards) — so each token is also tried with the gather
+    dim merged into either neighbor.
+
+    `tables`: iterable of (shape tuple, sharded-axis index or None)."""
+    m = re.search(r"dimensions=\{(\d+)", line)
+    gdim = int(m.group(1)) if m else None
+    head = line.split("replica_groups=")[0].split("metadata=")[0]
+    toks = [tuple(int(x) for x in sm.group(1).split(",") if x)
+            for sm in _SHAPE_RE.finditer(head)]
+
+    def candidates(dims):
+        """(shape, effective gathered-axis) readings of one token."""
+        out = [(dims, gdim)]
+        if gdim is not None and gdim < len(dims):
+            if gdim > 0:               # merge into the left neighbor
+                out.append((dims[:gdim - 1]
+                            + (dims[gdim - 1] * dims[gdim],)
+                            + dims[gdim + 1:], gdim - 1))
+            if gdim < len(dims) - 1:   # merge into the right neighbor
+                out.append((dims[:gdim]
+                            + (dims[gdim] * dims[gdim + 1],)
+                            + dims[gdim + 2:], gdim))
+        return out
+
+    for shape, axis in tables:
+        shape = tuple(shape)
+        for dims in toks:
+            for cand, cdim in candidates(dims):
+                if cand != shape:
+                    continue
+                if cdim is not None and axis is not None and cdim != axis:
+                    continue
+                return True
+    return False
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -63,12 +119,15 @@ def main() -> int:
                        "title_vocab=5100")
     tr = Trainer(cfg, seed=1, mesh=mesh)
 
-    # which params came out vocab-sharded, and their row counts
+    # which params came out vocab-sharded, their shapes + sharded axis
     sharded = {}
+    tables = []
     for k, v in tr.params.items():
         spec = list(getattr(v.sharding, "spec", []) or [])
         if any(s is not None for s in spec):
             sharded[k] = {"shape": list(v.shape), "spec": [str(s) for s in spec]}
+            axis = next((i for i, s in enumerate(spec) if s is not None), None)
+            tables.append((tuple(v.shape), axis))
     if not sharded:
         print(json.dumps({"error": "no sharded tables under the mesh"}))
         return 1
@@ -87,8 +146,8 @@ def main() -> int:
     # async forms (all-gather-start/-done — the standard TPU lowering);
     # -done lines are skipped so async pairs count once
     colls: dict[str, int] = {}
-    gathers = []
-    for ln in hlo.splitlines():
+    gathers = []          # full lines — the shape/dimension parse needs
+    for ln in hlo.splitlines():   # the attribute tail; truncate on output
         m = re.search(r"(all-gather|all-reduce|reduce-scatter|"
                       r"all-to-all|collective-permute)(-start|-done)?\(", ln)
         if not m or m.group(2) == "-done":
@@ -96,16 +155,12 @@ def main() -> int:
         op = m.group(1)
         colls[op] = colls.get(op, 0) + 1
         if op == "all-gather":
-            gathers.append(ln.strip()[:200])
+            gathers.append(ln.strip())
 
-    # does any all-gather's result shape span a table's full row space?
-    table_rows = {v["shape"][0] for v in sharded.values()}
-    table_gathers = []
-    for ln in gathers:
-        for rows in table_rows:
-            if re.search(rf"\b{rows},", ln) or re.search(rf"\[{rows},", ln):
-                table_gathers.append(ln)
-                break
+    # does any all-gather materialize a table — full table shape gathered
+    # along its sharded axis?  (shape-anchored — see gather_spans_table)
+    table_gathers = [ln[:200] for ln in gathers
+                     if gather_spans_table(ln, tables)]
 
     verdict = {
         "mesh": {"data": args.data, "model": args.model},
