@@ -419,10 +419,68 @@ def bench_lm(dtype: str) -> dict:
     }
 
 
+def bench_serving(dtype: str) -> dict:
+    """Continuous-batching LM serving throughput (serving/engine.py): a
+    mixed-length greedy workload through the paged-KV slot engine, closed
+    loop (all requests at t=0 — peak tokens/sec at full slot pressure).
+    Exactness against lm_generate is tests/test_serving.py's job; this
+    measures tokens/sec, slot occupancy, and that the decode step stayed
+    at ONE compiled signature.  The per-rate occupancy curve lives in
+    tools/bench_serving.py; this is the compact record for the driver's
+    BENCH capture."""
+    import argparse
+
+    import numpy as np
+
+    from tools.bench_serving import (build_engine, make_requests,
+                                     run_workload, warm_workload)
+
+    # ONE engine construction recipe — tools/bench_serving.py's — fed from
+    # the env knobs, so the banked record and the sweep tool can never
+    # measure differently-built engines
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        dtype=dtype)
+    n_reqs = int(os.environ.get("BENCH_SERVE_REQS", "64"))
+    lo = int(os.environ.get("BENCH_SERVE_PROMPT_LO", "32"))
+    hi = int(os.environ.get("BENCH_SERVE_PROMPT_HI", "256"))
+    max_new = int(os.environ.get("BENCH_SERVE_MAX_NEW", "64"))
+    reps = int(os.environ.get("BENCH_SERVE_REPS", "3"))
+
+    eng = build_engine(args)
+    base = dict(n=n_reqs, prompt_lo=lo, prompt_hi=hi, max_new=max_new,
+                vocab=args.vocab)
+    rep_sets = [make_requests(seed=1 + rep, **base) for rep in range(reps)]
+    warm_workload(eng, [make_requests(seed=0, **base)] + rep_sets)
+    vals, occs = [], []
+    for reqs in rep_sets:
+        rec = run_workload(eng, reqs)
+        vals.append(rec["tokens"] / rec["seconds"])
+        occs.append(rec["occupancy"])
+    return {
+        "metric": "lm_serving_tok_per_sec",
+        "value": round(float(np.median(vals)), 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"vocab={args.vocab} dim={args.dim} L={args.layers} "
+                  f"H={args.heads} slots={args.slots} page={args.page_size} "
+                  f"prompts={lo}-{hi} max_new={max_new}",
+        "occupancy": round(float(np.mean(occs)), 3),
+        "decode_signatures": eng._decode_step._cache_size(),
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
     "lm": bench_lm,
+    "serving": bench_serving,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -536,6 +594,7 @@ _METRIC_OF = {
     "vgg": "vgg16_cifar10_train_samples_per_sec_per_chip",
     "seq2seq": "wmt14_seq2seq_train_samples_per_sec_per_chip",
     "lm": "transformer_lm_train_tokens_per_sec_per_chip",
+    "serving": "lm_serving_tok_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -613,7 +672,8 @@ def _assemble_lkg() -> dict | None:
         "metric": _METRIC_OF["vgg"], "value": 0.0,
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
-    for key in ("lm", "mnist", "sentiment", "recommendation", "seq2seq"):
+    for key in ("lm", "serving", "mnist", "sentiment", "recommendation",
+                "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:
@@ -769,6 +829,8 @@ def main() -> None:
         extras = []
         if os.environ.get("BENCH_SKIP_LM", "0") != "1":
             extras.append("lm")
+        if os.environ.get("BENCH_SKIP_SERVING", "0") != "1":
+            extras.append("serving")
         if os.environ.get("BENCH_EXTENDED", "1") != "0":
             # the three remaining BASELINE.md configs (BENCH_EXTENDED=0 skips)
             extras += ["mnist", "sentiment", "recommendation"]

@@ -69,6 +69,14 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
     causal = bool(cfg.attrs.get("causal", False))
 
     cache = ctx.state_in.get(cfg.name)
+    if isinstance(cache, dict) and "k_pages" in cache:
+        # continuous-batching decode against the serving engine's paged KV
+        # pool (serving/paged_kv.py): one new token per SLOT, context read
+        # through the per-slot page table — the fixed-signature step the
+        # engine compiles once and reuses for the whole workload
+        assert causal, f"layer {cfg.name!r}: paged decode requires causal"
+        return _paged_step(ctx, cfg, q_arg, w_q, w_k, w_v, w_o, num_heads,
+                           cache)
     if isinstance(cache, dict) and "k" in cache:
         # incremental decode against a KV cache (lm_decode use_cache path):
         # the input carries only NEW tokens; per-row positions come from the
@@ -224,6 +232,51 @@ def _cached_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
             q, k, v, cache["k"], cache["v"], pos, n_new, window=window)
     ctx.state_out[cfg.name] = {"k": ck, "v": cv, "pos": newpos}
     o = out.reshape(B, Tn, model_dim) @ w_o
+    bias = ctx.bias_of(cfg)
+    if bias is not None:
+        o = o + bias
+    return finish_layer(ctx, cfg, o, like=x_arg)
+
+
+def _paged_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
+                w_q, w_k, w_v, w_o, num_heads: int,
+                cache: dict) -> Argument:
+    """One serving decode micro-step: project each slot's single new token,
+    scatter its k/v into the slot's current page of the shared pool, attend
+    causally over the slot's paged context (ops/attention.py:
+    paged_attention_step — page-table gather, or the Pallas ragged-paged
+    kernel when supported).  Emits the updated pool through ctx.state_out;
+    the page table itself is host-managed and passes through untouched."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import paged_attention_step, rope
+
+    x = x_arg.value                                   # [S, 1, model_dim]
+    S, Tn, _ = x.shape
+    assert Tn == 1, (f"layer {cfg.name!r}: paged decode feeds exactly one "
+                     f"new token per slot (got {Tn}); prompts prefill "
+                     f"through the dense per-request cache")
+    model_dim = w_q.shape[1]
+    Dh = model_dim // num_heads
+    h_kv = int(cfg.attrs.get("num_kv_heads", 0) or num_heads)
+    pos = cache["pos"]
+    q = (x @ w_q).reshape(S, 1, num_heads, Dh)
+    k = (x @ w_k).reshape(S, 1, h_kv, Dh)
+    v = (x @ w_v).reshape(S, 1, h_kv, Dh)
+    if bool(cfg.attrs.get("use_rope", False)):
+        theta = float(cfg.attrs.get("rope_theta", 10000.0))
+        qpos = pos[:, None]
+        q, k = rope(q, qpos, theta), rope(k, qpos, theta)
+    window = (int(cfg.attrs["window"]) if "window" in cfg.attrs else None)
+    out, ck, cv = paged_attention_step(
+        q, k, v, cache["k_pages"], cache["v_pages"], cache["page_table"],
+        pos, window=window,
+        use_kernel=(False if str(cfg.attrs.get("attn_impl", "auto"))
+                    in ("dense", "blockwise") else None))
+    ctx.state_out[cfg.name] = {"k_pages": ck, "v_pages": cv,
+                               "page_table": cache["page_table"],
+                               "pos": pos + 1}
+    o = out.reshape(S, 1, model_dim) @ w_o
     bias = ctx.bias_of(cfg)
     if bias is not None:
         o = o + bias

@@ -52,6 +52,57 @@ def nucleus_filter(scaled: Array, top_p: float) -> Array:
         jnp.where(keep, srt, -jnp.inf))
 
 
+def pick_next(last: Array, key: Optional[Array], temperature: float = 0.0,
+              top_k: int = 0, top_p: float = 0.0,
+              is_probs: bool = False) -> Array:
+    """One sampling decision on [B, V] next-token scores -> [B] int32.
+
+    Module-level (not a closure inside lm_generate) so the serving
+    engine's per-slot sampler (serving/sampler.py:pick_next_per_slot) can
+    hold itself to EXACTLY these semantics — any drift between the two
+    shows up as a token divergence in the serving parity oracle.
+
+    `is_probs`: the logits layer emits probabilities (softmax activation)
+    — sample through log; raw-activation layers sample directly."""
+    last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
+        if is_probs else last.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(last, axis=-1).astype(jnp.int32)
+    scaled = last / temperature
+    if top_k > 0:
+        # exact k-best support via top_k (ref pattern:
+        # graph/generator.py beam candidate selection): scatter the
+        # k values back to -inf elsewhere so ties at the kth value
+        # can never widen the candidate set
+        vals, idxs = jax.lax.top_k(scaled, top_k)
+        scaled = jnp.full_like(scaled, -jnp.inf).at[
+            jnp.arange(scaled.shape[0])[:, None], idxs].set(vals)
+    scaled = nucleus_filter(scaled, top_p)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+def _chunked_scan(step, carry, keys, chunk: int, done_of):
+    """`lax.scan(step, carry, keys)` split into `chunk`-step scans with a
+    HOST all-done check between chunks: a batch whose every row hit eos at
+    step 5 of max_new=512 stops paying for the 507 dead steps.  Bit-exact
+    with the single scan (scan composes sequentially; done rows are frozen
+    by `advance`, so skipped trailing steps are no-ops on the outputs, and
+    the pre-split keys mean skipped steps never consumed rng).  Compiled
+    signatures stay bounded: one `chunk`-length scan program plus at most
+    one remainder-length program."""
+    if chunk <= 0 or chunk >= keys.shape[0]:
+        carry, _ = jax.lax.scan(step, carry, keys)
+        return carry
+    i = 0
+    while i < keys.shape[0]:
+        n = min(chunk, keys.shape[0] - i)
+        carry, _ = jax.lax.scan(step, carry, keys[i:i + n])
+        i += n
+        if i < keys.shape[0] and bool(jnp.all(done_of(carry))):
+            break
+    return carry
+
+
 def _resolve_io_names(model, input_name, logits_name):
     """Default input = first data layer; default logits = last non-cost,
     non-validation layer (shared by lm_generate / lm_beam_generate)."""
@@ -96,6 +147,9 @@ def lm_generate(
     eos_id: int = -1,             # -1 = never stop early
     rng: Optional[Array] = None,
     use_cache: bool = False,      # O(T) per-token decode via KV caches
+    early_exit_chunk: int = 0,    # >0: decode in chunked scans with a host
+                                  # all-done check between chunks (eos
+                                  # batches stop paying for dead steps)
 ):
     """Returns (tokens [B, P+max_new], lengths [B]) — the prompt plus up to
     max_new sampled tokens per row (rows stop growing at eos_id).
@@ -130,22 +184,10 @@ def lm_generate(
             f"temperature=0 means greedy argmax, which would silently "
             f"ignore them")
 
-    def pick_next(last, key):
-        last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
-            if _is_probs(model, logits_name) else last.astype(jnp.float32)
-        if temperature <= 0.0:
-            return jnp.argmax(last, axis=-1).astype(jnp.int32)
-        scaled = last / temperature
-        if top_k > 0:
-            # exact k-best support via top_k (ref pattern:
-            # graph/generator.py beam candidate selection): scatter the
-            # k values back to -inf elsewhere so ties at the kth value
-            # can never widen the candidate set
-            vals, idxs = jax.lax.top_k(scaled, top_k)
-            scaled = jnp.full_like(scaled, -jnp.inf).at[
-                jnp.arange(scaled.shape[0])[:, None], idxs].set(vals)
-        scaled = nucleus_filter(scaled, top_p)
-        return jax.random.categorical(key, scaled).astype(jnp.int32)
+    import functools
+    sample = functools.partial(
+        pick_next, temperature=temperature, top_k=top_k, top_p=top_p,
+        is_probs=_is_probs(model, logits_name))
 
     def advance(buf, lengths, done, nxt):
         # frozen rows keep their buffer and length
@@ -167,7 +209,7 @@ def lm_generate(
         # row, threading the caches through the executor's state channel
         state, last = _prefill(executor, params, input_name, logits_name,
                                prompt_ids, prompt_lengths, total)
-        nxt = pick_next(last, keys[0])
+        nxt = sample(last, keys[0])
         buf, lengths, done = advance(buf0, prompt_lengths,
                                      jnp.zeros((B,), bool), nxt)
 
@@ -178,12 +220,13 @@ def lm_generate(
                                          lengths=jnp.ones((B,), jnp.int32))}
             outputs, _, state = executor.forward(params, feed, state, TEST,
                                                  None)
-            nxt = pick_next(outputs[logits_name].value[:, 0, :], key)
+            nxt = sample(outputs[logits_name].value[:, 0, :], key)
             buf, lengths, done = advance(buf, lengths, done, nxt)
             return (buf, lengths, done, state), None
 
-        (buf, lengths, _, _), _ = jax.lax.scan(
-            step_cached, (buf, lengths, done, state), keys[1:])
+        buf, lengths, _, _ = _chunked_scan(
+            step_cached, (buf, lengths, done, state), keys[1:],
+            early_exit_chunk, done_of=lambda c: c[2])
         return buf, lengths
 
     def step(carry, key):
@@ -193,11 +236,12 @@ def lm_generate(
         logits = outputs[logits_name].value          # [B, total, V]
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
-        nxt = pick_next(last, key)
+        nxt = sample(last, key)
         return advance(buf, lengths, done, nxt), None
 
-    (buf, lengths, _), _ = jax.lax.scan(
-        step, (buf0, prompt_lengths, jnp.zeros((B,), bool)), keys)
+    buf, lengths, _ = _chunked_scan(
+        step, (buf0, prompt_lengths, jnp.zeros((B,), bool)), keys,
+        early_exit_chunk, done_of=lambda c: c[2])
     return buf, lengths
 
 

@@ -436,6 +436,92 @@ def cached_attention_step(
     return out, ck, cv, pos + n_new
 
 
+def paged_attention_step(
+    q_new: Array,          # [S, 1, H, D] one new-token query per slot
+    k_new: Array,          # [S, 1, H_kv, D]
+    v_new: Array,          # [S, 1, H_kv, D]
+    k_pages: Array,        # [P, page_size, H_kv, D] shared page pool
+    v_pages: Array,        # [P, page_size, H_kv, D]
+    page_table: Array,     # [S, max_pages] int32 physical page per logical
+                           # page of each slot (0 = unmapped -> trash page)
+    pos: Array,            # [S] int32 tokens already resident per slot
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    use_kernel: Optional[bool] = None,
+) -> tuple[Array, Array, Array]:
+    """One continuous-batching decode micro-step against a PAGED KV cache —
+    the serving analog of `cached_attention_step`: instead of one dense
+    [B, Tmax, H_kv, D] cache per request batch, every slot's context lives
+    in fixed-size pages of a shared pool, mapped by a per-slot page table,
+    so cache HBM is proportional to tokens actually held and ONE compiled
+    step serves an ever-changing request mix.
+
+    Contract (mirrors cached_attention_step with Tn == 1): slot s's new
+    token lands at logical position pos[s] — physical page
+    page_table[s, pos[s] // page_size], offset pos[s] % page_size — and
+    attends causally over logical positions 0..pos[s].  Physical page 0 is
+    the TRASH page: unmapped logical pages (inactive slots, a paused slot
+    whose next page is not yet allocated) write there and their reads are
+    causally masked or discarded by the scheduler, so the one compiled
+    program needs no per-slot branching.  Gathered positions past pos[s]
+    carry finite garbage; the -1e30 mask makes their softmax weight exactly
+    0.0, so they cannot perturb live slots (same discipline as the dense
+    cache's padded-prefill slots).
+
+    Returns (out [S, 1, H, D], new_k_pages, new_v_pages).  `use_kernel`
+    routes the read through the Pallas ragged-paged kernel
+    (ops/pallas_paged.py) — default: auto (kernel when supported and no
+    sliding window); False forces the jnp gather fallback (the oracle in
+    tests and the exactness anchor of the serving engine).
+    """
+    S, Tn, H, D = q_new.shape
+    assert Tn == 1, "paged decode feeds exactly one new token per slot"
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+
+    # -- write: scatter each slot's new k/v into its current page --------
+    phys = jnp.take_along_axis(page_table, (pos // page_size)[:, None],
+                               axis=1)[:, 0]                     # [S]
+    off = pos % page_size
+    ck = k_pages.at[phys, off].set(k_new[:, 0].astype(k_pages.dtype))
+    cv = v_pages.at[phys, off].set(v_new[:, 0].astype(v_pages.dtype))
+
+    if use_kernel is None:
+        from paddle_tpu.ops import pallas_paged
+        use_kernel = pallas_paged.supported() and window is None
+    if use_kernel:
+        if window is not None:
+            raise ValueError(
+                "paged_attention_step: the Pallas ragged-paged kernel has "
+                "no sliding-window support — pass use_kernel=False (or "
+                "None for auto, which already falls back) for window "
+                "attention")
+        from paddle_tpu.ops import pallas_paged
+        out = pallas_paged.paged_attention(q_new[:, 0], ck, cv, page_table,
+                                           pos + 1, scale=scale)[:, None]
+        return out, ck, cv
+
+    # -- read: page-table gather -> [S, T_ctx] contiguous view -----------
+    T_ctx = max_pages * page_size
+    kc = ck[page_table].reshape(S, T_ctx, *ck.shape[2:])
+    vc = cv[page_table].reshape(S, T_ctx, *cv.shape[2:])
+    k_full, v_full = _expand_kv_heads(kc, vc, H)
+    t = jnp.arange(T_ctx)
+    mask = t[None, None, :] <= pos[:, None, None]                # causal
+    if window is not None:
+        mask = jnp.logical_and(mask,
+                               t[None, None, :] > pos[:, None, None] - window)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q_new, k_full) * scale
+    from paddle_tpu.utils.dtypes import promote_compute
+    s = promote_compute(s)
+    s = jnp.where(mask[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_full.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+    return out, ck, cv
+
+
 def additive_attention_step(
     dec_state: Array,      # [B, Ds] decoder state for THIS timestep
     w: Array,              # [Ds, D] state transform

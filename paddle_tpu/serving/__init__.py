@@ -1,0 +1,8 @@
+"""Continuous-batching LM serving: paged KV cache + slot scheduler +
+one compiled decode step (see serving/engine.py for the design note)."""
+
+from paddle_tpu.serving.engine import Request, ServingEngine
+from paddle_tpu.serving.paged_kv import PagedKVCache
+from paddle_tpu.serving.sampler import pick_next_per_slot
+
+__all__ = ["Request", "ServingEngine", "PagedKVCache", "pick_next_per_slot"]

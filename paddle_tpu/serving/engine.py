@@ -1,0 +1,376 @@
+"""Continuous-batching LM decode engine.
+
+The batch-at-a-time `lm_decode.lm_generate` compiles per (B, P, max_new)
+shape and always runs max_new steps; mixed-length production traffic either
+pads everything to the worst case or recompiles constantly.  This engine is
+the serving answer (the slot configuration studied in arXiv:2605.25645):
+
+  * a fixed set of S decode SLOTS, each holding at most one in-flight
+    request; the decode step is ONE jitted function of fixed shape, compiled
+    once and reused for the whole workload — freed slots refill mid-flight,
+    so the chip never waits for the longest request of a batch;
+  * KV context lives in the paged pool (serving/paged_kv.py) behind
+    per-slot page tables — HBM proportional to tokens actually held;
+  * prompts PREFILL through the per-request dense cache path at
+    feeder-bucketed lengths (`data/feeder._bucket_len`), so prompt compiles
+    are per-bucket, not per-length;
+  * per-slot rng streams and sampling knobs are preserved EXACTLY: request
+    r's tokens are identical to `lm_generate(..., use_cache=True)` run on r
+    alone (same rng key schedule, same sampler semantics via
+    serving/sampler.py, same eos early-stop) — the oracle contract
+    tests/test_serving.py enforces token-for-token.
+
+Scheduling is a host loop (numpy metadata, device pools): admit from the
+FIFO queue into free slots, run one compiled step over all S slots, retire
+finished slots, repeat.  A slot that cannot get its next page (overcommitted
+pool) is PAUSED — excluded from that step's key consumption and token
+banking — and resumes bit-identically once a page frees, because its key
+schedule is indexed by its own generation counter, not by wall-clock steps.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.data.feeder import _bucket_len
+from paddle_tpu.graph.context import TEST
+from paddle_tpu.graph.lm_decode import (_is_probs, _resolve_io_names,
+                                        init_kv_caches, pick_next)
+from paddle_tpu.parameter.argument import Argument
+from paddle_tpu.serving.paged_kv import PagedKVCache
+from paddle_tpu.serving.sampler import pick_next_per_slot
+
+
+class Request:
+    """One generation request — the per-row knobs of `lm_generate`."""
+
+    def __init__(self, req_id, prompt_ids, max_new: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, eos_id: int = -1, rng=None):
+        self.req_id = req_id
+        self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_id = int(eos_id)
+        # default PRNGKey(0) — the same default lm_generate uses, so the
+        # parity oracle needs no special-casing
+        self.rng = jax.random.PRNGKey(0) if rng is None else rng
+        assert self.prompt_ids.size >= 1, "empty prompt"
+        if self.temperature <= 0.0 and (self.top_k > 0 or
+                                        0.0 < self.top_p < 1.0):
+            raise ValueError(
+                f"top_k={self.top_k}/top_p={self.top_p} need temperature "
+                f"> 0 — temperature=0 means greedy argmax, which would "
+                f"silently ignore them")
+
+
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+
+    __slots__ = ("req", "keys", "pos", "gen", "last_tok", "generated",
+                 "admit_seq")
+
+    def __init__(self, req: Request, keys: np.ndarray, pos: int,
+                 first_tok: int, admit_seq: int):
+        self.req = req
+        self.keys = keys          # [max_new, 2] uint32 — key g samples token g
+        self.pos = pos            # tokens resident in the paged cache
+        self.gen = 1              # tokens emitted so far (token 0 at admit)
+        self.last_tok = first_tok # emitted but not yet in the cache
+        self.generated = [first_tok]
+        self.admit_seq = admit_seq  # admission order — preemption victims
+                                    # are youngest-first (least work lost)
+
+
+class ServingEngine:
+    """Slot scheduler + paged KV + one compiled decode step.
+
+    >>> eng = ServingEngine(tr.executor, tr.params, num_slots=4)
+    >>> eng.add_request(Request("a", prompt, max_new=16, eos_id=2))
+    >>> results = eng.run()          # {"a": np.int32 prompt+generated}
+    """
+
+    def __init__(self, executor, params, num_slots: int = 4,
+                 page_size: int = 16, max_context: int = 256,
+                 num_pages: Optional[int] = None,
+                 input_name: Optional[str] = None,
+                 logits_name: Optional[str] = None):
+        self.executor = executor
+        self.params = params
+        self.input_name, self.logits_name = _resolve_io_names(
+            executor.model, input_name, logits_name)
+        self._probs = _is_probs(executor.model, self.logits_name)
+        pages_per_slot = -(-int(max_context) // int(page_size))
+        self.kv = PagedKVCache(executor, num_slots, page_size,
+                               pages_per_slot, num_pages)
+        self.queue: deque[Request] = deque()
+        self.slots: list[Optional[_Slot]] = [None] * num_slots
+        # finished-but-uncollected outputs: run() POPS what completed on
+        # its watch, so a long-lived engine does not accumulate results
+        self.results: dict = {}
+        self.n_decode_steps = 0
+        self.n_preemptions = 0
+        self.tokens_generated = 0
+        self.occupancy_sum = 0.0              # sum of live/S over steps
+        self._admit_seq = 0
+        self._prefill_cache: dict[int, object] = {}
+        self._pack_cache: dict[int, object] = {}
+        self._decode_step = jax.jit(self._decode_impl, donate_argnums=(1,))
+
+    # -- public API -------------------------------------------------------
+    def add_request(self, req: Request) -> None:
+        """Enqueue; admission happens inside step()/run()."""
+        if req.max_new == 0:
+            # lm_generate(max_new=0) returns the prompt unchanged whatever
+            # its length — resolve before any capacity/page validation,
+            # since this request never touches a slot or a page
+            self.results[req.req_id] = req.prompt_ids.copy()
+            return
+        p = req.prompt_ids.size
+        cap = self.kv.capacity_tokens
+        if p + req.max_new > cap:
+            raise ValueError(
+                f"request {req.req_id!r}: prompt {p} + max_new "
+                f"{req.max_new} exceeds the {cap}-token slot capacity "
+                f"(pages_per_slot * page_size) — raise max_context")
+        # guaranteed-completion bound: the last decode step writes KV at
+        # position p + max_new - 2, so a request that never hits eos needs
+        # pages covering p + max_new - 1 tokens.  A pool below that can
+        # only preempt-and-replay the request forever once it is alone.
+        need = self.kv.pages_for(max(p + req.max_new - 1, p))
+        if need > self.kv.num_pages - 1:
+            raise ValueError(
+                f"request {req.req_id!r} needs up to {need} pages to "
+                f"complete but the pool holds {self.kv.num_pages - 1} — "
+                f"raise num_pages")
+        self.queue.append(req)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit -> one compiled decode step over
+        all slots -> retire.  Returns False when idle (nothing in flight
+        and nothing admittable)."""
+        self._admit_from_queue()
+        live = [s for s in range(len(self.slots)) if self.slots[s] is not None]
+        if not live:
+            return False
+        runnable = [s for s in live
+                    if self.kv.try_grow(s, self.slots[s].pos + 1)]
+        while not runnable:
+            # overcommitted-pool wedge: every live slot needs its next page
+            # and the free list is dry.  Preempt the YOUNGEST slot (the
+            # recompute policy of arXiv:2605.25645-style engines): release
+            # its pages and requeue its request at the queue front — its
+            # deterministic per-request key schedule regenerates the exact
+            # same tokens when it is re-admitted, so preemption is
+            # invisible in the output (and in the parity oracle).
+            victim = max(live, key=lambda s: self.slots[s].admit_seq)
+            self._preempt(victim)
+            live.remove(victim)
+            if not live:
+                return True        # pages freed; next step() re-admits
+            runnable = [s for s in live
+                        if self.kv.try_grow(s, self.slots[s].pos + 1)]
+
+        S = len(self.slots)
+        pos = np.zeros(S, np.int32)
+        toks = np.zeros(S, np.int32)
+        keys = np.zeros((S, 2), np.uint32)
+        temp = np.zeros(S, np.float32)
+        topk = np.zeros(S, np.int32)
+        topp = np.zeros(S, np.float32)
+        run_set = set(runnable)
+        for s in live:
+            sl = self.slots[s]
+            pos[s], toks[s] = sl.pos, sl.last_tok
+            if s in run_set:
+                # key g samples token g — indexing by the slot's own
+                # generation counter is what keeps a paused slot's stream
+                # intact (a pause consumes no key)
+                keys[s] = sl.keys[sl.gen]
+                temp[s] = sl.req.temperature
+                topk[s] = sl.req.top_k
+                topp[s] = sl.req.top_p
+        # the pool buffers were just donated — rebind them on the cache
+        # object too, so no stale (deleted-buffer) aliases survive
+        self.kv.pools, nxt = self._decode_step(
+            self.params, self.kv.pools, jnp.asarray(self.kv.table),
+            jnp.asarray(pos), jnp.asarray(toks), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp))
+        self.n_decode_steps += 1
+        self.occupancy_sum += len(live) / S
+        nxt = np.asarray(nxt)                          # host sync
+        for s in runnable:
+            sl = self.slots[s]
+            tok = int(nxt[s])
+            sl.generated.append(tok)
+            sl.pos += 1
+            sl.gen += 1
+            sl.last_tok = tok
+            self.tokens_generated += 1
+            if tok == sl.req.eos_id or sl.gen >= sl.req.max_new:
+                self._retire(s)
+        return True
+
+    def run(self, requests=()) -> dict:
+        """Add `requests`, drive step() to completion, and POP
+        {req_id: np.int32 tokens (prompt + generated, eos included)} for
+        everything that completed during this call (including requests
+        queued before it) — earlier, already-collected runs don't bleed
+        in, and a long-lived engine holds no unbounded result archive."""
+        done_before = set(self.results)
+        for r in requests:
+            self.add_request(r)
+        while self.step():
+            pass
+        return {k: self.results.pop(k) for k in list(self.results)
+                if k not in done_before}
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Prefill length for a prompt: the feeder bucket, page-aligned,
+        capped at slot capacity — one compiled prefill per distinct value."""
+        ps = self.kv.page_size
+        Lb = -(-_bucket_len(int(prompt_len)) // ps) * ps
+        return min(Lb, self.kv.capacity_tokens)
+
+    # -- scheduling internals --------------------------------------------
+    def _admit_from_queue(self) -> None:
+        for s in range(len(self.slots)):
+            if not self.queue:
+                return
+            if self.slots[s] is not None:
+                continue
+            req = self.queue[0]
+            if not self.kv.try_grow(s, req.prompt_ids.size):
+                # page-starved: keep FIFO order, retry later.  Return the
+                # partial grab to the free list — a later retry may land on
+                # a DIFFERENT free slot, and pages stranded on this one
+                # would be invisible to it (the pool would leak).
+                self.kv.release(s)
+                return
+            self.queue.popleft()
+            self._admit(s, req)
+
+    def _admit(self, s: int, req: Request) -> None:
+        """Prefill the prompt at its bucket length, pack its K/V into the
+        slot's pages, sample token 0 from the prefill logits (keys[0] — the
+        same key schedule lm_generate consumes)."""
+        p = req.prompt_ids.size
+        ps = self.kv.page_size
+        Lb = self.bucket_for(p)
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, :p] = req.prompt_ids
+        last, kv_prompt = self._prefill_fn(Lb)(
+            self.params, jnp.asarray(ids),
+            jnp.asarray([p], np.int32))
+        keys = np.asarray(jax.random.split(req.rng, req.max_new))
+        tok0 = int(np.asarray(pick_next(
+            last, jnp.asarray(keys[0]), temperature=req.temperature,
+            top_k=req.top_k, top_p=req.top_p, is_probs=self._probs))[0])
+
+        pages = np.zeros(Lb // ps, np.int32)           # 0 = trash for pad
+        n_real = self.kv.pages_for(p)
+        pages[:n_real] = self.kv.table[s, :n_real]
+        self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
+                                          jnp.asarray(pages))
+        self._admit_seq += 1
+        self.slots[s] = _Slot(req, keys, pos=p, first_tok=tok0,
+                              admit_seq=self._admit_seq)
+        self.tokens_generated += 1
+        if tok0 == req.eos_id or req.max_new == 1:
+            self._retire(s)
+
+    def _preempt(self, s: int) -> None:
+        sl = self.slots[s]
+        self.queue.appendleft(sl.req)
+        self.tokens_generated -= sl.gen       # the restart re-emits them
+        self.n_preemptions += 1
+        self.kv.release(s)
+        self.slots[s] = None
+
+    def _retire(self, s: int) -> None:
+        sl = self.slots[s]
+        self.results[sl.req.req_id] = np.concatenate(
+            [sl.req.prompt_ids,
+             np.asarray(sl.generated, np.int32)]).astype(np.int32)
+        self.kv.release(s)
+        self.slots[s] = None
+
+    # -- compiled pieces --------------------------------------------------
+    def _decode_impl(self, params, pools, table, pos, toks, keys, temp,
+                     topk, topp):
+        """THE decode step — one signature for the whole workload: every
+        slot advances one token against its paged context; per-slot
+        knobs/keys make sampling data-dependent, not program-dependent."""
+        S = toks.shape[0]
+        state = {name: {"k_pages": pools[name]["k"],
+                        "v_pages": pools[name]["v"],
+                        "page_table": table, "pos": pos}
+                 for name in pools}
+        feed = {self.input_name: Argument(ids=toks[:, None],
+                                          lengths=jnp.ones((S,), jnp.int32))}
+        outputs, _, state_out = self.executor.forward(params, feed, state,
+                                                      TEST, None)
+        last = outputs[self.logits_name].value[:, 0, :]
+        nxt = pick_next_per_slot(last, keys, temp, topk, topp,
+                                 is_probs=self._probs)
+        new_pools = {name: {"k": state_out[name]["k_pages"],
+                            "v": state_out[name]["v_pages"]}
+                     for name in pools}
+        return new_pools, nxt
+
+    def _prefill_fn(self, Lb: int):
+        """Jitted prompt prefill for bucket length Lb — compiled once per
+        BUCKET (the feeder's _bucket_len grid), not per prompt length."""
+        fn = self._prefill_cache.get(Lb)
+        if fn is None:
+            executor = self.executor
+            input_name, logits_name = self.input_name, self.logits_name
+            attn_layers = list(self.kv.pools)
+
+            def prefill(params, ids, n):               # ids [1, Lb], n [1]
+                state = init_kv_caches(executor, 1, Lb)
+                outputs, _, state = executor.forward(
+                    params, {input_name: Argument(ids=ids, lengths=n)},
+                    state, TEST, None)
+                logits = outputs[logits_name].value
+                last = jnp.take_along_axis(
+                    logits, (n - 1)[:, None, None], axis=1)[:, 0, :]
+                return last, {name: (state[name]["k"], state[name]["v"])
+                              for name in attn_layers}
+
+            fn = self._prefill_cache[Lb] = jax.jit(prefill)
+        return fn
+
+    def _pack_fn(self, Lb: int):
+        """Jitted page writer: scatter a bucket-length prompt's K/V into
+        the slot's pages (page j of the prompt -> physical pages[j]; pad
+        pages target the trash page 0)."""
+        fn = self._pack_cache.get(Lb)
+        if fn is None:
+            ps = self.kv.page_size
+            n_pages = Lb // ps
+            specs = self.kv.layer_specs
+
+            def pack(pools, kv_prompt, pages):
+                out = {}
+                for name, (h_kv, dh) in specs.items():
+                    k, v = kv_prompt[name]
+                    out[name] = {
+                        "k": pools[name]["k"].at[pages].set(
+                            k[0, :Lb].reshape(n_pages, ps, h_kv, dh)
+                            .astype(pools[name]["k"].dtype)),
+                        "v": pools[name]["v"].at[pages].set(
+                            v[0, :Lb].reshape(n_pages, ps, h_kv, dh)
+                            .astype(pools[name]["v"].dtype)),
+                    }
+                return out
+
+            fn = self._pack_cache[Lb] = jax.jit(pack, donate_argnums=(0,))
+        return fn

@@ -1,0 +1,120 @@
+"""Paged KV cache: a fixed pool of [num_pages, page_size, h_kv, dh] pages
+per attention layer plus per-slot page tables.
+
+Replaces the dense `lm_decode.init_kv_caches` layout for SERVING: a dense
+cache sizes every row at P+max_new whatever the row actually holds, and its
+[B, total, ...] shape bakes the request mix into the compiled program.
+Here the pool shape is fixed forever — one compiled decode step serves any
+request mix — and HBM cost is proportional to pages actually allocated
+(Ragged Paged Attention, arXiv:2604.15464; the slot/page serving
+configuration of arXiv:2605.25645).
+
+Device side: per-attention-layer page pools (`pools[name]["k"/"v"]`) that
+thread through the engine's jitted decode step, and ONE logical page table
+shared by every layer (all layers hold the same tokens).  Host side: the
+page allocator — a free list plus the per-slot table mirror the scheduler
+consults and mutates between steps.  PHYSICAL PAGE 0 IS RESERVED as the
+trash page: unmapped table entries are 0, so inactive/paused slots' writes
+land there and reads of unallocated logical pages gather finite garbage
+that causal masking weighs to exactly 0 (see
+ops/attention.py:paged_attention_step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagedKVCache:
+    """Device page pools + host page allocator for `num_slots` decode slots.
+
+    `pages_per_slot * page_size` bounds one slot's context (prompt +
+    generated); `num_pages` bounds the whole pool (default: worst case,
+    every slot full, plus the trash page — pass something smaller to
+    overcommit, the engine then pauses slots/defers admission when the
+    free list runs dry)."""
+
+    def __init__(self, executor, num_slots: int, page_size: int,
+                 pages_per_slot: int, num_pages: Optional[int] = None):
+        assert page_size > 0 and pages_per_slot > 0
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        self.num_slots = int(num_slots)
+        self.num_pages = int(num_pages) if num_pages else \
+            1 + num_slots * pages_per_slot
+        assert self.num_pages >= 2, "pool needs the trash page + 1 real page"
+
+        dtype = jnp.dtype(executor.compute_dtype) if executor.compute_dtype \
+            else jnp.float32
+        self.layer_specs: dict[str, tuple[int, int]] = {}
+        self.pools: dict[str, dict[str, jnp.ndarray]] = {}
+        for l in executor.model.layers:
+            if l.type != "multi_head_attention":
+                continue
+            heads = int(l.attrs["num_heads"])
+            h_kv = int(l.attrs.get("num_kv_heads", 0) or heads)
+            dh = int(l.size) // heads
+            self.layer_specs[l.name] = (h_kv, dh)
+            self.pools[l.name] = {
+                "k": jnp.zeros((self.num_pages, page_size, h_kv, dh), dtype),
+                "v": jnp.zeros((self.num_pages, page_size, h_kv, dh), dtype),
+            }
+        assert self.pools, "model has no multi_head_attention layers to page"
+
+        # host allocator state: table[s, j] = physical page backing logical
+        # page j of slot s (0 = unmapped -> trash)
+        self.table = np.zeros((num_slots, pages_per_slot), np.int32)
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._n_pages = np.zeros(num_slots, np.int32)
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def capacity_tokens(self) -> int:
+        """Max tokens (prompt + generated) one slot can hold."""
+        return self.pages_per_slot * self.page_size
+
+    @property
+    def free_page_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.page_size)
+
+    # -- allocator --------------------------------------------------------
+    def try_grow(self, slot: int, n_tokens: int) -> bool:
+        """Ensure `slot` has pages covering `n_tokens` tokens, allocating
+        from the free list on demand.  False (and no change beyond pages
+        already grabbed — they stay with the slot for the retry) when the
+        free list runs dry: the caller pauses the slot or defers the
+        admission."""
+        need = self.pages_for(n_tokens)
+        assert need <= self.pages_per_slot, \
+            f"slot {slot}: {n_tokens} tokens exceed the " \
+            f"{self.capacity_tokens}-token slot capacity"
+        while self._n_pages[slot] < need:
+            if not self._free:
+                return False
+            page = self._free.pop()
+            self.table[slot, self._n_pages[slot]] = page
+            self._n_pages[slot] += 1
+        return True
+
+    def release(self, slot: int) -> None:
+        """Return every page of `slot` to the free list (retire/abort)."""
+        for j in range(int(self._n_pages[slot])):
+            self._free.append(int(self.table[slot, j]))
+        self.table[slot, :] = 0
+        self._n_pages[slot] = 0
+
+    def reset(self) -> None:
+        """Release every slot (pool contents need no zeroing: stale pages
+        are unreachable once unmapped, and masked if ever gathered)."""
+        for s in range(self.num_slots):
+            self.release(s)

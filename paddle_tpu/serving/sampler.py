@@ -1,0 +1,84 @@
+"""Per-slot sampling for the continuous-batching decode step.
+
+`lm_decode.pick_next` bakes its knobs (temperature/top_k/top_p) into the
+compiled program — fine for one homogeneous batch, useless for a serving
+step whose slots each carry their OWN request's knobs and rng stream.
+`pick_next_per_slot` is the data-dependent twin: knobs ride [S] arrays,
+every slot samples with its own key, and row s reproduces EXACTLY what
+
+    pick_next(last[s:s+1], keys[s], temperature=t[s], top_k=k[s],
+              top_p=p[s], is_probs=is_probs)
+
+computes — same filtered support (top_k ties broken value-desc/index-asc
+by the full-V `lax.top_k` sort, exactly the k-best scatter of the scalar
+path; the nucleus cut is the same cumsum-minus-probs formulation with the
+scalar threshold made a per-row column), and the same randomness (each
+slot's `jax.random.categorical` runs under vmap on a [1, V] row with that
+slot's key — bit-identical to the B=1 oracle call).  That equivalence is
+what makes the serving engine's per-request exactness oracle
+(tests/test_serving.py) hold for sampled decoding, not just greedy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def pick_next_per_slot(last: Array, keys: Array, temperature: Array,
+                       top_k: Array, top_p: Array,
+                       is_probs: bool = False) -> Array:
+    """[S, V] scores + per-slot keys [S, 2] / knobs [S] -> [S] int32.
+
+    Slots with temperature <= 0 decode greedily (their key is never
+    consumed); top_k <= 0 keeps the full support; top_p outside (0, 1)
+    disables the nucleus cut — all per slot, all in ONE compiled program.
+    """
+    S, V = last.shape
+    last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
+        if is_probs else last.astype(jnp.float32)
+    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        t_safe = jnp.where(temperature > 0.0, temperature, 1.0)
+        scaled = last / t_safe[:, None]
+
+        # per-slot top-k: the full-V descending sort has the same ordering
+        # (value desc, ties index asc) as lax.top_k(scaled, k), so rank < k
+        # reproduces the scalar path's exact k-best support
+        vals, idxs = jax.lax.top_k(scaled, V)
+        k_eff = jnp.where(top_k > 0, top_k, V)
+        keep = jnp.arange(V)[None, :] < k_eff[:, None]
+        filtered = jnp.full_like(scaled, -jnp.inf).at[
+            jnp.arange(S)[:, None], idxs].set(
+            jnp.where(keep, vals, -jnp.inf))
+        scaled = jnp.where((top_k > 0)[:, None], filtered, scaled)
+
+        # per-slot nucleus cut — lm_decode.nucleus_filter with the scalar
+        # threshold broadcast per row; the (0, 1) gate selects, it does not
+        # approximate (p = 1.0 must be a true no-op, not "keep prob > 0")
+        order = jnp.argsort(scaled, axis=-1)[:, ::-1]
+        srt = jnp.take_along_axis(scaled, order, axis=-1)
+        probs = jax.nn.softmax(srt, axis=-1)
+        keep_p = jnp.cumsum(probs, axis=-1) - probs < top_p[:, None]
+        nuc = jnp.full_like(scaled, -jnp.inf).at[
+            jnp.arange(S)[:, None], order].set(
+            jnp.where(keep_p, srt, -jnp.inf))
+        apply_p = jnp.logical_and(top_p > 0.0, top_p < 1.0)
+        scaled = jnp.where(apply_p[:, None], nuc, scaled)
+
+        # per-slot randomness: each row samples as its own B=1 batch under
+        # its own key — the exactness contract with the per-request oracle
+        sampled = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg[None, :])[0])(
+            keys, scaled)
+        return jnp.where(temperature > 0.0, sampled.astype(jnp.int32),
+                         greedy)
+
+    # all-greedy steps (the common serving default) skip the two full-V
+    # sorts + softmax + categorical entirely — same single jit signature,
+    # the cond just picks the cheap branch at run time
+    return jax.lax.cond(jnp.any(temperature > 0.0), _sampled,
+                        lambda _: greedy, None)

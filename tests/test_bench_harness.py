@@ -76,6 +76,31 @@ def test_assemble_lkg_stitches_per_config_records(tmp_path):
         "2026-07-30T12:00:00+00:00"
 
 
+def test_assemble_lkg_stitches_serving_record(tmp_path):
+    """The continuous-batching serving metric (lm_serving_tok_per_sec)
+    rides the same per-config queue shape: a top-level BENCH_ONLY=serving
+    record must stitch into the assembled fallback under the `serving`
+    key, newest occurrence winning."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving"] == "lm_serving_tok_per_sec"
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-07-30T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0,
+                    "serving": {"metric": M["serving"], "value": 1000.0}}},
+        {"ts": "2026-07-31T10:00:00+00:00",
+         "record": {"metric": M["serving"], "value": 2000.0,
+                    "occupancy": 0.9,
+                    "measured_at": "2026-07-31T10:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving"]["value"] == 2000.0
+    assert out["serving"]["occupancy"] == 0.9
+
+
 def test_assemble_lkg_decode_only_survives_missing_train(tmp_path):
     """s2s_decode can bank while s2s_train wedges — the measured decode
     number must still surface in the assembled fallback."""

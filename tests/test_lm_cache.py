@@ -105,6 +105,65 @@ def test_cached_step_op_matches_dense():
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("use_cache", [False, True])
+def test_early_exit_chunks_match_single_scan(use_cache):
+    """lm_generate(early_exit_chunk=k) decodes in k-step scans with a host
+    all-done check between chunks — tokens, lengths AND the rng stream
+    must be bit-identical to the single-scan path (chunk sizes that divide
+    max_new and a ragged remainder both)."""
+    import jax
+
+    tr = _make("vocab=11,dim=16,layers=1,heads=2,batch_size=3")
+    ids, lens = _prompts(3, 6, 11, seed=3)
+    for chunk, kw in [(3, dict(eos_id=5)),                  # remainder chunk
+                      (5, dict(eos_id=5)),                  # divides max_new
+                      (4, dict(temperature=0.9, top_k=4,    # sampled stream
+                               rng=jax.random.PRNGKey(2)))]:
+        base = dict(prompt_lengths=lens, max_new=10, use_cache=use_cache,
+                    **kw)
+        f_t, f_l = lm_generate(tr.executor, tr.params, ids, **base)
+        c_t, c_l = lm_generate(tr.executor, tr.params, ids,
+                               early_exit_chunk=chunk, **base)
+        np.testing.assert_array_equal(np.asarray(f_l), np.asarray(c_l))
+        np.testing.assert_array_equal(np.asarray(f_t), np.asarray(c_t))
+
+
+def test_early_exit_stops_after_all_rows_done():
+    """_chunked_scan must stop dispatching chunks once the host all-done
+    check trips: a batch done at step 7 of 29 runs 2 five-step chunks, not
+    6 — and leaves the carry exactly as the full scan would (done rows
+    freeze, so skipped trailing steps are no-ops)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.graph.lm_decode import _chunked_scan
+
+    def step(carry, key):
+        i, done = carry
+        i = jnp.where(done, i, i + 1)
+        return (i, i >= 7), None
+
+    keys = jnp.arange(29)
+    full, _ = jax.lax.scan(step, (jnp.int32(0), jnp.bool_(False)), keys)
+
+    chunks = []
+    orig_scan = jax.lax.scan
+
+    def counting_scan(f, init, xs, *a, **kw):
+        chunks.append(int(xs.shape[0]))
+        return orig_scan(f, init, xs, *a, **kw)
+
+    jax.lax.scan = counting_scan
+    try:
+        chunked = _chunked_scan(step, (jnp.int32(0), jnp.bool_(False)),
+                                keys, chunk=5, done_of=lambda c: c[1])
+    finally:
+        jax.lax.scan = orig_scan
+    assert int(chunked[0]) == int(full[0]) == 7
+    assert chunks == [5, 5], f"expected 2 five-step chunks (done at " \
+                             f"step 7), got {chunks}"
+
+
 def test_beam1_equals_greedy_cached():
     from paddle_tpu.graph.lm_decode import lm_beam_generate
 

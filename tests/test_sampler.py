@@ -1,0 +1,97 @@
+"""Sampler edge cases for `lm_decode.pick_next` / `nucleus_filter` and the
+serving per-slot twin (`serving/sampler.py:pick_next_per_slot`):
+
+  * top_p = 1.0 must be a true no-op (the (0,1) gate, not a float knife
+    edge at cumulative mass 1.0),
+  * logit ties AT the k-th value must not widen the top-k support,
+  * the probs-layer path (`_is_probs` -> sample through log) must floor
+    zero probabilities instead of producing -inf/nan,
+  * and every per-slot row must reproduce the scalar sampler exactly —
+    the serving engine's sampled-decode exactness rests on it."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.graph.lm_decode import nucleus_filter, pick_next
+from paddle_tpu.serving.sampler import pick_next_per_slot
+
+
+def _logits(rows):
+    return jnp.asarray(rows, jnp.float32)
+
+
+def test_top_p_one_is_exact_noop():
+    """top_p=1.0 (and 0.0) disables the nucleus cut exactly: identical
+    draws to the unfiltered sampler under the same key, and
+    nucleus_filter returns its input unchanged."""
+    logits = _logits([[0.3, -1.0, 2.0, 0.0, 1.4]])
+    for p in (0.0, 1.0):
+        np.testing.assert_array_equal(np.asarray(nucleus_filter(logits, p)),
+                                      np.asarray(logits))
+    for seed in range(8):
+        key = jax.random.PRNGKey(seed)
+        np.testing.assert_array_equal(
+            np.asarray(pick_next(logits, key, temperature=0.7, top_p=1.0)),
+            np.asarray(pick_next(logits, key, temperature=0.7)))
+
+
+def test_top_k_tie_at_kth_value_does_not_widen_support():
+    """[3, 2, 2, 1] with top_k=2: the tie at the 2nd value breaks to the
+    LOWER index (lax.top_k order) — index 2 must never be drawn, and both
+    kept tokens must actually appear."""
+    logits = _logits([[3.0, 2.0, 2.0, 1.0]])
+    drawn = {int(np.asarray(pick_next(
+        logits, jax.random.PRNGKey(s), temperature=1.5, top_k=2))[0])
+        for s in range(64)}
+    assert drawn == {0, 1}, drawn
+
+
+def test_probs_layer_log_path():
+    """is_probs=True samples through log(max(p, 1e-30)): greedy equals
+    argmax of the probabilities, zero-probability tokens are never drawn,
+    and the draw equals sampling the floored log directly."""
+    probs = _logits([[0.0, 0.3, 0.7, 0.0]])
+    assert int(np.asarray(pick_next(probs, None, is_probs=True))[0]) == 2
+    floored = jnp.log(jnp.maximum(probs, 1e-30))
+    for seed in range(16):
+        key = jax.random.PRNGKey(seed)
+        got = pick_next(probs, key, temperature=1.0, is_probs=True)
+        assert int(np.asarray(got)[0]) in (1, 2)
+        np.testing.assert_array_equal(
+            np.asarray(got),
+            np.asarray(pick_next(floored, key, temperature=1.0)))
+
+
+def test_greedy_ignores_knobless_key():
+    logits = _logits([[0.1, 5.0, -2.0]])
+    out = pick_next(logits, None)          # temperature=0: key never touched
+    assert int(np.asarray(out)[0]) == 1
+
+
+def test_per_slot_rows_match_scalar_sampler():
+    """The serving sampler's row s must reproduce pick_next on row s alone
+    — heterogeneous knobs (greedy / top-k / nucleus / full / tied logits)
+    under per-slot keys, in one call."""
+    rng = np.random.default_rng(0)
+    S, V = 6, 17
+    last = rng.normal(size=(S, V)).astype(np.float32)
+    last[4, :4] = 2.0                       # ties for the top-k row
+    last = jnp.asarray(last)
+    temp = np.asarray([0.0, 0.8, 0.7, 1.2, 1.0, 0.0], np.float32)
+    topk = np.asarray([0, 5, 0, 0, 3, 0], np.int32)
+    topp = np.asarray([0.0, 0.0, 0.9, 1.0, 0.0, 0.0], np.float32)
+    keys = np.asarray([np.asarray(jax.random.PRNGKey(100 + s))
+                       for s in range(S)])
+    for is_probs in (False, True):
+        rows = jnp.abs(last) if is_probs else last
+        got = np.asarray(pick_next_per_slot(
+            rows, jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(topk),
+            jnp.asarray(topp), is_probs=is_probs))
+        for s in range(S):
+            want = pick_next(rows[s:s + 1], jnp.asarray(keys[s]),
+                             temperature=float(temp[s]), top_k=int(topk[s]),
+                             top_p=float(topp[s]), is_probs=is_probs)
+            assert got[s] == int(np.asarray(want)[0]), \
+                f"slot {s} diverged (is_probs={is_probs})"

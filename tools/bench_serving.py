@@ -1,0 +1,222 @@
+"""Continuous-batching serving benchmark — mixed-length Poisson-arrival
+workload through serving/engine.py.
+
+Timing discipline (tools/_scan_bench.py's lessons applied to a host-driven
+engine): the scheduler IS a host loop and every decode step already ends in
+a host read of the sampled tokens — that read is the only completion
+barrier the axon tunnel has been observed to honor, so per-step timing can
+never report beyond-hardware numbers the way an unsynced dispatch loop
+does.  What DOES need guarding is compile time: a full warmup pass drives
+the same request mix through the engine first, so every prefill bucket and
+the ONE decode signature are compiled before the timed region (asserted:
+the decode jit cache must not grow during measurement).
+
+Two modes per row:
+  * --rate 0 (default): all requests arrive at t=0 — closed loop, peak
+    tokens/sec at full slot pressure;
+  * --rate R: open-loop Poisson arrivals at R requests/sec — tokens/sec at
+    that offered load plus the mean slot occupancy (the capacity-planning
+    curve PERF.md's serving section reads).
+
+One JSON line per measurement, MEASURE/-compatible.
+
+Usage:
+  python tools/bench_serving.py                       # TPU-sized defaults
+  python tools/bench_serving.py --rate 2,8,32         # occupancy curve
+  python tools/bench_serving.py --num-requests 6 --slots 2 ... (rehearse)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_requests(n: int, prompt_lo: int, prompt_hi: int, max_new: int,
+                  vocab: int, seed: int = 0, eos_id: int = -1):
+    """Mixed-length request set: prompt lengths uniform in
+    [prompt_lo, prompt_hi] (spanning several feeder buckets), greedy
+    decode (throughput does not depend on token values)."""
+    import numpy as np
+
+    from paddle_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        p = int(rng.integers(prompt_lo, prompt_hi + 1))
+        prompt = rng.integers(2, vocab, p).astype(np.int32)
+        reqs.append(Request(f"r{seed}_{i}", prompt, max_new=max_new,
+                            eos_id=eos_id))
+    return reqs
+
+
+def poisson_arrivals(n: int, rate: float, seed: int = 0):
+    """Arrival offsets (seconds from t0): exponential gaps at `rate`
+    req/s; rate <= 0 -> everything at t=0 (closed loop)."""
+    import numpy as np
+
+    if rate <= 0:
+        return np.zeros(n)
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / rate, n))
+
+
+def run_workload(engine, requests, arrivals=None) -> dict:
+    """Drive one workload to completion; returns wall seconds, generated
+    tokens, mean occupancy over the steps of THIS run, decode steps,
+    preemptions.  The per-step host token read is the sync barrier."""
+    import numpy as np
+
+    arrivals = np.zeros(len(requests)) if arrivals is None else arrivals
+    order = np.argsort(arrivals, kind="stable")
+    requests = [requests[i] for i in order]
+    arrivals = arrivals[order]
+    tok0 = engine.tokens_generated
+    step0 = engine.n_decode_steps
+    occ0 = engine.occupancy_sum
+    pre0 = engine.n_preemptions
+    i, n = 0, len(requests)
+    t0 = time.perf_counter()
+    while True:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            engine.add_request(requests[i])
+            i += 1
+        busy = engine.step()
+        if not busy:
+            if i >= n:
+                break
+            time.sleep(min(max(arrivals[i] - (time.perf_counter() - t0),
+                               0.0), 0.05))
+    dt = time.perf_counter() - t0
+    steps = engine.n_decode_steps - step0
+    return {
+        "seconds": dt,
+        "tokens": engine.tokens_generated - tok0,
+        "decode_steps": steps,
+        "occupancy": (engine.occupancy_sum - occ0) / steps if steps else 0.0,
+        "preemptions": engine.n_preemptions - pre0,
+    }
+
+
+def warm_workload(engine, request_sets) -> None:
+    """Compile everything the measured reps will touch BEFORE the timed
+    region: run the first set end-to-end (decode signature + its buckets),
+    then prefill one 1-token request per bucket any OTHER set needs —
+    otherwise a rep whose seed draws a bucket the warmup seed missed pays
+    a multi-second jit compile inside its timing window."""
+    import numpy as np
+
+    from paddle_tpu.serving import Request
+
+    engine.run(request_sets[0])
+    seen = set(engine._prefill_cache)
+    for reqs in request_sets[1:]:
+        for r in reqs:
+            b = engine.bucket_for(r.prompt_ids.size)
+            if b not in seen:
+                seen.add(b)
+                engine.run([Request(f"_warm{b}",
+                                    np.full(min(b, r.prompt_ids.size), 2,
+                                            np.int32), max_new=1)])
+
+
+def build_engine(args):
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config(
+        "demo/model_zoo/transformer_lm.py",
+        f"vocab={args.vocab},dim={args.dim},layers={args.layers},"
+        f"heads={args.heads},batch_size={args.slots},"
+        f"compute_dtype={args.dtype}")
+    tr = Trainer(cfg, seed=1)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=args.slots,
+                        page_size=args.page_size,
+                        max_context=args.max_context)
+    return eng
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-requests", type=int, default=64)
+    ap.add_argument("--rate", default="0",
+                    help="comma list of offered req/s (0 = closed loop)")
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-context", type=int, default=768)
+    ap.add_argument("--prompt-lo", type=int, default=32)
+    ap.add_argument("--prompt-hi", type=int, default=256)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    eng = build_engine(args)
+    base = dict(n=args.num_requests, prompt_lo=args.prompt_lo,
+                prompt_hi=args.prompt_hi, max_new=args.max_new,
+                vocab=args.vocab)
+
+    # every measured workload, generated up front so warmup can compile
+    # exactly the buckets the timed reps will touch
+    rep_sets = [make_requests(seed=args.seed + 1 + rep, **base)
+                for rep in range(args.reps)]
+    warm_workload(eng, [make_requests(seed=args.seed, **base)] + rep_sets)
+    sigs = eng._decode_step._cache_size()
+    buckets = len(eng._prefill_cache)
+
+    ok = True
+    for rate in [float(r) for r in str(args.rate).split(",") if r != ""]:
+        vals, occs, pres = [], [], 0
+        rec = {}
+        for rep in range(args.reps):
+            reqs = make_requests(seed=args.seed + 1 + rep, **base)
+            arr = poisson_arrivals(len(reqs), rate, seed=args.seed + rep)
+            rec = run_workload(eng, reqs, arr)
+            vals.append(rec["tokens"] / rec["seconds"])
+            occs.append(rec["occupancy"])
+            pres += rec["preemptions"]
+        if eng._decode_step._cache_size() != sigs or \
+                len(eng._prefill_cache) != buckets:
+            ok = False
+            print(json.dumps({"bench": "serving",
+                              "error": "decode step or prefill bucket "
+                                       "recompiled during the timed "
+                                       "region"}), flush=True)
+        q1, med, q3 = np.percentile(vals, [25, 50, 75])
+        print(json.dumps({
+            "bench": "serving", "rate_req_per_sec": rate,
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prompt_lens": [args.prompt_lo, args.prompt_hi],
+            "max_new": args.max_new,
+            "dim": args.dim, "layers": args.layers, "dtype": args.dtype,
+            "tokens_per_sec_median": round(float(med), 1),
+            "tokens_per_sec_iqr": [round(float(q1), 1), round(float(q3), 1)],
+            "occupancy": round(float(np.mean(occs)), 3),   # mean over reps —
+            # stays consistent with the median throughput it sits next to
+            "decode_steps": rec["decode_steps"],
+            "preemptions": pres,
+            "decode_signatures": eng._decode_step._cache_size(),
+            "prefill_buckets": len(eng._prefill_cache),
+            "reps": args.reps,
+        }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

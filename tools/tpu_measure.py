@@ -186,6 +186,10 @@ _REHEARSE_ENV = {
     "BENCH_LM_HEADS": "2", "BENCH_LM_LEN": "32", "BENCH_LM_BATCH": "4",
     "BENCH_LM_ITERS": "2", "BENCH_LM_DECODE_BATCH": "2",
     "BENCH_LM_MAX_NEW": "8", "BENCH_LM_DECODE_REPS": "2",
+    "BENCH_SERVE_SLOTS": "2", "BENCH_SERVE_PAGE": "8",
+    "BENCH_SERVE_CONTEXT": "48", "BENCH_SERVE_REQS": "6",
+    "BENCH_SERVE_PROMPT_LO": "3", "BENCH_SERVE_PROMPT_HI": "12",
+    "BENCH_SERVE_MAX_NEW": "4", "BENCH_SERVE_REPS": "2",
 }
 
 
@@ -233,6 +237,13 @@ def main() -> int:
                    "--dtype", "float32", "--iters", "2",
                    "--tokens-per-batch", "128", "--decode-batch", "2",
                    "--max-new", "8", "--decode-reps", "2"]
+        serving_args = ["--num-requests", "6", "--slots", "2",
+                        "--page-size", "8", "--max-context", "32",
+                        "--prompt-lo", "3", "--prompt-hi", "10",
+                        "--max-new", "4", "--vocab", "64", "--dim", "32",
+                        "--layers", "1", "--heads", "2",
+                        "--dtype", "float32", "--reps", "1",
+                        "--rate", "0,20"]
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
         tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
                      "--heads", "2", "--target-ms", "5", "--reps", "1"]
@@ -244,6 +255,9 @@ def main() -> int:
         attn_args = ["--lens", "512,1024,2048,4096,8192,16384"]
         attn_f32_args = ["--lens", "512,1024,4096", "--dtype", "float32"]
         lm_args = []
+        # closed-loop peak + the offered-load curve PERF.md's serving
+        # section reads (tokens/s + occupancy vs arrival rate)
+        serving_args = ["--rate", "0,4,16,64"]
         rnn_args = []
         additive_args = []
         profile_args = []
@@ -273,6 +287,11 @@ def main() -> int:
          lambda: _metric_fresh(_METRIC_OF["recommendation"], fh)),
         ("bench_lm_record", [py, "bench.py"], 900, bench_env("lm", 840),
          lambda: _metric_fresh(_METRIC_OF["lm"], fh)),
+        # the continuous-batching serving record (lm_serving_tok_per_sec):
+        # never measured on hardware before this queue entry
+        ("bench_serving_record", [py, "bench.py"], 900,
+         bench_env("serving", 840),
+         lambda: _metric_fresh(_METRIC_OF["serving"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
@@ -292,6 +311,11 @@ def main() -> int:
          lambda: _out_fresh("attn_bench", fh)),
         ("bench_lm", [py, "tools/bench_lm.py"] + lm_args, 1500, {},
          lambda: _out_fresh("bench_lm", fh)),
+        # serving sweep: closed-loop peak + the tokens/s-vs-arrival-rate
+        # occupancy curve (PERF.md "reading the serving bench")
+        ("bench_serving", [py, "tools/bench_serving.py"] + serving_args,
+         1200, {},
+         lambda: _out_fresh("bench_serving", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
