@@ -458,11 +458,15 @@ def bench_serving(dtype: str) -> dict:
                 vocab=args.vocab)
     rep_sets = [make_requests(seed=1 + rep, **base) for rep in range(reps)]
     warm_workload(eng, [make_requests(seed=0, **base)] + rep_sets)
-    vals, occs = [], []
+    vals, occs, step_s, req_s = [], [], [], []
     for reqs in rep_sets:
         rec = run_workload(eng, reqs)
         vals.append(rec["tokens"] / rec["seconds"])
         occs.append(rec["occupancy"])
+        step_s += rec["step_seconds"]
+        req_s += rec["req_seconds"]
+    tok_p50, tok_p99 = (np.percentile(step_s, [50, 99]) * 1e3
+                        if step_s else (0.0, 0.0))
     return {
         "metric": "lm_serving_tok_per_sec",
         "value": round(float(np.median(vals)), 1),
@@ -472,6 +476,13 @@ def bench_serving(dtype: str) -> dict:
                   f"H={args.heads} slots={args.slots} page={args.page_size} "
                   f"prompts={lo}-{hi} max_new={max_new}",
         "occupancy": round(float(np.mean(occs)), 3),
+        # the serving-latency companion metric: p99 busy-step duration =
+        # p99 inter-token latency a live request observed (the SLO number;
+        # tools/bench_serving.py reports the same fields per arrival rate)
+        "tok_latency_ms_p50": round(float(tok_p50), 3),
+        "lm_serving_p99_tok_latency_ms": round(float(tok_p99), 3),
+        "req_latency_ms_p99": round(
+            float(np.percentile(req_s, 99) * 1e3) if req_s else 0.0, 3),
         "decode_signatures": eng._decode_step._cache_size(),
     }
 

@@ -1,8 +1,26 @@
 """Continuous-batching LM serving: paged KV cache + slot scheduler +
-one compiled decode step (see serving/engine.py for the design note)."""
+one compiled decode step (serving/engine.py for the core design note),
+fronted by a length-prefixed-JSON TCP RPC server with streaming,
+deadlines, cancellation, bounded admission, and graceful drain
+(serving/server.py; protocol in serving/wire.py, blocking client in
+serving/client.py, CLI in tools/serve.py)."""
 
 from paddle_tpu.serving.engine import Request, ServingEngine
 from paddle_tpu.serving.paged_kv import PagedKVCache
 from paddle_tpu.serving.sampler import pick_next_per_slot
 
-__all__ = ["Request", "ServingEngine", "PagedKVCache", "pick_next_per_slot"]
+__all__ = ["Request", "ServingEngine", "PagedKVCache", "pick_next_per_slot",
+           "ServingServer", "ServingClient"]
+
+
+def __getattr__(name):
+    # server/client import lazily: the server pulls in asyncio machinery
+    # nobody batch-scoring with the bare engine needs, and keeping them out
+    # of the eager path keeps `from paddle_tpu.serving import Request` light
+    if name == "ServingServer":
+        from paddle_tpu.serving.server import ServingServer
+        return ServingServer
+    if name == "ServingClient":
+        from paddle_tpu.serving.client import ServingClient
+        return ServingClient
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,161 @@
+"""Blocking-socket client for the serving front end (serving/server.py).
+
+Deliberately dependency-light (stdlib sockets + serving/wire.py framing —
+no jax, no asyncio in the client logic itself): a deploy target or load
+generator can lift this file plus wire.py, rewriting the one package
+import.  One connection multiplexes many requests: `submit()`
+fires a generate, `collect()` routes the interleaved token/done frames
+back per request, `cancel()` can be sent while streams are in flight —
+which is exactly the shape tests/test_server.py and tools/serve.py's
+--client mode drive.
+
+>>> with ServingClient(host, port) as c:
+...     toks, reason = c.generate([2, 7, 9], max_new=16, eos_id=3)
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Callable, Optional
+
+from paddle_tpu.serving import wire
+
+
+class OverloadError(RuntimeError):
+    """Server refused admission (bounded queue full, or draining)."""
+
+    def __init__(self, msg: dict):
+        super().__init__(f"server overloaded: {msg.get('reason', '?')} "
+                         f"(inflight={msg.get('inflight')}, "
+                         f"max={msg.get('max_inflight')})")
+        self.info = msg
+
+
+class ServerError(RuntimeError):
+    """Server answered a request with an error frame."""
+
+
+class ServingClient:
+    def __init__(self, host: str, port: int, timeout: float = 300.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._next_id = 0
+        # frames that arrived while collect() was routing for OTHER ids
+        # (e.g. a stats reply read mid-stream) are buffered, never dropped
+        self._pending: list[dict] = []
+
+    # -- context management ------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- low-level frames --------------------------------------------------
+    def send(self, msg: dict) -> None:
+        wire.write_frame_sync(self.sock, msg)
+
+    def recv(self) -> dict:
+        if self._pending:
+            return self._pending.pop(0)
+        msg = wire.read_frame_sync(self.sock)
+        if msg is None:
+            raise ConnectionError("server closed the connection")
+        return msg
+
+    # -- request lifecycle -------------------------------------------------
+    def submit(self, prompt, max_new: int = 32, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 0.0, eos_id: int = -1,
+               seed: Optional[int] = None, timeout_s: Optional[float] = None,
+               stream: bool = True, req_id=None):
+        """Fire one generate; returns the request id (auto-assigned unless
+        given).  Does NOT wait — pair with collect()."""
+        if req_id is None:
+            req_id = f"q{self._next_id}"
+            self._next_id += 1
+        msg = {"type": "generate", "id": req_id,
+               "prompt": [int(t) for t in prompt],
+               "max_new": int(max_new), "stream": bool(stream)}
+        if temperature:
+            msg["temperature"] = float(temperature)
+        if top_k:
+            msg["top_k"] = int(top_k)
+        if top_p:
+            msg["top_p"] = float(top_p)
+        if eos_id != -1:
+            msg["eos_id"] = int(eos_id)
+        if seed is not None:
+            msg["seed"] = int(seed)
+        if timeout_s is not None:
+            msg["timeout_s"] = float(timeout_s)
+        self.send(msg)
+        return req_id
+
+    def cancel(self, req_id) -> None:
+        """Client-initiated cancellation; the stream's final frame will be
+        `done` with reason "cancelled" (or whatever finished it first)."""
+        self.send({"type": "cancel", "id": req_id})
+
+    def collect(self, req_ids, on_token: Optional[Callable] = None) -> dict:
+        """Route frames until every id in `req_ids` reached its terminal
+        frame.  Returns {req_id: {"tokens": [...], "reason": str,
+        "stream": [token ids in arrival order]}}.  `on_token(req_id,
+        token, index)` observes streaming tokens as they arrive.  Raises
+        OverloadError / ServerError on those terminal frames."""
+        want = set(req_ids)
+        out = {rid: {"tokens": None, "reason": None, "stream": []}
+               for rid in want}
+        while any(out[rid]["reason"] is None for rid in want):
+            msg = self.recv()
+            rid = msg.get("id")
+            if rid not in want:
+                self._pending.append(msg)      # someone else's frame
+                continue
+            t = msg.get("type")
+            if t == "token":
+                out[rid]["stream"].append(int(msg["token"]))
+                if on_token is not None:
+                    on_token(rid, int(msg["token"]), int(msg["index"]))
+            elif t == "done":
+                out[rid]["tokens"] = list(msg["tokens"])
+                out[rid]["reason"] = msg["reason"]
+            elif t == "overload":
+                raise OverloadError(msg)
+            elif t == "error":
+                raise ServerError(msg.get("error", "unknown server error"))
+            else:
+                self._pending.append(msg)
+        return out
+
+    def generate(self, prompt, on_token: Optional[Callable] = None,
+                 **kw) -> tuple[list, str]:
+        """Submit one request and wait it out: (tokens, reason).  `tokens`
+        is prompt + generated, exactly lm_generate's layout."""
+        rid = self.submit(prompt, **kw)
+        res = self.collect([rid], on_token=on_token)[rid]
+        return res["tokens"], res["reason"]
+
+    # -- ops ----------------------------------------------------------------
+    def stats(self) -> dict:
+        """The server's stats RPC (queue/slot/page occupancy, latency
+        percentiles).  Safe to call with streams in flight: interleaved
+        token frames are buffered for the next collect()."""
+        self.send({"type": "stats"})
+        while True:
+            msg = self.recv()
+            if msg.get("type") == "stats":
+                return msg
+            self._pending.append(msg)
+
+    def ping(self) -> bool:
+        self.send({"type": "ping"})
+        while True:
+            msg = self.recv()
+            if msg.get("type") == "pong":
+                return True
+            self._pending.append(msg)

@@ -30,6 +30,7 @@ schedule is indexed by its own generation counter, not by wall-clock steps.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Optional
 
@@ -51,7 +52,8 @@ class Request:
 
     def __init__(self, req_id, prompt_ids, max_new: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0, eos_id: int = -1, rng=None):
+                 top_p: float = 0.0, eos_id: int = -1, rng=None,
+                 deadline: Optional[float] = None):
         self.req_id = req_id
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new = int(max_new)
@@ -59,10 +61,23 @@ class Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.eos_id = int(eos_id)
+        # absolute time on the ENGINE's clock (engine.clock(), default
+        # time.monotonic) after which the request is expired — swept at the
+        # top of every step(), whether the request is queued or in flight
+        self.deadline = None if deadline is None else float(deadline)
+        # tokens this request had generated when it was preempted back
+        # into the queue: a re-admission replays them identically (cleared
+        # there), but a cancel/deadline that lands while it WAITS must
+        # report them — the front end already streamed them to the client
+        self._preempted_gen: Optional[list] = None
         # default PRNGKey(0) — the same default lm_generate uses, so the
         # parity oracle needs no special-casing
         self.rng = jax.random.PRNGKey(0) if rng is None else rng
-        assert self.prompt_ids.size >= 1, "empty prompt"
+        if self.prompt_ids.size < 1:
+            # ValueError, not assert: requests arrive off the NETWORK
+            # (serving/server.py) and `python -O` strips asserts — an
+            # empty prompt must never reach the pump
+            raise ValueError(f"request {req_id!r}: empty prompt")
         if self.temperature <= 0.0 and (self.top_k > 0 or
                                         0.0 < self.top_p < 1.0):
             raise ValueError(
@@ -115,8 +130,25 @@ class ServingEngine:
         # finished-but-uncollected outputs: run() POPS what completed on
         # its watch, so a long-lived engine does not accumulate results
         self.results: dict = {}
+        # req_id -> why it finished: "stop" (eos) / "length" (max_new) /
+        # "cancelled" / "deadline" — popped alongside results in run()
+        self.finish_reasons: dict = {}
+        # request-lifecycle hooks for a front end driving step() directly
+        # (serving/server.py): on_token(req_id, token, index) fires for
+        # every emitted token (index 0 = the prefill-sampled token),
+        # on_finish(req_id, tokens, reason) once per request.  Both run on
+        # the thread calling step() — keep them cheap.  A preempted request
+        # REPLAYS its (identical) tokens from index 0 on re-admission:
+        # streaming consumers must dedup by index (server.py does).
+        self.on_token = None
+        self.on_finish = None
+        # the deadline clock — injectable so tests can expire requests
+        # deterministically (e.g. clock = lambda: engine.n_decode_steps)
+        self.clock = time.monotonic
         self.n_decode_steps = 0
         self.n_preemptions = 0
+        self.n_cancelled = 0
+        self.n_expired = 0
         self.tokens_generated = 0
         self.occupancy_sum = 0.0              # sum of live/S over steps
         self._admit_seq = 0
@@ -125,13 +157,15 @@ class ServingEngine:
         self._decode_step = jax.jit(self._decode_impl, donate_argnums=(1,))
 
     # -- public API -------------------------------------------------------
-    def add_request(self, req: Request) -> None:
-        """Enqueue; admission happens inside step()/run()."""
+    def validate(self, req: Request) -> None:
+        """Raise ValueError if `req` can never be served by this engine's
+        capacity — pure read of construction-time constants, so a front
+        end on another thread can reject before enqueueing."""
+        if req.max_new < 0:
+            # jax.random.split(rng, -1) inside _admit would kill the pump
+            raise ValueError(
+                f"request {req.req_id!r}: max_new {req.max_new} is negative")
         if req.max_new == 0:
-            # lm_generate(max_new=0) returns the prompt unchanged whatever
-            # its length — resolve before any capacity/page validation,
-            # since this request never touches a slot or a page
-            self.results[req.req_id] = req.prompt_ids.copy()
             return
         p = req.prompt_ids.size
         cap = self.kv.capacity_tokens
@@ -150,12 +184,89 @@ class ServingEngine:
                 f"request {req.req_id!r} needs up to {need} pages to "
                 f"complete but the pool holds {self.kv.num_pages - 1} — "
                 f"raise num_pages")
+
+    def add_request(self, req: Request) -> None:
+        """Enqueue; admission happens inside step()/run()."""
+        self.validate(req)
+        if req.max_new == 0:
+            # lm_generate(max_new=0) returns the prompt unchanged whatever
+            # its length — resolve before any capacity/page validation,
+            # since this request never touches a slot or a page
+            self._finish(req.req_id, req.prompt_ids.copy(), "length")
+            return
         self.queue.append(req)
 
+    def cancel(self, request_id, reason: str = "cancelled") -> bool:
+        """Abort a queued or in-flight request: its slot and pages return
+        to the pool THIS call (reusable by waiting requests on the very
+        next step), its tokens-so-far land in results with the given
+        finish reason.  False when the id is unknown or already finished.
+        Call from the step()-driving thread only (the scheduler state is
+        not locked)."""
+        for i, r in enumerate(self.queue):
+            if r.req_id == request_id:
+                del self.queue[i]
+                self._count_abort(reason)
+                stash = r._preempted_gen or []
+                if stash:
+                    # the preempt rollback un-banked these on the promise
+                    # the restart would re-emit them; an abort breaks that
+                    # promise, and they WERE genuinely emitted (and
+                    # possibly streamed) — restore the count
+                    self.tokens_generated += len(stash)
+                toks = np.concatenate(
+                    [r.prompt_ids,
+                     np.asarray(stash, np.int32)]).astype(np.int32)
+                self._finish(request_id, toks, reason)
+                return True
+        for s, sl in enumerate(self.slots):
+            if sl is not None and sl.req.req_id == request_id:
+                gen = sl.generated
+                stash = sl.req._preempted_gen or []
+                if len(stash) > len(gen):
+                    # cancelled MID-REPLAY after a preemption: the replay
+                    # has not yet caught up to what was already emitted
+                    # (and streamed) before the preempt.  Determinism
+                    # makes both identical prefixes of one stream — report
+                    # the longer one and restore the still-un-rebanked
+                    # remainder of the preempt rollback
+                    self.tokens_generated += len(stash) - len(gen)
+                    gen = stash
+                toks = np.concatenate(
+                    [sl.req.prompt_ids,
+                     np.asarray(gen, np.int32)]).astype(np.int32)
+                self.kv.release(s)
+                self.slots[s] = None
+                self._count_abort(reason)
+                self._finish(request_id, toks, reason)
+                return True
+        return False
+
+    def _count_abort(self, reason: str) -> None:
+        if reason == "deadline":
+            self.n_expired += 1
+        else:
+            self.n_cancelled += 1
+
+    def _sweep_deadlines(self) -> None:
+        """Expire every queued/in-flight request whose deadline passed on
+        the engine clock — runs at the top of step(), BEFORE admission, so
+        an expired queued request never takes a slot and an expired slot's
+        pages free up for this very step's admissions."""
+        now = self.clock()
+        expired = [r.req_id for r in self.queue
+                   if r.deadline is not None and r.deadline <= now]
+        expired += [sl.req.req_id for sl in self.slots
+                    if sl is not None and sl.req.deadline is not None
+                    and sl.req.deadline <= now]
+        for rid in expired:
+            self.cancel(rid, reason="deadline")
+
     def step(self) -> bool:
-        """One scheduler iteration: admit -> one compiled decode step over
-        all slots -> retire.  Returns False when idle (nothing in flight
-        and nothing admittable)."""
+        """One scheduler iteration: sweep deadlines -> admit -> one
+        compiled decode step over all slots -> retire.  Returns False when
+        idle (nothing in flight and nothing admittable)."""
+        self._sweep_deadlines()
         self._admit_from_queue()
         live = [s for s in range(len(self.slots)) if self.slots[s] is not None]
         if not live:
@@ -214,6 +325,8 @@ class ServingEngine:
             sl.gen += 1
             sl.last_tok = tok
             self.tokens_generated += 1
+            if self.on_token is not None:
+                self.on_token(sl.req.req_id, tok, sl.gen - 1)
             if tok == sl.req.eos_id or sl.gen >= sl.req.max_new:
                 self._retire(s)
         return True
@@ -229,8 +342,11 @@ class ServingEngine:
             self.add_request(r)
         while self.step():
             pass
-        return {k: self.results.pop(k) for k in list(self.results)
-                if k not in done_before}
+        out = {k: self.results.pop(k) for k in list(self.results)
+               if k not in done_before}
+        for k in out:
+            self.finish_reasons.pop(k, None)
+        return out
 
     def bucket_for(self, prompt_len: int) -> int:
         """Prefill length for a prompt: the feeder bucket, page-aligned,
@@ -260,7 +376,12 @@ class ServingEngine:
     def _admit(self, s: int, req: Request) -> None:
         """Prefill the prompt at its bucket length, pack its K/V into the
         slot's pages, sample token 0 from the prefill logits (keys[0] — the
-        same key schedule lm_generate consumes)."""
+        same key schedule lm_generate consumes).
+
+        A re-admission after preemption keeps req._preempted_gen: until the
+        deterministic replay catches up, an abort must still report those
+        already-delivered tokens (cancel's mid-replay branch).  A later
+        preemption simply overwrites it with the longer prefix."""
         p = req.prompt_ids.size
         ps = self.kv.page_size
         Lb = self.bucket_for(p)
@@ -283,12 +404,17 @@ class ServingEngine:
         self.slots[s] = _Slot(req, keys, pos=p, first_tok=tok0,
                               admit_seq=self._admit_seq)
         self.tokens_generated += 1
+        if self.on_token is not None:
+            self.on_token(req.req_id, tok0, 0)
         if tok0 == req.eos_id or req.max_new == 1:
             self._retire(s)
 
     def _preempt(self, s: int) -> None:
         sl = self.slots[s]
         self.queue.appendleft(sl.req)
+        old = sl.req._preempted_gen or []
+        if len(sl.generated) >= len(old):     # a re-preempt mid-replay
+            sl.req._preempted_gen = list(sl.generated)  # keeps the longer
         self.tokens_generated -= sl.gen       # the restart re-emits them
         self.n_preemptions += 1
         self.kv.release(s)
@@ -296,11 +422,19 @@ class ServingEngine:
 
     def _retire(self, s: int) -> None:
         sl = self.slots[s]
-        self.results[sl.req.req_id] = np.concatenate(
+        toks = np.concatenate(
             [sl.req.prompt_ids,
              np.asarray(sl.generated, np.int32)]).astype(np.int32)
+        reason = "stop" if sl.last_tok == sl.req.eos_id else "length"
         self.kv.release(s)
         self.slots[s] = None
+        self._finish(sl.req.req_id, toks, reason)
+
+    def _finish(self, req_id, toks: np.ndarray, reason: str) -> None:
+        self.results[req_id] = toks
+        self.finish_reasons[req_id] = reason
+        if self.on_finish is not None:
+            self.on_finish(req_id, toks, reason)
 
     # -- compiled pieces --------------------------------------------------
     def _decode_impl(self, params, pools, table, pos, toks, keys, temp,

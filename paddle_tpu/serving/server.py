@@ -1,0 +1,507 @@
+"""RPC serving front end: asyncio TCP server + continuous engine pump.
+
+The reference's layer 5 is a length-prefixed RPC socket server in front of
+the compute (ref: paddle/pserver/ProtoServer.h:37, LightNetwork.h:41);
+this is its TPU-native serving echo — a request-lifecycle front end
+(admission, deadlines, cancellation, streaming, drain — the architecture
+production TPU serving stacks put in front of a continuous-batching core,
+arXiv:2605.25645) over `serving/engine.py`:
+
+  * ONE background PUMP THREAD owns the ServingEngine and drives step()
+    continuously — requests arrive mid-flight, per-token completions
+    stream back as they decode.  All engine access goes through the pump:
+    the asyncio side never touches scheduler state, it posts commands
+    (add/cancel) to a thread-safe queue the pump drains between steps, and
+    the engine's on_token/on_finish hooks post frames back via
+    call_soon_threadsafe.  No locks around the scheduler, no torn state.
+  * BOUNDED ADMISSION: the server accepts at most
+    `num_slots + max_queue` unfinished requests; one more gets an explicit
+    `overload` response instead of unbounded queueing (the client backs
+    off; the queue never eats the host).
+  * DEADLINES and CANCELLATION free the request's slot and KV pages
+    mid-flight (engine.cancel / the per-step deadline sweep) — freed pages
+    are reusable by waiting requests on the very next step, and surviving
+    requests stay token-exact against the per-request lm_generate oracle
+    (tests/test_server.py).
+  * GRACEFUL DRAIN: stop admitting (new requests get
+    `overload/reason=draining`), finish everything in flight, stop the
+    pump, close the listener.  tools/serve.py wires SIGTERM to this and
+    exits 0.
+  * STATS RPC: queue depth, slot/page occupancy, preemptions, and
+    per-request / per-token latency percentiles from a utils/stat.py
+    StatSet (bounded sample windows — a week-old server reports recent
+    latency, not its lifetime average).
+
+Wire protocol: serving/wire.py (4-byte big-endian length + JSON body);
+message schemas in docs/serving.md.  The blocking-socket client is
+serving/client.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.serving import wire
+from paddle_tpu.serving.engine import Request, ServingEngine
+from paddle_tpu.utils.stat import StatSet
+
+
+class _ReqState:
+    """Server-side lifecycle of one accepted request."""
+
+    __slots__ = ("conn", "cid", "stream", "t_submit", "t_last", "next_idx")
+
+    def __init__(self, conn, cid, stream):
+        self.conn = conn
+        self.cid = cid                # the client's id (frame field)
+        self.stream = bool(stream)
+        self.t_submit = time.monotonic()
+        self.t_last = self.t_submit   # last token emission (TTFT base)
+        self.next_idx = 0             # next UNSEEN token index — a
+                                      # preempted request replays identical
+                                      # tokens from 0; indexes below this
+                                      # are dropped, not re-streamed
+
+
+class _Conn:
+    """One client connection (asyncio side)."""
+
+    _seq = 0
+    #: a client that stops READING while its streams keep producing would
+    #: grow the transport's send buffer without bound (token frames are
+    #: pushed from loop callbacks, never awaiting drain) — past this cap
+    #: the connection is declared dead and its requests get cancelled,
+    #: the same path as a disconnect
+    MAX_WRITE_BUFFER = 8 * 1024 * 1024
+
+    def __init__(self, writer):
+        _Conn._seq += 1
+        self.seq = _Conn._seq
+        self.writer = writer
+        self.dead = False
+        self.rids = {}                # client id -> engine req_id (active)
+
+    def send(self, msg: dict) -> None:
+        if self.dead or self.writer.is_closing():
+            return
+        try:
+            if self.writer.transport.get_write_buffer_size() > \
+                    self.MAX_WRITE_BUFFER:
+                self.dead = True      # slow reader: sever, don't buffer
+                self.writer.close()   # -> reader EOF -> handler cancels
+                return                #    its in-flight requests
+            self.writer.write(wire.encode(msg))
+        except (ConnectionError, RuntimeError):
+            self.dead = True
+
+
+class ServingServer:
+    """TCP front end over one ServingEngine.
+
+    >>> eng = ServingEngine(tr.executor, tr.params, num_slots=4)
+    >>> srv = ServingServer(eng, port=0)           # 0 = ephemeral
+    >>> host, port = srv.start_background()
+    >>> ...                                        # serving/client.py
+    >>> srv.stop_background(drain=True)
+
+    `max_queue` bounds requests accepted beyond the engine's slots:
+    admission cap = num_slots + max_queue unfinished requests.
+    """
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, max_queue: int = 32):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.max_inflight = len(engine.slots) + int(max_queue)
+        self.stats = StatSet("serving_server")
+        self._inflight = 0            # accepted, not finished (loop thread)
+        self._draining = False
+        self._conns: set = set()      # open connections (loop thread)
+        self._routes: dict[str, _ReqState] = {}
+        self._cmds: queue.Queue = queue.Queue()
+        self._wake = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+        self._pump_error: Optional[BaseException] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._idle: Optional[asyncio.Event] = None
+        self._closed: Optional[asyncio.Event] = None
+        self._bg_thread: Optional[threading.Thread] = None
+        engine.on_token = self._on_token
+        engine.on_finish = self._on_finish
+
+    # -- lifecycle (asyncio side) -----------------------------------------
+    async def start(self, start_pump: bool = True) -> tuple[str, int]:
+        """Bind the listener (port 0 = ephemeral; self.port is updated to
+        the bound port) and start the engine pump."""
+        self._loop = asyncio.get_running_loop()
+        self._idle = asyncio.Event()
+        self._closed = asyncio.Event()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        if start_pump:
+            self.start_pump()
+        return self.host, self.port
+
+    def start_pump(self) -> None:
+        """Start (or no-op if running) the engine pump thread.  Split from
+        start() so tests can stage deterministic admission states before
+        any scheduling happens."""
+        if self._pump_thread is not None and self._pump_thread.is_alive():
+            return
+        self._pump_thread = threading.Thread(
+            target=self._pump, name="serving-engine-pump", daemon=True)
+        self._pump_thread.start()
+
+    async def drain(self) -> None:
+        """Graceful shutdown: stop admitting (new generates get an
+        `overload/reason=draining` response), let every accepted request
+        finish (deadlines still fire on schedule), then stop the pump and
+        close the listener."""
+        self._draining = True
+        if self._inflight > 0:
+            self._ensure_pump_for_inflight()
+            self._idle.clear()
+            await self._idle.wait()
+        await self._shutdown()
+
+    async def stop(self) -> None:
+        """Hard shutdown: cancel everything in flight, then close."""
+        self._draining = True
+        for rid in list(self._routes):
+            self._cmds.put(("cancel", rid))
+        self._wake.set()
+        if self._inflight > 0:
+            self._ensure_pump_for_inflight()
+            self._idle.clear()
+            await self._idle.wait()
+        await self._shutdown()
+
+    def _ensure_pump_for_inflight(self) -> None:
+        """Waiting on in-flight work with no pump running would wedge the
+        drain forever (start_background(start_pump=False) is a public
+        path).  Accepted work is drain's to finish — start the pump; a
+        pump that DIED already failed every route via _pump_died_on_loop,
+        so don't resurrect it."""
+        if self._pump_error is None and (
+                self._pump_thread is None or not self._pump_thread.is_alive()):
+            self.start_pump()
+
+    async def _shutdown(self) -> None:
+        if self._pump_thread is not None and self._pump_thread.is_alive():
+            self._cmds.put(("stop",))
+            self._wake.set()
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._pump_thread.join)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        # close every live connection EXPLICITLY: a client blocked on a
+        # read must see EOF now, not hang until its socket timeout because
+        # the loop died with the transport still open
+        for conn in list(self._conns):
+            conn.dead = True
+            try:
+                conn.writer.close()
+            except (ConnectionError, RuntimeError):
+                pass
+        self._closed.set()
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()
+
+    # -- lifecycle (thread-facing wrappers) --------------------------------
+    def start_background(self, start_pump: bool = True) -> tuple[str, int]:
+        """Run the asyncio loop on a daemon thread; returns (host, port)
+        once bound.  For embedders and tests — tools/serve.py runs the
+        loop in the foreground instead."""
+        started = threading.Event()
+        addr: list = []
+
+        async def _amain():
+            addr.extend(await self.start(start_pump=start_pump))
+            started.set()
+            await self.wait_closed()
+
+        self._bg_thread = threading.Thread(
+            target=lambda: asyncio.run(_amain()),
+            name="serving-server-loop", daemon=True)
+        self._bg_thread.start()
+        if not started.wait(timeout=60):
+            raise RuntimeError("serving server failed to bind within 60s")
+        return addr[0], addr[1]
+
+    def stop_background(self, drain: bool = True, timeout: float = 120):
+        """Drain (or hard-stop) a start_background() server and join its
+        loop thread."""
+        if self._loop is None:
+            return
+        fut = asyncio.run_coroutine_threadsafe(
+            self.drain() if drain else self.stop(), self._loop)
+        fut.result(timeout=timeout)
+        if self._bg_thread is not None:
+            self._bg_thread.join(timeout=timeout)
+        if self._pump_error is not None:
+            raise RuntimeError("engine pump died") from self._pump_error
+
+    # -- the engine pump (its own thread; sole owner of the engine) --------
+    def _pump(self) -> None:
+        try:
+            while True:
+                try:
+                    while True:
+                        cmd = self._cmds.get_nowait()
+                        if cmd[0] == "stop":
+                            return
+                        if cmd[0] == "add":
+                            req = cmd[1]
+                            try:
+                                self.engine.add_request(req)
+                            except (ValueError, AssertionError) as e:
+                                # validate() ran at admission, so only a
+                                # race with a reconfigured engine lands
+                                # here — still must answer the client
+                                self._loop.call_soon_threadsafe(
+                                    self._fail_on_loop, req.req_id, str(e))
+                        elif cmd[0] == "cancel":
+                            self.engine.cancel(cmd[1])
+                except queue.Empty:
+                    pass
+                busy = self.engine.step()
+                if not busy:
+                    # idle: nothing queued or in flight — sleep until a
+                    # command arrives (bounded wait as a safety net)
+                    self._wake.wait(timeout=0.5)
+                    self._wake.clear()
+        except BaseException as e:                     # noqa: BLE001
+            self._pump_error = e
+            if self._loop is not None:
+                self._loop.call_soon_threadsafe(self._pump_died_on_loop)
+
+    def _pump_died_on_loop(self) -> None:
+        """A dead pump strands every accepted request — fail them all so
+        no client hangs on a stream that will never finish."""
+        for rid in list(self._routes):
+            self._fail_on_loop(rid, f"engine pump died: "
+                                    f"{type(self._pump_error).__name__}: "
+                                    f"{self._pump_error}")
+
+    # -- engine hooks (pump thread) ----------------------------------------
+    def _on_token(self, rid: str, tok: int, idx: int) -> None:
+        st = self._routes.get(rid)
+        if st is None:
+            return
+        now = time.monotonic()
+        if idx >= st.next_idx:                 # fresh, not a preempt replay
+            if idx == 0:
+                self.stats.get("first_token_latency").add(now - st.t_submit)
+            else:
+                self.stats.get("token_latency").add(now - st.t_last)
+            # t_last advances on FRESH tokens only: replayed (deduped)
+            # emissions reach no client, so the first post-replay fresh
+            # token must charge the whole preempt+re-prefill+replay stall
+            # to token_latency — that stall is exactly what the stats
+            # RPC's p99 exists to expose
+            st.t_last = now
+            st.next_idx = idx + 1
+            if st.stream:
+                self._loop.call_soon_threadsafe(
+                    st.conn.send, {"type": "token", "id": st.cid,
+                                   "token": int(tok), "index": int(idx)})
+
+    def _on_finish(self, rid: str, toks: np.ndarray, reason: str) -> None:
+        # the server owns delivery — keep the engine's archive empty so a
+        # long-lived process holds no unbounded result map
+        self.engine.results.pop(rid, None)
+        self.engine.finish_reasons.pop(rid, None)
+        st = self._routes.get(rid)
+        if st is None:
+            return
+        self.stats.get("request_latency").add(time.monotonic() - st.t_submit)
+        self._loop.call_soon_threadsafe(
+            self._finish_on_loop, rid,
+            np.asarray(toks).astype(int).tolist(), reason)
+
+    # -- loop-side completion/error delivery -------------------------------
+    def _finish_on_loop(self, rid: str, tokens: list, reason: str) -> None:
+        st = self._routes.pop(rid, None)
+        if st is None:
+            return
+        st.conn.rids.pop(st.cid, None)
+        st.conn.send({"type": "done", "id": st.cid, "tokens": tokens,
+                      "reason": reason})
+        self._dec_inflight()
+
+    def _fail_on_loop(self, rid: str, message: str) -> None:
+        st = self._routes.pop(rid, None)
+        if st is None:
+            return
+        st.conn.rids.pop(st.cid, None)
+        st.conn.send({"type": "error", "id": st.cid, "error": message})
+        self._dec_inflight()
+
+    def _dec_inflight(self) -> None:
+        self._inflight -= 1
+        if self._inflight == 0 and self._idle is not None:
+            self._idle.set()
+
+    # -- connection handling (asyncio side) --------------------------------
+    async def _handle(self, reader, writer) -> None:
+        conn = _Conn(writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                try:
+                    msg = await wire.read_frame(reader)
+                except wire.FrameError as e:
+                    conn.send({"type": "error", "error": str(e)})
+                    break
+                if msg is None:
+                    break
+                try:
+                    self._dispatch(conn, msg)
+                except Exception as e:         # noqa: BLE001 — protocol
+                    # garbage (e.g. an unhashable JSON id) must answer an
+                    # error frame, not tear down the connection and every
+                    # other request multiplexed on it
+                    bad_id = msg.get("id")
+                    conn.send({"type": "error",
+                               "id": bad_id if isinstance(bad_id, (str, int))
+                               else None,
+                               "error": f"bad {msg.get('type')!r} frame: "
+                                        f"{type(e).__name__}: {e}"})
+                try:
+                    await writer.drain()
+                except ConnectionError:
+                    break
+        finally:
+            conn.dead = True
+            self._conns.discard(conn)
+            # client went away: everything it still has in flight is a
+            # client-initiated cancel — slots and pages must not stay
+            # pinned to a dead socket
+            for rid in list(conn.rids.values()):
+                self._cmds.put(("cancel", rid))
+            self._wake.set()
+            try:
+                writer.close()
+            except ConnectionError:
+                pass
+
+    def _dispatch(self, conn: _Conn, msg: dict) -> None:
+        t = msg.get("type")
+        if t == "generate":
+            self._handle_generate(conn, msg)
+        elif t == "cancel":
+            cid = msg.get("id")
+            rid = conn.rids.get(cid) if isinstance(cid, (str, int)) else None
+            if rid is not None:
+                self._cmds.put(("cancel", rid))
+                self._wake.set()
+            # unknown/already-finished id: the done frame already answered
+        elif t == "stats":
+            conn.send(self._stats_msg())
+        elif t == "ping":
+            conn.send({"type": "pong"})
+        else:
+            conn.send({"type": "error", "id": msg.get("id"),
+                       "error": f"unknown message type {t!r}"})
+
+    def _handle_generate(self, conn: _Conn, msg: dict) -> None:
+        cid = msg.get("id")
+        if not isinstance(cid, (str, int)):
+            conn.send({"type": "error",
+                       "error": "generate needs a string or int 'id'"})
+            return
+        if cid in conn.rids:
+            conn.send({"type": "error", "id": cid,
+                       "error": f"id {cid!r} is already in flight on this "
+                                f"connection"})
+            return
+        if self._pump_error is not None:
+            # a dead pump can never serve this — fail fast instead of
+            # letting the client block on frames that will never come
+            conn.send({"type": "error", "id": cid,
+                       "error": f"engine pump died: "
+                                f"{type(self._pump_error).__name__}: "
+                                f"{self._pump_error}"})
+            return
+        if self._draining:
+            conn.send({"type": "overload", "id": cid, "reason": "draining"})
+            return
+        if self._inflight >= self.max_inflight:
+            # the explicit backpressure contract: never queue unboundedly
+            conn.send({"type": "overload", "id": cid, "reason": "queue_full",
+                       "inflight": self._inflight,
+                       "max_inflight": self.max_inflight})
+            return
+        try:
+            req = self._build_request(conn, cid, msg)
+            self.engine.validate(req)
+        except (ValueError, AssertionError, TypeError) as e:
+            conn.send({"type": "error", "id": cid, "error": str(e)})
+            return
+        self._routes[req.req_id] = _ReqState(conn, cid,
+                                             msg.get("stream", True))
+        conn.rids[cid] = req.req_id
+        self._inflight += 1
+        self._cmds.put(("add", req))
+        self._wake.set()
+
+    def _build_request(self, conn: _Conn, cid, msg: dict) -> Request:
+        prompt = np.asarray(msg.get("prompt", []), np.int32)
+        rng = None
+        if msg.get("seed") is not None:
+            import jax
+
+            rng = jax.random.PRNGKey(int(msg["seed"]))
+        deadline = None
+        if msg.get("timeout_s") is not None:
+            # absolute on the ENGINE clock — the deadline sweep in step()
+            # compares against engine.clock(), not the server's wall clock
+            deadline = self.engine.clock() + float(msg["timeout_s"])
+        # engine req_ids are namespaced per connection so two clients
+        # picking "0" can never collide inside the scheduler; the type tag
+        # keeps JSON id 1 and id "1" distinct too (conn.rids already does)
+        tag = "i" if isinstance(cid, int) else "s"
+        return Request(f"c{conn.seq}:{tag}:{cid}", prompt,
+                       max_new=int(msg.get("max_new", 32)),
+                       temperature=float(msg.get("temperature", 0.0)),
+                       top_k=int(msg.get("top_k", 0)),
+                       top_p=float(msg.get("top_p", 0.0)),
+                       eos_id=int(msg.get("eos_id", -1)),
+                       rng=rng, deadline=deadline)
+
+    def _stats_msg(self) -> dict:
+        eng = self.engine
+        ms = 1e3
+        lat = {name: {k: round(v * ms, 3) for k, v in
+                      self.stats.percentiles(name, (50.0, 90.0, 99.0)).items()}
+               for name in ("request_latency", "first_token_latency",
+                            "token_latency")}
+        return {
+            "type": "stats",
+            "queue_depth": len(eng.queue),
+            "inflight": self._inflight,
+            "max_inflight": self.max_inflight,
+            "slots_in_use": sum(1 for s in eng.slots if s is not None),
+            "num_slots": len(eng.slots),
+            "pages_in_use": int(eng.kv.pages_in_use),
+            "free_pages": int(eng.kv.free_page_count),
+            "num_pages": int(eng.kv.num_pages),
+            "decode_steps": eng.n_decode_steps,
+            "tokens_generated": eng.tokens_generated,
+            "preemptions": eng.n_preemptions,
+            "cancelled": eng.n_cancelled,
+            "expired": eng.n_expired,
+            "draining": self._draining,
+            "latency_ms": lat,
+        }

@@ -1,0 +1,105 @@
+"""Length-prefixed JSON frame protocol for the serving front end.
+
+The TPU-native echo of the reference's length-prefixed protobuf RPC (ref:
+paddle/pserver/ProtoServer.h:37 — "packet = uint32 length + body",
+LightNetwork.h:41): every message on the wire is
+
+    [4-byte big-endian unsigned length N][N bytes of UTF-8 JSON]
+
+JSON instead of protobuf because the payloads are tiny (token ids and
+knobs; the model weights never cross this wire) and the protocol must stay
+debuggable with `nc` + a human eye.  Message schemas live in
+docs/serving.md; the server (serving/server.py, asyncio) and the client
+(serving/client.py, blocking sockets) both speak through THIS module so
+the framing can never drift between them.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional
+
+_LEN = struct.Struct(">I")
+
+#: refuse frames above this — a corrupt/hostile length prefix must not make
+#: the receiver allocate gigabytes (64 MiB >> any real request/response)
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class FrameError(ValueError):
+    """Malformed frame: oversized length prefix or non-JSON body."""
+
+
+def encode(msg: dict) -> bytes:
+    """One message -> length-prefixed wire bytes."""
+    body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME:
+        raise FrameError(f"frame of {len(body)} bytes exceeds the "
+                         f"{MAX_FRAME}-byte cap")
+    return _LEN.pack(len(body)) + body
+
+
+def _decode_body(body: bytes) -> dict:
+    try:
+        msg = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"frame body is not JSON: {e}") from e
+    if not isinstance(msg, dict):
+        raise FrameError(f"frame body must be a JSON object, "
+                         f"got {type(msg).__name__}")
+    return msg
+
+
+def check_length(raw: bytes) -> int:
+    """Validate a length prefix; returns the body length."""
+    (n,) = _LEN.unpack(raw)
+    if n > MAX_FRAME:
+        raise FrameError(f"frame length {n} exceeds the {MAX_FRAME}-byte "
+                         f"cap — corrupt stream?")
+    return n
+
+
+async def read_frame(reader) -> Optional[dict]:
+    """One frame from an asyncio StreamReader; None on clean EOF."""
+    import asyncio
+
+    try:
+        raw = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    n = check_length(raw)
+    try:
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError) as e:
+        raise FrameError(f"stream ended mid-frame ({e})") from e
+    return _decode_body(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None if not buf else buf  # caller distinguishes
+        buf += chunk
+    return buf
+
+
+def read_frame_sync(sock: socket.socket) -> Optional[dict]:
+    """One frame from a blocking socket; None on clean EOF."""
+    raw = _recv_exact(sock, _LEN.size)
+    if raw is None:
+        return None
+    if len(raw) < _LEN.size:
+        raise FrameError("stream ended inside a length prefix")
+    n = check_length(raw)
+    body = _recv_exact(sock, n)
+    if body is None or len(body) < n:
+        raise FrameError(f"stream ended mid-frame (wanted {n} bytes)")
+    return _decode_body(body)
+
+
+def write_frame_sync(sock: socket.socket, msg: dict) -> None:
+    sock.sendall(encode(msg))

@@ -16,6 +16,7 @@ import os
 import re
 import shutil
 import struct
+import zipfile
 from typing import Any, Optional
 
 import jax
@@ -116,6 +117,22 @@ def _unflatten_dicts(flat: dict[str, np.ndarray]) -> dict:
     return root
 
 
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync (makes renames/creates durable on
+    filesystems with delayed allocation); a filesystem that cannot fsync
+    a directory fd is not a reason to fail the save."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def pass_dir(save_dir: str, pass_id: int) -> str:
     """pass_id < 0 = a snapshot taken BEFORE the first pass completed: it
     gets its own label so it can never collide with (or shadow) the real
@@ -139,9 +156,25 @@ def save_checkpoint(
     """Write pass-%05d/{model.npz, trainer_config.json}
     (ref: ParamUtil::saveParametersOnePass).  `rng` is the trainer's
     PRNG key: persisting it makes resume EXACT for stochastic models
-    too (dropout streams continue where the uninterrupted run would)."""
+    too (dropout streams continue where the uninterrupted run would).
+
+    ATOMIC: the whole pass dir is staged under `<dir>.tmp` and renamed
+    into place as the last step, and model.npz itself is os.replace'd
+    from a temp name inside the staging dir — a crash at ANY point leaves
+    either a committed checkpoint or `.tmp` stragglers that every reader
+    (load_checkpoint, latest_pass, latest_checkpoint, keep_last pruning)
+    ignores; never a loadable-looking truncated npz.  Re-saving an
+    EXISTING pass moves the committed dir aside (`.old.tmp`) rather than
+    deleting it pre-commit, so even that path never destroys data it has
+    not yet replaced (worst case after a crash between the two renames:
+    the pass is absent but both its old and new contents sit complete
+    under `.tmp` names).  keep_last pruning runs only after the rename
+    commits."""
     d = pass_dir(save_dir, pass_id)
-    os.makedirs(d, exist_ok=True)
+    tmp_d = d + ".tmp"
+    if os.path.isdir(tmp_d):
+        shutil.rmtree(tmp_d)                 # stale straggler from a crash
+    os.makedirs(tmp_d)
     flat = _flatten(params, "params")
     if opt_state is not None:
         flat.update(_flatten(opt_state, "opt"))
@@ -149,10 +182,33 @@ def save_checkpoint(
         flat.update(_flatten(net_state, "net"))
     if rng is not None:
         flat["rng"] = np.asarray(rng)
-    np.savez(os.path.join(d, "model.npz"), **flat)
+    tmp_npz = os.path.join(tmp_d, "model.npz.part")
+    with open(tmp_npz, "wb") as f:           # file handle: np.savez would
+        np.savez(f, **flat)                  # append .npz to a str path
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_npz, os.path.join(tmp_d, "model.npz"))
     if config_json is not None:
-        with open(os.path.join(d, "trainer_config.json"), "w") as f:
+        with open(os.path.join(tmp_d, "trainer_config.json"), "w") as f:
             f.write(config_json)
+            f.flush()
+            os.fsync(f.fileno())             # same durability as model.npz:
+            # the commit rename below must never reach disk ahead of this
+            # file's data (delayed allocation would leave a COMMITTED dir
+            # with a torn config)
+    old_d = d + ".old.tmp"
+    if os.path.isdir(old_d):
+        shutil.rmtree(old_d)                 # straggler from an old crash
+    if os.path.isdir(d):
+        # re-saving the same pass: POSIX cannot atomically swap two dirs,
+        # so move the committed one ASIDE (not rmtree — deleting it before
+        # the commit rename would open a crash window where the pass is
+        # simply gone) and drop it only after the new dir is in place
+        os.replace(d, old_d)
+    _fsync_dir(tmp_d)                        # staged entries durable first
+    os.replace(tmp_d, d)                     # THE commit point
+    _fsync_dir(save_dir)                     # ...then the rename itself
+    shutil.rmtree(old_d, ignore_errors=True)
     if keep_last > 0:
         _delete_old(save_dir, keep_last)
     return d
@@ -161,6 +217,14 @@ def save_checkpoint(
 def _delete_old(save_dir: str, keep_last: int) -> None:
     """(ref: ParamUtil::deleteParameters keeps save_only_one / latest).
     The pre-training pass-init snapshot counts as the oldest."""
+    for x in os.listdir(save_dir):
+        # crashed-save stragglers from OTHER passes (a pass that is never
+        # re-saved never triggers the same-pass cleanup) would otherwise
+        # hold a full checkpoint's disk forever while committed ones are
+        # being pruned to save space.  Runs post-commit: the current
+        # save's staging dirs are already renamed/removed.
+        if re.match(r"pass-(\d{5}|init)(\.old)?\.tmp$", x):
+            shutil.rmtree(os.path.join(save_dir, x), ignore_errors=True)
     dirs = sorted(
         (m.group(0) for m in (re.match(r"pass-\d{5}$", x) for x in os.listdir(save_dir)) if m))
     if os.path.isdir(os.path.join(save_dir, "pass-init")):
@@ -201,8 +265,18 @@ def load_checkpoint(path: str) -> dict[str, Any]:
                 if m:
                     out["pass_id"] = int(m.group(1))
                 return out
-    data = np.load(npz, allow_pickle=False)
-    flat = {k: data[k] for k in data.files}
+    try:
+        data = np.load(npz, allow_pickle=False)
+        flat = {k: data[k] for k in data.files}
+    except (zipfile.BadZipFile, EOFError, ValueError) as e:
+        # a truncated npz (crash mid-save before this module staged writes
+        # atomically, or a torn copy) surfaces as a raw BadZipFile with no
+        # hint WHICH file — name the path and the way out
+        raise ValueError(
+            f"checkpoint {npz} is corrupt or truncated ({e}); it cannot "
+            f"be loaded — delete its pass directory and resume from the "
+            f"newest committed one (trainer.checkpoint.latest_checkpoint)"
+        ) from e
     trees: dict[str, dict] = {"params": {}, "opt": {}, "net": {}}
     for prefix in trees:
         sub = {k[len(prefix) + 1:]: v for k, v in flat.items()
@@ -236,3 +310,18 @@ def latest_pass(save_dir: str) -> int:
         if m:
             best = max(best, int(m.group(1)))
     return best
+
+
+def latest_checkpoint(save_dir: str) -> Optional[str]:
+    """Path of the newest COMMITTED pass dir under `save_dir` (falling
+    back to `pass-init`), or None.  `.tmp` stragglers from a crashed
+    save_checkpoint never match — only dirs whose final rename committed
+    are candidates, so this is the safe resume/serve entry point
+    (tools/serve.py --checkpoint uses it)."""
+    lp = latest_pass(save_dir)
+    if lp >= 0:
+        return pass_dir(save_dir, lp)
+    init = os.path.join(save_dir, "pass-init")
+    if os.path.isdir(init):
+        return init
+    return None

@@ -14,14 +14,36 @@ import time
 from dataclasses import dataclass, field
 
 
+#: bounded per-stat sample window for percentile queries — old samples are
+#: overwritten ring-buffer style, so a long-lived server's stats RPC reports
+#: RECENT latency percentiles at O(1) memory per stat
+SAMPLE_WINDOW = 4096
+
+
+def _quantile(snap: list, q: float) -> float:
+    """Linear-interpolated quantile of an already-SORTED list (numpy
+    percentile semantics); 0.0 when empty."""
+    if not snap:
+        return 0.0
+    pos = (len(snap) - 1) * min(max(q, 0.0), 100.0) / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(snap) - 1)
+    return snap[lo] + (snap[hi] - snap[lo]) * (pos - lo)
+
+
 @dataclass
 class Stat:
     name: str
     total_s: float = 0.0
     count: int = 0
     max_s: float = 0.0
+    samples: list = field(default_factory=list)   # last SAMPLE_WINDOW dts
 
     def add(self, dt: float) -> None:
+        if len(self.samples) < SAMPLE_WINDOW:
+            self.samples.append(dt)
+        else:
+            self.samples[self.count % SAMPLE_WINDOW] = dt
         self.total_s += dt
         self.count += 1
         if dt > self.max_s:
@@ -31,6 +53,7 @@ class Stat:
         self.total_s = 0.0
         self.count = 0
         self.max_s = 0.0
+        self.samples = []
 
     def __str__(self) -> str:
         avg = self.total_s / max(self.count, 1)
@@ -57,6 +80,16 @@ class StatSet:
             yield
         finally:
             self.get(name).add(time.perf_counter() - t0)
+
+    def percentiles(self, name: str, qs=(50.0, 99.0)) -> dict[str, float]:
+        """{"p50": ..., "p99": ...} in SECONDS for stat `name` (0.0s when
+        the stat never recorded) — the serving stats RPC's building block.
+        Sorts the sample window ONCE for all requested quantiles (the
+        sort's iteration snapshots under the GIL: add() may run on another
+        thread — the serving pump — while the stats RPC reads)."""
+        s = self.stats.get(name)
+        snap = sorted(s.samples) if s else []
+        return {f"p{q:g}": _quantile(snap, q) for q in qs}
 
     def print_all(self, log=None) -> str:
         lines = ["======= StatSet: [%s] =======" % self.name]

@@ -101,6 +101,47 @@ def test_assemble_lkg_stitches_serving_record(tmp_path):
     assert out["serving"]["occupancy"] == 0.9
 
 
+def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
+    """PR 4 wiring: the serving record's p99 per-token latency companion
+    (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the
+    tpu_measure queue's freshness gate must treat a record WITHOUT the
+    field as stale (pre-latency-era records force one re-measure)."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    log = tmp_path / "PERF_LOG.jsonl"
+    old = {"ts": "2026-08-01T10:00:00+00:00",
+           "record": {"metric": M["serving"], "value": 1500.0,
+                      "measured_at": "2026-08-01T10:00:00+00:00"}}
+    new = {"ts": "2026-08-02T10:00:00+00:00",
+           "record": {"metric": M["serving"], "value": 2100.0,
+                      "tok_latency_ms_p50": 4.2,
+                      "lm_serving_p99_tok_latency_ms": 9.7,
+                      "measured_at": "2026-08-02T10:00:00+00:00"}}
+    log.write_text(json.dumps(old) + "\n" + json.dumps(new) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving"]["lm_serving_p99_tok_latency_ms"] == 9.7
+
+    # freshness: need_field distinguishes the eras (tools/tpu_measure.py
+    # passes it for the bench_serving_record step)
+    sys.path.insert(0, os.path.join(REPO, ""))
+    os.environ["BENCH_PERF_LOG"] = str(log)
+    try:
+        import importlib
+
+        import tools.tpu_measure as tm
+        importlib.reload(tm)
+        assert tm._metric_fresh(M["serving"], 1e6,
+                                need_field="lm_serving_p99_tok_latency_ms")
+        # only the latency-era record satisfies it: rewrite with old alone
+        log.write_text(json.dumps(old) + "\n")
+        assert not tm._metric_fresh(
+            M["serving"], 1e6, need_field="lm_serving_p99_tok_latency_ms")
+        assert tm._metric_fresh(M["serving"], 1e6)
+    finally:
+        del os.environ["BENCH_PERF_LOG"]
+
+
 def test_assemble_lkg_decode_only_survives_missing_train(tmp_path):
     """s2s_decode can bank while s2s_train wedges — the measured decode
     number must still surface in the assembled fallback."""

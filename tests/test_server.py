@@ -1,0 +1,389 @@
+"""Serving front-end loopback tests (serving/server.py + client.py).
+
+The acceptance contract: mixed-length STREAMING requests over real TCP —
+with one client-initiated cancellation and one deadline expiry mid-flight
+— produce per-request token streams exactly matching
+`lm_generate(use_cache=True)` run per surviving request, while the engine
+pump keeps ONE compiled decode signature; overload yields an explicit
+backpressure response instead of unbounded queueing; drain finishes
+in-flight work and refuses new; SIGTERM on tools/serve.py drains and
+exits 0 (slow)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.graph.lm_decode import lm_generate
+from paddle_tpu.serving import Request, ServingEngine
+from paddle_tpu.serving.client import OverloadError, ServingClient
+from paddle_tpu.serving.server import ServingServer
+from paddle_tpu.trainer.trainer import Trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_tr():
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    return Trainer(cfg, seed=7)
+
+
+def _engine(tr, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_context", 64)
+    eng = ServingEngine(tr.executor, tr.params, **kw)
+    # deterministic deadline clock: "seconds" = decode steps taken
+    eng.clock = lambda: float(eng.n_decode_steps)
+    return eng
+
+
+def _oracle(tr, prompt, max_new, **kw):
+    import jax
+
+    rng = jax.random.PRNGKey(kw.pop("seed")) if "seed" in kw else None
+    toks, lens = lm_generate(tr.executor, tr.params,
+                             np.asarray(prompt, np.int32)[None, :],
+                             max_new=max_new, use_cache=True, rng=rng, **kw)
+    return np.asarray(toks)[0, :int(np.asarray(lens)[0])].tolist()
+
+
+def test_streaming_cancel_deadline_oracle_exact_over_tcp(tiny_tr):
+    """The end-to-end acceptance test (ISSUE 4)."""
+    rng = np.random.default_rng(0)
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=32)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            assert c.ping()
+            # mixed lengths spanning prefill buckets; r3 sampled (seeded)
+            p0 = rng.integers(2, 31, 3).tolist()
+            p1 = rng.integers(2, 31, 9).tolist()
+            p2 = rng.integers(2, 31, 5).tolist()
+            p3 = rng.integers(2, 31, 12).tolist()
+            p_dead = rng.integers(2, 31, 4).tolist()
+            p_cancel = rng.integers(2, 31, 6).tolist()
+            # the deadline request goes FIRST: the idle pump admits it to a
+            # slot at step ~0, and a 3-step budget (engine.clock counts
+            # decode steps) against 30 tokens guarantees an IN-SLOT expiry
+            r_dead = c.submit(p_dead, max_new=30, timeout_s=3.0)
+            r0 = c.submit(p0, max_new=6)
+            r1 = c.submit(p1, max_new=8)
+            r2 = c.submit(p2, max_new=4)
+            r3 = c.submit(p3, max_new=5, temperature=0.8, top_k=5, seed=11)
+            r_cancel = c.submit(p_cancel, max_new=30)
+
+            cancelled = []
+
+            def on_token(rid, tok, idx):
+                # cancel mid-flight: after its first streamed token the
+                # request provably occupies a slot
+                if rid == r_cancel and idx >= 1 and not cancelled:
+                    cancelled.append(True)
+                    c.cancel(r_cancel)
+
+            out = c.collect([r0, r1, r2, r3, r_dead, r_cancel],
+                            on_token=on_token)
+        # surviving requests: token-for-token against the per-request oracle
+        assert out[r0]["tokens"] == _oracle(tiny_tr, p0, 6)
+        assert out[r1]["tokens"] == _oracle(tiny_tr, p1, 8)
+        assert out[r2]["tokens"] == _oracle(tiny_tr, p2, 4)
+        assert out[r3]["tokens"] == _oracle(tiny_tr, p3, 5, temperature=0.8,
+                                            top_k=5, seed=11)
+        # every stream (survivors AND aborted) is exactly its final result:
+        # token frames arrive in order and the done frame agrees
+        for rid, prompt in ((r0, p0), (r1, p1), (r2, p2), (r3, p3),
+                            (r_dead, p_dead), (r_cancel, p_cancel)):
+            assert out[rid]["tokens"][:len(prompt)] == prompt
+            assert out[rid]["stream"] == out[rid]["tokens"][len(prompt):]
+        for rid in (r0, r1, r2, r3):
+            assert out[rid]["reason"] == "length"
+        # the aborted pair: right reasons, genuinely stopped mid-flight
+        assert out[r_dead]["reason"] == "deadline"
+        assert len(p_dead) < len(out[r_dead]["tokens"]) < len(p_dead) + 30, \
+            "deadline request should die in a slot with partial output"
+        assert out[r_cancel]["reason"] == "cancelled"
+        assert cancelled, "cancel hook never fired"
+        assert len(out[r_cancel]["tokens"]) < len(p_cancel) + 30
+        assert eng.n_expired == 1 and eng.n_cancelled >= 1
+        # ONE compiled decode signature for the whole mixed workload
+        assert eng._decode_step._cache_size() == 1
+        # every page back in the pool once all requests resolved
+        assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_stats_rpc_reports_occupancy_and_latency(tiny_tr):
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            c.generate([3, 4, 5], max_new=4)
+            s = c.stats()
+        assert s["num_slots"] == 2
+        assert s["max_inflight"] == 6
+        assert s["queue_depth"] == 0 and s["inflight"] == 0
+        assert s["tokens_generated"] >= 4
+        assert s["free_pages"] == s["num_pages"] - 1
+        assert s["draining"] is False
+        lat = s["latency_ms"]
+        assert lat["request_latency"]["p50"] > 0.0
+        assert lat["first_token_latency"]["p99"] >= \
+            lat["first_token_latency"]["p50"]
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_overload_returns_backpressure_not_unbounded_queue(tiny_tr):
+    """Admission cap = num_slots + max_queue accepted-but-unfinished
+    requests; one more gets an explicit overload frame.  The pump is held
+    off so the staging is deterministic."""
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=1)          # cap = 2 slots + 1 = 3
+    host, port = srv.start_background(start_pump=False)
+    try:
+        with ServingClient(host, port) as c:
+            prompt = [3, 4, 5]
+            ids = [c.submit(prompt, max_new=3) for _ in range(3)]
+            over = c.submit(prompt, max_new=3)
+            with pytest.raises(OverloadError) as ei:
+                c.collect([over])
+            assert ei.value.info["reason"] == "queue_full"
+            assert ei.value.info["max_inflight"] == 3
+            # the three accepted ones complete once the pump starts —
+            # backpressure never cost admitted work
+            srv.start_pump()
+            out = c.collect(ids)
+            want = _oracle(tiny_tr, prompt, 3)
+            for rid in ids:
+                assert out[rid]["tokens"] == want
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_drain_finishes_inflight_and_refuses_new(tiny_tr):
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=8)
+    host, port = srv.start_background(start_pump=False)
+    stopper = threading.Thread(target=lambda: srv.stop_background(drain=True))
+    try:
+        with ServingClient(host, port) as c:
+            prompt = [4, 5, 6, 7]
+            rid = c.submit(prompt, max_new=5)      # accepted, pump off
+            # same-connection barrier: the stats reply proves the generate
+            # frame was ADMITTED before drain flips the refusal flag —
+            # otherwise drain could see inflight=0 and shut down first
+            assert c.stats()["inflight"] == 1
+            stopper.start()
+            for _ in range(200):                   # wait for draining state
+                if srv._draining:
+                    break
+                time.sleep(0.01)
+            assert srv._draining
+            late = c.submit(prompt, max_new=5)
+            with pytest.raises(OverloadError) as ei:
+                c.collect([late])
+            assert ei.value.info["reason"] == "draining"
+            # draining still FINISHES accepted work — drain itself starts
+            # the pump that was never running (no explicit start_pump)
+            out = c.collect([rid])
+            assert out[rid]["tokens"] == _oracle(tiny_tr, prompt, 5)
+            assert out[rid]["reason"] == "length"
+    finally:
+        stopper.join(timeout=120)
+    assert not stopper.is_alive(), "drain never completed"
+    # listener is down: fresh connections are refused
+    with pytest.raises(OSError):
+        ServingClient(host, port, timeout=5)
+
+
+def test_disconnect_cancels_inflight_requests(tiny_tr):
+    """A client that vanishes mid-stream must not pin its slot and pages
+    forever — the server cancels its requests on connection loss."""
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=8)
+    host, port = srv.start_background()
+    try:
+        c = ServingClient(host, port)
+        rid = c.submit([3, 4, 5, 6], max_new=50)
+        # wait for the first token frame, then vanish
+        msg = c.recv()
+        while msg.get("type") != "token":
+            msg = c.recv()
+        c.close()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (eng.kv.free_page_count == eng.kv.num_pages - 1
+                    and srv._inflight == 0):
+                break
+            time.sleep(0.02)
+        assert srv._inflight == 0, "dead client's request never cancelled"
+        assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_malformed_frames_get_error_frames_not_disconnect(tiny_tr):
+    """Protocol garbage — unhashable ids, negative max_new, empty prompts,
+    unknown types — must each answer an `error` frame and leave the
+    connection (and every other request multiplexed on it) alive."""
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            c.send({"type": "generate", "id": [1], "prompt": [3, 4]})
+            assert c.recv()["type"] == "error"          # unhashable id
+            c.send({"type": "generate", "id": "neg", "prompt": [3, 4],
+                    "max_new": -1})
+            msg = c.recv()
+            assert msg["type"] == "error" and msg["id"] == "neg"
+            assert "negative" in msg["error"]
+            c.send({"type": "generate", "id": "empty", "prompt": []})
+            msg = c.recv()
+            assert msg["type"] == "error" and "prompt" in msg["error"]
+            c.send({"type": "generate", "id": "bad", "prompt": "zzz"})
+            assert c.recv()["type"] == "error"          # non-id prompt
+            c.send({"type": "cancel", "id": {}})        # silently ignored
+            c.send({"type": "wat"})
+            assert "unknown" in c.recv()["error"]
+            # the connection survived all of it — real work still flows
+            toks, reason = c.generate([3, 4, 5], max_new=3)
+            assert reason == "length" and len(toks) == 6
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_int_and_str_client_ids_do_not_collide(tiny_tr):
+    """JSON id 1 and id \"1\" are distinct requests: the engine req_id
+    namespace must keep them apart or one route is overwritten and
+    _inflight leaks (wedging drain forever)."""
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            c.send({"type": "generate", "id": 1, "prompt": [3, 4],
+                    "max_new": 2})
+            c.send({"type": "generate", "id": "1", "prompt": [3, 4, 5],
+                    "max_new": 2})
+            out = c.collect([1, "1"])
+        assert len(out[1]["tokens"]) == 4
+        assert len(out["1"]["tokens"]) == 5
+        assert srv._inflight == 0, "a route was overwritten and leaked"
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_pump_death_fails_pending_and_refuses_new(tiny_tr):
+    """If the engine pump dies (device fault mid-step), every accepted
+    request gets an error frame and later generates are refused
+    immediately — no client may hang on frames that will never come."""
+    from paddle_tpu.serving.client import ServerError
+
+    eng = _engine(tiny_tr)
+    orig_step = eng.step
+
+    def bad_step():
+        if eng.queue or any(s is not None for s in eng.slots):
+            raise RuntimeError("boom")
+        return orig_step()
+
+    eng.step = bad_step
+    srv = ServingServer(eng, max_queue=8)
+    host, port = srv.start_background()
+    with ServingClient(host, port) as c:
+        rid = c.submit([3, 4, 5], max_new=4)
+        with pytest.raises(ServerError, match="pump died.*boom"):
+            c.collect([rid])           # pending work failed, not stranded
+        rid2 = c.submit([3, 4], max_new=4)
+        with pytest.raises(ServerError, match="pump died"):
+            c.collect([rid2])          # new work refused up front
+    with pytest.raises(RuntimeError, match="engine pump died"):
+        srv.stop_background(drain=True)
+
+
+@pytest.mark.slow
+def test_serve_cli_sigterm_drains_and_exits_zero():
+    """tools/serve.py end to end in a subprocess: bind ephemeral port,
+    stream one completion, SIGTERM mid-flight on a second, the drain
+    finishes it, process exits 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "--config-args", "vocab=31,dim=16,layers=1,heads=2,batch_size=2",
+         "--slots", "2", "--page-size", "8", "--max-context", "32",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env)
+    try:
+        line = ""
+        t0 = time.time()
+        while time.time() - t0 < 300:
+            line = proc.stdout.readline()
+            if line.startswith("SERVE_JSON:"):
+                break
+        assert line.startswith("SERVE_JSON:"), "server never bound"
+        import json as _json
+
+        addr = _json.loads(line[len("SERVE_JSON:"):])
+        with ServingClient(addr["host"], addr["port"]) as c:
+            toks, reason = c.generate([3, 4, 5], max_new=4)
+            assert reason == "length" and len(toks) == 7
+            rid = c.submit([4, 5, 6], max_new=12)
+            # first token seen -> mid-flight; now ask for shutdown
+            msg = c.recv()
+            while msg.get("type") != "token":
+                msg = c.recv()
+            proc.send_signal(15)                   # SIGTERM
+            c._pending.append(msg)
+            out = c.collect([rid])
+            assert out[rid]["reason"] == "length"
+            assert len(out[rid]["tokens"]) == 3 + 12, \
+                "drain did not finish the in-flight request"
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+@pytest.mark.slow
+def test_soak_overcommitted_pool_over_tcp_stays_exact(tiny_tr):
+    """Longer mixed workload through TCP against an OVERCOMMITTED pool:
+    preemptions fire under the server pump and every completed request
+    still matches its oracle exactly."""
+    rng = np.random.default_rng(3)
+    eng = _engine(tiny_tr, num_slots=2, page_size=4, max_context=16,
+                  num_pages=6)
+    srv = ServingServer(eng, max_queue=64)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            jobs = []
+            for i in range(10):
+                # every request's full footprint is 16 tokens = 4 pages,
+                # so any two concurrently-decoding slots want 8 of the 5
+                # real pages — the pool MUST wedge and preempt
+                plen = int(rng.integers(7, 11))
+                p = rng.integers(2, 31, plen).tolist()
+                mn = 16 - plen
+                jobs.append((c.submit(p, max_new=mn), p, mn))
+            out = c.collect([rid for rid, _, _ in jobs])
+        for rid, p, mn in jobs:
+            assert out[rid]["tokens"] == _oracle(tiny_tr, p, mn), \
+                f"request {rid} diverged (preemption changed its tokens?)"
+        assert eng.n_preemptions > 0, "pool was never overcommitted"
+        assert eng._decode_step._cache_size() == 1
+    finally:
+        srv.stop_background(drain=True)

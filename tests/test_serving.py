@@ -157,6 +157,11 @@ def test_request_validation():
         Request(0, [3, 4], max_new=4, top_k=5)
     with pytest.raises(ValueError, match="slot capacity"):
         eng.add_request(Request(0, list(range(2, 10)), max_new=12))
+    # network-reachable garbage must raise, not crash the pump later
+    with pytest.raises(ValueError, match="negative"):
+        eng.add_request(Request(0, [3, 4], max_new=-1))
+    with pytest.raises(ValueError, match="empty prompt"):
+        Request(0, [], max_new=4)
     # max_new=0 resolves immediately to the prompt (lm_generate semantics)
     # — even when the prompt alone would flunk capacity/page validation,
     # since it never touches a slot or a page
@@ -220,6 +225,196 @@ def test_run_returns_only_its_own_completions_and_pools_stay_live():
     second = eng.run([Request("b", prompts[1], max_new=3)])
     assert set(second) == {"b"}
     assert not eng.results, "completed results were retained after run()"
+
+
+def test_cancel_inflight_frees_slot_and_pages_and_survivors_stay_exact():
+    """Client-initiated cancellation mid-flight: the victim's slot and
+    pages return to the pool immediately (accounting back to baseline at
+    the end), its partial tokens are an exact PREFIX of its oracle run,
+    and every surviving request still matches the oracle token-for-token
+    through ONE compiled decode signature."""
+    tr = _make("vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    prompts = _prompts((5, 9, 4, 7), 31, seed=4)
+    reqs = [Request(i, p, max_new=8) for i, p in enumerate(prompts)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=32)
+    for r in reqs:
+        eng.add_request(r)
+    for _ in range(3):                     # get the first wave mid-flight
+        eng.step()
+    victim = next(sl.req.req_id for sl in eng.slots if sl is not None)
+    pages_before = eng.kv.pages_in_use
+    assert eng.cancel(victim)
+    assert eng.kv.pages_in_use < pages_before, "cancel freed no pages"
+    assert not eng.cancel(victim), "double-cancel must report unknown"
+    assert eng.finish_reasons[victim] == "cancelled"
+    partial = eng.results[victim]
+    full = _oracle(tr, reqs[victim])
+    np.testing.assert_array_equal(partial, full[:partial.size],
+                                  err_msg="cancelled tokens are not a "
+                                          "prefix of the oracle run")
+    assert partial.size > reqs[victim].prompt_ids.size, \
+        "victim was not actually mid-flight"
+    results = eng.run()
+    survivors = [r for r in reqs if r.req_id != victim]
+    _assert_all_match(tr, survivors, results)
+    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    assert eng._decode_step._cache_size() == 1
+    assert eng.n_cancelled == 1
+
+
+def test_deadline_expiry_frees_pages_for_waiting_requests():
+    """Deadline sweep on a deterministic step-count clock over an
+    overcommitted pool: the expired request's pages are what let the
+    WAITING request admit at all — after expiry it runs to completion
+    oracle-exact, and the sweep reports reason 'deadline'."""
+    tr = _make("vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    rng = np.random.default_rng(5)
+    # ps=4, 4 pages/slot, pool = 8 real pages: a and b (4 pages each once
+    # decoding) fill it; c can only ever admit from freed pages
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=16, num_pages=9)
+    eng.clock = lambda: float(eng.n_decode_steps)   # deterministic clock
+    a = Request("a", rng.integers(2, 31, 9).astype(np.int32), max_new=7,
+                deadline=3.0)                       # expires at step 3
+    b = Request("b", rng.integers(2, 31, 10).astype(np.int32), max_new=6)
+    c = Request("c", rng.integers(2, 31, 11).astype(np.int32), max_new=5)
+    results = eng.run([a, b, c])
+    assert eng.n_expired == 1
+    assert set(results) == {"a", "b", "c"}
+    partial = results["a"]
+    np.testing.assert_array_equal(partial, _oracle(tr, a)[:partial.size])
+    assert partial.size < _oracle(tr, a).size, \
+        "deadline request ran to completion — never actually expired"
+    _assert_all_match(tr, [b, c], results)
+    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    assert eng._decode_step._cache_size() == 1
+
+
+def test_cancel_and_deadline_on_queued_requests():
+    """A queued (never-admitted) request cancels/expires cleanly: result
+    is the bare prompt, no slot or page was ever touched."""
+    tr = _make("vocab=11,dim=16,layers=1,heads=2,batch_size=3")
+    eng = ServingEngine(tr.executor, tr.params, num_slots=1, page_size=4,
+                        max_context=16)
+    eng.clock = lambda: float(eng.n_decode_steps)
+    run = Request("run", [3, 4, 5], max_new=4)
+    q_cancel = Request("qc", [4, 5], max_new=4)
+    q_expire = Request("qe", [5, 6], max_new=4, deadline=0.0)  # born dead
+    eng.add_request(run)
+    eng.add_request(q_cancel)
+    eng.add_request(q_expire)
+    assert eng.cancel("qc")
+    np.testing.assert_array_equal(eng.results["qc"], [4, 5])
+    assert eng.finish_reasons["qc"] == "cancelled"
+    results = eng.run()
+    np.testing.assert_array_equal(results["qe"], [5, 6])
+    assert eng.n_expired == 1 and eng.n_cancelled == 1
+    np.testing.assert_array_equal(_oracle(tr, run), results["run"])
+    assert not eng.cancel("nonexistent")
+
+
+def test_cancel_of_preempted_queued_request_keeps_streamed_tokens():
+    """A preempted request waits in the queue with its generated-so-far
+    rolled back; cancelling it THERE must still report the tokens that
+    were already emitted (a front end streamed them to the client — the
+    done frame has to agree with the stream) and restore the
+    tokens_generated accounting the preempt rollback subtracted."""
+    tr = _make("vocab=11,dim=16,layers=1,heads=2,batch_size=3")
+    prompts = _prompts((6, 4, 5), 11, seed=3)
+    reqs = [Request(i, p, max_new=8) for i, p in enumerate(prompts)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=16, num_pages=6)
+    streamed: dict = {}
+    eng.on_token = lambda rid, tok, idx: streamed.setdefault(
+        rid, {}).update({idx: tok})
+    for r in reqs:
+        eng.add_request(r)
+    while eng.n_preemptions == 0 and eng.step():
+        pass
+    assert eng.n_preemptions > 0, "pool was never overcommitted"
+    victim = eng.queue[0]              # preemption requeues at the front
+    stash = list(victim._preempted_gen)
+    assert stash, "preempted request carried no generated-token stash"
+    tg_before = eng.tokens_generated
+    assert eng.cancel(victim.req_id)
+    toks = eng.results[victim.req_id]
+    # prompt + exactly what was emitted (== what a server streamed), and
+    # still a prefix of the uninterrupted oracle run
+    np.testing.assert_array_equal(toks[victim.prompt_ids.size:], stash)
+    seen = streamed[victim.req_id]
+    np.testing.assert_array_equal(
+        stash, [seen[i] for i in range(len(stash))])
+    np.testing.assert_array_equal(toks, _oracle(tr, victim)[:toks.size])
+    assert eng.tokens_generated == tg_before + len(stash)
+    # survivors finished either during the pressure loop (still sitting in
+    # eng.results) or under run() — merge both phases
+    results = dict(eng.results)
+    results.update(eng.run())
+    survivors = [r for r in reqs if r.req_id != victim.req_id]
+    _assert_all_match(tr, survivors, results)
+    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+
+
+def test_cancel_mid_replay_reports_all_previously_streamed_tokens():
+    """Preempt a request that already emitted k tokens, re-admit it, and
+    cancel while the deterministic replay is still short of k: the result
+    must carry all k originally-delivered tokens (replay and original are
+    identical prefixes of one stream) and re-bank the not-yet-replayed
+    remainder in tokens_generated."""
+    tr = _make("vocab=11,dim=16,layers=1,heads=2,batch_size=3")
+    eng = ServingEngine(tr.executor, tr.params, num_slots=1, page_size=4,
+                        max_context=16)
+    r = Request("r", [3, 4, 5], max_new=8)
+    eng.add_request(r)
+    for _ in range(3):                     # admit + decode: gen = 4
+        assert eng.step()
+    s = next(i for i, sl in enumerate(eng.slots) if sl is not None)
+    stash = list(eng.slots[s].generated)
+    assert len(stash) == 4
+    eng._preempt(s)
+    assert r._preempted_gen == stash
+    assert eng.step()                      # re-admit; replay at gen = 2
+    sl = next(sl for sl in eng.slots if sl is not None)
+    assert sl.req is r and sl.gen < len(stash), "replay already caught up"
+    tg = eng.tokens_generated
+    behind = len(stash) - sl.gen
+    assert eng.cancel("r")
+    toks = eng.results["r"]
+    np.testing.assert_array_equal(
+        toks, np.concatenate([r.prompt_ids, np.asarray(stash, np.int32)]),
+        err_msg="mid-replay cancel dropped already-delivered tokens")
+    np.testing.assert_array_equal(toks, _oracle(tr, r)[:toks.size])
+    assert eng.tokens_generated == tg + behind
+    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+
+
+def test_finish_hooks_fire_once_per_token_and_request():
+    """on_token sees every emitted token exactly once (index = position in
+    the generated stream), on_finish exactly once per request with the
+    final array — the contract serving/server.py streams through."""
+    tr = _make("vocab=11,dim=16,layers=1,heads=2,batch_size=3")
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=16)
+    seen_toks: dict = {}
+    finishes: dict = {}
+    eng.on_token = lambda rid, tok, idx: seen_toks.setdefault(
+        rid, []).append((idx, tok))
+    eng.on_finish = lambda rid, toks, reason: finishes.setdefault(
+        rid, (toks, reason))
+    reqs = [Request(i, p, max_new=m) for i, (p, m) in
+            enumerate(zip(_prompts((3, 5, 4), 11, seed=7), (4, 6, 1)))]
+    results = eng.run(reqs)
+    for r in reqs:
+        toks, reason = finishes[r.req_id]
+        np.testing.assert_array_equal(toks, results[r.req_id])
+        assert reason in ("stop", "length")
+        gen = [t for _, t in sorted(seen_toks[r.req_id])]
+        idxs = [i for i, _ in sorted(seen_toks[r.req_id])]
+        assert idxs == list(range(len(gen))), "token indices not dense"
+        np.testing.assert_array_equal(
+            gen, results[r.req_id][r.prompt_ids.size:],
+            err_msg="streamed tokens disagree with the final result")
 
 
 def test_paged_kv_allocator():

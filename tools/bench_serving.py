@@ -70,7 +70,11 @@ def poisson_arrivals(n: int, rate: float, seed: int = 0):
 def run_workload(engine, requests, arrivals=None) -> dict:
     """Drive one workload to completion; returns wall seconds, generated
     tokens, mean occupancy over the steps of THIS run, decode steps,
-    preemptions.  The per-step host token read is the sync barrier."""
+    preemptions — plus the raw latency samples: `step_seconds` (duration
+    of every busy decode step = the inter-token latency each live request
+    observed on it) and `req_seconds` (admission -> finish per request,
+    via the engine's on_finish hook).  The per-step host token read is the
+    sync barrier."""
     import numpy as np
 
     arrivals = np.zeros(len(requests)) if arrivals is None else arrivals
@@ -81,19 +85,38 @@ def run_workload(engine, requests, arrivals=None) -> dict:
     step0 = engine.n_decode_steps
     occ0 = engine.occupancy_sum
     pre0 = engine.n_preemptions
+    t_add: dict = {}
+    req_seconds: list = []
+    step_seconds: list = []
+    prev_finish = engine.on_finish
+
+    def _on_finish(rid, toks, reason):
+        if rid in t_add:
+            req_seconds.append(time.perf_counter() - t_add.pop(rid))
+        if prev_finish is not None:
+            prev_finish(rid, toks, reason)
+
+    engine.on_finish = _on_finish
     i, n = 0, len(requests)
     t0 = time.perf_counter()
-    while True:
-        now = time.perf_counter() - t0
-        while i < n and arrivals[i] <= now:
-            engine.add_request(requests[i])
-            i += 1
-        busy = engine.step()
-        if not busy:
-            if i >= n:
-                break
-            time.sleep(min(max(arrivals[i] - (time.perf_counter() - t0),
-                               0.0), 0.05))
+    try:
+        while True:
+            now = time.perf_counter() - t0
+            while i < n and arrivals[i] <= now:
+                t_add[requests[i].req_id] = time.perf_counter()
+                engine.add_request(requests[i])
+                i += 1
+            ts = time.perf_counter()
+            busy = engine.step()
+            if busy:
+                step_seconds.append(time.perf_counter() - ts)
+            else:
+                if i >= n:
+                    break
+                time.sleep(min(max(arrivals[i] - (time.perf_counter() - t0),
+                                   0.0), 0.05))
+    finally:
+        engine.on_finish = prev_finish
     dt = time.perf_counter() - t0
     steps = engine.n_decode_steps - step0
     return {
@@ -102,6 +125,8 @@ def run_workload(engine, requests, arrivals=None) -> dict:
         "decode_steps": steps,
         "occupancy": (engine.occupancy_sum - occ0) / steps if steps else 0.0,
         "preemptions": engine.n_preemptions - pre0,
+        "step_seconds": step_seconds,
+        "req_seconds": req_seconds,
     }
 
 
@@ -182,6 +207,7 @@ def main() -> int:
     ok = True
     for rate in [float(r) for r in str(args.rate).split(",") if r != ""]:
         vals, occs, pres = [], [], 0
+        step_s, req_s = [], []
         rec = {}
         for rep in range(args.reps):
             reqs = make_requests(seed=args.seed + 1 + rep, **base)
@@ -190,6 +216,8 @@ def main() -> int:
             vals.append(rec["tokens"] / rec["seconds"])
             occs.append(rec["occupancy"])
             pres += rec["preemptions"]
+            step_s += rec["step_seconds"]
+            req_s += rec["req_seconds"]
         if eng._decode_step._cache_size() != sigs or \
                 len(eng._prefill_cache) != buckets:
             ok = False
@@ -198,6 +226,14 @@ def main() -> int:
                                        "recompiled during the timed "
                                        "region"}), flush=True)
         q1, med, q3 = np.percentile(vals, [25, 50, 75])
+        # per-token latency = busy decode-step duration (each live request
+        # advances one token per step); per-request = admit -> finish.
+        # p99 over all reps at this rate — the tail the capacity curve is
+        # actually planned around, not the mean the throughput row shows.
+        tok_p50, tok_p99 = (np.percentile(step_s, [50, 99]) * 1e3
+                            if step_s else (0.0, 0.0))
+        req_p50, req_p99 = (np.percentile(req_s, [50, 99]) * 1e3
+                            if req_s else (0.0, 0.0))
         print(json.dumps({
             "bench": "serving", "rate_req_per_sec": rate,
             "num_requests": args.num_requests, "slots": args.slots,
@@ -209,6 +245,10 @@ def main() -> int:
             "tokens_per_sec_iqr": [round(float(q1), 1), round(float(q3), 1)],
             "occupancy": round(float(np.mean(occs)), 3),   # mean over reps —
             # stays consistent with the median throughput it sits next to
+            "tok_latency_ms_p50": round(float(tok_p50), 3),
+            "lm_serving_p99_tok_latency_ms": round(float(tok_p99), 3),
+            "req_latency_ms_p50": round(float(req_p50), 3),
+            "req_latency_ms_p99": round(float(req_p99), 3),
             "decode_steps": rec["decode_steps"],
             "preemptions": pres,
             "decode_signatures": eng._decode_step._cache_size(),

@@ -1,0 +1,142 @@
+"""Serve a transformer-LM config over TCP (serving/server.py front end).
+
+Server (foreground; SIGTERM or SIGINT drains — finish in-flight requests,
+refuse new ones, exit 0):
+
+  python tools/serve.py --config demo/model_zoo/transformer_lm.py \
+      --config-args "vocab=256,dim=64,layers=2,heads=4,batch_size=8" \
+      --slots 8 --page-size 16 --max-context 256 --port 8431
+      [--checkpoint runs/lm/  # newest committed pass dir, .tmp skipped]
+
+On bind it prints one machine-readable line (the scripting contract —
+tests/test_server.py's SIGTERM smoke parses it):
+
+  SERVE_JSON:{"host": "127.0.0.1", "port": 8431, "pid": 12345}
+
+Client one-shot (no jax needed beyond the shared package import):
+
+  python tools/serve.py --client 127.0.0.1:8431 --prompt 2,7,9 \
+      --max-new 16 --stream
+  python tools/serve.py --client 127.0.0.1:8431 --stats
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_client(args) -> int:
+    from paddle_tpu.serving.client import ServingClient
+
+    host, _, port = args.client.rpartition(":")
+    with ServingClient(host or "127.0.0.1", int(port)) as c:
+        if args.stats:
+            print(json.dumps(c.stats(), indent=2))
+            return 0
+        prompt = [int(t) for t in str(args.prompt).split(",") if t != ""]
+        if not prompt:
+            print("need --prompt id,id,... (or --stats)", file=sys.stderr)
+            return 2
+
+        def on_token(rid, tok, idx):
+            if args.stream:
+                print(f"token[{idx}] = {tok}", flush=True)
+
+        toks, reason = c.generate(
+            prompt, max_new=args.max_new, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p, eos_id=args.eos_id,
+            seed=args.seed, timeout_s=args.timeout_s, on_token=on_token)
+        print(json.dumps({"tokens": toks, "reason": reason}))
+    return 0
+
+
+def build_engine(args):
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config(args.config, args.config_args)
+    tr = Trainer(cfg, seed=args.seed or 0)
+    if args.checkpoint:
+        from paddle_tpu.trainer.checkpoint import latest_checkpoint
+
+        path = latest_checkpoint(args.checkpoint) or args.checkpoint
+        print(f"loading checkpoint {path}", file=sys.stderr)
+        tr.load(path)
+    return ServingEngine(tr.executor, tr.params, num_slots=args.slots,
+                         page_size=args.page_size,
+                         max_context=args.max_context,
+                         num_pages=args.num_pages)
+
+
+async def amain(args) -> int:
+    from paddle_tpu.serving.server import ServingServer
+
+    engine = build_engine(args)
+    srv = ServingServer(engine, host=args.host, port=args.port,
+                        max_queue=args.max_queue)
+    host, port = await srv.start()
+    print("SERVE_JSON:" + json.dumps(
+        {"host": host, "port": port, "pid": os.getpid()}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("draining: refusing new requests, finishing in-flight...",
+          file=sys.stderr, flush=True)
+    await srv.drain()
+    print("drained; bye", file=sys.stderr, flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="demo/model_zoo/transformer_lm.py")
+    ap.add_argument("--config-args",
+                    default="vocab=256,dim=64,layers=2,heads=4,batch_size=8")
+    ap.add_argument("--checkpoint", default="",
+                    help="save_dir (newest committed pass used) or pass dir")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (see the SERVE_JSON line)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-context", type=int, default=256)
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="overcommit the page pool (default: worst case)")
+    ap.add_argument("--max-queue", type=int, default=32,
+                    help="admission bound beyond the slots; one more "
+                         "request gets an overload response")
+    ap.add_argument("--seed", type=int, default=0)
+    # client mode
+    ap.add_argument("--client", default="",
+                    help="HOST:PORT — run as a one-shot client instead")
+    ap.add_argument("--prompt", default="", help="comma-separated token ids")
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=0.0)
+    ap.add_argument("--eos-id", type=int, default=-1)
+    ap.add_argument("--timeout-s", type=float, default=None)
+    ap.add_argument("--stream", action="store_true",
+                    help="print token frames as they arrive")
+    ap.add_argument("--stats", action="store_true",
+                    help="with --client: print the stats RPC and exit")
+    args = ap.parse_args(argv)
+
+    if args.client:
+        return run_client(args)
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -287,11 +287,14 @@ def main() -> int:
          lambda: _metric_fresh(_METRIC_OF["recommendation"], fh)),
         ("bench_lm_record", [py, "bench.py"], 900, bench_env("lm", 840),
          lambda: _metric_fresh(_METRIC_OF["lm"], fh)),
-        # the continuous-batching serving record (lm_serving_tok_per_sec):
-        # never measured on hardware before this queue entry
+        # the continuous-batching serving record (lm_serving_tok_per_sec +
+        # the p99 per-token latency companion): a record from before the
+        # latency fields existed must NOT satisfy freshness — require the
+        # new field so the queue re-measures once per code era
         ("bench_serving_record", [py, "bench.py"], 900,
          bench_env("serving", 840),
-         lambda: _metric_fresh(_METRIC_OF["serving"], fh)),
+         lambda: _metric_fresh(_METRIC_OF["serving"], fh,
+                               need_field="lm_serving_p99_tok_latency_ms")),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
