@@ -68,6 +68,23 @@ class ServingClient:
             raise ConnectionError("server closed the connection")
         return msg
 
+    def _route(self, match: Callable[[dict], bool]) -> dict:
+        """Return the next frame for which match(msg) is true.  Non-matching
+        frames stay in _pending (in arrival order) for later calls: the
+        buffer is scanned ONCE per invocation, then we fall through to the
+        socket — so a backlog of other requests' frames can never starve
+        the socket read."""
+        for i, msg in enumerate(self._pending):
+            if match(msg):
+                return self._pending.pop(i)
+        while True:
+            msg = wire.read_frame_sync(self.sock)
+            if msg is None:
+                raise ConnectionError("server closed the connection")
+            if match(msg):
+                return msg
+            self._pending.append(msg)           # someone else's frame
+
     # -- request lifecycle -------------------------------------------------
     def submit(self, prompt, max_new: int = 32, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 0.0, eos_id: int = -1,
@@ -110,13 +127,12 @@ class ServingClient:
         want = set(req_ids)
         out = {rid: {"tokens": None, "reason": None, "stream": []}
                for rid in want}
+        mine = ("token", "done", "overload", "error")
         while any(out[rid]["reason"] is None for rid in want):
-            msg = self.recv()
-            rid = msg.get("id")
-            if rid not in want:
-                self._pending.append(msg)      # someone else's frame
-                continue
-            t = msg.get("type")
+            msg = self._route(lambda m: m.get("id") in want
+                              and m.get("type") in mine)
+            rid = msg["id"]
+            t = msg["type"]
             if t == "token":
                 out[rid]["stream"].append(int(msg["token"]))
                 if on_token is not None:
@@ -126,10 +142,8 @@ class ServingClient:
                 out[rid]["reason"] = msg["reason"]
             elif t == "overload":
                 raise OverloadError(msg)
-            elif t == "error":
-                raise ServerError(msg.get("error", "unknown server error"))
             else:
-                self._pending.append(msg)
+                raise ServerError(msg.get("error", "unknown server error"))
         return out
 
     def generate(self, prompt, on_token: Optional[Callable] = None,
@@ -146,16 +160,9 @@ class ServingClient:
         percentiles).  Safe to call with streams in flight: interleaved
         token frames are buffered for the next collect()."""
         self.send({"type": "stats"})
-        while True:
-            msg = self.recv()
-            if msg.get("type") == "stats":
-                return msg
-            self._pending.append(msg)
+        return self._route(lambda m: m.get("type") == "stats")
 
     def ping(self) -> bool:
         self.send({"type": "ping"})
-        while True:
-            msg = self.recv()
-            if msg.get("type") == "pong":
-                return True
-            self._pending.append(msg)
+        self._route(lambda m: m.get("type") == "pong")
+        return True

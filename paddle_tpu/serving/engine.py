@@ -66,9 +66,10 @@ class Request:
         # top of every step(), whether the request is queued or in flight
         self.deadline = None if deadline is None else float(deadline)
         # tokens this request had generated when it was preempted back
-        # into the queue: a re-admission replays them identically (cleared
-        # there), but a cancel/deadline that lands while it WAITS must
-        # report them — the front end already streamed them to the client
+        # into the queue: a re-admission replays them identically (and
+        # KEEPS the stash — see _admit), and a cancel/deadline that lands
+        # while it waits or mid-replay must report at least them — the
+        # front end already streamed them to the client
         self._preempted_gen: Optional[list] = None
         # default PRNGKey(0) — the same default lm_generate uses, so the
         # parity oracle needs no special-casing

@@ -418,7 +418,10 @@ class ServingServer:
     def _handle_generate(self, conn: _Conn, msg: dict) -> None:
         cid = msg.get("id")
         if not isinstance(cid, (str, int)):
-            conn.send({"type": "error",
+            # echo whatever id the client sent (it came off the wire, so it
+            # is JSON-serializable) — an id-less error frame could never be
+            # routed by the client and would stall its collect()
+            conn.send({"type": "error", "id": cid,
                        "error": "generate needs a string or int 'id'"})
             return
         if cid in conn.rids:
@@ -481,6 +484,12 @@ class ServingServer:
                        rng=rng, deadline=deadline)
 
     def _stats_msg(self) -> dict:
+        # Runs on the asyncio loop thread while the pump thread may be
+        # mid-step: each individual read is GIL-atomic, but the snapshot as
+        # a whole can be torn (e.g. slots_in_use and pages_in_use observed
+        # across a step boundary).  Stats are advisory monitoring output,
+        # so we accept the skew rather than stall the pump for a
+        # between-steps consistent snapshot.
         eng = self.engine
         ms = 1e3
         lat = {name: {k: round(v * ms, 3) for k, v in

@@ -55,6 +55,56 @@ def _oracle(tr, prompt, max_new, **kw):
     return np.asarray(toks)[0, :int(np.asarray(lens)[0])].tolist()
 
 
+def _paired_client():
+    """A ServingClient wired to one end of a socketpair — lets the frame
+    routing be tested without a server (or jax) in the loop."""
+    import socket
+
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    c = ServingClient.__new__(ServingClient)
+    c.sock = a
+    c._next_id = 0
+    c._pending = []
+    return c, b
+
+
+def test_client_routing_drains_socket_past_buffered_foreign_frames():
+    """Regression: collect()/stats() with _pending holding ONLY other
+    requests' frames must fall through to the socket instead of recycling
+    the buffer forever (the pre-fix behavior busy-looped here)."""
+    from paddle_tpu.serving import wire
+
+    c, peer = _paired_client()
+    try:
+        # buffer frames that belong to a different in-flight request
+        c._pending = [{"type": "token", "id": "r1", "token": 5, "index": 0},
+                      {"type": "token", "id": "r1", "token": 6, "index": 1}]
+        peer.sendall(wire.encode({"type": "done", "id": "r0",
+                                  "tokens": [1, 2], "reason": "length"}))
+        res = c.collect(["r0"])
+        assert res["r0"]["tokens"] == [1, 2]
+        # r1's frames survived, untouched and in order
+        assert [m["token"] for m in c._pending] == [5, 6]
+
+        # stats() mid-stream: socket frames for r1 get stashed, stats returns
+        peer.sendall(wire.encode({"type": "token", "id": "r1",
+                                  "token": 7, "index": 2}))
+        peer.sendall(wire.encode({"type": "stats", "queue_depth": 0}))
+        assert c.stats()["queue_depth"] == 0
+        assert [m["token"] for m in c._pending] == [5, 6, 7]
+
+        # the buffered stream then collects exactly, buffer first
+        peer.sendall(wire.encode({"type": "done", "id": "r1",
+                                  "tokens": [5, 6, 7], "reason": "length"}))
+        res = c.collect(["r1"])
+        assert res["r1"]["stream"] == [5, 6, 7]
+        assert c._pending == []
+    finally:
+        c.close()
+        peer.close()
+
+
 def test_streaming_cancel_deadline_oracle_exact_over_tcp(tiny_tr):
     """The end-to-end acceptance test (ISSUE 4)."""
     rng = np.random.default_rng(0)
