@@ -465,6 +465,22 @@ def bench_serving(dtype: str) -> dict:
         occs.append(rec["occupancy"])
         step_s += rec["step_seconds"]
         req_s += rec["req_seconds"]
+    # tracing-overhead probe: the SAME workload (fresh Request objects,
+    # same seeds — the buckets are already compiled) with the span tracer
+    # on; the acceptance budget for the lifecycle tracer is <= 2% off->on,
+    # and this keeps the measured number in the perf trajectory
+    from paddle_tpu.obs import get_tracer
+    tracer = get_tracer()
+    tracer.enabled = True
+    try:
+        on_vals = []
+        for rep in range(reps):
+            rec = run_workload(eng, make_requests(seed=1 + rep, **base))
+            on_vals.append(rec["tokens"] / rec["seconds"])
+    finally:
+        tracer.enabled = False
+    off_med, on_med = float(np.median(vals)), float(np.median(on_vals))
+    overhead_pct = 100.0 * (off_med - on_med) / off_med if off_med else 0.0
     tok_p50, tok_p99 = (np.percentile(step_s, [50, 99]) * 1e3
                         if step_s else (0.0, 0.0))
     return {
@@ -483,6 +499,9 @@ def bench_serving(dtype: str) -> dict:
         "lm_serving_p99_tok_latency_ms": round(float(tok_p99), 3),
         "req_latency_ms_p99": round(
             float(np.percentile(req_s, 99) * 1e3) if req_s else 0.0, 3),
+        # tok/s cost of lifecycle tracing (negative = noise): tracked so a
+        # tracer hot-path regression shows in the perf trajectory
+        "lm_serving_trace_overhead_pct": round(overhead_pct, 2),
         "decode_signatures": eng._decode_step._cache_size(),
     }
 

@@ -1,0 +1,34 @@
+"""Unified observability: span tracing, metrics, pump watchdog.
+
+Three pieces (stdlib-only — importable from the client-side tools and the
+dependency-light serving client path without pulling in jax):
+
+  * `obs.trace` — a bounded-ring span tracer (request lifecycle on the
+    serving pump, per-dispatch phases on the trainer), exportable as
+    structured JSONL and Chrome `trace_event` JSON (Perfetto-loadable;
+    `tools/trace_dump.py`).  `get_tracer()` is the process-global
+    instance, disabled by default.
+  * `obs.metrics` — a registry of counters/gauges/histograms with labels
+    that unifies StatSet, BarrierTimer, and the serving engine's counters
+    behind one Prometheus-style `render()` (the server's `metrics` frame)
+    and a flat `snapshot()` (the trainer's `metrics.jsonl` sink).
+    `CATALOG` pins every metric name; `tools/check_metrics_names.py`
+    keeps it in lockstep with `docs/observability.md`.
+  * the pump heartbeat watchdog lives with its thread in
+    `serving/server.py` and exports through this registry
+    (`pump_last_step_age_s`, `pump_alive`).
+
+See docs/observability.md for the span model, metric reference, and the
+trace_dump workflow.
+"""
+
+from paddle_tpu.obs.metrics import (CATALOG, Counter,  # noqa: F401
+                                    Gauge, Histogram, MetricsRegistry,
+                                    barrier_collector, statset_collector,
+                                    tracer_collector)
+from paddle_tpu.obs.trace import (Tracer, get_tracer,  # noqa: F401
+                                  spans_to_chrome)
+
+__all__ = ["Tracer", "get_tracer", "spans_to_chrome", "MetricsRegistry",
+           "Counter", "Gauge", "Histogram", "CATALOG", "statset_collector",
+           "barrier_collector", "tracer_collector"]

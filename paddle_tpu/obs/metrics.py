@@ -1,0 +1,378 @@
+"""Metrics registry: counters / gauges / histograms with labels.
+
+One registry unifies the stack's three pre-existing ad-hoc stat systems —
+`utils/stat.py` StatSet (host-phase timers + serving latency windows),
+`parallel/barrier_stat.py` BarrierTimer (per-step dispatch/sync/h2d/scan
+windows), and the serving engine's occupancy/preemption counters — behind
+a single render surface:
+
+  * a Prometheus-style text exposition (`render()`), served by the RPC
+    front end as the `metrics` frame and one-shotted by
+    `tools/serve.py --metrics`;
+  * a flat `snapshot()` dict, appended by the trainer to a
+    `metrics.jsonl` sink next to its checkpoints.
+
+Existing stat objects are NOT rewritten — they keep their owners and
+their thread contracts, and the registry pulls from them at render time
+through **collectors** (`register_collector`): a collector is a zero-arg
+callable returning `(name, kind, labels|None, value)` samples.  That
+keeps render a read-only observer of state the pump/trainer threads own,
+consistent with the no-cross-thread-mutation architecture.
+
+`CATALOG` is the authoritative name -> help map for every metric this
+repo emits.  A registry built with `strict=True` (the server's and the
+trainer's are) refuses metric names outside it, and
+`tools/check_metrics_names.py` asserts CATALOG and
+`docs/observability.md` agree both ways — so a metric cannot ship
+undocumented, and the doc cannot drift from the code.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+#: every metric name this repo emits -> one-line help.  The single source
+#: of truth the strict registries and the docs lint both anchor to.
+CATALOG: dict[str, str] = {
+    # -- serving: engine state (pump-consistent in the stats RPC) ---------
+    "serving_queue_depth": "requests waiting in the engine FIFO",
+    "serving_slots_in_use": "decode slots holding an in-flight request",
+    "serving_num_slots": "configured decode slots",
+    "serving_pages_in_use": "KV pages allocated to slots",
+    "serving_free_pages": "KV pages on the free list",
+    "serving_num_pages": "configured KV page pool size (incl. trash page)",
+    "serving_decode_steps_total": "compiled decode steps executed",
+    "serving_tokens_generated_total": "tokens emitted across all requests",
+    "serving_preemptions_total": "slots preempted by page-pool pressure",
+    "serving_cancelled_total": "requests aborted by client cancel/disconnect",
+    "serving_expired_total": "requests aborted by deadline expiry",
+    # -- serving: front-end admission state -------------------------------
+    "serving_inflight": "accepted-but-unfinished requests",
+    "serving_max_inflight": "admission cap (num_slots + max_queue)",
+    "serving_draining": "1 while the server refuses new work to drain",
+    "serving_requests_accepted_total": "generate requests admitted",
+    "serving_overload_total": "generate requests refused with overload",
+    "serving_latency_seconds":
+        "request/first-token/inter-token latency quantiles "
+        "(labels: stat, quantile; bounded recent-sample windows)",
+    "serving_latency_count": "samples recorded per latency stat (label: stat)",
+    # -- pump-thread heartbeat watchdog -----------------------------------
+    "pump_alive": "1 while the engine pump thread is running",
+    "pump_last_step_age_s":
+        "seconds since the pump last completed a loop iteration — a wedged "
+        "engine shows here before clients time out",
+    # -- trainer -----------------------------------------------------------
+    "trainer_pass_id": "passes completed",
+    "trainer_cost": "mean cost of the last finished pass",
+    "trainer_samples_per_sec": "throughput of the last finished pass",
+    "trainer_batches_total": "batches trained since process start",
+    "trainer_samples_total": "samples trained since process start",
+    "trainer_host_phase_seconds":
+        "host-phase duration quantiles from the global StatSet "
+        "(labels: phase, quantile)",
+    "trainer_host_phase_count": "timed occurrences per host phase",
+    "trainer_host_phase_seconds_total": "accumulated seconds per host phase",
+    "trainer_barrier_seconds":
+        "BarrierTimer window quantiles: dispatch/sync/h2d/scan "
+        "(labels: window, quantile)",
+    # -- tracer ------------------------------------------------------------
+    "trace_spans_recorded_total": "spans recorded since enable (incl. wrapped)",
+    "trace_spans_dropped_total": "spans overwritten by ring wrap-around",
+}
+
+
+def _validate_name(name: str) -> None:
+    if not name or not all(c.isalnum() or c == "_" for c in name) \
+            or name[0].isdigit():
+        raise ValueError(f"invalid metric name {name!r}")
+
+
+def _escape_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple,
+                 lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._vals: dict[tuple, float] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} declared labels "
+                f"{self.labelnames}, got {tuple(sorted(labels))}")
+        return tuple(labels[k] for k in self.labelnames)
+
+    def _labels_of(self, key: tuple) -> Optional[dict]:
+        return dict(zip(self.labelnames, key)) if self.labelnames else None
+
+    def samples(self) -> list[tuple]:
+        with self._lock:
+            items = list(self._vals.items())
+        return [(self.name, self.kind, self._labels_of(k), v)
+                for k, v in items]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        k = self._key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._vals.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self._vals[self._key(labels)] = float(value)
+
+    def set_fn(self, fn: Callable[[], float], **labels) -> None:
+        """Callback gauge: `fn` is sampled at render time (on the render
+        thread — keep it a cheap read of GIL-atomic state)."""
+        self._vals[self._key(labels)] = fn
+
+    def value(self, **labels) -> float:
+        v = self._vals.get(self._key(labels), 0.0)
+        return float(v()) if callable(v) else v
+
+    def samples(self) -> list[tuple]:
+        with self._lock:
+            items = list(self._vals.items())
+        return [(self.name, self.kind, self._labels_of(k),
+                 float(v()) if callable(v) else v)
+                for k, v in items]
+
+
+#: latency-shaped default buckets, seconds
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, lock,
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames, lock)
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = b
+        # per label-key: ([cumulative counts per bucket + inf], sum, count)
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._vals.get(k)
+            if st is None:
+                st = self._vals[k] = [[0] * (len(self.buckets) + 1),
+                                      0.0, 0]
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    st[0][i] += 1
+            st[0][-1] += 1                       # +Inf
+            st[1] += v
+            st[2] += 1
+
+    def samples(self) -> list[tuple]:
+        with self._lock:
+            items = [(k, ([*st[0]], st[1], st[2]))
+                     for k, st in self._vals.items()]
+        out = []
+        for k, (counts, total, n) in items:
+            base = self._labels_of(k) or {}
+            for i, le in enumerate(self.buckets):
+                out.append((self.name + "_bucket", "histogram",
+                            dict(base, le=f"{le:g}"), float(counts[i])))
+            out.append((self.name + "_bucket", "histogram",
+                        dict(base, le="+Inf"), float(counts[-1])))
+            out.append((self.name + "_sum", "histogram",
+                        self._labels_of(k), total))
+            out.append((self.name + "_count", "histogram",
+                        self._labels_of(k), float(n)))
+        return out
+
+
+class MetricsRegistry:
+    """Named metric registry + render surface.  `strict=True` pins every
+    metric name (declared or collector-emitted) to CATALOG."""
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Callable[[], Iterable[tuple]]] = []
+
+    # -- declaration -------------------------------------------------------
+    def _declare(self, cls, name: str, help: str, labels, **kw):
+        _validate_name(name)
+        if self.strict and name not in CATALOG:
+            raise ValueError(
+                f"metric {name!r} is not in obs.metrics.CATALOG — add it "
+                f"(and document it in docs/observability.md; "
+                f"tools/check_metrics_names.py enforces the pairing)")
+        help = help or CATALOG.get(name, "")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} re-declared as {cls.kind} with "
+                        f"labels {tuple(labels)} (was {m.kind} "
+                        f"{m.labelnames})")
+                return m
+            m = cls(name, help, tuple(labels), self._lock, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "", labels=()) -> Counter:
+        return self._declare(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels=()) -> Gauge:
+        return self._declare(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", labels=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._declare(Histogram, name, help, labels, buckets=buckets)
+
+    def register_collector(self, fn: Callable[[], Iterable[tuple]]) -> None:
+        """`fn()` -> iterable of (name, kind, labels|None, value), pulled
+        at every render/snapshot — the adapter hook for stat objects that
+        keep their own storage (StatSet, BarrierTimer, engine counters)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    # -- reading -----------------------------------------------------------
+    def _all_samples(self) -> list[tuple]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
+        out = []
+        for m in metrics:
+            out.extend(m.samples())
+        for fn in collectors:
+            for name, kind, labels, value in fn():
+                if self.strict and \
+                        self._family_of(name, kind) not in CATALOG:
+                    raise ValueError(
+                        f"collector emitted uncataloged metric {name!r}")
+                out.append((name, kind, labels, value))
+        return out
+
+    @staticmethod
+    def _family_of(name: str, kind: str) -> str:
+        """Metric family a sample belongs to: histogram samples group
+        under their base name (x_bucket/x_sum/x_count -> x), which is
+        where the exposition format wants the one HELP/TYPE pair."""
+        if kind == "histogram":
+            for suf in ("_bucket", "_sum", "_count"):
+                if name.endswith(suf):
+                    return name[: -len(suf)]
+        return name
+
+    def render(self) -> str:
+        """Prometheus text exposition (text/plain; version 0.0.4)."""
+        families: dict[str, dict] = {}
+        for name, kind, labels, value in self._all_samples():
+            base = self._family_of(name, kind)
+            fam = families.setdefault(base, {"kind": kind, "samples": []})
+            fam["samples"].append((name, labels, value))
+        lines = []
+        for base in sorted(families):
+            fam = families[base]
+            help = self._metrics[base].help if base in self._metrics \
+                else CATALOG.get(base, "")
+            if help:
+                lines.append(f"# HELP {base} {help}")
+            lines.append(f"# TYPE {base} {fam['kind']}")
+            for name, labels, value in fam["samples"]:
+                v = f"{value:.10g}" if isinstance(value, float) else value
+                lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """Flat {name or name{k=v,...}: value} dict — the metrics.jsonl
+        record shape."""
+        return {name + _fmt_labels(labels): value
+                for name, _kind, labels, value in self._all_samples()}
+
+
+# -- collector adapters for the pre-existing stat systems -------------------
+
+def statset_collector(statset, metric: str, count_metric: str,
+                      label: str = "stat", qs=(50.0, 90.0, 99.0),
+                      total_metric: Optional[str] = None):
+    """Expose a utils/stat.py StatSet as quantile gauges + sample counts.
+    Pure read-time adapter: the StatSet keeps its owner and its per-Stat
+    lock; quantiles come from its bounded recent-sample windows."""
+
+    def collect():
+        out = []
+        for name in sorted(statset.stats):
+            s = statset.stats.get(name)
+            if s is None:
+                continue
+            for q, v in statset.percentiles(name, qs).items():
+                out.append((metric, "gauge",
+                            {label: name, "quantile": q}, v))
+            out.append((count_metric, "counter", {label: name},
+                        float(s.count)))
+            if total_metric is not None:
+                out.append((total_metric, "counter", {label: name},
+                            float(s.total_s)))
+        return out
+
+    return collect
+
+
+def barrier_collector(bt, metric: str = "trainer_barrier_seconds"):
+    """Expose a BarrierTimer's rolling windows (dispatch/sync/h2d/scan)
+    as quantile gauges, in seconds."""
+
+    def collect():
+        out = []
+        for window, pct in bt.local_summary().items():     # values in ms
+            for q, v in pct.items():
+                out.append((metric, "gauge",
+                            {"window": window, "quantile": q}, v / 1e3))
+        return out
+
+    return collect
+
+
+def tracer_collector(tracer):
+    """Expose the span tracer's ring accounting."""
+
+    def collect():
+        return [
+            ("trace_spans_recorded_total", "counter", None,
+             float(tracer.recorded)),
+            ("trace_spans_dropped_total", "counter", None,
+             float(tracer.dropped)),
+        ]
+
+    return collect

@@ -1,0 +1,225 @@
+"""Span tracer: request-lifecycle and trainer-phase timing spans.
+
+The TensorFlow-timeline analog for this stack (arXiv:1605.08695 ships
+timeline tracing as a first-class subsystem; the TPU serving literature
+diagnoses tail latency via per-phase request spans, arXiv:2605.25645):
+lightweight begin/end spans with attributes, recorded into a BOUNDED ring
+by the one thread that owns the instrumented state — the serving pump or
+the trainer loop — so recording needs no locks and a week-old process
+holds the last `capacity` spans, not its lifetime.
+
+Design constraints, in order:
+
+  1. **Off means off.**  `tracer.enabled` is False by default and every
+     recording entry point checks it first — a disabled tracer costs one
+     attribute read per call site (the bench_serving overhead budget is
+     <= 2% with tracing off).
+  2. **Single-writer ring.**  Spans are appended by the owning thread
+     only; `snapshot()` may run on another thread (drain, a test) and
+     copies the list under the GIL, using each record's monotonic `seq`
+     to restore order.  No cross-thread mutation, matching the serving
+     command-queue architecture.
+  3. **Two export shapes.**  Structured JSONL (one span per line — the
+     greppable archival form) and Chrome `trace_event` JSON (the
+     `tools/trace_dump.py` product, loadable in Perfetto/chrome://tracing).
+
+Span model: a span is (seq, name, track, ts, dur, attrs).  `track` is the
+horizontal lane the viewer shows — one per request (`req:<id>`), one for
+the engine (`engine`), one for the trainer (`trainer`).  `dur` 0.0 with
+`instant=True` renders as an instant marker (preempt, done).  Times are
+`time.perf_counter()` seconds; exports convert to microseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    """Context manager recording one complete span on exit."""
+
+    __slots__ = ("tracer", "name", "track", "attrs", "t0")
+
+    def __init__(self, tracer, name, track, attrs):
+        self.tracer = tracer
+        self.name = name
+        self.track = track
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.add(self.name, self.t0,
+                        time.perf_counter() - self.t0,
+                        track=self.track, attrs=self.attrs)
+        return False
+
+
+class Tracer:
+    """Bounded-ring span recorder.  One writer thread; see module note."""
+
+    def __init__(self, capacity: int = 16384):
+        self.capacity = int(capacity)
+        assert self.capacity > 0
+        self.enabled = False
+        self._ring: list = []          # grows to capacity, then wraps
+        self._n = 0                    # spans ever recorded (monotonic)
+
+    # -- recording (owner thread) -----------------------------------------
+    def add(self, name: str, ts: float, dur: float, track: str = "main",
+            attrs: Optional[dict] = None, instant: bool = False) -> None:
+        """Record one completed span (ts/dur in perf_counter seconds).
+
+        Designed single-writer (the pump/trainer thread).  An occasional
+        add from a sibling thread (the trainer's h2d prefetch lane) is
+        GIL-safe — list ops never tear — but a racing pair may overwrite
+        one span; tracing tolerates a lost sample, so no lock is paid on
+        the per-step hot path."""
+        if not self.enabled:
+            return
+        rec = (self._n, name, track, ts, dur, attrs,
+               True if instant else False)
+        if len(self._ring) < self.capacity:
+            self._ring.append(rec)
+        else:
+            self._ring[self._n % self.capacity] = rec
+        self._n += 1
+
+    def span(self, name: str, track: str = "main", **attrs):
+        """``with tracer.span("prefill", bucket=32): ...`` — records on
+        exit; a shared no-op object when disabled."""
+        if not self.enabled:
+            return _NULL
+        return _Span(self, name, track, attrs or None)
+
+    def begin(self, name: str, track: str = "main", **attrs):
+        """Open a span that a LATER call (possibly in another method)
+        closes via end().  Returns an opaque handle; None when disabled —
+        end(None) is a no-op, so call sites never branch."""
+        if not self.enabled:
+            return None
+        return [name, track, time.perf_counter(), attrs or None]
+
+    def end(self, handle, **extra_attrs) -> None:
+        if handle is None:
+            return
+        name, track, t0, attrs = handle
+        if extra_attrs:
+            attrs = dict(attrs or (), **extra_attrs)
+        self.add(name, t0, time.perf_counter() - t0, track=track,
+                 attrs=attrs)
+
+    def instant(self, name: str, track: str = "main", **attrs) -> None:
+        """Zero-duration marker (preempt, done, cancelled)."""
+        if not self.enabled:
+            return
+        self.add(name, time.perf_counter(), 0.0, track=track,
+                 attrs=attrs or None, instant=True)
+
+    # -- reading / export (any thread) ------------------------------------
+    @property
+    def recorded(self) -> int:
+        """Spans ever recorded (monotonic, includes overwritten)."""
+        return self._n
+
+    @property
+    def dropped(self) -> int:
+        """Spans overwritten by ring wrap-around."""
+        return max(0, self._n - self.capacity)
+
+    def clear(self) -> None:
+        self._ring = []
+        self._n = 0
+
+    def snapshot(self) -> list[dict]:
+        """Retained spans, oldest first, as dicts — the JSONL record
+        shape.  Copies under the GIL; safe concurrent with recording
+        (a span landing mid-copy may or may not appear, never torn)."""
+        recs = sorted(list(self._ring))          # seq-first tuples
+        return [{"seq": r[0], "name": r[1], "track": r[2],
+                 "ts": r[3], "dur": r[4],
+                 **({"attrs": r[5]} if r[5] else {}),
+                 **({"instant": True} if r[6] else {})}
+                for r in recs]
+
+    def export_jsonl(self, path: str) -> int:
+        """Write retained spans as JSON-lines; returns the span count."""
+        spans = self.snapshot()
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s, separators=(",", ":")) + "\n")
+        return len(spans)
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace_event JSON object (Perfetto-loadable)."""
+        return spans_to_chrome(self.snapshot())
+
+    def export_chrome(self, path: str) -> int:
+        spans = self.snapshot()
+        with open(path, "w") as f:
+            json.dump(spans_to_chrome(spans), f)
+        return len(spans)
+
+
+def spans_to_chrome(spans: list[dict]) -> dict:
+    """JSONL-shaped span records -> Chrome trace_event JSON.
+
+    Each track becomes a tid with a thread_name metadata event; complete
+    spans are "X" events, instants are "i" (thread-scoped).  Times convert
+    from perf_counter seconds to integer-friendly microseconds, rebased to
+    the earliest span so the viewer opens at t=0."""
+    pid = os.getpid()
+    tids: dict[str, int] = {}
+    events: list[dict] = []
+    t_base = min((s["ts"] for s in spans), default=0.0)
+    for s in spans:
+        track = s.get("track", "main")
+        tid = tids.get(track)
+        if tid is None:
+            tid = tids[track] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": track}})
+        ev = {"name": s["name"], "pid": pid, "tid": tid,
+              "ts": round((s["ts"] - t_base) * 1e6, 3),
+              "cat": track.split(":", 1)[0]}
+        if s.get("attrs"):
+            ev["args"] = s["attrs"]
+        if s.get("instant"):
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = round(s["dur"] * 1e6, 3)
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: the process-global tracer every subsystem records into by default —
+#: serving engine spans, trainer barrier windows, pass/eval spans.  Off
+#: until something (tools/serve.py --trace-out, bench.py's overhead probe,
+#: a test) flips `.enabled`.
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer

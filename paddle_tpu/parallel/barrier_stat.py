@@ -44,9 +44,19 @@ def _fmt_pct(name: str, p: dict[str, float]) -> str:
 
 
 class BarrierTimer:
-    """Rolling per-step timing windows + cross-process straggler report."""
+    """Rolling per-step timing windows + cross-process straggler report.
 
-    def __init__(self, window: int = 500):
+    When a `tracer` (paddle_tpu/obs/trace.py) is attached and enabled,
+    every timed window ALSO lands as a span on the given track — the
+    trainer's per-dispatch phases (dispatch / sync / h2d / scan) become
+    Perfetto-viewable without a second instrumentation layer; h2d spans
+    are emitted from the prefetch thread onto their own track so the
+    staging-vs-scan overlap is visible as parallel lanes."""
+
+    def __init__(self, window: int = 500, tracer=None,
+                 track: str = "trainer"):
+        self.tracer = tracer
+        self.track = track
         self.dispatch_s: deque[float] = deque(maxlen=window)
         self.sync_s: deque[float] = deque(maxlen=window)
         # fused-dispatch (--steps_per_dispatch > 1) windows: h2d is the
@@ -61,20 +71,20 @@ class BarrierTimer:
     # -- recording --------------------------------------------------------
     def time_dispatch(self):
         """Context manager timing one step dispatch."""
-        return _Timed(self.dispatch_s)
+        return _Timed(self.dispatch_s, self.tracer, "dispatch", self.track)
 
     def time_sync(self):
         """Context manager timing one host<-device drain (the barrier)."""
-        return _Timed(self.sync_s)
+        return _Timed(self.sync_s, self.tracer, "sync", self.track)
 
     def time_h2d(self):
         """Context manager timing one k-group host->device staging (runs on
         the prefetch thread — overlaps the current scan)."""
-        return _Timed(self.h2d_s)
+        return _Timed(self.h2d_s, self.tracer, "h2d", self.track + ":h2d")
 
     def time_scan(self):
         """Context manager timing one fused k-step scan dispatch."""
-        return _Timed(self.scan_s)
+        return _Timed(self.scan_s, self.tracer, "scan", self.track)
 
     # -- reporting --------------------------------------------------------
     def local_summary(self) -> dict[str, dict[str, float]]:
@@ -126,13 +136,21 @@ class BarrierTimer:
 
 
 class _Timed:
-    def __init__(self, sink: deque):
+    def __init__(self, sink: deque, tracer=None, name: str = "",
+                 track: str = "trainer"):
         self.sink = sink
+        self.tracer = tracer
+        self.name = name
+        self.track = track
 
     def __enter__(self):
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self.sink.append(time.perf_counter() - self.t0)
+        dt = time.perf_counter() - self.t0
+        self.sink.append(dt)
+        t = self.tracer
+        if t is not None and t.enabled:
+            t.add(self.name, self.t0, dt, track=self.track)
         return False

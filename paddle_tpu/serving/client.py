@@ -155,12 +155,29 @@ class ServingClient:
         return res["tokens"], res["reason"]
 
     # -- ops ----------------------------------------------------------------
-    def stats(self) -> dict:
+    def stats(self, stale_ok: bool = False) -> dict:
         """The server's stats RPC (queue/slot/page occupancy, latency
         percentiles).  Safe to call with streams in flight: interleaved
-        token frames are buffered for the next collect()."""
-        self.send({"type": "stats"})
+        token frames are buffered for the next collect().
+
+        Default: the engine half of the snapshot is built between steps
+        on the pump thread — mutually consistent (`"consistent": true`).
+        `stale_ok=True` answers immediately from the server's loop thread
+        without waiting on the pump — the watchdog path, which also works
+        against a wedged engine (watch `pump_last_step_age_s`)."""
+        msg = {"type": "stats"}
+        if stale_ok:
+            msg["stale_ok"] = True
+        self.send(msg)
         return self._route(lambda m: m.get("type") == "stats")
+
+    def metrics(self) -> str:
+        """The server's Prometheus-style text exposition (the `metrics`
+        frame; answered on the loop thread, readable even while the
+        engine pump is wedged).  Metric reference:
+        docs/observability.md."""
+        self.send({"type": "metrics"})
+        return self._route(lambda m: m.get("type") == "metrics")["text"]
 
     def ping(self) -> bool:
         self.send({"type": "ping"})

@@ -42,6 +42,7 @@ from paddle_tpu.data.feeder import _bucket_len
 from paddle_tpu.graph.context import TEST
 from paddle_tpu.graph.lm_decode import (_is_probs, _resolve_io_names,
                                         init_kv_caches, pick_next)
+from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.serving.paged_kv import PagedKVCache
 from paddle_tpu.serving.sampler import pick_next_per_slot
@@ -91,7 +92,7 @@ class _Slot:
     """Host-side state of one occupied decode slot."""
 
     __slots__ = ("req", "keys", "pos", "gen", "last_tok", "generated",
-                 "admit_seq")
+                 "admit_seq", "replay_until")
 
     def __init__(self, req: Request, keys: np.ndarray, pos: int,
                  first_tok: int, admit_seq: int):
@@ -103,6 +104,11 @@ class _Slot:
         self.generated = [first_tok]
         self.admit_seq = admit_seq  # admission order — preemption victims
                                     # are youngest-first (least work lost)
+        # tokens below this generation index are a post-preemption REPLAY
+        # of already-emitted output (deduped downstream) — the lifecycle
+        # trace shows them as a `replay` span, flipping to `decode` at the
+        # first genuinely fresh token.  0 = never preempted / caught up.
+        self.replay_until = 0
 
 
 class ServingEngine:
@@ -146,6 +152,14 @@ class ServingEngine:
         # the deadline clock — injectable so tests can expire requests
         # deterministically (e.g. clock = lambda: engine.n_decode_steps)
         self.clock = time.monotonic
+        # request-lifecycle tracing (paddle_tpu/obs): spans are recorded
+        # ONLY while tracer.enabled — every emission site checks first, so
+        # the disabled cost is one attribute read.  All spans record on
+        # the step()-driving thread (the pump), matching the tracer's
+        # single-writer ring contract.
+        self.tracer = get_tracer()
+        self._obs_open: dict = {}   # req_id -> open span handle (one phase
+                                    # open per request at any moment)
         self.n_decode_steps = 0
         self.n_preemptions = 0
         self.n_cancelled = 0
@@ -156,6 +170,28 @@ class ServingEngine:
         self._prefill_cache: dict[int, object] = {}
         self._pack_cache: dict[int, object] = {}
         self._decode_step = jax.jit(self._decode_impl, donate_argnums=(1,))
+
+    # -- lifecycle tracing helpers ----------------------------------------
+    def _tr_on(self) -> bool:
+        t = self.tracer
+        return t is not None and t.enabled
+
+    def _tr_begin(self, req_id, phase: str, **attrs) -> None:
+        """Open the request's next lifecycle phase (queued / decode /
+        replay).  At most one phase is open per request; the previous one
+        must have been closed by _tr_end."""
+        if self._tr_on():
+            self._obs_open[req_id] = self.tracer.begin(
+                phase, track=f"req:{req_id}", **attrs)
+
+    def _tr_end(self, req_id, **attrs) -> None:
+        h = self._obs_open.pop(req_id, None)
+        if h is not None:
+            self.tracer.end(h, **attrs)
+
+    def _tr_instant(self, req_id, name: str, **attrs) -> None:
+        if self._tr_on():
+            self.tracer.instant(name, track=f"req:{req_id}", **attrs)
 
     # -- public API -------------------------------------------------------
     def validate(self, req: Request) -> None:
@@ -195,6 +231,9 @@ class ServingEngine:
             # since this request never touches a slot or a page
             self._finish(req.req_id, req.prompt_ids.copy(), "length")
             return
+        self._tr_begin(req.req_id, "queued",
+                       prompt_len=int(req.prompt_ids.size),
+                       max_new=req.max_new)
         self.queue.append(req)
 
     def cancel(self, request_id, reason: str = "cancelled") -> bool:
@@ -290,6 +329,8 @@ class ServingEngine:
             runnable = [s for s in live
                         if self.kv.try_grow(s, self.slots[s].pos + 1)]
 
+        traced = self._tr_on()
+        t_step = time.perf_counter() if traced else 0.0
         S = len(self.slots)
         pos = np.zeros(S, np.int32)
         toks = np.zeros(S, np.int32)
@@ -318,8 +359,21 @@ class ServingEngine:
         self.n_decode_steps += 1
         self.occupancy_sum += len(live) / S
         nxt = np.asarray(nxt)                          # host sync
+        if traced:
+            # one engine-lane span per compiled step (dispatch + the host
+            # token read = the inter-token latency every live slot paid)
+            self.tracer.add("decode_step", t_step,
+                            time.perf_counter() - t_step, track="engine",
+                            attrs={"live": len(live),
+                                   "step": self.n_decode_steps})
         for s in runnable:
             sl = self.slots[s]
+            if sl.replay_until and sl.gen >= sl.replay_until:
+                # the next token is the first FRESH one after a preempt
+                # replay — flip the lifecycle phase
+                sl.replay_until = 0
+                self._tr_end(sl.req.req_id)
+                self._tr_begin(sl.req.req_id, "decode")
             tok = int(nxt[s])
             sl.generated.append(tok)
             sl.pos += 1
@@ -383,27 +437,39 @@ class ServingEngine:
         deterministic replay catches up, an abort must still report those
         already-delivered tokens (cancel's mid-replay branch).  A later
         preemption simply overwrites it with the longer prefix."""
+        self._tr_end(req.req_id)                       # queued ends here
         p = req.prompt_ids.size
         ps = self.kv.page_size
         Lb = self.bucket_for(p)
-        ids = np.zeros((1, Lb), np.int32)
-        ids[0, :p] = req.prompt_ids
-        last, kv_prompt = self._prefill_fn(Lb)(
-            self.params, jnp.asarray(ids),
-            jnp.asarray([p], np.int32))
-        keys = np.asarray(jax.random.split(req.rng, req.max_new))
-        tok0 = int(np.asarray(pick_next(
-            last, jnp.asarray(keys[0]), temperature=req.temperature,
-            top_k=req.top_k, top_p=req.top_p, is_probs=self._probs))[0])
+        with self.tracer.span("prefill", track=f"req:{req.req_id}",
+                              bucket=Lb):
+            ids = np.zeros((1, Lb), np.int32)
+            ids[0, :p] = req.prompt_ids
+            last, kv_prompt = self._prefill_fn(Lb)(
+                self.params, jnp.asarray(ids),
+                jnp.asarray([p], np.int32))
+            keys = np.asarray(jax.random.split(req.rng, req.max_new))
+            tok0 = int(np.asarray(pick_next(
+                last, jnp.asarray(keys[0]), temperature=req.temperature,
+                top_k=req.top_k, top_p=req.top_p, is_probs=self._probs))[0])
 
-        pages = np.zeros(Lb // ps, np.int32)           # 0 = trash for pad
-        n_real = self.kv.pages_for(p)
-        pages[:n_real] = self.kv.table[s, :n_real]
-        self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
-                                          jnp.asarray(pages))
+            pages = np.zeros(Lb // ps, np.int32)       # 0 = trash for pad
+            n_real = self.kv.pages_for(p)
+            pages[:n_real] = self.kv.table[s, :n_real]
+            self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
+                                              jnp.asarray(pages))
         self._admit_seq += 1
-        self.slots[s] = _Slot(req, keys, pos=p, first_tok=tok0,
-                              admit_seq=self._admit_seq)
+        sl = _Slot(req, keys, pos=p, first_tok=tok0,
+                   admit_seq=self._admit_seq)
+        self.slots[s] = sl
+        stash = req._preempted_gen or []
+        if stash:
+            # tokens 0..len(stash)-1 re-emit deterministically — a replay
+            # span until the first fresh token (step()'s flip)
+            sl.replay_until = len(stash)
+            self._tr_begin(req.req_id, "replay", replays=len(stash))
+        else:
+            self._tr_begin(req.req_id, "decode")
         self.tokens_generated += 1
         if self.on_token is not None:
             self.on_token(req.req_id, tok0, 0)
@@ -412,6 +478,10 @@ class ServingEngine:
 
     def _preempt(self, s: int) -> None:
         sl = self.slots[s]
+        rid = sl.req.req_id
+        self._tr_end(rid, tokens=sl.gen)      # decode/replay ends here
+        self._tr_instant(rid, "preempt")
+        self._tr_begin(rid, "queued", requeued=True)
         self.queue.appendleft(sl.req)
         old = sl.req._preempted_gen or []
         if len(sl.generated) >= len(old):     # a re-preempt mid-replay
@@ -432,6 +502,13 @@ class ServingEngine:
         self._finish(sl.req.req_id, toks, reason)
 
     def _finish(self, req_id, toks: np.ndarray, reason: str) -> None:
+        # close whatever lifecycle phase is open (queued for an aborted
+        # waiter, decode/replay for an in-slot finish) and mark the
+        # terminal event: done (stop/length), cancelled, or deadline
+        self._tr_end(req_id, reason=reason)
+        self._tr_instant(req_id,
+                         "done" if reason in ("stop", "length") else reason,
+                         reason=reason, tokens=int(toks.size))
         self.results[req_id] = toks
         self.finish_reasons[req_id] = reason
         if self.on_finish is not None:

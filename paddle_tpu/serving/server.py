@@ -30,7 +30,18 @@ arXiv:2605.25645) over `serving/engine.py`:
   * STATS RPC: queue depth, slot/page occupancy, preemptions, and
     per-request / per-token latency percentiles from a utils/stat.py
     StatSet (bounded sample windows — a week-old server reports recent
-    latency, not its lifetime average).
+    latency, not its lifetime average).  The engine-state part of the
+    snapshot is built ON THE PUMP THREAD via a command-queue round trip,
+    so `slots_in_use`/`pages_in_use`/`queue_depth` are mutually
+    consistent (between-steps view); `{"stale_ok": true}` keeps the old
+    loop-thread fast path for pollers that must never wait on the pump
+    (the watchdog's path — it also works when the pump is wedged).
+  * METRICS + WATCHDOG: a Prometheus-style `metrics` frame (obs.metrics
+    registry — engine counters, admission state, latency quantiles,
+    tracer accounting) answered on the LOOP thread so it stays readable
+    while the pump is wedged; the pump heartbeats every loop iteration
+    and `pump_last_step_age_s` exposes a hung engine in metrics before
+    clients time out.
 
 Wire protocol: serving/wire.py (4-byte big-endian length + JSON body);
 message schemas in docs/serving.md.  The blocking-socket client is
@@ -47,6 +58,9 @@ from typing import Optional
 
 import numpy as np
 
+from paddle_tpu.obs import (MetricsRegistry, statset_collector,
+                            tracer_collector)
+from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.engine import Request, ServingEngine
 from paddle_tpu.utils.stat import StatSet
@@ -123,6 +137,10 @@ class ServingServer:
         self.stats = StatSet("serving_server")
         self._inflight = 0            # accepted, not finished (loop thread)
         self._draining = False
+        # pump heartbeat: (monotonic time, engine step count) written by
+        # the pump once per loop iteration — a single tuple rebind, so any
+        # thread reads it torn-free.  None until the pump first runs.
+        self._pump_beat: Optional[tuple] = None
         self._conns: set = set()      # open connections (loop thread)
         self._routes: dict[str, _ReqState] = {}
         self._cmds: queue.Queue = queue.Queue()
@@ -136,6 +154,68 @@ class ServingServer:
         self._bg_thread: Optional[threading.Thread] = None
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
+        self._init_metrics()
+
+    def _init_metrics(self) -> None:
+        """The unified registry behind the `metrics` frame.  Rendered on
+        the LOOP thread: engine-derived values are advisory stale-ok
+        reads of pump-owned state (each individually GIL-atomic; the
+        CONSISTENT view is the stats RPC's pump round trip) — that is
+        what keeps metrics answerable while the pump is wedged, which is
+        the whole point of the watchdog gauges."""
+        reg = self.metrics = MetricsRegistry(strict=True)
+        self._m_accepted = reg.counter("serving_requests_accepted_total")
+        self._m_overload = reg.counter("serving_overload_total")
+        reg.gauge("serving_inflight").set_fn(lambda: float(self._inflight))
+        reg.gauge("serving_max_inflight").set(float(self.max_inflight))
+        reg.gauge("serving_draining").set_fn(
+            lambda: 1.0 if self._draining else 0.0)
+        reg.gauge("pump_alive").set_fn(
+            lambda: 1.0 if (self._pump_thread is not None
+                            and self._pump_thread.is_alive()) else 0.0)
+        reg.gauge("pump_last_step_age_s").set_fn(self.pump_last_step_age)
+        eng = self.engine
+
+        def engine_state():
+            return [
+                ("serving_queue_depth", "gauge", None,
+                 float(len(eng.queue))),
+                ("serving_slots_in_use", "gauge", None,
+                 float(sum(1 for s in eng.slots if s is not None))),
+                ("serving_num_slots", "gauge", None, float(len(eng.slots))),
+                ("serving_pages_in_use", "gauge", None,
+                 float(eng.kv.pages_in_use)),
+                ("serving_free_pages", "gauge", None,
+                 float(eng.kv.free_page_count)),
+                ("serving_num_pages", "gauge", None,
+                 float(eng.kv.num_pages)),
+                ("serving_decode_steps_total", "counter", None,
+                 float(eng.n_decode_steps)),
+                ("serving_tokens_generated_total", "counter", None,
+                 float(eng.tokens_generated)),
+                ("serving_preemptions_total", "counter", None,
+                 float(eng.n_preemptions)),
+                ("serving_cancelled_total", "counter", None,
+                 float(eng.n_cancelled)),
+                ("serving_expired_total", "counter", None,
+                 float(eng.n_expired)),
+            ]
+
+        reg.register_collector(engine_state)
+        reg.register_collector(statset_collector(
+            self.stats, "serving_latency_seconds", "serving_latency_count"))
+        reg.register_collector(tracer_collector(get_tracer()))
+
+    def pump_last_step_age(self) -> float:
+        """Seconds since the pump last completed a loop iteration; -1.0
+        when it has not run yet.  Healthy: < ~0.6s even when idle (the
+        idle wait is bounded at 0.5s).  Growing: the engine is wedged
+        inside step() — visible here (and in the metrics frame) while
+        generate streams merely stall."""
+        beat = self._pump_beat
+        if beat is None:
+            return -1.0
+        return time.monotonic() - beat[0]
 
     # -- lifecycle (asyncio side) -----------------------------------------
     async def start(self, start_pump: bool = True) -> tuple[str, int]:
@@ -201,6 +281,18 @@ class ServingServer:
             self._wake.set()
             await asyncio.get_running_loop().run_in_executor(
                 None, self._pump_thread.join)
+        # TOCTOU sweep, mirroring _pump_died_on_loop: _handle_stats may
+        # have seen the pump alive and enqueued AFTER the pump's own
+        # stop-drain ran.  We are on the loop thread, so any such put
+        # either already happened (visible here) or its _handle_stats
+        # runs after this and sees the dead pump (stale fast path).
+        try:
+            while True:
+                cmd = self._cmds.get_nowait()
+                if cmd[0] == "stats":
+                    self._stats_on_loop(cmd[1], None)
+        except queue.Empty:
+            pass
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -256,10 +348,30 @@ class ServingServer:
     def _pump(self) -> None:
         try:
             while True:
+                # heartbeat FIRST: written once per loop iteration, so a
+                # wedge anywhere below (a hung compiled step, a stuck
+                # host sync) freezes it and pump_last_step_age_s grows
+                self._pump_beat = (time.monotonic(),
+                                   self.engine.n_decode_steps)
                 try:
                     while True:
                         cmd = self._cmds.get_nowait()
                         if cmd[0] == "stop":
+                            # commands queued behind "stop" must not be
+                            # orphaned: a consistent-stats client is
+                            # blocking on its reply — answer it here (we
+                            # ARE between steps on the pump thread, so
+                            # the snapshot is consistent); _shutdown
+                            # sweeps anything put after this drain
+                            try:
+                                while True:
+                                    cmd = self._cmds.get_nowait()
+                                    if cmd[0] == "stats":
+                                        self._loop.call_soon_threadsafe(
+                                            self._stats_on_loop, cmd[1],
+                                            self._engine_stats())
+                            except queue.Empty:
+                                pass
                             return
                         if cmd[0] == "add":
                             req = cmd[1]
@@ -273,6 +385,12 @@ class ServingServer:
                                     self._fail_on_loop, req.req_id, str(e))
                         elif cmd[0] == "cancel":
                             self.engine.cancel(cmd[1])
+                        elif cmd[0] == "stats":
+                            # between-steps = the consistent view: no
+                            # slot/page/queue mutation can interleave
+                            self._loop.call_soon_threadsafe(
+                                self._stats_on_loop, cmd[1],
+                                self._engine_stats())
                 except queue.Empty:
                     pass
                 busy = self.engine.step()
@@ -288,7 +406,21 @@ class ServingServer:
 
     def _pump_died_on_loop(self) -> None:
         """A dead pump strands every accepted request — fail them all so
-        no client hangs on a stream that will never finish."""
+        no client hangs on a stream that will never finish.  Pending
+        consistent-stats round trips must answer too (stale): draining
+        them HERE, on the loop thread, closes the TOCTOU where
+        _handle_stats checks pump health, the pump dies and drains, and
+        only then does the command land in the queue — any such late put
+        happens on this thread, so it is either already in the queue now
+        or its _handle_stats saw _pump_error set (the pump writes it
+        before scheduling this callback) and took the stale path."""
+        try:
+            while True:
+                cmd = self._cmds.get_nowait()     # nobody else reads now
+                if cmd[0] == "stats":
+                    self._stats_on_loop(cmd[1], None)
+        except queue.Empty:
+            pass
         for rid in list(self._routes):
             self._fail_on_loop(rid, f"engine pump died: "
                                     f"{type(self._pump_error).__name__}: "
@@ -408,7 +540,13 @@ class ServingServer:
                 self._wake.set()
             # unknown/already-finished id: the done frame already answered
         elif t == "stats":
-            conn.send(self._stats_msg())
+            self._handle_stats(conn, msg)
+        elif t == "metrics":
+            # answered on the LOOP thread on purpose: the Prometheus view
+            # (incl. pump_last_step_age_s) must stay readable while the
+            # pump is wedged — engine-derived values are stale-ok reads
+            conn.send({"type": "metrics", "text": self.metrics.render(),
+                       "content_type": "text/plain; version=0.0.4"})
         elif t == "ping":
             conn.send({"type": "pong"})
         else:
@@ -438,10 +576,12 @@ class ServingServer:
                                 f"{self._pump_error}"})
             return
         if self._draining:
+            self._m_overload.inc()
             conn.send({"type": "overload", "id": cid, "reason": "draining"})
             return
         if self._inflight >= self.max_inflight:
             # the explicit backpressure contract: never queue unboundedly
+            self._m_overload.inc()
             conn.send({"type": "overload", "id": cid, "reason": "queue_full",
                        "inflight": self._inflight,
                        "max_inflight": self.max_inflight})
@@ -456,6 +596,7 @@ class ServingServer:
                                              msg.get("stream", True))
         conn.rids[cid] = req.req_id
         self._inflight += 1
+        self._m_accepted.inc()
         self._cmds.put(("add", req))
         self._wake.set()
 
@@ -483,24 +624,33 @@ class ServingServer:
                        eos_id=int(msg.get("eos_id", -1)),
                        rng=rng, deadline=deadline)
 
-    def _stats_msg(self) -> dict:
-        # Runs on the asyncio loop thread while the pump thread may be
-        # mid-step: each individual read is GIL-atomic, but the snapshot as
-        # a whole can be torn (e.g. slots_in_use and pages_in_use observed
-        # across a step boundary).  Stats are advisory monitoring output,
-        # so we accept the skew rather than stall the pump for a
-        # between-steps consistent snapshot.
+    def _handle_stats(self, conn: _Conn, msg: dict) -> None:
+        """Default path: the engine-state half of the snapshot is built
+        BETWEEN STEPS on the pump thread (command-queue round trip), so
+        `slots_in_use`/`pages_in_use`/`queue_depth` can never tear across
+        a step boundary.  `{"stale_ok": true}` (or a pump that is dead /
+        never started) answers immediately from the loop thread with
+        GIL-atomic-but-unsynchronized reads — the watchdog's fast path,
+        which must not block behind a wedged or absent pump."""
+        pump_ok = (self._pump_error is None
+                   and self._pump_thread is not None
+                   and self._pump_thread.is_alive())
+        if msg.get("stale_ok") or not pump_ok:
+            conn.send(self._stats_msg(engine_part=None))
+            return
+        self._cmds.put(("stats", conn))
+        self._wake.set()
+
+    def _stats_on_loop(self, conn: _Conn, engine_part: Optional[dict]):
+        conn.send(self._stats_msg(engine_part=engine_part))
+
+    def _engine_stats(self) -> dict:
+        """The engine-owned snapshot half.  Mutually consistent ONLY when
+        called on the pump thread between steps; the stale fast path
+        calls it from the loop thread and labels the result."""
         eng = self.engine
-        ms = 1e3
-        lat = {name: {k: round(v * ms, 3) for k, v in
-                      self.stats.percentiles(name, (50.0, 90.0, 99.0)).items()}
-               for name in ("request_latency", "first_token_latency",
-                            "token_latency")}
         return {
-            "type": "stats",
             "queue_depth": len(eng.queue),
-            "inflight": self._inflight,
-            "max_inflight": self.max_inflight,
             "slots_in_use": sum(1 for s in eng.slots if s is not None),
             "num_slots": len(eng.slots),
             "pages_in_use": int(eng.kv.pages_in_use),
@@ -511,6 +661,28 @@ class ServingServer:
             "preemptions": eng.n_preemptions,
             "cancelled": eng.n_cancelled,
             "expired": eng.n_expired,
+        }
+
+    def _stats_msg(self, engine_part: Optional[dict]) -> dict:
+        # Loop-thread half (admission state, latency percentiles, pump
+        # health) merged with the engine half — either the pump-built
+        # consistent one, or a fresh stale read (engine_part=None).
+        ms = 1e3
+        lat = {name: {k: round(v * ms, 3) for k, v in
+                      self.stats.percentiles(name, (50.0, 90.0, 99.0)).items()}
+               for name in ("request_latency", "first_token_latency",
+                            "token_latency")}
+        out = {
+            "type": "stats",
+            "consistent": engine_part is not None,
+            "inflight": self._inflight,
+            "max_inflight": self.max_inflight,
             "draining": self._draining,
+            "pump_alive": bool(self._pump_thread is not None
+                               and self._pump_thread.is_alive()),
+            "pump_last_step_age_s": round(self.pump_last_step_age(), 3),
             "latency_ms": lat,
         }
+        out.update(engine_part if engine_part is not None
+                   else self._engine_stats())
+        return out

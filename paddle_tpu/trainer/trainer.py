@@ -146,9 +146,33 @@ class Trainer:
         self._last_batch: Optional[dict[str, Argument]] = None
         # BarrierStat analog: per-step dispatch/sync timing + straggler skew,
         # logged every log_period on mesh runs (ref: utils/BarrierStat.h:
-        # 198-389, REGISTER_BARRIER_TIMER_SERVER)
+        # 198-389, REGISTER_BARRIER_TIMER_SERVER).  The windows also route
+        # through the process-global span tracer (paddle_tpu/obs) when
+        # tracing is enabled, so per-dispatch phases land in the same
+        # Perfetto timeline as serving request lifecycles.
+        from paddle_tpu.obs.trace import get_tracer
         from paddle_tpu.parallel.barrier_stat import BarrierTimer
-        self.barrier_stat = BarrierTimer()
+        self._tracer = get_tracer()
+        self.barrier_stat = BarrierTimer(tracer=self._tracer)
+        # unified metrics registry (obs.metrics): training progress gauges
+        # plus read-time collectors over the pre-existing stat systems
+        # (global_stat host phases, the barrier windows, tracer ring
+        # accounting).  Snapshots append to <save_dir>/metrics.jsonl next
+        # to the checkpoints (append_metrics, called per pass by train()).
+        from paddle_tpu.obs import (MetricsRegistry, barrier_collector,
+                                    statset_collector, tracer_collector)
+        self.metrics = MetricsRegistry(strict=True)
+        self._m_pass = self.metrics.gauge("trainer_pass_id")
+        self._m_cost = self.metrics.gauge("trainer_cost")
+        self._m_sps = self.metrics.gauge("trainer_samples_per_sec")
+        self._m_batches = self.metrics.counter("trainer_batches_total")
+        self._m_samples = self.metrics.counter("trainer_samples_total")
+        self.metrics.register_collector(statset_collector(
+            global_stat, "trainer_host_phase_seconds",
+            "trainer_host_phase_count", label="phase",
+            total_metric="trainer_host_phase_seconds_total"))
+        self.metrics.register_collector(barrier_collector(self.barrier_stat))
+        self.metrics.register_collector(tracer_collector(self._tracer))
         # immutable after construction; _validate_batch uses it per batch
         self._data_layers = {l.name: l for l in self.model.layers
                              if l.type == "data"}
@@ -610,7 +634,18 @@ class Trainer:
                      samples=n_samples, seconds=dt,
                      samples_per_sec=n_samples / dt if dt > 0 else 0.0)
         log.info("pass %d done: %s", self.pass_id, _fmt(stats))
+        if self._tracer.enabled:
+            self._tracer.add("train_pass", time.perf_counter() - dt, dt,
+                             track="trainer",
+                             attrs={"pass": self.pass_id,
+                                    "batches": n_batches})
         self.pass_id += 1
+        self._m_pass.set(self.pass_id)             # = passes completed
+        self._m_cost.set(stats["cost"])
+        self._m_sps.set(stats["samples_per_sec"])
+        if n_batches:
+            self._m_batches.inc(n_batches)
+            self._m_samples.inc(n_samples)
         return stats
 
     # -- fused k-step dispatch (--steps_per_dispatch) ---------------------
@@ -760,8 +795,35 @@ class Trainer:
                 stats["test"] = test_stats
             if save_dir:
                 self.save(save_dir, keep_last=keep_last)
+                # the metrics sink rides next to the checkpoints: one
+                # registry snapshot per pass, JSON-lines, append-only
+                self.append_metrics(save_dir, extra=stats)
             history.append(stats)
         return history
+
+    def append_metrics(self, save_dir: str, extra: Optional[dict] = None
+                       ) -> str:
+        """Append one metrics record to `<save_dir>/metrics.jsonl` — the
+        trainer-side counterpart of the serving server's `metrics` frame:
+        {ts, pass_id, extra scalar pass stats, metrics: registry snapshot
+        (progress gauges + host-phase/barrier quantiles)}.  Process 0
+        only, like checkpoint writes."""
+        if jax.process_index() != 0:
+            return ""
+        import datetime
+
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, "metrics.jsonl")
+        rec = {"ts": datetime.datetime.now(datetime.timezone.utc)
+                       .isoformat(timespec="seconds"),
+               "pass_id": self.pass_id}
+        for k, v in (extra or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                rec[k] = v
+        rec["metrics"] = self.metrics.snapshot()
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return path
 
     def test(self, batches: Optional[Iterator] = None) -> dict[str, float]:
         """(ref: Tester::testOnePeriod)."""
@@ -780,15 +842,16 @@ class Trainer:
             self.evaluators.host_configs else None
         total, n = 0.0, 0
         self.rng, sub = jax.random.split(self.rng)
-        for batch in batches:
-            loss, partials, host_out = self._test_step(
-                params, self.net_state, batch, sub)
-            bsz = _batch_size(batch)
-            total += float(loss) * bsz
-            n += bsz
-            acc = self.evaluators.accumulate(acc, partials)
-            if host_acc is not None:
-                self.evaluators.host_update(host_acc, host_out)
+        with self._tracer.span("eval", track="trainer"):
+            for batch in batches:
+                loss, partials, host_out = self._test_step(
+                    params, self.net_state, batch, sub)
+                bsz = _batch_size(batch)
+                total += float(loss) * bsz
+                n += bsz
+                acc = self.evaluators.accumulate(acc, partials)
+                if host_acc is not None:
+                    self.evaluators.host_update(host_acc, host_out)
         stats = self.evaluators.finalize(acc)
         if host_acc is not None:
             stats.update(self.evaluators.finalize_host(host_acc))

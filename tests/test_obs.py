@@ -1,0 +1,436 @@
+"""Observability suite (paddle_tpu/obs): tracer ring semantics, Chrome
+trace export validity, metrics registry + Prometheus render, Stat
+thread-safety, full request-lifecycle traces out of the serving engine
+(incl. preempt + replay), and the trainer's metrics.jsonl sink."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.obs import (CATALOG, MetricsRegistry, Tracer,
+                            barrier_collector, get_tracer,
+                            spans_to_chrome, statset_collector)
+from paddle_tpu.utils.stat import SAMPLE_WINDOW, Stat, StatSet
+
+
+# ---------------------------------------------------------------------------
+# tracer ring
+# ---------------------------------------------------------------------------
+
+def test_ring_overflow_keeps_newest_in_order():
+    t = Tracer(capacity=8)
+    t.enabled = True
+    for i in range(20):
+        t.add(f"s{i}", ts=float(i), dur=0.5)
+    assert t.recorded == 20 and t.dropped == 12
+    snap = t.snapshot()
+    assert [s["name"] for s in snap] == [f"s{i}" for i in range(12, 20)]
+    assert [s["seq"] for s in snap] == list(range(12, 20))
+    # under capacity: everything retained, oldest first
+    t.clear()
+    t.add("a", 0.0, 1.0)
+    t.add("b", 2.0, 1.0)
+    assert [s["name"] for s in t.snapshot()] == ["a", "b"]
+    assert t.dropped == 0
+
+
+def test_disabled_tracer_records_nothing():
+    t = Tracer(capacity=8)
+    t.add("x", 0.0, 1.0)
+    t.instant("y")
+    with t.span("z"):
+        pass
+    assert t.end(t.begin("w")) is None
+    assert t.recorded == 0 and t.snapshot() == []
+
+
+def test_begin_end_and_span_record_attrs_and_durations():
+    t = Tracer()
+    t.enabled = True
+    h = t.begin("queued", track="req:a", max_new=4)
+    t.end(h, reason="length")
+    with t.span("prefill", track="req:a", bucket=16):
+        pass
+    t.instant("done", track="req:a")
+    snap = t.snapshot()
+    assert [s["name"] for s in snap] == ["queued", "prefill", "done"]
+    assert snap[0]["attrs"] == {"max_new": 4, "reason": "length"}
+    assert snap[1]["attrs"] == {"bucket": 16}
+    assert snap[2].get("instant") is True
+    assert all(s["dur"] >= 0.0 for s in snap)
+
+
+def test_chrome_export_schema_and_track_nesting():
+    """Chrome trace_event validity: metadata thread names per track, "X"
+    complete events with non-negative ts/dur, instants as "i" — and spans
+    on one track are monotonically ordered and non-overlapping (the
+    sequential-phase contract a lifecycle trace relies on)."""
+    t = Tracer()
+    t.enabled = True
+    t.add("queued", 10.0, 0.5, track="req:a")
+    t.add("prefill", 10.5, 0.25, track="req:a", attrs={"bucket": 16})
+    t.add("decode", 10.75, 1.0, track="req:a")
+    t.instant("done", track="req:a")
+    t.add("dispatch", 10.2, 0.1, track="trainer")
+    doc = t.chrome_trace()
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {"req:a", "trainer"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    ins = [e for e in evs if e["ph"] == "i"]
+    assert len(xs) == 4 and len(ins) == 1
+    for e in xs + ins:
+        assert e["ts"] >= 0 and e["name"]
+        assert {"pid", "tid"} <= set(e)
+    assert all(e["dur"] >= 0 for e in xs)
+    # per-track phases nest monotonically: next span starts at/after the
+    # previous one's end (1us grid tolerance)
+    tid_a = next(m["tid"] for m in meta if m["args"]["name"] == "req:a")
+    lane = sorted((e for e in xs if e["tid"] == tid_a),
+                  key=lambda e: e["ts"])
+    assert [e["name"] for e in lane] == ["queued", "prefill", "decode"]
+    for prev, nxt in zip(lane, lane[1:]):
+        assert nxt["ts"] >= prev["ts"] + prev["dur"] - 1.0
+    # attrs survive as args
+    assert next(e for e in xs if e["name"] == "prefill")["args"] == \
+        {"bucket": 16}
+    # json-serializable end to end
+    json.dumps(doc)
+
+
+def test_trace_dump_tool_roundtrip(tmp_path):
+    from tools.trace_dump import load_spans, main, summarize
+
+    t = Tracer()
+    t.enabled = True
+    t.add("queued", 0.0, 0.5, track="req:a")
+    t.instant("done", track="req:a")
+    src = tmp_path / "spans.jsonl"
+    assert t.export_jsonl(str(src)) == 2
+    spans = load_spans(str(src))
+    assert [s["name"] for s in spans] == ["queued", "done"]
+    assert "queued" in summarize(spans)
+    out = tmp_path / "trace.json"
+    assert main([str(src), "-o", str(out)]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert any(e.get("name") == "queued" for e in doc["traceEvents"])
+    # empty input is a loud exit 2, not a silent empty trace
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main([str(empty), "-o", str(out)]) == 2
+    # a complete span missing dur (hand-edited / foreign JSONL) is the
+    # clean error path too, not a KeyError traceback from spans_to_chrome
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"name": "x", "ts": 1.0}\n')
+    assert main([str(bad), "-o", str(out)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_render():
+    reg = MetricsRegistry()
+    c = reg.counter("demo_requests_total", "requests")
+    c.inc()
+    c.inc(2)
+    g = reg.gauge("demo_depth", "queue depth", labels=("lane",))
+    g.set(3, lane="a")
+    g.set_fn(lambda: 7.0, lane="b")
+    h = reg.histogram("demo_latency_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert "# HELP demo_requests_total requests" in text
+    assert "# TYPE demo_requests_total counter" in text
+    assert "demo_requests_total 3" in text
+    assert 'demo_depth{lane="a"} 3' in text
+    assert 'demo_depth{lane="b"} 7' in text
+    assert "# TYPE demo_latency_seconds histogram" in text
+    assert 'demo_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'demo_latency_seconds_bucket{le="1"} 2' in text
+    assert 'demo_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "demo_latency_seconds_count 3" in text
+    snap = reg.snapshot()
+    assert snap["demo_requests_total"] == 3.0
+    assert snap['demo_depth{lane="b"}'] == 7.0
+    # re-declaration is idempotent; kind mismatch is loud
+    assert reg.counter("demo_requests_total") is c
+    with pytest.raises(ValueError, match="re-declared"):
+        reg.gauge("demo_requests_total")
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    with pytest.raises(ValueError, match="declared labels"):
+        g.set(1, wrong="x")
+
+
+def test_strict_registry_pins_names_to_catalog():
+    reg = MetricsRegistry(strict=True)
+    reg.gauge("serving_queue_depth")             # catalogued: fine
+    with pytest.raises(ValueError, match="CATALOG"):
+        reg.gauge("not_a_documented_metric")
+    reg.register_collector(lambda: [("rogue_metric", "gauge", None, 1.0)])
+    with pytest.raises(ValueError, match="uncataloged"):
+        reg.render()
+    # every catalog name is docs-lintable (the tools/check_metrics_names
+    # grammar): lowercase identifier
+    for name in CATALOG:
+        assert name[0].isalpha() and name == name.lower()
+
+
+def test_statset_and_barrier_collectors():
+    from paddle_tpu.parallel.barrier_stat import BarrierTimer
+
+    ss = StatSet("t")
+    for v in (0.01, 0.02, 0.03):
+        ss.get("phase_a").add(v)
+    reg = MetricsRegistry()
+    reg.register_collector(statset_collector(
+        ss, "trainer_host_phase_seconds", "trainer_host_phase_count",
+        label="phase", total_metric="trainer_host_phase_seconds_total"))
+    bt = BarrierTimer()
+    bt.dispatch_s.extend([0.001, 0.002])
+    reg.register_collector(barrier_collector(bt))
+    snap = reg.snapshot()
+    assert snap['trainer_host_phase_count{phase="phase_a"}'] == 3.0
+    assert abs(snap['trainer_host_phase_seconds_total{phase="phase_a"}']
+               - 0.06) < 1e-9
+    p50 = snap['trainer_host_phase_seconds{phase="phase_a",quantile="p50"}']
+    assert abs(p50 - 0.02) < 1e-9
+    disp = snap['trainer_barrier_seconds{quantile="p50",window="dispatch"}']
+    assert abs(disp - 0.0015) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Stat thread-safety (pump add() vs stats-RPC percentiles())
+# ---------------------------------------------------------------------------
+
+def test_stat_concurrent_add_and_percentiles_exact():
+    ss = StatSet("conc")
+    n_threads, per = 4, 5000
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                ss.percentiles("hot", (50.0, 99.0))
+        except Exception as e:                     # noqa: BLE001
+            errors.append(e)
+
+    def writer(k):
+        try:
+            for i in range(per):
+                ss.get("hot").add((k * per + i) * 1e-6)
+        except Exception as e:                     # noqa: BLE001
+            errors.append(e)
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    writers = [threading.Thread(target=writer, args=(k,))
+               for k in range(n_threads)]
+    for th in readers + writers:
+        th.start()
+    for th in writers:
+        th.join()
+    stop.set()
+    for th in readers:
+        th.join()
+    assert not errors, errors
+    s = ss.get("hot")
+    # the lock makes accounting EXACT under contention, not approximate
+    assert s.count == n_threads * per
+    assert len(s.samples) == min(SAMPLE_WINDOW, s.count)
+    total = sum((k * per + i) * 1e-6
+                for k in range(n_threads) for i in range(per))
+    assert abs(s.total_s - total) < 1e-9
+    p = ss.percentiles("hot", (50.0,))
+    assert p["p50"] > 0.0
+
+
+def test_statset_get_creation_race_single_object():
+    ss = StatSet("race")
+    got = []
+
+    def grab():
+        got.append(ss.get("only"))
+
+    ths = [threading.Thread(target=grab) for _ in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert all(s is got[0] for s in got)
+
+
+# ---------------------------------------------------------------------------
+# engine request-lifecycle traces (the oracle-implied phase regression)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lifecycle_tracer():
+    t = get_tracer()
+    saved = (t.enabled, t._ring, t._n)
+    t.clear()
+    t.enabled = True
+    yield t
+    t.enabled, t._ring, t._n = saved
+
+
+def _phases(tracer, rid):
+    return [s["name"] for s in tracer.snapshot()
+            if s["track"] == f"req:{rid}"]
+
+
+def test_request_lifecycle_phases_incl_preempt_replay(lifecycle_tracer):
+    """A full serving run traces exactly the lifecycle the oracle run
+    implies: queued -> prefill -> decode -> done for untroubled requests;
+    a page-pool preemption inserts preempt -> queued -> prefill -> replay
+    before the terminal phase.  Durations are sane: phases on one request
+    track are sequential and the decode span covers the decode steps."""
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.serving import Request, ServingEngine
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    tr = Trainer(cfg, seed=7)
+
+    # -- no preemption: exact phase list ---------------------------------
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64)
+    rng = np.random.default_rng(0)
+    eng.add_request(Request("plain", rng.integers(2, 31, 5), max_new=4))
+    res = eng.run()
+    assert len(res["plain"]) == 9
+    assert _phases(lifecycle_tracer, "plain") == \
+        ["queued", "prefill", "decode", "done"]
+    spans = {s["name"]: s for s in lifecycle_tracer.snapshot()
+             if s["track"] == "req:plain"}
+    assert spans["prefill"]["attrs"]["bucket"] == eng.bucket_for(5)
+    assert spans["done"]["attrs"]["reason"] == "length"
+    # sequential, non-overlapping phases
+    order = [spans[n] for n in ("queued", "prefill", "decode")]
+    for a, b in zip(order, order[1:]):
+        assert b["ts"] >= a["ts"] + a["dur"] - 1e-6
+    # the engine lane recorded one span per decode step
+    steps = [s for s in lifecycle_tracer.snapshot()
+             if s["track"] == "engine" and s["name"] == "decode_step"]
+    assert len(steps) == eng.n_decode_steps
+    # span-vs-stats reconciliation: the decode span covers every decode
+    # step this (only) request was live for
+    assert spans["decode"]["dur"] >= sum(s["dur"] for s in steps) - 1e-6
+
+    # -- overcommitted pool: preempt + replay phases ---------------------
+    lifecycle_tracer.clear()
+    eng2 = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                         max_context=16, num_pages=6)
+    rng = np.random.default_rng(1)
+    reqs = [Request(f"r{i}", rng.integers(2, 31, 8), max_new=8)
+            for i in range(2)]
+    out = eng2.run(reqs)
+    assert eng2.n_preemptions > 0, "pool was never overcommitted"
+    assert set(out) == {"r0", "r1"}
+    preempted = [s["track"][4:] for s in lifecycle_tracer.snapshot()
+                 if s["name"] == "preempt"]
+    assert preempted, "no preempt instant recorded"
+    survivors = {"r0", "r1"} - set(preempted)
+    for rid in survivors:
+        assert _phases(lifecycle_tracer, rid) == \
+            ["queued", "prefill", "decode", "done"]
+    for rid in set(preempted):
+        ph = _phases(lifecycle_tracer, rid)
+        # one preempt cycle: the oracle-implied shape is
+        #   queued prefill decode (preempt queued prefill replay)+ ... done
+        assert ph[:4] == ["queued", "prefill", "decode", "preempt"]
+        assert "replay" in ph, f"preempted {rid} never traced a replay: {ph}"
+        assert ph[-1] == "done"
+        i = ph.index("replay")
+        assert ph[i - 2:i] == ["queued", "prefill"], ph
+        # replay happened strictly after the preempt marker
+        assert i > ph.index("preempt")
+
+
+def test_cancel_and_deadline_terminal_phases(lifecycle_tracer):
+    """Aborted requests close their open phase and mark the right
+    terminal event: cancelled (client abort while decoding) and deadline
+    (expired while queued — no slot ever held)."""
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.serving import Request, ServingEngine
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    tr = Trainer(cfg, seed=3)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=1, page_size=8,
+                        max_context=64)
+    eng.clock = lambda: float(eng.n_decode_steps)
+    eng.add_request(Request("work", [3, 4, 5], max_new=30))
+    # expires while QUEUED: the single slot is busy with "work"
+    eng.add_request(Request("late", [4, 5], max_new=30, deadline=2.0))
+    for _ in range(4):
+        eng.step()
+    eng.cancel("work")
+    ph_w = _phases(lifecycle_tracer, "work")
+    assert ph_w == ["queued", "prefill", "decode", "cancelled"]
+    ph_l = _phases(lifecycle_tracer, "late")
+    assert ph_l == ["queued", "deadline"]
+
+
+# ---------------------------------------------------------------------------
+# trainer metrics.jsonl sink
+# ---------------------------------------------------------------------------
+
+def test_trainer_metrics_jsonl_sink(tmp_path):
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.parameter.argument import Argument
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg_src = (
+        "from paddle_tpu.dsl import *\n"
+        "settings(batch_size=8, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "out = fc_layer(input=x, size=2, act=SoftmaxActivation(), "
+        "name='out')\n"
+        "classification_cost(input=out, label=data_layer(name='y', "
+        "size=2))\n")
+    cfg_file = tmp_path / "cfg.py"
+    cfg_file.write_text(cfg_src)
+    tr = Trainer(parse_config(str(cfg_file), ""), seed=0)
+    rng = np.random.default_rng(0)
+
+    def batches():
+        for _ in range(3):
+            x = rng.normal(size=(8, 4)).astype(np.float32)
+            yield {"x": Argument(value=x),
+                   "y": Argument(ids=(x.sum(-1) > 0).astype(np.int32))}
+
+    stats = tr.train_one_pass(batches=batches())
+    path = tr.append_metrics(str(tmp_path / "run"), extra=stats)
+    assert path.endswith("metrics.jsonl")
+    with open(path) as f:
+        recs = [json.loads(ln) for ln in f]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["pass_id"] == 1 and "ts" in rec
+    assert rec["cost"] == pytest.approx(stats["cost"])
+    m = rec["metrics"]
+    assert m["trainer_pass_id"] == 1.0
+    assert m["trainer_batches_total"] == 3.0
+    assert m["trainer_samples_total"] == 24.0
+    # the global StatSet host phases flowed through the collector
+    assert any(k.startswith('trainer_host_phase_count{phase="trainOneBatch"')
+               for k in m), sorted(m)[:8]
+    # appends accumulate (one line per pass)
+    tr.append_metrics(str(tmp_path / "run"))
+    with open(path) as f:
+        assert len(f.readlines()) == 2

@@ -194,6 +194,100 @@ def test_stats_rpc_reports_occupancy_and_latency(tiny_tr):
         srv.stop_background(drain=True)
 
 
+def test_metrics_frame_and_consistent_stats_over_tcp(tiny_tr):
+    """ISSUE 5: the Prometheus-style `metrics` frame over TCP loopback,
+    plus the reworked stats snapshot — the default path builds the engine
+    half on the PUMP thread (consistent), `stale_ok` answers from the
+    loop thread immediately, and both carry the watchdog fields."""
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            c.generate([3, 4, 5], max_new=4)
+            text = c.metrics()
+            # exposition-format spot checks against documented names
+            assert "# TYPE serving_queue_depth gauge" in text
+            assert "# TYPE serving_tokens_generated_total counter" in text
+            assert "pump_alive 1" in text
+            vals = {}
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    key, v = line.rsplit(" ", 1)
+                    vals[key] = float(v)
+            assert vals["serving_tokens_generated_total"] >= 4.0
+            assert vals["serving_requests_accepted_total"] == 1.0
+            assert vals["serving_num_slots"] == 2.0
+            assert 0.0 <= vals["pump_last_step_age_s"] < 60.0
+            assert vals['serving_latency_seconds'
+                        '{quantile="p50",stat="request_latency"}'] > 0.0
+            assert vals['serving_latency_count'
+                        '{stat="first_token_latency"}'] == 1.0
+            # consistent (pump round-trip) vs stale_ok (loop fast path)
+            s = c.stats()
+            assert s["consistent"] is True and s["pump_alive"] is True
+            assert s["queue_depth"] == 0 and s["slots_in_use"] == 0
+            s2 = c.stats(stale_ok=True)
+            assert s2["consistent"] is False
+            assert s2["tokens_generated"] == s["tokens_generated"]
+            assert s2["pump_last_step_age_s"] >= 0.0
+        # docs lint lockstep: every name the frame rendered is catalogued
+        from paddle_tpu.obs import CATALOG
+        for key in vals:
+            base = key.split("{", 1)[0]
+            assert base in CATALOG, f"{base} rendered but not in CATALOG"
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_stats_stale_ok_works_with_pump_off(tiny_tr):
+    """The watchdog path must answer when the pump never started — and
+    the DEFAULT path must fall back rather than hang forever."""
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background(start_pump=False)
+    try:
+        with ServingClient(host, port) as c:
+            s = c.stats(stale_ok=True)
+            assert s["consistent"] is False and s["pump_alive"] is False
+            assert s["pump_last_step_age_s"] == -1.0
+            s = c.stats()                      # no pump -> stale fallback
+            assert s["consistent"] is False
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_stats_queued_behind_stop_is_still_answered(tiny_tr):
+    """A consistent-stats command already sitting in the command queue
+    when the pump pops "stop" must be answered, not orphaned — the
+    pump's stop-drain replies (consistently: it runs between steps on
+    the pump thread) instead of leaving the client blocked until its
+    socket times out."""
+    import socket
+
+    from paddle_tpu.serving import wire
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    sock = socket.create_connection((host, port))
+    sock.settimeout(30)
+    try:
+        deadline = time.time() + 10
+        while not srv._conns and time.time() < deadline:
+            time.sleep(0.01)
+        conn = next(iter(srv._conns))
+        # deterministic ordering: the stats round trip lands BEHIND stop
+        srv._cmds.put(("stop",))
+        srv._cmds.put(("stats", conn))
+        srv._wake.set()
+        msg = wire.read_frame_sync(sock)
+        assert msg["type"] == "stats" and msg["consistent"] is True
+    finally:
+        sock.close()
+        srv.stop_background(drain=True)
+
+
 def test_overload_returns_backpressure_not_unbounded_queue(tiny_tr):
     """Admission cap = num_slots + max_queue accepted-but-unfinished
     requests; one more gets an explicit overload frame.  The pump is held
@@ -359,6 +453,8 @@ def test_pump_death_fails_pending_and_refuses_new(tiny_tr):
         rid2 = c.submit([3, 4], max_new=4)
         with pytest.raises(ServerError, match="pump died"):
             c.collect([rid2])          # new work refused up front
+        s = c.stats()                  # dead pump: stale fallback, no hang
+        assert s["consistent"] is False and s["pump_alive"] is False
     with pytest.raises(RuntimeError, match="engine pump died"):
         srv.stop_background(drain=True)
 

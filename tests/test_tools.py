@@ -58,6 +58,32 @@ def test_hlo_gather_detector_anchors_to_shapes():
     assert gather_spans_table(grouped, tables)
 
 
+def test_check_metrics_names_lint(tmp_path):
+    """ISSUE 5 tier-1 lint: obs.metrics.CATALOG and docs/observability.md
+    must agree both ways — plus the drift detectors actually detect."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.check_metrics_names import SECTION, check, doc_metric_names, main
+
+    assert main() == 0, "CATALOG vs docs/observability.md drifted"
+
+    # drift detection: a doc with one bogus row and none of the real names
+    fake = tmp_path / "observability.md"
+    fake.write_text(f"# x\n\n{SECTION}\n\n| Metric | Kind |\n|---|---|\n"
+                    f"| `made_up_metric` | gauge |\n")
+    undocumented, stale = check(str(fake))
+    assert stale == {"made_up_metric"}
+    assert "serving_queue_depth" in undocumented
+
+    # a doc without the anchor section is a loud error, not a silent pass
+    nosec = tmp_path / "empty.md"
+    nosec.write_text("# nothing here\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="Metric reference"):
+        doc_metric_names(str(nosec))
+
+
 def test_merge_model_roundtrip(tmp_path):
     import jax
 

@@ -1,0 +1,83 @@
+"""Lint: obs.metrics.CATALOG and docs/observability.md must agree.
+
+The metric catalog (paddle_tpu/obs/metrics.py CATALOG) is the single
+source of truth for every metric name this repo emits — the strict
+registries (serving server, trainer) refuse names outside it at runtime,
+so any metric that actually renders is catalogued.  This lint closes the
+other half of the loop against the documentation:
+
+  * every CATALOG name must appear as a `` `name` `` row in the
+    "## Metric reference" section of docs/observability.md (a metric
+    cannot ship undocumented);
+  * every metric row in that section must name a CATALOG entry (the doc
+    cannot advertise metrics the code no longer emits).
+
+Wired as a tier-1 test in tests/test_tools.py.  Exit 0 = in sync,
+1 = drift (both directions printed), 2 = doc/section missing.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.obs.metrics import CATALOG  # noqa: E402
+
+DOC = os.path.join(REPO, "docs", "observability.md")
+SECTION = "## Metric reference"
+#: a metric row: a table line whose FIRST cell is a backticked name
+_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`")
+
+
+def doc_metric_names(doc_path: str = DOC) -> set[str]:
+    """Names documented in the metric-reference tables of the doc."""
+    with open(doc_path) as f:
+        text = f.read()
+    if SECTION not in text:
+        raise ValueError(f"{doc_path} has no '{SECTION}' section — the "
+                         f"lint anchors to it")
+    section = text.split(SECTION, 1)[1]
+    # the section runs to the next same-level heading (or EOF)
+    section = re.split(r"\n## ", section, maxsplit=1)[0]
+    names = set()
+    for line in section.splitlines():
+        m = _ROW.match(line.strip())
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def check(doc_path: str = DOC) -> tuple[set, set]:
+    """(undocumented, stale) name sets — both empty when in sync."""
+    documented = doc_metric_names(doc_path)
+    code = set(CATALOG)
+    return code - documented, documented - code
+
+
+def main(argv=None) -> int:
+    try:
+        undocumented, stale = check()
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    ok = True
+    for name in sorted(undocumented):
+        ok = False
+        print(f"UNDOCUMENTED: {name!r} is in obs.metrics.CATALOG but has "
+              f"no row in {DOC} '{SECTION}'")
+    for name in sorted(stale):
+        ok = False
+        print(f"STALE DOC: {DOC} documents {name!r} but it is not in "
+              f"obs.metrics.CATALOG")
+    if ok:
+        print(f"ok: {len(CATALOG)} metric names in sync with "
+              f"docs/observability.md")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,13 @@ Client one-shot (no jax needed beyond the shared package import):
   python tools/serve.py --client 127.0.0.1:8431 --prompt 2,7,9 \
       --max-new 16 --stream
   python tools/serve.py --client 127.0.0.1:8431 --stats
+  python tools/serve.py --client 127.0.0.1:8431 --metrics   # Prometheus text
+
+Request-lifecycle tracing: `--trace-out spans.jsonl` enables the span
+tracer for the server's lifetime and writes the retained spans (bounded
+ring) as JSONL on drain; `python tools/trace_dump.py spans.jsonl -o
+trace.json` converts to Perfetto-loadable Chrome trace_event JSON.  See
+docs/observability.md.
 """
 
 from __future__ import annotations
@@ -37,8 +44,11 @@ def run_client(args) -> int:
 
     host, _, port = args.client.rpartition(":")
     with ServingClient(host or "127.0.0.1", int(port)) as c:
+        if args.metrics:
+            print(c.metrics(), end="")
+            return 0
         if args.stats:
-            print(json.dumps(c.stats(), indent=2))
+            print(json.dumps(c.stats(stale_ok=args.stale_ok), indent=2))
             return 0
         prompt = [int(t) for t in str(args.prompt).split(",") if t != ""]
         if not prompt:
@@ -79,6 +89,12 @@ def build_engine(args):
 async def amain(args) -> int:
     from paddle_tpu.serving.server import ServingServer
 
+    tracer = None
+    if args.trace_out:
+        from paddle_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        tracer.enabled = True
     engine = build_engine(args)
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_queue=args.max_queue)
@@ -94,6 +110,11 @@ async def amain(args) -> int:
     print("draining: refusing new requests, finishing in-flight...",
           file=sys.stderr, flush=True)
     await srv.drain()
+    if tracer is not None:
+        n = tracer.export_jsonl(args.trace_out)
+        print(f"wrote {n} spans to {args.trace_out} "
+              f"({tracer.dropped} dropped by ring wrap); convert with "
+              f"tools/trace_dump.py", file=sys.stderr, flush=True)
     print("drained; bye", file=sys.stderr, flush=True)
     return 0
 
@@ -131,6 +152,18 @@ def main(argv=None) -> int:
                     help="print token frames as they arrive")
     ap.add_argument("--stats", action="store_true",
                     help="with --client: print the stats RPC and exit")
+    ap.add_argument("--stale-ok", action="store_true",
+                    help="with --stats: loop-thread fast path that never "
+                         "waits on the engine pump (the watchdog poll — "
+                         "works against a wedged engine)")
+    ap.add_argument("--metrics", action="store_true",
+                    help="with --client: print the Prometheus-style "
+                         "metrics frame and exit")
+    # server-side tracing
+    ap.add_argument("--trace-out", default="",
+                    help="enable request-lifecycle tracing; write spans "
+                         "as JSONL here on drain (tools/trace_dump.py "
+                         "converts to Perfetto-loadable Chrome JSON)")
     args = ap.parse_args(argv)
 
     if args.client:

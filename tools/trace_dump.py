@@ -1,0 +1,106 @@
+"""Convert a span-tracer JSONL dump to Chrome trace_event JSON.
+
+The span tracer (paddle_tpu/obs/trace.py) archives spans as JSON-lines —
+one span per line: {"seq", "name", "track", "ts", "dur", "attrs"?,
+"instant"?}.  This tool turns that into the Chrome trace_event format
+that Perfetto (https://ui.perfetto.dev) and chrome://tracing load
+directly: every track becomes a named thread lane, complete spans render
+as bars, instants (preempt/done/cancelled/deadline) as markers.
+
+  # server side: record a serving run's request lifecycles
+  python tools/serve.py ... --trace-out spans.jsonl     # drain writes it
+  # convert + eyeball
+  python tools/trace_dump.py spans.jsonl -o trace.json
+  python tools/trace_dump.py spans.jsonl --summary      # per-name table
+
+Exit codes: 0 ok, 2 on unreadable/empty input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.obs.trace import spans_to_chrome  # noqa: E402
+
+
+def load_spans(path: str) -> list[dict]:
+    """Read a JSONL span file; skips blank lines, raises on garbage."""
+    spans = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: not JSON: {e}") from e
+            if not isinstance(rec, dict) or "name" not in rec \
+                    or "ts" not in rec:
+                raise ValueError(f"{path}:{i}: not a span record "
+                                 f"(need name/ts fields): {rec!r}")
+            if not rec.get("instant") and "dur" not in rec:
+                raise ValueError(f"{path}:{i}: complete span without a "
+                                 f"dur field: {rec!r}")
+            spans.append(rec)
+    return spans
+
+
+def summarize(spans: list[dict]) -> str:
+    """Per-name span table: count, total duration, max — the quick look
+    before opening Perfetto."""
+    agg: dict[str, list] = {}
+    for s in spans:
+        a = agg.setdefault(s["name"], [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += float(s.get("dur", 0.0))
+        a[2] = max(a[2], float(s.get("dur", 0.0)))
+    lines = [f"{'span':<16} {'count':>7} {'total_ms':>10} {'max_ms':>9}"]
+    for name in sorted(agg, key=lambda n: -agg[n][1]):
+        c, tot, mx = agg[name]
+        lines.append(f"{name:<16} {c:>7} {tot * 1e3:>10.2f} {mx * 1e3:>9.2f}")
+    tracks = sorted({s.get("track", "main") for s in spans})
+    lines.append(f"{len(spans)} spans on {len(tracks)} tracks")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="span JSONL (tools/serve.py --trace-out, "
+                                  "or Tracer.export_jsonl)")
+    ap.add_argument("-o", "--out", default="",
+                    help="write Chrome trace_event JSON here "
+                         "(default: <input>.trace.json)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print a per-span-name table instead of writing")
+    args = ap.parse_args(argv)
+
+    try:
+        spans = load_spans(args.jsonl)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not spans:
+        print(f"error: {args.jsonl} holds no spans (tracing never "
+              f"enabled, or the ring was cleared)", file=sys.stderr)
+        return 2
+
+    if args.summary:
+        print(summarize(spans))
+        return 0
+
+    out = args.out or args.jsonl + ".trace.json"
+    with open(out, "w") as f:
+        json.dump(spans_to_chrome(spans), f)
+    print(f"wrote {out}: {len(spans)} spans — load in "
+          f"https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
