@@ -203,30 +203,46 @@ def lm_generate(
         return buf0, prompt_lengths
     keys = jax.random.split(rng, max_new)
 
+    # compile observability: the batch decode compiles per
+    # (B, P, max_new, path, knob) tuple — the first call with a new tuple
+    # records a compile event on the `compile` lane (obs/compile_watch.py),
+    # so a caller churning shapes shows up as a recompile storm instead of
+    # a silent slowdown.  id(executor) scopes the key per model instance.
+    from paddle_tpu.obs.compile_watch import get_compile_watch
+    _cw = get_compile_watch().watch(
+        "lm_decode.generate",
+        (id(executor), B, P, int(max_new), bool(use_cache),
+         int(early_exit_chunk), float(temperature), int(top_k),
+         float(top_p), int(eos_id)))
+
     if use_cache:
         # O(total) per token: prefill the per-layer KV caches on the padded
         # prompt once, then each step runs the stack on ONE new token per
         # row, threading the caches through the executor's state channel
-        state, last = _prefill(executor, params, input_name, logits_name,
-                               prompt_ids, prompt_lengths, total)
-        nxt = sample(last, keys[0])
-        buf, lengths, done = advance(buf0, prompt_lengths,
-                                     jnp.zeros((B,), bool), nxt)
+        with _cw:
+            state, last = _prefill(executor, params, input_name,
+                                   logits_name, prompt_ids, prompt_lengths,
+                                   total)
+            nxt = sample(last, keys[0])
+            buf, lengths, done = advance(buf0, prompt_lengths,
+                                         jnp.zeros((B,), bool), nxt)
 
-        def step_cached(carry, key):
-            buf, lengths, done, state = carry
-            tok = buf[jnp.arange(B), jnp.clip(lengths - 1, 0, total - 1)]
-            feed = {input_name: Argument(ids=tok[:, None],
-                                         lengths=jnp.ones((B,), jnp.int32))}
-            outputs, _, state = executor.forward(params, feed, state, TEST,
-                                                 None)
-            nxt = sample(outputs[logits_name].value[:, 0, :], key)
-            buf, lengths, done = advance(buf, lengths, done, nxt)
-            return (buf, lengths, done, state), None
+            def step_cached(carry, key):
+                buf, lengths, done, state = carry
+                tok = buf[jnp.arange(B),
+                          jnp.clip(lengths - 1, 0, total - 1)]
+                feed = {input_name: Argument(ids=tok[:, None],
+                                             lengths=jnp.ones((B,),
+                                                              jnp.int32))}
+                outputs, _, state = executor.forward(params, feed, state,
+                                                     TEST, None)
+                nxt = sample(outputs[logits_name].value[:, 0, :], key)
+                buf, lengths, done = advance(buf, lengths, done, nxt)
+                return (buf, lengths, done, state), None
 
-        buf, lengths, _, _ = _chunked_scan(
-            step_cached, (buf, lengths, done, state), keys[1:],
-            early_exit_chunk, done_of=lambda c: c[2])
+            buf, lengths, _, _ = _chunked_scan(
+                step_cached, (buf, lengths, done, state), keys[1:],
+                early_exit_chunk, done_of=lambda c: c[2])
         return buf, lengths
 
     def step(carry, key):
@@ -239,9 +255,10 @@ def lm_generate(
         nxt = sample(last, key)
         return advance(buf, lengths, done, nxt), None
 
-    buf, lengths, _ = _chunked_scan(
-        step, (buf0, prompt_lengths, jnp.zeros((B,), bool)), keys,
-        early_exit_chunk, done_of=lambda c: c[2])
+    with _cw:
+        buf, lengths, _ = _chunked_scan(
+            step, (buf0, prompt_lengths, jnp.zeros((B,), bool)), keys,
+            early_exit_chunk, done_of=lambda c: c[2])
     return buf, lengths
 
 

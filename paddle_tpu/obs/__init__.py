@@ -17,11 +17,27 @@ dependency-light serving client path without pulling in jax):
   * the pump heartbeat watchdog lives with its thread in
     `serving/server.py` and exports through this registry
     (`pump_last_step_age_s`, `pump_alive`).
+  * `obs.compile_watch` — per-signature jit compile events on a `compile`
+    tracer lane with a recompile-storm detector (`get_compile_watch()`,
+    always on — compiles are rare).
+  * `obs.hbm` — device-memory accounting (KV pool / param / live-array
+    bytes plus the backend's own stats, CPU-safe).
+  * `obs.flight` — the flight recorder: a bounded structured-event ring
+    that dumps atomic postmortem bundles on pump death / watchdog wedge /
+    an operator `dump` RPC (`get_flight_recorder()`;
+    `tools/postmortem.py` pretty-prints a bundle).
 
-See docs/observability.md for the span model, metric reference, and the
-trace_dump workflow.
+See docs/observability.md for the span model, metric reference, the
+trace_dump workflow, and the postmortem-bundle format.
 """
 
+from paddle_tpu.obs.compile_watch import (CompileWatch,  # noqa: F401
+                                          compile_collector,
+                                          get_compile_watch)
+from paddle_tpu.obs.flight import (FlightRecorder,  # noqa: F401
+                                   flight_collector, get_flight_recorder,
+                                   load_bundle)
+from paddle_tpu.obs.hbm import hbm_collector, hbm_snapshot  # noqa: F401
 from paddle_tpu.obs.metrics import (CATALOG, Counter,  # noqa: F401
                                     Gauge, Histogram, MetricsRegistry,
                                     barrier_collector, statset_collector,
@@ -31,4 +47,7 @@ from paddle_tpu.obs.trace import (Tracer, get_tracer,  # noqa: F401
 
 __all__ = ["Tracer", "get_tracer", "spans_to_chrome", "MetricsRegistry",
            "Counter", "Gauge", "Histogram", "CATALOG", "statset_collector",
-           "barrier_collector", "tracer_collector"]
+           "barrier_collector", "tracer_collector", "CompileWatch",
+           "get_compile_watch", "compile_collector", "FlightRecorder",
+           "get_flight_recorder", "flight_collector", "load_bundle",
+           "hbm_collector", "hbm_snapshot"]

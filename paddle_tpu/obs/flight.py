@@ -1,0 +1,251 @@
+"""Flight recorder: bounded structured-event ring + atomic postmortem bundles.
+
+PR 5's telemetry (spans, metrics frame, watchdog) is all in-memory — when a
+replica actually dies or wedges, everything dies with it and the operator
+gets a stack trace at best.  The flight recorder is the black box: a small
+BOUNDED ring of structured events (engine state transitions, admission and
+overload decisions, compile events, sampled watchdog beats) that costs
+nothing on the token hot path (events are per-request, per-compile, and
+per-second — never per-token), and a `dump()` that freezes everything an
+operator needs into one atomic on-disk **postmortem bundle**:
+
+    <dir>/postmortem-<utc-ts>-<pid>/
+        meta.json      reason, timestamps, pid/host, component versions
+        events.jsonl   the flight-recorder ring, oldest first
+        spans.jsonl    the span tracer's retained ring (obs/trace.py shape —
+                       tools/trace_dump.py loads it directly)
+        engine.json    serving snapshot: slots, page occupancy, queued ids
+        metrics.json   flat registry snapshot (obs/metrics.py shape)
+        config.json    the serving configuration that produced the crash
+
+The bundle directory is staged under a `.tmp` suffix and committed with one
+`os.replace`, mirroring the trainer's atomic checkpoints — a crash mid-dump
+leaves a visible `.tmp` straggler, never a half-readable bundle.
+`tools/postmortem.py` pretty-prints one; `load_bundle()` is the programmatic
+reader both it and the tests use.
+
+The serving front end (serving/server.py) triggers dumps on pump death,
+on the watchdog-wedge threshold, and on an operator `dump` RPC frame; the
+engine and server record lifecycle events whenever `enabled` is on.  Like
+the tracer, this module is stdlib-only (client-side tools import it
+without jax).
+
+Threading: events arrive from the pump thread AND the asyncio loop thread
+(accept/overload vs admit/preempt), so `record` takes a lock — acceptable
+because events are orders of magnitude rarer than tokens.  `dump()` may run
+on any thread; it reads rings via their snapshot paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Optional
+
+#: bundle directory prefix — tests and tools key off it
+BUNDLE_PREFIX = "postmortem-"
+
+#: files every bundle carries (engine/config may hold {} for non-serving
+#: dumps, but the file is always present so readers never stat-and-branch)
+BUNDLE_FILES = ("meta.json", "events.jsonl", "spans.jsonl", "engine.json",
+                "metrics.json", "config.json")
+
+
+class FlightRecorder:
+    """Bounded ring of structured events; off until `enabled` is set."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = int(capacity)
+        assert self.capacity > 0
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._dump_lock = threading.Lock()   # serializes whole bundles
+        self._ring: list = []          # grows to capacity, then wraps
+        self._n = 0                    # events ever recorded (monotonic)
+        self.bundles_written = 0
+        self.last_bundle_path: Optional[str] = None
+
+    # -- recording (any thread) -------------------------------------------
+    def record(self, kind: str, **data) -> None:
+        """Append one event.  `data` must be JSON-serializable; keep it
+        small (ids and counts, not payloads)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = (self._n, time.time(), kind, data or None)
+            if len(self._ring) < self.capacity:
+                self._ring.append(rec)
+            else:
+                self._ring[self._n % self.capacity] = rec
+            self._n += 1
+
+    # -- reading -----------------------------------------------------------
+    @property
+    def recorded(self) -> int:
+        return self._n
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._n = 0
+
+    def snapshot(self) -> list[dict]:
+        """Retained events, oldest first, as dicts (the events.jsonl
+        record shape)."""
+        with self._lock:
+            recs = sorted(self._ring)
+        return [{"seq": r[0], "ts": r[1], "kind": r[2],
+                 **({"data": r[3]} if r[3] else {})} for r in recs]
+
+    # -- the postmortem bundle --------------------------------------------
+    def dump(self, out_dir: str, reason: str, *, spans=None, engine=None,
+             metrics=None, config=None, error: Optional[str] = None) -> str:
+        """Write one atomic postmortem bundle under `out_dir`; returns the
+        committed bundle path.  Never raises into a dying caller's frame
+        for snapshot problems — a part that fails to serialize is replaced
+        by an {"snapshot_error": ...} stub (the bundle must outlive the
+        bug it documents); only out_dir-level I/O errors propagate.
+
+        Serialized: concurrent dumps (a pump-death dump racing an
+        operator `dump` RPC from the loop thread) each commit their OWN
+        complete bundle instead of interleaving writes into a shared
+        same-second staging dir."""
+        with self._dump_lock:
+            return self._dump_locked(out_dir, reason, spans=spans,
+                                     engine=engine, metrics=metrics,
+                                     config=config, error=error)
+
+    def _dump_locked(self, out_dir: str, reason: str, *, spans=None,
+                     engine=None, metrics=None, config=None,
+                     error: Optional[str] = None) -> str:
+        ts = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        base = os.path.join(out_dir, f"{BUNDLE_PREFIX}{ts}-{os.getpid()}")
+        final = base
+        n = 0
+        # same-second re-dump: probe the .tmp path too, so a straggler
+        # from a crashed earlier dump is never reused as our staging dir
+        while os.path.exists(final) or os.path.exists(final + ".tmp"):
+            n += 1
+            final = f"{base}.{n}"
+        tmp = final + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+
+        def _write_json(name, obj):
+            with open(os.path.join(tmp, name), "w") as f:
+                try:
+                    json.dump(obj, f, indent=2, default=str)
+                except (TypeError, ValueError) as e:
+                    f.seek(0)
+                    f.truncate()
+                    json.dump({"snapshot_error": f"{type(e).__name__}: {e}"},
+                              f)
+
+        def _write_jsonl(name, records):
+            with open(os.path.join(tmp, name), "w") as f:
+                for rec in records:
+                    try:
+                        f.write(json.dumps(rec, separators=(",", ":"),
+                                           default=str) + "\n")
+                    except (TypeError, ValueError):
+                        continue
+
+        meta = {
+            "reason": reason,
+            "ts": time.time(),
+            "ts_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "events_recorded": self.recorded,
+            "events_dropped": self.dropped,
+            "versions": _versions(),
+        }
+        if error:
+            meta["error"] = error
+        _write_json("meta.json", meta)
+        _write_jsonl("events.jsonl", self.snapshot())
+        _write_jsonl("spans.jsonl", _safe(lambda: spans or [], []))
+        _write_json("engine.json", _safe(lambda: engine or {}, {}))
+        _write_json("metrics.json", _safe(lambda: metrics or {}, {}))
+        _write_json("config.json", _safe(lambda: config or {}, {}))
+        os.replace(tmp, final)             # commit: rename is the txn
+        self.bundles_written += 1
+        self.last_bundle_path = final
+        return final
+
+
+def _safe(fn, fallback):
+    try:
+        return fn()
+    except Exception as e:                 # noqa: BLE001 — see dump()
+        return {"snapshot_error": f"{type(e).__name__}: {e}"} \
+            if isinstance(fallback, dict) else fallback
+
+
+def _versions() -> dict:
+    out = {"python": sys.version.split()[0]}
+    for mod in ("jax", "jaxlib", "numpy"):
+        try:
+            out[mod] = __import__(mod).__version__
+        except Exception:                  # noqa: BLE001 — absent is fine
+            pass
+    return out
+
+
+def load_bundle(path: str) -> dict:
+    """Read a committed bundle back: {"path", "meta", "events", "spans",
+    "engine", "metrics", "config"}.  Raises ValueError on a directory that
+    is not a complete bundle (e.g. a crashed dump's `.tmp` straggler)."""
+    if not os.path.isdir(path):
+        raise ValueError(f"{path}: not a bundle directory")
+    missing = [f for f in BUNDLE_FILES
+               if not os.path.exists(os.path.join(path, f))]
+    if missing:
+        raise ValueError(f"{path}: incomplete bundle, missing {missing} "
+                         f"(a .tmp straggler from a crashed dump?)")
+    out = {"path": path}
+    for name in ("meta", "engine", "metrics", "config"):
+        with open(os.path.join(path, name + ".json")) as f:
+            out[name] = json.load(f)
+    for name in ("events", "spans"):
+        recs = []
+        with open(os.path.join(path, name + ".jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+        out[name] = recs
+    return out
+
+
+def flight_collector(recorder: "FlightRecorder"):
+    """obs.metrics collector: ring accounting + bundles written."""
+
+    def collect():
+        return [
+            ("flight_events_recorded_total", "counter", None,
+             float(recorder.recorded)),
+            ("flight_events_dropped_total", "counter", None,
+             float(recorder.dropped)),
+            ("postmortem_bundles_total", "counter", None,
+             float(recorder.bundles_written)),
+        ]
+
+    return collect
+
+
+#: the process-global recorder every subsystem records into — the serving
+#: engine and front end share it so one bundle holds the whole story.  Off
+#: until a ServingServer (or a test/tool) flips `.enabled`.
+_recorder = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _recorder

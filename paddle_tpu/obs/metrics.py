@@ -79,6 +79,30 @@ CATALOG: dict[str, str] = {
     # -- tracer ------------------------------------------------------------
     "trace_spans_recorded_total": "spans recorded since enable (incl. wrapped)",
     "trace_spans_dropped_total": "spans overwritten by ring wrap-around",
+    # -- compile observability (obs/compile_watch.py) ----------------------
+    "jit_compiles_total":
+        "jit compiles observed per instrumented entry point (label: site)",
+    "jit_compile_seconds":
+        "accumulated compile+first-run wall seconds per site (label: site)",
+    "jit_signatures":
+        "distinct compiled signatures seen per site (label: site)",
+    "jit_recompile_storms_total":
+        "recompile-storm warnings fired per site (label: site)",
+    # -- device-memory accounting (obs/hbm.py) -----------------------------
+    "hbm_bytes_in_use":
+        "device-reported bytes in use (absent when the backend, e.g. CPU, "
+        "does not report)",
+    "hbm_bytes_limit": "device-reported memory limit (absent on CPU)",
+    "hbm_live_array_bytes": "total nbytes over jax.live_arrays()",
+    "hbm_live_arrays": "count of live device arrays",
+    "hbm_param_bytes": "bytes held by the model parameter pytree",
+    "hbm_kv_pool_bytes": "bytes held by the paged KV cache pools",
+    # -- flight recorder (obs/flight.py) -----------------------------------
+    "flight_events_recorded_total":
+        "flight-recorder events recorded (incl. wrapped)",
+    "flight_events_dropped_total":
+        "flight-recorder events overwritten by ring wrap-around",
+    "postmortem_bundles_total": "postmortem bundles written by this process",
 }
 
 

@@ -179,6 +179,26 @@ class ServingClient:
         self.send({"type": "metrics"})
         return self._route(lambda m: m.get("type") == "metrics")["text"]
 
+    def dump(self) -> dict:
+        """Ask the server to freeze a postmortem bundle NOW (answered on
+        the loop thread — works against a wedged or dead engine pump).
+        Returns {"path", "events", "spans"}; raises ServerError when the
+        server has no postmortem directory configured.  Pretty-print the
+        bundle with `python tools/postmortem.py <path>`."""
+        # the dump gets its own id (the server echoes it on both reply
+        # types): matching bare `error` frames would steal another
+        # request's terminal error on a multiplexed connection — e.g. a
+        # generate failed by a dying pump, exactly the scenario dump()
+        # is advertised for
+        rid = f"dump{self._next_id}"
+        self._next_id += 1
+        self.send({"type": "dump", "id": rid})
+        msg = self._route(lambda m: m.get("type") in ("dump", "error")
+                          and m.get("id") == rid)
+        if msg["type"] == "error":
+            raise ServerError(msg.get("error", "dump failed"))
+        return {k: msg[k] for k in ("path", "events", "spans") if k in msg}
+
     def ping(self) -> bool:
         self.send({"type": "ping"})
         self._route(lambda m: m.get("type") == "pong")

@@ -42,6 +42,8 @@ from paddle_tpu.data.feeder import _bucket_len
 from paddle_tpu.graph.context import TEST
 from paddle_tpu.graph.lm_decode import (_is_probs, _resolve_io_names,
                                         init_kv_caches, pick_next)
+from paddle_tpu.obs.compile_watch import get_compile_watch
+from paddle_tpu.obs.flight import get_flight_recorder
 from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.serving.paged_kv import PagedKVCache
@@ -160,6 +162,11 @@ class ServingEngine:
         self.tracer = get_tracer()
         self._obs_open: dict = {}   # req_id -> open span handle (one phase
                                     # open per request at any moment)
+        # black box (obs/flight.py): request-lifecycle transitions recorded
+        # when the front end (or a test) enables the process-global
+        # recorder — events are per-request, never per-token, so the
+        # disabled AND enabled costs both stay off the token hot path
+        self.flight = get_flight_recorder()
         self.n_decode_steps = 0
         self.n_preemptions = 0
         self.n_cancelled = 0
@@ -169,7 +176,12 @@ class ServingEngine:
         self._admit_seq = 0
         self._prefill_cache: dict[int, object] = {}
         self._pack_cache: dict[int, object] = {}
-        self._decode_step = jax.jit(self._decode_impl, donate_argnums=(1,))
+        # every engine jit reports to the compile watcher (obs/
+        # compile_watch.py): the decode step must stay at ONE signature,
+        # per-bucket prefill compiles feed the recompile-storm detector
+        self._decode_step = get_compile_watch().wrap_jit(
+            "serving.decode_step",
+            jax.jit(self._decode_impl, donate_argnums=(1,)))
 
     # -- lifecycle tracing helpers ----------------------------------------
     def _tr_on(self) -> bool:
@@ -234,6 +246,9 @@ class ServingEngine:
         self._tr_begin(req.req_id, "queued",
                        prompt_len=int(req.prompt_ids.size),
                        max_new=req.max_new)
+        self.flight.record("queued", req=str(req.req_id),
+                           prompt_len=int(req.prompt_ids.size),
+                           max_new=req.max_new)
         self.queue.append(req)
 
     def cancel(self, request_id, reason: str = "cancelled") -> bool:
@@ -462,6 +477,9 @@ class ServingEngine:
         sl = _Slot(req, keys, pos=p, first_tok=tok0,
                    admit_seq=self._admit_seq)
         self.slots[s] = sl
+        self.flight.record("admit", req=str(req.req_id), slot=s,
+                           bucket=Lb, prompt_len=p,
+                           pages=int(self.kv.pages_for(p)))
         stash = req._preempted_gen or []
         if stash:
             # tokens 0..len(stash)-1 re-emit deterministically — a replay
@@ -488,6 +506,9 @@ class ServingEngine:
             sl.req._preempted_gen = list(sl.generated)  # keeps the longer
         self.tokens_generated -= sl.gen       # the restart re-emits them
         self.n_preemptions += 1
+        self.flight.record("preempt", req=str(rid), slot=s,
+                           tokens=sl.gen,
+                           free_pages=int(self.kv.free_page_count))
         self.kv.release(s)
         self.slots[s] = None
 
@@ -509,6 +530,8 @@ class ServingEngine:
         self._tr_instant(req_id,
                          "done" if reason in ("stop", "length") else reason,
                          reason=reason, tokens=int(toks.size))
+        self.flight.record("finish", req=str(req_id), reason=reason,
+                           tokens=int(toks.size))
         self.results[req_id] = toks
         self.finish_reasons[req_id] = reason
         if self.on_finish is not None:
@@ -557,7 +580,8 @@ class ServingEngine:
                 return last, {name: (state[name]["k"], state[name]["v"])
                               for name in attn_layers}
 
-            fn = self._prefill_cache[Lb] = jax.jit(prefill)
+            fn = self._prefill_cache[Lb] = get_compile_watch().wrap_jit(
+                "serving.prefill", jax.jit(prefill))
         return fn
 
     def _pack_fn(self, Lb: int):
@@ -584,5 +608,6 @@ class ServingEngine:
                     }
                 return out
 
-            fn = self._pack_cache[Lb] = jax.jit(pack, donate_argnums=(0,))
+            fn = self._pack_cache[Lb] = get_compile_watch().wrap_jit(
+                "serving.pack", jax.jit(pack, donate_argnums=(0,)))
         return fn

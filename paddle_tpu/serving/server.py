@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import asyncio
 import queue
+import sys
 import threading
 import time
 from typing import Optional
@@ -60,6 +61,9 @@ import numpy as np
 
 from paddle_tpu.obs import (MetricsRegistry, statset_collector,
                             tracer_collector)
+from paddle_tpu.obs.compile_watch import compile_collector, get_compile_watch
+from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
+from paddle_tpu.obs.hbm import hbm_collector, hbm_snapshot
 from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.engine import Request, ServingEngine
@@ -129,12 +133,27 @@ class ServingServer:
     """
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0, max_queue: int = 32):
+                 port: int = 0, max_queue: int = 32,
+                 postmortem_dir: Optional[str] = None,
+                 wedge_threshold_s: float = 30.0):
         self.engine = engine
         self.host = host
         self.port = port
         self.max_inflight = len(engine.slots) + int(max_queue)
         self.stats = StatSet("serving_server")
+        # flight recorder (obs/flight.py): lifecycle events always record
+        # while a server exists (they are per-request, not per-token);
+        # postmortem BUNDLES are written only when a directory is
+        # configured — on pump death, on the watchdog-wedge threshold
+        # (pump_last_step_age_s > wedge_threshold_s), and on an operator
+        # `dump` frame.
+        self.flight = get_flight_recorder()
+        self.flight.enabled = True
+        self.postmortem_dir = postmortem_dir
+        self._last_dump_error = "unknown"
+        self.wedge_threshold_s = float(wedge_threshold_s)
+        self._wedge_dumped = False    # one bundle per wedge episode
+        self._last_beat_event = 0.0   # flight beats sampled at ~1/s
         self._inflight = 0            # accepted, not finished (loop thread)
         self._draining = False
         # pump heartbeat: (monotonic time, engine step count) written by
@@ -151,6 +170,8 @@ class ServingServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._idle: Optional[asyncio.Event] = None
         self._closed: Optional[asyncio.Event] = None
+        self._crashed: Optional[asyncio.Event] = None
+        self._watch_task = None       # the loop-side wedge watchdog
         self._bg_thread: Optional[threading.Thread] = None
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
@@ -205,6 +226,14 @@ class ServingServer:
         reg.register_collector(statset_collector(
             self.stats, "serving_latency_seconds", "serving_latency_count"))
         reg.register_collector(tracer_collector(get_tracer()))
+        # deep introspection: per-site jit compile counters (the recompile-
+        # storm fuel), device-memory accounting (KV pool / param / live-
+        # array bytes, CPU-safe), and flight-recorder ring accounting —
+        # all render-time reads, nothing on the token hot path
+        reg.register_collector(compile_collector())
+        reg.register_collector(hbm_collector(
+            params_fn=lambda: eng.params, kv_fn=lambda: eng.kv))
+        reg.register_collector(flight_collector(self.flight))
 
     def pump_last_step_age(self) -> float:
         """Seconds since the pump last completed a loop iteration; -1.0
@@ -224,12 +253,23 @@ class ServingServer:
         self._loop = asyncio.get_running_loop()
         self._idle = asyncio.Event()
         self._closed = asyncio.Event()
+        self._crashed = asyncio.Event()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # the wedge watchdog rides the LOOP thread (it must keep running
+        # while the pump is stuck inside step()): past the threshold it
+        # records a wedge event and freezes one postmortem bundle
+        self._watch_task = self._loop.create_task(self._wedge_watchdog())
         if start_pump:
             self.start_pump()
         return self.host, self.port
+
+    async def wait_crashed(self) -> None:
+        """Resolves when the engine pump dies (tools/serve.py races this
+        against its signal wait so a crashed server flushes its trace and
+        exits nonzero instead of idling forever)."""
+        await self._crashed.wait()
 
     def start_pump(self) -> None:
         """Start (or no-op if running) the engine pump thread.  Split from
@@ -276,6 +316,9 @@ class ServingServer:
             self.start_pump()
 
     async def _shutdown(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None
         if self._pump_thread is not None and self._pump_thread.is_alive():
             self._cmds.put(("stop",))
             self._wake.set()
@@ -351,8 +394,18 @@ class ServingServer:
                 # heartbeat FIRST: written once per loop iteration, so a
                 # wedge anywhere below (a hung compiled step, a stuck
                 # host sync) freezes it and pump_last_step_age_s grows
-                self._pump_beat = (time.monotonic(),
-                                   self.engine.n_decode_steps)
+                now = time.monotonic()
+                self._pump_beat = (now, self.engine.n_decode_steps)
+                if now - self._last_beat_event >= 1.0:
+                    # SAMPLED into the flight ring (~1/s): a postmortem
+                    # shows how recently, and at what step, the pump was
+                    # demonstrably alive — without beats evicting the
+                    # lifecycle events the ring exists for
+                    self._last_beat_event = now
+                    self.flight.record(
+                        "pump_beat", step=self.engine.n_decode_steps,
+                        queue_depth=len(self.engine.queue),
+                        inflight=self._inflight)
                 try:
                     while True:
                         cmd = self._cmds.get_nowait()
@@ -401,6 +454,16 @@ class ServingServer:
                     self._wake.clear()
         except BaseException as e:                     # noqa: BLE001
             self._pump_error = e
+            # the black-box moment: the pump thread is dying with the
+            # engine state frozen exactly as the failure left it — record
+            # the death and freeze one bundle HERE, before the loop-side
+            # cleanup mutates anything (routes, inflight)
+            import traceback
+
+            err = f"{type(e).__name__}: {e}"
+            self.flight.record("pump_death", error=err)
+            self._write_bundle("pump_death",
+                               error=err + "\n" + traceback.format_exc())
             if self._loop is not None:
                 self._loop.call_soon_threadsafe(self._pump_died_on_loop)
 
@@ -425,6 +488,110 @@ class ServingServer:
             self._fail_on_loop(rid, f"engine pump died: "
                                     f"{type(self._pump_error).__name__}: "
                                     f"{self._pump_error}")
+        if self._crashed is not None:
+            self._crashed.set()
+
+    # -- the flight recorder / postmortem bundles --------------------------
+    async def _wedge_watchdog(self) -> None:
+        """Loop-side wedge detector: when the pump is ALIVE but its beat
+        age crosses `wedge_threshold_s`, record a wedge event and freeze
+        one postmortem bundle (engine reads are stale-ok — the pump is
+        stuck, not racing).  Re-arms when the beat recovers, so a flapping
+        engine produces one bundle per episode, not one per poll."""
+        period = max(0.05, min(1.0, self.wedge_threshold_s / 4.0))
+        while True:
+            await asyncio.sleep(period)
+            age = self.pump_last_step_age()
+            alive = (self._pump_thread is not None
+                     and self._pump_thread.is_alive())
+            if alive and age > self.wedge_threshold_s:
+                if not self._wedge_dumped:
+                    self._wedge_dumped = True
+                    self.flight.record("wedge", age_s=round(age, 3),
+                                       step=(self._pump_beat or (0, -1))[1])
+                    self._write_bundle(
+                        "wedge", error=f"pump wedged: last beat "
+                                       f"{age:.1f}s ago "
+                                       f"(threshold "
+                                       f"{self.wedge_threshold_s:g}s)")
+            elif age >= 0.0 and age <= self.wedge_threshold_s:
+                self._wedge_dumped = False
+
+    def _engine_snapshot(self) -> dict:
+        """Engine state for a bundle: per-slot occupancy, queued request
+        ids, pool accounting.  Stale-ok reads from whatever thread dumps
+        (the pump is dead or wedged in every trigger path); a racing
+        mutation degrades one field to an error string, never the dump."""
+        eng = self.engine
+
+        def _safe(fn):
+            try:
+                return fn()
+            except Exception as e:             # noqa: BLE001 — see above
+                return f"snapshot_error: {type(e).__name__}: {e}"
+
+        return {
+            "slots": _safe(lambda: [
+                None if sl is None else {
+                    "slot": i, "req_id": str(sl.req.req_id),
+                    "pos": int(sl.pos), "generated": int(sl.gen),
+                    "max_new": int(sl.req.max_new),
+                    "replay_until": int(sl.replay_until),
+                } for i, sl in enumerate(list(eng.slots))]),
+            "queued": _safe(lambda: [str(r.req_id)
+                                     for r in list(eng.queue)]),
+            "inflight_routes": _safe(lambda: [str(r)
+                                              for r in list(self._routes)]),
+            "pages_in_use": _safe(lambda: int(eng.kv.pages_in_use)),
+            "free_pages": _safe(lambda: int(eng.kv.free_page_count)),
+            "num_pages": int(eng.kv.num_pages),
+            "page_size": int(eng.kv.page_size),
+            "num_slots": len(eng.slots),
+            "n_decode_steps": eng.n_decode_steps,
+            "tokens_generated": eng.tokens_generated,
+            "n_preemptions": eng.n_preemptions,
+            "n_cancelled": eng.n_cancelled,
+            "n_expired": eng.n_expired,
+            "compile_watch": get_compile_watch().snapshot(),
+            "hbm": hbm_snapshot(params=eng.params, kv=eng.kv),
+        }
+
+    def _config_snapshot(self) -> dict:
+        return {
+            "host": self.host, "port": self.port,
+            "max_inflight": self.max_inflight,
+            "num_slots": len(self.engine.slots),
+            "page_size": int(self.engine.kv.page_size),
+            "num_pages": int(self.engine.kv.num_pages),
+            "capacity_tokens": int(self.engine.kv.capacity_tokens),
+            "wedge_threshold_s": self.wedge_threshold_s,
+            "postmortem_dir": self.postmortem_dir,
+        }
+
+    def _write_bundle(self, reason: str,
+                      error: Optional[str] = None) -> Optional[str]:
+        """Freeze one postmortem bundle; returns its path, or None when no
+        directory is configured or the dump itself failed (a broken dump
+        must never mask the failure being documented)."""
+        if not self.postmortem_dir:
+            return None
+        try:
+            tracer = get_tracer()
+            path = self.flight.dump(
+                self.postmortem_dir, reason,
+                spans=tracer.snapshot(),
+                engine=self._engine_snapshot(),
+                metrics=self.metrics.snapshot(),
+                config=self._config_snapshot(),
+                error=error)
+            print(f"postmortem bundle ({reason}): {path}", file=sys.stderr,
+                  flush=True)
+            return path
+        except Exception as e:                 # noqa: BLE001
+            self._last_dump_error = f"{type(e).__name__}: {e}"
+            print(f"postmortem dump failed ({reason}): "
+                  f"{self._last_dump_error}", file=sys.stderr, flush=True)
+            return None
 
     # -- engine hooks (pump thread) ----------------------------------------
     def _on_token(self, rid: str, tok: int, idx: int) -> None:
@@ -547,6 +714,30 @@ class ServingServer:
             # pump is wedged — engine-derived values are stale-ok reads
             conn.send({"type": "metrics", "text": self.metrics.render(),
                        "content_type": "text/plain; version=0.0.4"})
+        elif t == "dump":
+            # operator-initiated postmortem: freeze a bundle NOW (loop
+            # thread, stale-ok engine reads — works against a wedged or
+            # dead pump, which is exactly when an operator wants one)
+            self.flight.record("dump_rpc")
+            if not self.postmortem_dir:
+                conn.send({"type": "error", "id": msg.get("id"),
+                           "error": "no postmortem dir configured "
+                                    "(ServingServer(postmortem_dir=...) / "
+                                    "tools/serve.py --postmortem-dir)"})
+                return
+            path = self._write_bundle("rpc")
+            if path is None:
+                # configured but the dump itself failed (disk full, bad
+                # permissions, ...) — tell the operator the REAL cause,
+                # not "go configure the directory you already configured"
+                conn.send({"type": "error", "id": msg.get("id"),
+                           "error": f"postmortem dump failed: "
+                                    f"{self._last_dump_error}"})
+            else:
+                conn.send({"type": "dump", "id": msg.get("id"),
+                           "path": path,
+                           "events": self.flight.recorded,
+                           "spans": get_tracer().recorded})
         elif t == "ping":
             conn.send({"type": "pong"})
         else:
@@ -577,11 +768,14 @@ class ServingServer:
             return
         if self._draining:
             self._m_overload.inc()
+            self.flight.record("overload", reason="draining")
             conn.send({"type": "overload", "id": cid, "reason": "draining"})
             return
         if self._inflight >= self.max_inflight:
             # the explicit backpressure contract: never queue unboundedly
             self._m_overload.inc()
+            self.flight.record("overload", reason="queue_full",
+                               inflight=self._inflight)
             conn.send({"type": "overload", "id": cid, "reason": "queue_full",
                        "inflight": self._inflight,
                        "max_inflight": self.max_inflight})
@@ -597,6 +791,8 @@ class ServingServer:
         conn.rids[cid] = req.req_id
         self._inflight += 1
         self._m_accepted.inc()
+        self.flight.record("accept", req=str(req.req_id),
+                           inflight=self._inflight)
         self._cmds.put(("add", req))
         self._wake.set()
 

@@ -126,17 +126,28 @@ class Trainer:
         else:
             self.zero_stage = 0
         self._train_step_fn = self._build_train_step_fn()
-        self._train_step = jax.jit(self._train_step_fn, donate_argnums=(0, 1))
-        self._fused_step = self._build_fused_step()
+        # every trainer jit reports to the compile watcher (obs/
+        # compile_watch.py): a data pipeline that churns batch signatures
+        # shows up as a recompile storm instead of a silent slowdown.  The
+        # wrapper proxies .lower()/._cache_size() introspection unchanged.
+        from paddle_tpu.obs.compile_watch import get_compile_watch
+        _cw = get_compile_watch()
+        self._train_step = _cw.wrap_jit(
+            "trainer.train_step",
+            jax.jit(self._train_step_fn, donate_argnums=(0, 1)))
+        self._fused_step = _cw.wrap_jit("trainer.fused_step",
+                                        self._build_fused_step())
         # benchmark twin: same scanned step, losses only (no [iters, ...]
         # evaluator/host buffers stacked on device)
-        self._fused_step_losses = self._build_fused_step(
-            collect_outputs=False)
+        self._fused_step_losses = _cw.wrap_jit(
+            "trainer.fused_step", self._build_fused_step(
+                collect_outputs=False))
         # fused-dispatch oracles: tests assert exactly ceil(n/k) compiled
         # scan executions for n same-signature batches
         self._n_fused_dispatches = 0
         self._settled_sigs: set = set()
-        self._test_step = self._build_test_step()
+        self._test_step = _cw.wrap_jit("trainer.eval_step",
+                                       self._build_test_step())
         # device-side losses buffered between host syncs (VERDICT: the
         # reference pays a per-batch cost check but not an XLA pipeline
         # stall; here finiteness is checked in bulk every
@@ -173,6 +184,15 @@ class Trainer:
             total_metric="trainer_host_phase_seconds_total"))
         self.metrics.register_collector(barrier_collector(self.barrier_stat))
         self.metrics.register_collector(tracer_collector(self._tracer))
+        # compile events + device-memory accounting ride the same registry
+        # (and therefore metrics.jsonl): per-site jit compile counters from
+        # the process-global watcher, HBM/param-byte gauges with the
+        # CPU-safe fallbacks of obs/hbm.py
+        from paddle_tpu.obs.compile_watch import compile_collector
+        from paddle_tpu.obs.hbm import hbm_collector
+        self.metrics.register_collector(compile_collector())
+        self.metrics.register_collector(
+            hbm_collector(params_fn=lambda: self.params))
         # immutable after construction; _validate_batch uses it per batch
         self._data_layers = {l.name: l for l in self.model.layers
                              if l.type == "data"}

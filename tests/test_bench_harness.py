@@ -260,9 +260,65 @@ def test_spawn_recovers_interim_record_on_timeout(monkeypatch):
     out = bench._spawn("seq2seq", 900)
     assert out["value"] == 123.0
     assert "partial" in out and "error" not in out
+    # ISSUE 6: the interim record carries the degraded provenance flag —
+    # it was measured inside a wedging window (the r04/r05 init-hang
+    # pattern), so LKG assembly must be able to skip it explicitly
+    assert out["degraded"] is True
 
     # no banked line -> the plain timeout error as before
     monkeypatch.setattr(bench, "_run_group",
                         lambda argv, t: (None, "no json here", ""))
     out = bench._spawn("seq2seq", 900)
     assert "error" in out and "timeout" in out["error"]
+
+
+def test_assemble_lkg_skips_degraded_records_explicitly(tmp_path):
+    """ISSUE 6: records (and nested parts) flagged `degraded` — a wedged
+    child's interim numbers, or parts echoed into a degraded fallback —
+    must be skipped by provenance, NOT by hoping a healthy record has a
+    newer timestamp.  Here the degraded records are strictly NEWER than
+    the healthy ones, which timestamp ordering alone would get wrong."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        # the healthy measurements — OLDER than everything degraded
+        {"ts": "2026-07-28T10:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0,
+                    "platform": "tpu",
+                    "measured_at": "2026-07-28T10:00:00+00:00",
+                    "lm": {"metric": M["lm"], "value": 5000.0,
+                           "measured_at": "2026-07-28T10:00:00+00:00"}}},
+        # a newer top-level record measured in a degraded window (a killed
+        # child's interim bank — _spawn stamps partial + degraded)
+        {"ts": "2026-07-29T10:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 1.0, "vs_baseline": 0.1,
+                    "partial": "child killed after 900s; interim record",
+                    "degraded": True,
+                    "measured_at": "2026-07-29T10:00:00+00:00"}},
+        # a newer full record whose nested lm part is a degraded interim
+        {"ts": "2026-07-30T10:00:00+00:00",
+         "record": {"metric": M["sentiment"], "value": 9.0,
+                    "measured_at": "2026-07-30T10:00:00+00:00",
+                    "lm": {"metric": M["lm"], "value": 2.0,
+                           "degraded": True,
+                           "measured_at": "2026-07-30T10:00:00+00:00"}}},
+        # a degraded fallback record echoing LKG parts (parent flag) —
+        # its nested serving echo must not read as a fresh measurement
+        {"ts": "2026-07-31T10:00:00+00:00",
+         "record": {"error": "tunnel died", "degraded": True,
+                    "metric": M["vgg"], "value": 100.0,
+                    "serving": {"metric": M["serving"], "value": 777.0,
+                                "measured_at":
+                                    "2026-07-31T10:00:00+00:00"}}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+
+    out = bench._assemble_lkg()
+    assert out["value"] == 100.0              # healthy headline, not 1.0
+    assert out["measured_at"] == "2026-07-28T10:00:00+00:00"
+    assert out["lm"]["value"] == 5000.0       # healthy part, not 2.0
+    # the degraded fallback's echoed serving part never became "measured"
+    assert "serving" not in out
+    assert out["sentiment"]["value"] == 9.0   # healthy parts still stitch

@@ -533,3 +533,166 @@ def test_soak_overcommitted_pool_over_tcp_stays_exact(tiny_tr):
         assert eng._decode_step._cache_size() == 1
     finally:
         srv.stop_background(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: flight recorder + postmortem bundle trigger paths
+# ---------------------------------------------------------------------------
+
+def _bundles(d):
+    import glob
+
+    return sorted(p for p in glob.glob(os.path.join(str(d), "postmortem-*"))
+                  if not p.endswith(".tmp"))
+
+
+def test_pump_crash_writes_loadable_postmortem_bundle(tiny_tr, tmp_path):
+    """An induced pump crash freezes one atomic bundle — written on the
+    DYING pump thread with engine state exactly as the failure left it —
+    and tools/postmortem.py round-trips it."""
+    from paddle_tpu.obs.flight import load_bundle
+    from paddle_tpu.serving.client import ServerError
+    from tools.postmortem import main as postmortem_main
+
+    eng = _engine(tiny_tr)
+    orig_step = eng.step
+
+    def bad_step():
+        if eng.queue or any(s is not None for s in eng.slots):
+            raise RuntimeError("induced device fault")
+        return orig_step()
+
+    eng.step = bad_step
+    srv = ServingServer(eng, max_queue=8, postmortem_dir=str(tmp_path))
+    host, port = srv.start_background()
+    with ServingClient(host, port) as c:
+        rid = c.submit([3, 4, 5], max_new=4)
+        with pytest.raises(ServerError, match="pump died"):
+            c.collect([rid])
+
+    found = _bundles(tmp_path)
+    assert len(found) == 1, "pump death must freeze exactly one bundle"
+    b = load_bundle(found[0])
+    assert b["meta"]["reason"] == "pump_death"
+    assert "induced device fault" in b["meta"]["error"]
+    assert "Traceback" in b["meta"]["error"]
+    kinds = [e["kind"] for e in b["events"]]
+    assert "pump_death" in kinds and "accept" in kinds
+    # the engine snapshot froze the crash state: the victim request is
+    # still visible (queued or in its slot), pools are accounted
+    occupied = [s for s in b["engine"]["slots"] if s]
+    assert b["engine"]["queued"] or occupied
+    assert b["engine"]["num_pages"] == eng.kv.num_pages
+    assert "compile_watch" in b["engine"] and "hbm" in b["engine"]
+    assert b["config"]["num_slots"] == 2
+    assert postmortem_main([found[0]]) == 0     # pretty-printer round-trip
+    with pytest.raises(RuntimeError, match="engine pump died"):
+        srv.stop_background(drain=True)
+
+
+def test_wedge_watchdog_dumps_once_and_metrics_stay_readable(tiny_tr,
+                                                             tmp_path):
+    """ISSUE 6 acceptance: deliberately wedge the pump — the watchdog
+    sees `pump_last_step_age_s` grow, the metrics frame stays readable
+    the whole time (loop-thread path), and the flight recorder emits
+    EXACTLY ONE bundle at the threshold (one per wedge episode)."""
+    from paddle_tpu.obs.flight import load_bundle
+
+    eng = _engine(tiny_tr)
+    orig_step = eng.step
+    wedged, release = threading.Event(), threading.Event()
+
+    def wedge_step():
+        if not release.is_set() and \
+                (eng.queue or any(s is not None for s in eng.slots)):
+            wedged.set()
+            release.wait(60)                  # the deliberate wedge
+        return orig_step()
+
+    eng.step = wedge_step
+    # threshold must clear the 0.5 s idle-wait bound or an idle pump
+    # reads as wedged (docs/observability.md watchdog semantics)
+    srv = ServingServer(eng, max_queue=4, postmortem_dir=str(tmp_path),
+                        wedge_threshold_s=1.0)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            rid = c.submit([3, 4, 5], max_new=3)
+            assert wedged.wait(30), "pump never picked up the request"
+            # the age gauge grows while wedged — stale-ok reads answer
+            # from the loop thread against the stuck pump
+            a1 = c.stats(stale_ok=True)["pump_last_step_age_s"]
+            time.sleep(0.3)
+            a2 = c.stats(stale_ok=True)["pump_last_step_age_s"]
+            # a1 can round to 0.0 when the read lands within 0.5ms of
+            # the frozen beat — the growth is the signal, not the start
+            assert a2 > a1 >= 0.0 and a2 >= 0.25
+            # the metrics frame stays readable against the wedged engine
+            text = c.metrics()
+            assert "pump_alive 1" in text
+            assert "pump_last_step_age_s" in text
+            # the watchdog crosses the 1.0s threshold and dumps ONCE
+            deadline = time.time() + 20
+            while not _bundles(tmp_path) and time.time() < deadline:
+                time.sleep(0.05)
+            found = _bundles(tmp_path)
+            assert len(found) == 1, "no bundle at the wedge threshold"
+            time.sleep(0.6)                   # > watchdog poll period
+            assert len(_bundles(tmp_path)) == 1, \
+                "a sustained wedge must be one bundle, not one per poll"
+            b = load_bundle(found[0])
+            assert b["meta"]["reason"] == "wedge"
+            assert "pump wedged" in b["meta"]["error"]
+            assert "wedge" in [e["kind"] for e in b["events"]]
+            # the wedged request is frozen in the snapshot
+            assert b["engine"]["queued"] or \
+                [s for s in b["engine"]["slots"] if s]
+            # release: the pump recovers and the request completes exactly
+            release.set()
+            out = c.collect([rid])
+            assert out[rid]["tokens"] == _oracle(tiny_tr, [3, 4, 5], 3)
+    finally:
+        release.set()
+        srv.stop_background(drain=True)
+
+
+def test_dump_rpc_freezes_bundle_on_demand(tiny_tr, tmp_path):
+    """The operator path: `dump` over the wire freezes a bundle NOW and
+    answers its path; without a configured directory it is a clean error
+    frame, not a dead connection."""
+    from paddle_tpu.obs.flight import load_bundle
+    from paddle_tpu.serving.client import ServerError
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4, postmortem_dir=str(tmp_path))
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            toks, reason = c.generate([3, 4, 5], max_new=4)
+            assert reason == "length"
+            d = c.dump()
+            assert os.path.isdir(d["path"])
+            assert d["events"] > 0
+            b = load_bundle(d["path"])
+            assert b["meta"]["reason"] == "rpc"
+            kinds = [e["kind"] for e in b["events"]]
+            assert "dump_rpc" in kinds and "finish" in kinds
+            assert b["metrics"]["serving_requests_accepted_total"] >= 1.0
+            # the engine is healthy and idle in the snapshot
+            assert b["engine"]["queued"] == []
+            assert all(s is None for s in b["engine"]["slots"])
+            # connection survives; the server keeps serving after a dump
+            toks2, _ = c.generate([4, 5], max_new=3)
+            assert len(toks2) == 5
+    finally:
+        srv.stop_background(drain=True)
+
+    eng2 = _engine(tiny_tr)
+    srv2 = ServingServer(eng2, max_queue=4)    # no postmortem dir
+    host, port = srv2.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            with pytest.raises(ServerError, match="no postmortem dir"):
+                c.dump()
+    finally:
+        srv2.stop_background(drain=True)

@@ -84,6 +84,82 @@ def test_check_metrics_names_lint(tmp_path):
         doc_metric_names(str(nosec))
 
 
+def test_check_metrics_names_catches_dead_catalog_rows(tmp_path):
+    """ISSUE 6: the third lint direction — every CATALOG name must be
+    referenced somewhere under paddle_tpu/ OUTSIDE the CATALOG block
+    itself, so a dead row (declared, documented, never emitted) cannot
+    linger.  The current tree is clean; a planted bogus name is caught;
+    the CATALOG assignment cannot vouch for itself."""
+    from tools.check_metrics_names import _source_without_catalog, \
+        unreferenced_names
+
+    assert unreferenced_names() == set(), \
+        "dead CATALOG rows (or the reference scan broke)"
+    assert unreferenced_names({"totally_made_up_metric"}) == \
+        {"totally_made_up_metric"}
+    # a real name referenced ONLY by its own catalog row reads as dead:
+    # the blanked source must not contain the rows the full source has
+    metrics_py = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "paddle_tpu", "obs", "metrics.py")
+    blanked = _source_without_catalog(metrics_py)
+    with open(metrics_py) as f:
+        full = f.read()
+    assert "jit_compiles_total" in full
+    assert "jit_compiles_total" not in blanked
+    assert "CATALOG" in blanked                # only the assignment went
+
+
+def test_trace_dump_summary_lanes_and_compile_breakdown(tmp_path, capsys):
+    """ISSUE 6: --summary must make a recompile storm visible from the
+    trace file alone — per-lane counts plus a compile-lane table with
+    signatures × compile-time and STORMS markers."""
+    import json
+
+    from tools.trace_dump import compile_breakdown, load_spans, main
+
+    spans = [
+        {"seq": 0, "name": "queued", "track": "req:a", "ts": 0.0,
+         "dur": 0.1},
+        {"seq": 1, "name": "decode", "track": "req:a", "ts": 0.1,
+         "dur": 0.4},
+        {"seq": 2, "name": "queued", "track": "req:b", "ts": 0.0,
+         "dur": 0.2},
+        {"seq": 3, "name": "decode_step", "track": "engine", "ts": 0.1,
+         "dur": 0.2},
+        {"seq": 4, "name": "serving.prefill", "track": "compile",
+         "ts": 0.0, "dur": 0.8, "attrs": {"sig": "int32[1,8]"}},
+        {"seq": 5, "name": "serving.prefill", "track": "compile",
+         "ts": 1.0, "dur": 0.6, "attrs": {"sig": "int32[1,16]"}},
+        {"seq": 6, "name": "recompile_storm", "track": "compile",
+         "ts": 1.5, "instant": True,
+         "attrs": {"site": "serving.prefill", "signatures": 6}},
+    ]
+    src = tmp_path / "spans.jsonl"
+    src.write_text("".join(json.dumps(s) + "\n" for s in spans))
+
+    assert main([str(src), "--summary"]) == 0
+    out = capsys.readouterr().out
+    # per-lane counts: request lanes collapse to one req:* row
+    assert "req:*" in out and "compile" in out and "engine" in out
+    assert "7 spans on 3 lanes" in out
+    # the compile breakdown: 2 compiles, 2 sigs, 1400ms, storm marker
+    assert "compile lane (2 compiles):" in out
+    lines = out.splitlines()
+    start = next(i for i, l in enumerate(lines)
+                 if l.startswith("compile lane"))
+    line = next(l for l in lines[start:]
+                if l.strip().startswith("serving.prefill"))
+    assert "2" in line and "1400.00" in line and "STORMS=1" in line
+
+    # a trace with no compile lane gets no breakdown (older traces)
+    assert compile_breakdown(load_spans(str(src))[:4]) == ""
+    plain = tmp_path / "plain.jsonl"
+    plain.write_text("".join(json.dumps(s) + "\n" for s in spans[:4]))
+    assert main([str(plain), "--summary"]) == 0
+    assert "compile lane" not in capsys.readouterr().out
+
+
 def test_merge_model_roundtrip(tmp_path):
     import jax
 

@@ -1,19 +1,24 @@
-"""Lint: obs.metrics.CATALOG and docs/observability.md must agree.
+"""Lint: obs.metrics.CATALOG, docs/observability.md, and the code agree.
 
 The metric catalog (paddle_tpu/obs/metrics.py CATALOG) is the single
 source of truth for every metric name this repo emits — the strict
 registries (serving server, trainer) refuse names outside it at runtime,
 so any metric that actually renders is catalogued.  This lint closes the
-other half of the loop against the documentation:
+loop in all three directions:
 
   * every CATALOG name must appear as a `` `name` `` row in the
     "## Metric reference" section of docs/observability.md (a metric
     cannot ship undocumented);
   * every metric row in that section must name a CATALOG entry (the doc
-    cannot advertise metrics the code no longer emits).
+    cannot advertise metrics the code no longer emits);
+  * every CATALOG name must be REFERENCED as a literal somewhere under
+    `paddle_tpu/` outside the CATALOG block itself (a dead catalog row —
+    a metric nothing declares or collects — cannot linger and mislead
+    dashboards; the CATALOG assignment in obs/metrics.py is excluded via
+    ast so a row cannot vouch for itself).
 
 Wired as a tier-1 test in tests/test_tools.py.  Exit 0 = in sync,
-1 = drift (both directions printed), 2 = doc/section missing.
+1 = drift (all directions printed), 2 = doc/section missing.
 """
 
 from __future__ import annotations
@@ -58,9 +63,52 @@ def check(doc_path: str = DOC) -> tuple[set, set]:
     return code - documented, documented - code
 
 
+def _source_without_catalog(path: str) -> str:
+    """File source with the CATALOG assignment blanked (ast-located), so
+    the catalog's own rows cannot count as references to themselves."""
+    import ast
+
+    with open(path) as f:
+        src = f.read()
+    if os.path.abspath(path) != os.path.abspath(
+            os.path.join(REPO, "paddle_tpu", "obs", "metrics.py")):
+        return src
+    tree = ast.parse(src)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        if any(getattr(t, "id", "") == "CATALOG" for t in targets):
+            lines = src.splitlines(True)
+            return "".join(lines[:node.lineno - 1]) \
+                + "".join(lines[node.end_lineno:])
+    return src
+
+
+def unreferenced_names(names=None, root: str = None) -> set[str]:
+    """CATALOG names never referenced as a literal in any .py under
+    paddle_tpu/ (outside the CATALOG block) — dead rows the registry
+    would happily accept but nothing emits."""
+    names = set(CATALOG if names is None else names)
+    root = root or os.path.join(REPO, "paddle_tpu")
+    sources = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if fn.endswith(".py"):
+                sources.append(
+                    _source_without_catalog(os.path.join(dirpath, fn)))
+    blob = "\n".join(sources)
+    return {name for name in names if name not in blob}
+
+
 def main(argv=None) -> int:
     try:
         undocumented, stale = check()
+        dead = unreferenced_names()
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -73,9 +121,14 @@ def main(argv=None) -> int:
         ok = False
         print(f"STALE DOC: {DOC} documents {name!r} but it is not in "
               f"obs.metrics.CATALOG")
+    for name in sorted(dead):
+        ok = False
+        print(f"DEAD CATALOG ROW: {name!r} is in obs.metrics.CATALOG but "
+              f"nothing under paddle_tpu/ references it — delete the row "
+              f"or wire the metric")
     if ok:
         print(f"ok: {len(CATALOG)} metric names in sync with "
-              f"docs/observability.md")
+              f"docs/observability.md and all referenced in code")
     return 0 if ok else 1
 
 

@@ -22,8 +22,16 @@ Client one-shot (no jax needed beyond the shared package import):
 
 Request-lifecycle tracing: `--trace-out spans.jsonl` enables the span
 tracer for the server's lifetime and writes the retained spans (bounded
-ring) as JSONL on drain; `python tools/trace_dump.py spans.jsonl -o
-trace.json` converts to Perfetto-loadable Chrome trace_event JSON.  See
+ring) as JSONL on EVERY exit path — clean drain, engine-pump crash
+(exit 1), or an unexpected error — never an empty file; `python
+tools/trace_dump.py spans.jsonl -o trace.json` converts to
+Perfetto-loadable Chrome trace_event JSON.
+
+Postmortem bundles: `--postmortem-dir DIR` arms the flight recorder's
+dump paths — a pump crash, a watchdog wedge (`--wedge-threshold-s`), or
+a client `--dump` each freeze an atomic `DIR/postmortem-<ts>-<pid>/`
+bundle (events, spans, engine snapshot, metrics, config).  Inspect with
+`python tools/postmortem.py DIR/postmortem-.../`.  See
 docs/observability.md.
 """
 
@@ -46,6 +54,9 @@ def run_client(args) -> int:
     with ServingClient(host or "127.0.0.1", int(port)) as c:
         if args.metrics:
             print(c.metrics(), end="")
+            return 0
+        if args.dump:
+            print(json.dumps(c.dump(), indent=2))
             return 0
         if args.stats:
             print(json.dumps(c.stats(stale_ok=args.stale_ok), indent=2))
@@ -95,28 +106,52 @@ async def amain(args) -> int:
 
         tracer = get_tracer()
         tracer.enabled = True
+
+    def flush_trace():
+        # EVERY exit path flushes — a crashed or wedged server must never
+        # leave an empty trace file behind (the spans up to the failure
+        # are exactly the ones a postmortem wants)
+        if tracer is not None:
+            n = tracer.export_jsonl(args.trace_out)
+            print(f"wrote {n} spans to {args.trace_out} "
+                  f"({tracer.dropped} dropped by ring wrap); convert with "
+                  f"tools/trace_dump.py", file=sys.stderr, flush=True)
+
     engine = build_engine(args)
     srv = ServingServer(engine, host=args.host, port=args.port,
-                        max_queue=args.max_queue)
-    host, port = await srv.start()
-    print("SERVE_JSON:" + json.dumps(
-        {"host": host, "port": port, "pid": os.getpid()}), flush=True)
+                        max_queue=args.max_queue,
+                        postmortem_dir=args.postmortem_dir or None,
+                        wedge_threshold_s=args.wedge_threshold_s)
+    try:
+        host, port = await srv.start()
+        print("SERVE_JSON:" + json.dumps(
+            {"host": host, "port": port, "pid": os.getpid()}), flush=True)
 
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, stop.set)
-    await stop.wait()
-    print("draining: refusing new requests, finishing in-flight...",
-          file=sys.stderr, flush=True)
-    await srv.drain()
-    if tracer is not None:
-        n = tracer.export_jsonl(args.trace_out)
-        print(f"wrote {n} spans to {args.trace_out} "
-              f"({tracer.dropped} dropped by ring wrap); convert with "
-              f"tools/trace_dump.py", file=sys.stderr, flush=True)
-    print("drained; bye", file=sys.stderr, flush=True)
-    return 0
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        # a dead engine pump must take the PROCESS down (nonzero, trace
+        # flushed, bundle already frozen by the server) instead of leaving
+        # a zombie listener that answers every generate with an error
+        stop_w = asyncio.ensure_future(stop.wait())
+        crash_w = asyncio.ensure_future(srv.wait_crashed())
+        done, pending = await asyncio.wait(
+            [stop_w, crash_w], return_when=asyncio.FIRST_COMPLETED)
+        for fut in pending:
+            fut.cancel()
+        if crash_w in done:
+            print("engine pump died; shutting down", file=sys.stderr,
+                  flush=True)
+            await srv.stop()
+            return 1
+        print("draining: refusing new requests, finishing in-flight...",
+              file=sys.stderr, flush=True)
+        await srv.drain()
+        print("drained; bye", file=sys.stderr, flush=True)
+        return 0
+    finally:
+        flush_trace()
 
 
 def main(argv=None) -> int:
@@ -137,6 +172,14 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")
+    ap.add_argument("--postmortem-dir", default="",
+                    help="arm the flight recorder: pump crash / watchdog "
+                         "wedge / a client --dump each freeze an atomic "
+                         "postmortem bundle here (tools/postmortem.py "
+                         "pretty-prints one)")
+    ap.add_argument("--wedge-threshold-s", type=float, default=30.0,
+                    help="pump beat age past which the watchdog declares "
+                         "a wedge and dumps a bundle")
     ap.add_argument("--seed", type=int, default=0)
     # client mode
     ap.add_argument("--client", default="",
@@ -159,6 +202,10 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="with --client: print the Prometheus-style "
                          "metrics frame and exit")
+    ap.add_argument("--dump", action="store_true",
+                    help="with --client: ask the server to freeze a "
+                         "postmortem bundle and print its path (works "
+                         "against a wedged engine)")
     # server-side tracing
     ap.add_argument("--trace-out", default="",
                     help="enable request-lifecycle tracing; write spans "

@@ -11,7 +11,8 @@ as bars, instants (preempt/done/cancelled/deadline) as markers.
   python tools/serve.py ... --trace-out spans.jsonl     # drain writes it
   # convert + eyeball
   python tools/trace_dump.py spans.jsonl -o trace.json
-  python tools/trace_dump.py spans.jsonl --summary      # per-name table
+  python tools/trace_dump.py spans.jsonl --summary      # per-name table,
+                                  # per-lane counts, compile-lane breakdown
 
 Exit codes: 0 ok, 2 on unreadable/empty input.
 """
@@ -52,8 +53,9 @@ def load_spans(path: str) -> list[dict]:
 
 
 def summarize(spans: list[dict]) -> str:
-    """Per-name span table: count, total duration, max — the quick look
-    before opening Perfetto."""
+    """Per-name span table, per-lane counts, and a compile-lane
+    breakdown (signatures × compile time) — a recompile storm is visible
+    from the trace file alone, no Perfetto needed."""
     agg: dict[str, list] = {}
     for s in spans:
         a = agg.setdefault(s["name"], [0, 0.0, 0.0])
@@ -64,8 +66,59 @@ def summarize(spans: list[dict]) -> str:
     for name in sorted(agg, key=lambda n: -agg[n][1]):
         c, tot, mx = agg[name]
         lines.append(f"{name:<16} {c:>7} {tot * 1e3:>10.2f} {mx * 1e3:>9.2f}")
-    tracks = sorted({s.get("track", "main") for s in spans})
-    lines.append(f"{len(spans)} spans on {len(tracks)} tracks")
+
+    # per-lane counts: request lanes collapse to one `req:*` row so a
+    # thousand-request trace still summarizes in a screenful
+    lanes: dict[str, int] = {}
+    for s in spans:
+        track = s.get("track", "main")
+        if track.startswith("req:"):
+            track = "req:*"
+        lanes[track] = lanes.get(track, 0) + 1
+    lines.append("")
+    lines.append(f"{'lane':<16} {'spans':>7}")
+    for track in sorted(lanes, key=lambda t: -lanes[t]):
+        lines.append(f"{track:<16} {lanes[track]:>7}")
+
+    lines.append(f"{len(spans)} spans on {len(lanes)} lanes")
+    comp = compile_breakdown(spans)
+    if comp:
+        lines.append("")
+        lines.append(comp)
+    return "\n".join(lines)
+
+
+def compile_breakdown(spans: list[dict]) -> str:
+    """The compile lane, by site: compiles × distinct signatures × wall
+    time, plus any recompile-storm markers.  Empty string when the trace
+    holds no compile-lane spans (tracing predates the compile watcher,
+    or nothing compiled while the ring retained)."""
+    sites: dict[str, list] = {}      # site -> [compiles, sigs, seconds]
+    storms: dict[str, int] = {}
+    for s in spans:
+        if s.get("track") != "compile":
+            continue
+        attrs = s.get("attrs") or {}
+        if s.get("instant"):
+            if s["name"] == "recompile_storm":
+                site = str(attrs.get("site", "?"))
+                storms[site] = storms.get(site, 0) + 1
+            continue
+        a = sites.setdefault(s["name"], [0, set(), 0.0])
+        a[0] += 1
+        a[1].add(attrs.get("sig", a[0]))   # no sig recorded: count as new
+        a[2] += float(s.get("dur", 0.0))
+    if not sites and not storms:
+        return ""
+    lines = [f"compile lane ({sum(a[0] for a in sites.values())} compiles):",
+             f"  {'site':<24} {'compiles':>8} {'sigs':>5} {'total_ms':>10}"]
+    for site in sorted(sites, key=lambda n: -sites[n][2]):
+        c, sigs, tot = sites[site]
+        storm = (f"  STORMS={storms.pop(site)}" if site in storms else "")
+        lines.append(f"  {site:<24} {c:>8} {len(sigs):>5} "
+                     f"{tot * 1e3:>10.2f}{storm}")
+    for site, n in sorted(storms.items()):  # storm without retained spans
+        lines.append(f"  {site:<24} {'?':>8} {'?':>5} {'?':>10}  STORMS={n}")
     return "\n".join(lines)
 
 
@@ -77,7 +130,9 @@ def main(argv=None) -> int:
                     help="write Chrome trace_event JSON here "
                          "(default: <input>.trace.json)")
     ap.add_argument("--summary", action="store_true",
-                    help="print a per-span-name table instead of writing")
+                    help="print per-span-name and per-lane tables (plus a "
+                         "compile-lane breakdown when present) instead of "
+                         "writing")
     args = ap.parse_args(argv)
 
     try:
