@@ -510,11 +510,72 @@ def bench_serving(dtype: str) -> dict:
     }
 
 
+def bench_serving_prefix(dtype: str) -> dict:
+    """Prefix-cache effectiveness record (serving/prefix_tree.py): the
+    Zipf prefix-skew workload through ONE engine, cache off then on —
+    tools/bench_serving.py --prefix-skew is the sweep tool, this is the
+    compact record for the driver's BENCH capture.  Headline = the hit
+    rate; the companions are the prefill tokens saved and the first-token
+    p50 against the no-cache baseline (the latency the cache exists to
+    cut).  Exactness against lm_generate is tests/test_prefix_cache.py's
+    job."""
+    import argparse
+
+    from tools.bench_serving import build_engine, measure_prefix_skew
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        dtype=dtype)
+    wl = dict(
+        n=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prefix_pool=int(os.environ.get("BENCH_SERVE_PREFIX_POOL", "8")),
+        prefix_len=int(os.environ.get("BENCH_SERVE_PREFIX_LEN", "128")),
+        prefix_skew=float(os.environ.get("BENCH_SERVE_PREFIX_SKEW", "1.0")),
+        suffix_lo=int(os.environ.get("BENCH_SERVE_SUFFIX_LO", "16")),
+        suffix_hi=int(os.environ.get("BENCH_SERVE_SUFFIX_HI", "64")),
+        max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "64")),
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")))
+    reps = int(os.environ.get("BENCH_SERVE_REPS", "3"))
+
+    eng = build_engine(args)
+    m = measure_prefix_skew(eng, wl, reps, seed=0)
+    share = wl["prefix_len"] / (
+        wl["prefix_len"] + (wl["suffix_lo"] + wl["suffix_hi"]) / 2.0)
+    return {
+        "metric": "lm_serving_prefix_hit_rate",
+        "value": round(m["hit_rate"], 4),
+        "unit": "hit fraction",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"pool={wl['prefix_pool']} prefix={wl['prefix_len']} "
+                  f"skew={wl['prefix_skew']} "
+                  f"suffix={wl['suffix_lo']}-{wl['suffix_hi']} "
+                  f"slots={args.slots} page={args.page_size} "
+                  f"reqs={wl['n']} max_new={wl['max_new']}",
+        "prefix_share_configured": round(share, 3),
+        "lm_serving_prefill_tokens_saved_total": m["tokens_saved"],
+        "first_tok_ms_p50": m["first_tok_ms_p50"],
+        "baseline_first_tok_ms_p50": m["baseline_first_tok_ms_p50"],
+        "tokens_per_sec_median": round(m["cached_tok_per_sec"], 1),
+        "baseline_tokens_per_sec_median":
+            round(m["baseline_tok_per_sec"], 1),
+        "prefix_evictions": m["evictions"],
+        "prefix_cow": m["cow"],
+        "decode_sig_stable": m["decode_sig_stable"],
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
     "lm": bench_lm,
     "serving": bench_serving,
+    "serving_prefix": bench_serving_prefix,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -635,6 +696,7 @@ _METRIC_OF = {
     "seq2seq": "wmt14_seq2seq_train_samples_per_sec_per_chip",
     "lm": "transformer_lm_train_tokens_per_sec_per_chip",
     "serving": "lm_serving_tok_per_sec",
+    "serving_prefix": "lm_serving_prefix_hit_rate",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -717,8 +779,8 @@ def _assemble_lkg() -> dict | None:
         "metric": _METRIC_OF["vgg"], "value": 0.0,
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
-    for key in ("lm", "serving", "mnist", "sentiment", "recommendation",
-                "seq2seq"):
+    for key in ("lm", "serving", "serving_prefix", "mnist", "sentiment",
+                "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:

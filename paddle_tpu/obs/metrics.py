@@ -42,6 +42,23 @@ CATALOG: dict[str, str] = {
     "serving_pages_in_use": "KV pages allocated to slots",
     "serving_free_pages": "KV pages on the free list",
     "serving_num_pages": "configured KV page pool size (incl. trash page)",
+    "serving_private_pages_in_use":
+        "KV pages mapped by exactly one slot and not prefix-cached",
+    "serving_shared_pages_in_use":
+        "slot-mapped KV pages shared read-only (multi-slot or prefix-cached)",
+    "serving_prefix_cached_pages":
+        "KV pages retained only by the prefix index (evictable on pressure)",
+    "serving_prefix_nodes": "nodes in the radix prefix index",
+    "serving_prefix_hits_total":
+        "admissions that mapped at least one cached prefix page",
+    "serving_prefix_misses_total":
+        "admissions that found no cached prefix (prefix cache enabled)",
+    "serving_prefix_tokens_saved_total":
+        "prompt tokens skipped at prefill via cached prefixes",
+    "serving_prefix_evictions_total":
+        "prefix pages evicted by page-pool pressure (LRU, before pausing)",
+    "serving_prefix_cow_total":
+        "copy-on-write page copies (divergence inside a shared boundary page)",
     "serving_decode_steps_total": "compiled decode steps executed",
     "serving_tokens_generated_total": "tokens emitted across all requests",
     "serving_preemptions_total": "slots preempted by page-pool pressure",

@@ -47,6 +47,7 @@ from paddle_tpu.obs.flight import get_flight_recorder
 from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.serving.paged_kv import PagedKVCache
+from paddle_tpu.serving.prefix_tree import PrefixTree
 from paddle_tpu.serving.sampler import pick_next_per_slot
 
 
@@ -125,7 +126,8 @@ class ServingEngine:
                  page_size: int = 16, max_context: int = 256,
                  num_pages: Optional[int] = None,
                  input_name: Optional[str] = None,
-                 logits_name: Optional[str] = None):
+                 logits_name: Optional[str] = None,
+                 prefix_cache: bool = True):
         self.executor = executor
         self.params = params
         self.input_name, self.logits_name = _resolve_io_names(
@@ -134,6 +136,20 @@ class ServingEngine:
         pages_per_slot = -(-int(max_context) // int(page_size))
         self.kv = PagedKVCache(executor, num_slots, page_size,
                                pages_per_slot, num_pages)
+        # prefix caching (serving/prefix_tree.py): retired requests donate
+        # their fully-committed pages to a radix index keyed on token-id
+        # runs; admission walks it and prefills ONLY the uncached suffix.
+        # Sharing is entirely host-side allocator/table state — the decode
+        # step's one compiled signature is untouched.  The tree's LRU
+        # eviction is the allocator's page-pressure hook, so cached
+        # prefixes are reclaimed BEFORE slots pause or preempt.
+        self.prefix: Optional[PrefixTree] = \
+            PrefixTree(self.kv) if prefix_cache else None
+        if self.prefix is not None:
+            self.kv.on_page_pressure = self.prefix.evict_for
+        self.n_prefix_hits = 0
+        self.n_prefix_misses = 0
+        self.prefill_tokens_saved = 0
         self.queue: deque[Request] = deque()
         self.slots: list[Optional[_Slot]] = [None] * num_slots
         # finished-but-uncollected outputs: run() POPS what completed on
@@ -176,6 +192,13 @@ class ServingEngine:
         self._admit_seq = 0
         self._prefill_cache: dict[int, object] = {}
         self._pack_cache: dict[int, object] = {}
+        # prefix-hit compiled pieces: suffix prefill keyed on (prefix
+        # pages, suffix bucket), offset pack keyed on suffix bucket — the
+        # matched token count and in-page offset stay DYNAMIC operands, so
+        # signatures are bounded by (pages_per_slot x buckets), never by
+        # distinct prefix lengths
+        self._prefix_prefill_cache: dict[tuple, object] = {}
+        self._prefix_pack_cache: dict[int, object] = {}
         # every engine jit reports to the compile watcher (obs/
         # compile_watch.py): the decode step must stay at ONE signature,
         # per-bucket prefill compiles feed the recompile-storm detector
@@ -290,6 +313,7 @@ class ServingEngine:
                 toks = np.concatenate(
                     [sl.req.prompt_ids,
                      np.asarray(gen, np.int32)]).astype(np.int32)
+                self._donate(s)
                 self.kv.release(s)
                 self.slots[s] = None
                 self._count_abort(reason)
@@ -358,6 +382,13 @@ class ServingEngine:
             sl = self.slots[s]
             pos[s], toks[s] = sl.pos, sl.last_tok
             if s in run_set:
+                # a shared page is never written: the page receiving this
+                # step's K/V write must be private to the slot (admission's
+                # COW guarantees it — this tripwire catches refcount bugs
+                # before they corrupt a cached prefix)
+                assert self.kv.page_writable(
+                    int(self.kv.table[s, sl.pos // self.kv.page_size])), \
+                    f"slot {s} would write a shared page"
                 # key g samples token g — indexing by the slot's own
                 # generation counter is what keeps a paused slot's stream
                 # intact (a pause consumes no key)
@@ -433,20 +464,68 @@ class ServingEngine:
             if self.slots[s] is not None:
                 continue
             req = self.queue[0]
-            if not self.kv.try_grow(s, req.prompt_ids.size):
-                # page-starved: keep FIFO order, retry later.  Return the
-                # partial grab to the free list — a later retry may land on
-                # a DIFFERENT free slot, and pages stranded on this one
-                # would be invisible to it (the pool would leak).
-                self.kv.release(s)
+            res = self._reserve(s, req)
+            if res is None:
+                # page-starved: keep FIFO order, retry later (_reserve
+                # already rolled the slot back to empty — pages stranded
+                # on it would be invisible to a retry on a different slot)
                 return
             self.queue.popleft()
-            self._admit(s, req)
+            self._admit(s, req, *res)
 
-    def _admit(self, s: int, req: Request) -> None:
-        """Prefill the prompt at its bucket length, pack its K/V into the
-        slot's pages, sample token 0 from the prefill logits (keys[0] — the
-        same key schedule lm_generate consumes).
+    def _reserve(self, s: int, req: Request):
+        """Map any cached prefix into empty slot `s` and allocate the
+        remaining pages for the whole prompt.  Returns (matched_tokens,
+        matched_pages) on success, None on page starvation (slot rolled
+        back to empty).
+
+        The prefix walk caps at prompt_len - 1 tokens: at least one token
+        always prefills, because sampling token 0 needs the last prompt
+        position's logits.  A partial-run boundary match maps one page the
+        request will WRITE into mid-run, so it is copy-on-written here, at
+        reservation time — the request's divergent suffix must never touch
+        the shared original.  The COW runs AFTER the suffix pages are
+        secured: a page-starved reservation then fails at try_grow before
+        paying the device copy, instead of repeating copy + n_cow +
+        flight event on every retry step while the queue head is stuck.
+
+        If the shared mapping cannot be completed (COW page or suffix
+        pages unavailable even after eviction), the whole reservation
+        rolls back and admission retries COLD: the just-unmapped prefix
+        pages drop to refcount zero, so the cold attempt's page-pressure
+        eviction can reclaim them — holding them mapped would starve the
+        very admission they were meant to speed up (livelock)."""
+        p = req.prompt_ids.size
+        if self.prefix is not None:
+            full, partial = self.prefix.match(req.prompt_ids[:p - 1])
+            if full or partial is not None:
+                mapped = full + ([partial[0]] if partial is not None else [])
+                self.kv.map_shared(s, mapped)
+                C = len(full) * self.kv.page_size + \
+                    (partial[1] if partial is not None else 0)
+                ok = self.kv.try_grow(s, p)
+                if ok and partial is not None:
+                    cow = self.kv.ensure_writable(s, len(mapped) - 1)
+                    ok = cow is not None
+                    if cow:
+                        self.flight.record("prefix_cow",
+                                           req=str(req.req_id),
+                                           page=int(partial[0]),
+                                           matched_in_page=int(partial[1]))
+                if ok:
+                    return (C, len(mapped))
+                self.kv.release(s)
+        if self.kv.try_grow(s, p):
+            return (0, 0)
+        self.kv.release(s)
+        return None
+
+    def _admit(self, s: int, req: Request, C: int = 0, n_pp: int = 0) -> None:
+        """Prefill the prompt (or, on a prefix hit, ONLY its uncached
+        suffix) at a bucket length, pack its K/V into the slot's pages,
+        sample token 0 from the prefill logits (keys[0] — the same key
+        schedule lm_generate consumes).  `C` = tokens already mapped from
+        the prefix index across the slot's first `n_pp` pages.
 
         A re-admission after preemption keeps req._preempted_gen: until the
         deterministic replay catches up, an abort must still report those
@@ -455,24 +534,71 @@ class ServingEngine:
         self._tr_end(req.req_id)                       # queued ends here
         p = req.prompt_ids.size
         ps = self.kv.page_size
-        Lb = self.bucket_for(p)
-        with self.tracer.span("prefill", track=f"req:{req.req_id}",
-                              bucket=Lb):
-            ids = np.zeros((1, Lb), np.int32)
-            ids[0, :p] = req.prompt_ids
-            last, kv_prompt = self._prefill_fn(Lb)(
-                self.params, jnp.asarray(ids),
-                jnp.asarray([p], np.int32))
-            keys = np.asarray(jax.random.split(req.rng, req.max_new))
-            tok0 = int(np.asarray(pick_next(
-                last, jnp.asarray(keys[0]), temperature=req.temperature,
-                top_k=req.top_k, top_p=req.top_p, is_probs=self._probs))[0])
+        keys = np.asarray(jax.random.split(req.rng, req.max_new))
+        if self.prefix is not None:
+            if C > 0:
+                self.n_prefix_hits += 1
+                self.prefill_tokens_saved += C
+                self._tr_instant(req.req_id, "prefix_hit", n_pages=n_pp,
+                                 tokens=C)
+                self.flight.record("prefix_hit", req=str(req.req_id),
+                                   pages=n_pp, tokens=C, suffix=p - C)
+            else:
+                self.n_prefix_misses += 1
+                self.flight.record("prefix_miss", req=str(req.req_id),
+                                   prompt_len=int(p))
+        if C > 0:
+            # suffix-only prefill: the transformer runs on tokens [C, p)
+            # against a cache seeded from the slot's mapped prefix pages
+            # (layers_attn's "cont" continuation path), so prefill compute
+            # scales with the UNCACHED suffix only.  The suffix is
+            # bucketed like cold prefill; C and the in-page offset ride as
+            # dynamic operands.
+            suf = p - C
+            Lb = min(-(-_bucket_len(suf) // ps) * ps,
+                     self.kv.capacity_tokens - C)
+            with self.tracer.span("prefill", track=f"req:{req.req_id}",
+                                  bucket=Lb, prefix_tokens=C):
+                ids = np.zeros((1, Lb), np.int32)
+                ids[0, :suf] = req.prompt_ids[C:]
+                last, kv_suffix = self._prefix_prefill_fn(n_pp, Lb)(
+                    self.params, self.kv.pools,
+                    jnp.asarray(self.kv.table[s, :n_pp].copy()),
+                    jnp.asarray(ids), jnp.asarray([suf], np.int32),
+                    jnp.asarray([C], np.int32))
+                tok0 = int(np.asarray(pick_next(
+                    last, jnp.asarray(keys[0]),
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, is_probs=self._probs))[0])
+                # suffix K/V scatter from in-page offset C % ps across the
+                # slot's remaining pages (trash page 0 beyond the prompt)
+                n_span = Lb // ps + 1
+                pages = np.zeros(n_span, np.int32)
+                m_b = C // ps
+                span = min(n_span, self.kv.pages_for(p) - m_b)
+                pages[:span] = self.kv.table[s, m_b:m_b + span]
+                self.kv.pools = self._prefix_pack_fn(Lb)(
+                    self.kv.pools, kv_suffix, jnp.asarray(pages),
+                    jnp.asarray(C % ps, np.int32))
+        else:
+            Lb = self.bucket_for(p)
+            with self.tracer.span("prefill", track=f"req:{req.req_id}",
+                                  bucket=Lb):
+                ids = np.zeros((1, Lb), np.int32)
+                ids[0, :p] = req.prompt_ids
+                last, kv_prompt = self._prefill_fn(Lb)(
+                    self.params, jnp.asarray(ids),
+                    jnp.asarray([p], np.int32))
+                tok0 = int(np.asarray(pick_next(
+                    last, jnp.asarray(keys[0]),
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, is_probs=self._probs))[0])
 
-            pages = np.zeros(Lb // ps, np.int32)       # 0 = trash for pad
-            n_real = self.kv.pages_for(p)
-            pages[:n_real] = self.kv.table[s, :n_real]
-            self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
-                                              jnp.asarray(pages))
+                pages = np.zeros(Lb // ps, np.int32)   # 0 = trash for pad
+                n_real = self.kv.pages_for(p)
+                pages[:n_real] = self.kv.table[s, :n_real]
+                self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
+                                                  jnp.asarray(pages))
         self._admit_seq += 1
         sl = _Slot(req, keys, pos=p, first_tok=tok0,
                    admit_seq=self._admit_seq)
@@ -509,8 +635,67 @@ class ServingEngine:
         self.flight.record("preempt", req=str(rid), slot=s,
                            tokens=sl.gen,
                            free_pages=int(self.kv.free_page_count))
+        # donate before releasing: the victim's committed pages become
+        # cached refcount-zero (evictable under the very pressure that
+        # caused this preempt), and its re-admission prefix-hits its own
+        # prompt — the deterministic replay skips the prefill it already
+        # paid for
+        self._donate(s)
         self.kv.release(s)
         self.slots[s] = None
+
+    def _donate(self, s: int) -> None:
+        """Offer the slot's fully-committed clean pages to the prefix
+        index (retire/preempt/abort).  Only WHOLE pages strictly below
+        `pos` qualify — every position in them holds committed K/V; the
+        partial boundary page (and the not-yet-written last token) stay
+        private and free normally.  The index retains via the allocator's
+        cached mark, so the subsequent release drops these pages to
+        cached-only instead of freeing them."""
+        if self.prefix is None:
+            return
+        sl = self.slots[s]
+        full = int(sl.pos) // self.kv.page_size
+        if full <= 0:
+            return
+        seq = np.concatenate([sl.req.prompt_ids,
+                              np.asarray(sl.generated, np.int32)])
+        self.prefix.insert(seq[:full * self.kv.page_size],
+                           [int(self.kv.table[s, j]) for j in range(full)])
+
+    def reset_prefix_cache(self) -> None:
+        """Full allocator cold start (idle engine only): release every
+        slot mapping, forget all prefix retention, rebuild the free list
+        in canonical order (kv.reset) AND clear the index — page
+        placement afterwards is bit-reproducible across engine restarts
+        (exactness tests and postmortem engine.json snapshots stay
+        stable)."""
+        assert all(sl is None for sl in self.slots) and not self.queue, \
+            "reset_prefix_cache requires an idle engine"
+        self.kv.reset()
+        if self.prefix is not None:
+            self.prefix.clear()
+
+    def set_prefix_cache(self, enabled: bool) -> None:
+        """A/B knob (bench_serving --prefix-skew measures the same engine
+        with the cache off, then on): disabling detaches AND empties the
+        index — every node's page drops its cached retention, so pages
+        still mapped by live slots stay with their slots and free through
+        the normal release flow — leaving nothing for a baseline run to
+        match; enabling attaches a fresh empty index."""
+        if enabled == (self.prefix is not None):
+            return
+        if enabled:
+            self.prefix = PrefixTree(self.kv)
+            self.kv.on_page_pressure = self.prefix.evict_for
+            return
+        stack = list(self.prefix.root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self.kv.uncache_page(node.page)
+        self.prefix = None
+        self.kv.on_page_pressure = None
 
     def _retire(self, s: int) -> None:
         sl = self.slots[s]
@@ -518,6 +703,7 @@ class ServingEngine:
             [sl.req.prompt_ids,
              np.asarray(sl.generated, np.int32)]).astype(np.int32)
         reason = "stop" if sl.last_tok == sl.req.eos_id else "length"
+        self._donate(s)
         self.kv.release(s)
         self.slots[s] = None
         self._finish(sl.req.req_id, toks, reason)
@@ -610,4 +796,93 @@ class ServingEngine:
 
             fn = self._pack_cache[Lb] = get_compile_watch().wrap_jit(
                 "serving.pack", jax.jit(pack, donate_argnums=(0,)))
+        return fn
+
+    def _prefix_prefill_fn(self, n_pp: int, Lb: int):
+        """Jitted SUFFIX prefill for a prefix-hit admission: gather the
+        matched prefix K/V out of `n_pp` pool pages into a dense seed
+        cache, then run the stack on the Lb-bucket suffix tokens through
+        layers_attn's continuation path (the static "cont" marker routes
+        multi-token cached attention through cached_attention_step, which
+        scatters at the dynamic offset `c` and masks on global positions).
+        Compiled once per (prefix pages, suffix bucket); the matched token
+        count `c` and valid suffix length `n` are dynamic operands.
+        Returns (last-valid-position logits, per-layer suffix K/V sliced
+        at [c, c+Lb) — the shape _prefix_pack_fn scatters)."""
+        key = (n_pp, Lb)
+        fn = self._prefix_prefill_cache.get(key)
+        if fn is None:
+            executor = self.executor
+            input_name, logits_name = self.input_name, self.logits_name
+            specs = self.kv.layer_specs
+            ps = self.kv.page_size
+            Cpad = n_pp * ps
+            dtype = jnp.dtype(executor.compute_dtype) \
+                if executor.compute_dtype else jnp.float32
+
+            def prefill(params, pools, ctx_pages, ids, n, c):
+                # ctx_pages [n_pp] physical pages; positions [c, Cpad) of
+                # the seed hold the boundary page's beyond-match tokens —
+                # garbage for THIS request, but cached_attention_step's
+                # scatter overwrites [c, c+Lb) before attention and its
+                # causal mask never reaches the rest
+                state = {}
+                for name, (h_kv, dh) in specs.items():
+                    seed_k = pools[name]["k"][ctx_pages] \
+                        .reshape(1, Cpad, h_kv, dh)
+                    seed_v = pools[name]["v"][ctx_pages] \
+                        .reshape(1, Cpad, h_kv, dh)
+                    state[name] = {
+                        "k": jnp.zeros((1, Cpad + Lb, h_kv, dh), dtype)
+                        .at[:, :Cpad].set(seed_k),
+                        "v": jnp.zeros((1, Cpad + Lb, h_kv, dh), dtype)
+                        .at[:, :Cpad].set(seed_v),
+                        "pos": c, "cont": (),
+                    }
+                outputs, _, state = executor.forward(
+                    params, {input_name: Argument(ids=ids, lengths=n)},
+                    state, TEST, None)
+                logits = outputs[logits_name].value
+                last = jnp.take_along_axis(
+                    logits, (n - 1)[:, None, None], axis=1)[:, 0, :]
+                return last, {
+                    name: tuple(
+                        jax.lax.dynamic_slice_in_dim(state[name][part],
+                                                     c[0], Lb, axis=1)
+                        for part in ("k", "v"))
+                    for name in specs}
+
+            fn = self._prefix_prefill_cache[key] = \
+                get_compile_watch().wrap_jit(
+                    "serving.prefix_prefill", jax.jit(prefill))
+        return fn
+
+    def _prefix_pack_fn(self, Lb: int):
+        """Jitted offset page writer: scatter an Lb-token suffix's K/V into
+        the slot's pages starting at dynamic in-page offset `off` — token i
+        lands in pages[(off + i) // ps] at row (off + i) % ps.  Pages past
+        the prompt's real span are the trash page 0 (same padded-bucket
+        discipline as the cold pack)."""
+        fn = self._prefix_pack_cache.get(Lb)
+        if fn is None:
+            ps = self.kv.page_size
+            specs = self.kv.layer_specs
+
+            def pack(pools, kv_suffix, pages, off):
+                idx = off + jnp.arange(Lb)
+                phys = pages[idx // ps]                       # [Lb]
+                row = idx % ps
+                out = {}
+                for name in specs:
+                    k, v = kv_suffix[name]
+                    out[name] = {
+                        "k": pools[name]["k"].at[phys, row].set(
+                            k[0].astype(pools[name]["k"].dtype)),
+                        "v": pools[name]["v"].at[phys, row].set(
+                            v[0].astype(pools[name]["v"].dtype)),
+                    }
+                return out
+
+            fn = self._prefix_pack_cache[Lb] = get_compile_watch().wrap_jit(
+                "serving.prefix_pack", jax.jit(pack, donate_argnums=(0,)))
         return fn

@@ -18,12 +18,29 @@ trash page: unmapped table entries are 0, so inactive/paused slots' writes
 land there and reads of unallocated logical pages gather finite garbage
 that causal masking weighs to exactly 0 (see
 ops/attention.py:paged_attention_step).
+
+PREFIX SHARING (PR 7): physical pages are REFCOUNTED so one committed page
+can back the same prompt prefix in many slots at once (and sit in the
+prefix index, serving/prefix_tree.py, between requests).  The contract:
+
+  * `_ref[p]` counts slot-table mappings of physical page p; `_cached[p]`
+    marks pages held read-only by the prefix index.  A page returns to the
+    free list only when BOTH drop away.
+  * a page with `_ref > 1` or `_cached` set is SHARED and must never be
+    written — the engine calls `ensure_writable` before any write into a
+    mapped page, which COWs a private copy (device page copy + remap) when
+    the page is shared.
+  * when the free list runs dry the allocator first asks
+    `on_page_pressure(n)` (the prefix index's LRU eviction) to reclaim
+    cached refcount-zero pages — eviction before pausing slots, preemption
+    stays last resort.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,8 +51,8 @@ class PagedKVCache:
     `pages_per_slot * page_size` bounds one slot's context (prompt +
     generated); `num_pages` bounds the whole pool (default: worst case,
     every slot full, plus the trash page — pass something smaller to
-    overcommit, the engine then pauses slots/defers admission when the
-    free list runs dry)."""
+    overcommit, the engine then evicts cached prefixes / pauses slots /
+    defers admission when the free list runs dry)."""
 
     def __init__(self, executor, num_slots: int, page_size: int,
                  pages_per_slot: int, num_pages: Optional[int] = None):
@@ -67,8 +84,23 @@ class PagedKVCache:
         # host allocator state: table[s, j] = physical page backing logical
         # page j of slot s (0 = unmapped -> trash)
         self.table = np.zeros((num_slots, pages_per_slot), np.int32)
-        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._free = self._canonical_free()
         self._n_pages = np.zeros(num_slots, np.int32)
+        # per-physical-page slot-mapping refcount + prefix-index membership
+        self._ref = np.zeros(self.num_pages, np.int32)
+        self._cached = np.zeros(self.num_pages, bool)
+        # called with the page shortfall when the free list runs dry;
+        # returns pages reclaimed (the prefix index's LRU eviction —
+        # serving/engine.py wires it).  None = no reclaimer, fail dry.
+        self.on_page_pressure: Optional[Callable[[int], int]] = None
+        self.n_cow = 0                 # copy-on-write page copies performed
+        self._copy_fn = None           # lazily-jitted device page copy
+
+    def _canonical_free(self) -> list:
+        """The free list in its construction-time canonical order (pop()
+        hands out page 1 first) — reset() rebuilds exactly this, so page
+        placement is reproducible across engine restarts."""
+        return list(range(self.num_pages - 1, 0, -1))
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -82,39 +114,208 @@ class PagedKVCache:
 
     @property
     def pages_in_use(self) -> int:
+        """Pages not on the free list: slot-mapped (private or shared) plus
+        pages retained only by the prefix index."""
         return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def private_pages_in_use(self) -> int:
+        """Pages mapped by exactly one slot and not in the prefix index."""
+        return int(np.sum((self._ref == 1) & ~self._cached))
+
+    @property
+    def shared_pages_in_use(self) -> int:
+        """Slot-mapped pages that are shared: mapped by >1 slot, or mapped
+        while also held by the prefix index (read-only either way)."""
+        return int(np.sum((self._ref >= 1) &
+                          ((self._ref > 1) | self._cached)))
+
+    @property
+    def cached_page_count(self) -> int:
+        """Pages held ONLY by the prefix index — reclaimable by eviction."""
+        return int(np.sum((self._ref == 0) & self._cached))
 
     def pages_for(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.page_size)
 
     # -- allocator --------------------------------------------------------
+    def _alloc_page(self) -> Optional[int]:
+        """Pop one free page, asking the pressure hook (prefix-index LRU
+        eviction) to reclaim when the list is dry.  None = genuinely out."""
+        if not self._free and self.on_page_pressure is not None:
+            self.on_page_pressure(1)
+        if not self._free:
+            return None
+        page = self._free.pop()
+        assert self._ref[page] == 0 and not self._cached[page], \
+            f"free list held a referenced page {page}"
+        return page
+
     def try_grow(self, slot: int, n_tokens: int) -> bool:
         """Ensure `slot` has pages covering `n_tokens` tokens, allocating
-        from the free list on demand.  False (and no change beyond pages
-        already grabbed — they stay with the slot for the retry) when the
-        free list runs dry: the caller pauses the slot or defers the
-        admission."""
+        from the free list on demand (evicting cached prefixes under
+        pressure).  False (and no change beyond pages already grabbed —
+        they stay with the slot for the retry) when the pool is genuinely
+        dry: the caller pauses the slot or defers the admission."""
         need = self.pages_for(n_tokens)
         assert need <= self.pages_per_slot, \
             f"slot {slot}: {n_tokens} tokens exceed the " \
             f"{self.capacity_tokens}-token slot capacity"
+        # ask for the whole shortfall in ONE pressure call (one tree walk),
+        # not page-by-page through _alloc_page's single-page fallback
+        shortfall = (need - int(self._n_pages[slot])) - len(self._free)
+        if shortfall > 0 and self.on_page_pressure is not None:
+            self.on_page_pressure(shortfall)
         while self._n_pages[slot] < need:
-            if not self._free:
+            page = self._alloc_page()
+            if page is None:
                 return False
-            page = self._free.pop()
+            self._ref[page] = 1
             self.table[slot, self._n_pages[slot]] = page
             self._n_pages[slot] += 1
         return True
 
+    def map_shared(self, slot: int, pages) -> None:
+        """Map already-committed (prefix-index) pages read-only into an
+        EMPTY slot's table as its first logical pages — the prefix-hit
+        admission path.  Bumps each page's refcount; the pages must never
+        be written through this slot until `ensure_writable` COWs them."""
+        assert self._n_pages[slot] == 0, \
+            f"slot {slot} is not empty — shared prefixes map at admission"
+        assert len(pages) <= self.pages_per_slot
+        for j, page in enumerate(pages):
+            page = int(page)
+            assert 0 < page < self.num_pages and (
+                self._ref[page] > 0 or self._cached[page]), \
+                f"page {page} is not a live committed page"
+            self._ref[page] += 1
+            self.table[slot, j] = page
+        self._n_pages[slot] = len(pages)
+
+    def page_writable(self, page: int) -> bool:
+        return self._ref[page] == 1 and not self._cached[page]
+
+    def ensure_writable(self, slot: int, j: int) -> Optional[bool]:
+        """Make logical page `j` of `slot` safe to write: if the mapped
+        physical page is shared (multi-mapped or prefix-cached), allocate a
+        private page, device-copy the contents, and remap.  Returns True if
+        a COW copy happened, False if the page was already private, None if
+        a copy was needed but the pool is dry (caller rolls back)."""
+        assert j < self._n_pages[slot], f"slot {slot} has no logical page {j}"
+        page = int(self.table[slot, j])
+        if self.page_writable(page):
+            return False
+        fresh = self._alloc_page()
+        if fresh is None:
+            return None
+        self.pools = self._page_copy()(self.pools, fresh, page)
+        self._ref[fresh] = 1
+        self.table[slot, j] = fresh
+        self._unref(page)
+        self.n_cow += 1
+        return True
+
+    def _unref(self, page: int) -> None:
+        assert self._ref[page] >= 1, \
+            f"page {page} unreferenced below zero (double release?)"
+        self._ref[page] -= 1
+        if self._ref[page] == 0 and not self._cached[page]:
+            self._free.append(page)
+
     def release(self, slot: int) -> None:
-        """Return every page of `slot` to the free list (retire/abort)."""
+        """Drop every mapping of `slot` (retire/abort): each page's
+        refcount decrements, and pages no slot maps and the prefix index
+        does not hold return to the free list.  Idempotent — a second
+        release (or a release after reset()) is a no-op, it can never
+        append the same physical page to the free list twice."""
         for j in range(int(self._n_pages[slot])):
-            self._free.append(int(self.table[slot, j]))
+            self._unref(int(self.table[slot, j]))
         self.table[slot, :] = 0
         self._n_pages[slot] = 0
 
     def reset(self) -> None:
-        """Release every slot (pool contents need no zeroing: stale pages
-        are unreachable once unmapped, and masked if ever gathered)."""
+        """Release every slot AND forget all prefix-index retention, then
+        rebuild the free list in CANONICAL order — page placement after a
+        reset is bit-reproducible across engine restarts (exactness tests
+        and postmortem engine.json snapshots stay stable).  The caller
+        owning a prefix index must clear it too (its nodes' pages are no
+        longer retained here); ServingEngine.reset_prefix_cache does both.
+        Pool contents need no zeroing: stale pages are unreachable once
+        unmapped, and masked if ever gathered."""
+        self.table[:, :] = 0
+        self._n_pages[:] = 0
+        self._ref[:] = 0
+        self._cached[:] = False
+        self._free = self._canonical_free()
+
+    # -- prefix-index retention -------------------------------------------
+    def cache_page(self, page: int) -> None:
+        """Mark `page` as held by the prefix index (called at donation —
+        the donor slot still maps it, so it cannot be on the free list)."""
+        page = int(page)
+        assert 0 < page < self.num_pages
+        assert self._ref[page] >= 1, \
+            f"page {page} donated to the prefix index without a live mapping"
+        self._cached[page] = True
+
+    def uncache_page(self, page: int) -> None:
+        """Drop prefix-index retention of `page` (eviction); frees it when
+        no slot maps it either."""
+        page = int(page)
+        assert self._cached[page], f"page {page} is not prefix-cached"
+        self._cached[page] = False
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+    # -- device page copy (COW) -------------------------------------------
+    def _page_copy(self):
+        if self._copy_fn is None:
+            def copy(pools, dst, src):
+                return {name: {
+                    "k": pools[name]["k"].at[dst].set(pools[name]["k"][src]),
+                    "v": pools[name]["v"].at[dst].set(pools[name]["v"][src]),
+                } for name in pools}
+
+            from paddle_tpu.obs.compile_watch import get_compile_watch
+            self._copy_fn = get_compile_watch().wrap_jit(
+                "serving.cow_copy", jax.jit(copy, donate_argnums=(0,)))
+        return self._copy_fn
+
+    # -- debugging / test oracle ------------------------------------------
+    def check(self) -> None:
+        """Assert the allocator invariants (tests call this after
+        workloads): refcounts agree with the tables, the free list is
+        exactly the unreferenced-and-uncached pages, no duplicates."""
+        ref = np.zeros(self.num_pages, np.int32)
         for s in range(self.num_slots):
-            self.release(s)
+            for j in range(int(self._n_pages[s])):
+                page = int(self.table[s, j])
+                assert 0 < page < self.num_pages, \
+                    f"slot {s} maps invalid page {page}"
+                ref[page] += 1
+        assert (ref == self._ref).all(), \
+            f"refcounts disagree with tables: {self._ref.tolist()} vs " \
+            f"recomputed {ref.tolist()}"
+        free = set(self._free)
+        assert len(free) == len(self._free), "free list holds duplicates"
+        expect = {p for p in range(1, self.num_pages)
+                  if self._ref[p] == 0 and not self._cached[p]}
+        assert free == expect, \
+            f"free list {sorted(free)} != unreferenced pages {sorted(expect)}"
+        assert not self._cached[0] and self._ref[0] == 0, \
+            "trash page 0 must never be referenced or cached"
+
+    def check_reclaimed(self) -> None:
+        """check() plus the end-of-workload invariant: no slot holds
+        pages (private or shared), and everything off the free list is
+        retained ONLY by the prefix index — evictable on demand, so the
+        pool is fully reclaimable even though retired pages stay cached."""
+        self.check()
+        assert self.private_pages_in_use == 0, \
+            f"{self.private_pages_in_use} private pages still slot-mapped"
+        assert self.shared_pages_in_use == 0, \
+            f"{self.shared_pages_in_use} shared pages still slot-mapped"
+        assert self.free_page_count + self.cached_page_count == \
+            self.num_pages - 1, \
+            f"free {self.free_page_count} + cached " \
+            f"{self.cached_page_count} != pool {self.num_pages - 1}"

@@ -1,0 +1,211 @@
+"""Radix prefix index over committed KV pages — host-side prefix caching.
+
+Production serving traffic is dominated by shared prompt prefixes (system
+prompts, few-shot templates, multi-turn chat history), yet every admission
+used to pay full prefill even when the first N pages of KV were
+bit-identical to work already done.  The page-table indirection of
+serving/paged_kv.py makes sharing nearly free on the device side: a cached
+prefix is just table entries pointing at already-committed physical pages
+(the prefix-cache configuration of the Ragged Paged Attention line,
+arXiv:2604.15464, on the slot/page serving design of arXiv:2605.25645).
+
+The index is a radix tree keyed on token-id runs at PAGE granularity: each
+node covers exactly `page_size` consecutive token ids and names the one
+physical page holding their committed K/V.  A path from the root spells a
+prompt prefix in whole pages.  On top of the full-page walk, `match` also
+probes ONE page deeper for a partial-run match — a child whose run starts
+with the remaining (< page_size) tokens.  Mapping that boundary page gives
+the admission up to page_size-1 more cached tokens; because the request
+will write its own divergent suffix into that page mid-run, the engine
+must copy-on-write it first (PagedKVCache.ensure_writable) — the "COW
+divergence mid-page" case.
+
+Ownership: the tree holds pages via PagedKVCache's `_cached` mark (no
+refcount of its own).  A node whose page no slot maps (`_ref == 0`) is
+reclaimable; when the allocator runs out of pages it calls `evict_for`
+(wired as `kv.on_page_pressure`), which evicts least-recently-used LEAVES
+first — leaf-first keeps the prefix property (a parent outlives its
+children), and refcount-zero-first means eviction never steals a page out
+from under a live slot.  Eviction runs BEFORE the engine pauses slots;
+preemption stays last resort.
+
+Single-threaded by design: all calls happen on the engine's step()-driving
+thread (the pump), like the rest of the scheduler state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.obs.flight import get_flight_recorder
+
+
+class _Node:
+    __slots__ = ("run", "page", "parent", "children", "by_first",
+                 "last_use")
+
+    def __init__(self, run: tuple, page: int, parent: Optional["_Node"]):
+        self.run = run                  # page_size token ids (() for root)
+        self.page = page                # physical page id (-1 for root)
+        self.parent = parent
+        self.children: dict[tuple, _Node] = {}
+        # first-token index over children: the partial-boundary probe
+        # scans only runs sharing the probe's first token — donation adds
+        # one divergent-boundary child per retired suffix under a hot
+        # prefix node, and a linear scan there would put O(children)
+        # admission cost on the hottest prefix exactly
+        self.by_first: dict[int, dict[tuple, _Node]] = {}
+        self.last_use = 0
+
+    def add_child(self, child: "_Node") -> None:
+        self.children[child.run] = child
+        self.by_first.setdefault(child.run[0], {})[child.run] = child
+
+    def drop_child(self, child: "_Node") -> None:
+        del self.children[child.run]
+        d = self.by_first[child.run[0]]
+        del d[child.run]
+        if not d:
+            del self.by_first[child.run[0]]
+
+
+class PrefixTree:
+    """Radix index over committed pages of one PagedKVCache."""
+
+    def __init__(self, kv):
+        self.kv = kv
+        self.ps = int(kv.page_size)
+        self.root = _Node((), -1, None)
+        self._clock = 0
+        self.flight = get_flight_recorder()
+        self.n_nodes = 0
+        self.n_evictions = 0
+
+    # -- LRU ---------------------------------------------------------------
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    # -- lookup ------------------------------------------------------------
+    def match(self, tokens) -> tuple[list[int], Optional[tuple[int, int]]]:
+        """Longest cached prefix of `tokens`: returns
+        (full_page_ids, partial) where `full_page_ids` are the physical
+        pages of the matched whole-page runs, and `partial` is
+        (boundary_page_id, r) when a child's run additionally matches the
+        next r (1 <= r < page_size... or up to the tokens left) tokens —
+        the caller maps that page too and MUST copy-on-write it before its
+        first write.  Ties between partially-matching children break
+        deterministically (longest match, then smallest run).  Touches the
+        matched path for LRU."""
+        toks = np.asarray(tokens).reshape(-1)
+        node, pages = self.root, []
+        i, n = 0, int(toks.size)
+        while n - i >= self.ps:
+            run = tuple(int(t) for t in toks[i:i + self.ps])
+            child = node.children.get(run)
+            if child is None:
+                break
+            node = child
+            self._touch(node)
+            pages.append(child.page)
+            i += self.ps
+        partial = None
+        rest = tuple(int(t) for t in toks[i:i + self.ps])
+        if rest:
+            best, best_r = None, 0
+            # only children whose run starts with the probe's first token
+            # can match (r >= 1) — the by_first index skips the rest
+            for run, child in node.by_first.get(rest[0], {}).items():
+                r = 1
+                while r < len(rest) and run[r] == rest[r]:
+                    r += 1
+                if r > best_r or (r == best_r and
+                                  best is not None and run < best.run):
+                    best, best_r = child, r
+            if best is not None:
+                self._touch(best)
+                partial = (best.page, best_r)
+        return pages, partial
+
+    # -- insertion (donation at retire/preempt/abort) ----------------------
+    def insert(self, tokens, pages) -> int:
+        """Register `len(pages)` fully-committed pages: pages[j] holds the
+        K/V of tokens[j*ps:(j+1)*ps].  A run already present keeps its
+        existing physical page (the donated duplicate stays with the
+        donor's normal release flow — it frees when the slot lets go);
+        new runs retain their page via kv.cache_page.  Returns the number
+        of nodes added."""
+        toks = np.asarray(tokens).reshape(-1)
+        assert toks.size >= len(pages) * self.ps
+        node, added = self.root, 0
+        for j, page in enumerate(pages):
+            run = tuple(int(t) for t in toks[j * self.ps:(j + 1) * self.ps])
+            child = node.children.get(run)
+            if child is None:
+                child = _Node(run, int(page), node)
+                node.add_child(child)
+                self.kv.cache_page(int(page))
+                self.n_nodes += 1
+                added += 1
+            self._touch(child)
+            node = child
+        return added
+
+    # -- eviction (the allocator's page-pressure hook) ----------------------
+    def _evictable_leaves(self):
+        out = []
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif self.kv._ref[node.page] == 0:
+                out.append(node)
+        return out
+
+    def evict_for(self, n_pages: int) -> int:
+        """Reclaim up to `n_pages` pages by evicting LRU leaves whose page
+        no slot maps.  Returns pages actually freed.  Wired as
+        `kv.on_page_pressure`, so try_grow/COW call here before failing —
+        eviction before pausing slots, preemption last resort.
+
+        One tree walk per CALL, not per freed page: the evictable leaves
+        go into a min-heap on last_use, and a victim's parent enters the
+        heap the moment it becomes a childless refcount-zero node — the
+        multi-page reclaim an overcommitted admission needs is
+        O(nodes + freed·log nodes), not O(freed·nodes), precisely when
+        the pool is under the pressure eviction exists to relieve.
+        Single-threaded with the allocator, so no heap entry goes stale
+        mid-call; ties on last_use (never-touched nodes share 0) break by
+        insertion order."""
+        import heapq
+
+        freed = 0
+        heap = []
+        for i, nd in enumerate(self._evictable_leaves()):
+            heap.append((nd.last_use, i, nd))
+        heapq.heapify(heap)
+        seq = len(heap)
+        while freed < int(n_pages) and heap:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            parent.drop_child(victim)
+            self.kv.uncache_page(victim.page)
+            self.n_nodes -= 1
+            self.n_evictions += 1
+            freed += 1
+            self.flight.record("prefix_evict", page=int(victim.page),
+                               nodes_left=self.n_nodes)
+            if parent is not self.root and not parent.children and \
+                    self.kv._ref[parent.page] == 0:
+                heapq.heappush(heap, (parent.last_use, seq, parent))
+                seq += 1
+        return freed
+
+    def clear(self) -> None:
+        """Forget everything WITHOUT touching allocator state — pair with
+        kv.reset(), which already drops the `_cached` marks."""
+        self.root = _Node((), -1, None)
+        self.n_nodes = 0

@@ -220,6 +220,26 @@ class ServingServer:
                  float(eng.n_cancelled)),
                 ("serving_expired_total", "counter", None,
                  float(eng.n_expired)),
+                # prefix caching: hit/miss/saved counters plus the
+                # private/shared/cached page-accounting split
+                ("serving_private_pages_in_use", "gauge", None,
+                 float(eng.kv.private_pages_in_use)),
+                ("serving_shared_pages_in_use", "gauge", None,
+                 float(eng.kv.shared_pages_in_use)),
+                ("serving_prefix_cached_pages", "gauge", None,
+                 float(eng.kv.cached_page_count)),
+                ("serving_prefix_nodes", "gauge", None,
+                 float(eng.prefix.n_nodes if eng.prefix else 0)),
+                ("serving_prefix_hits_total", "counter", None,
+                 float(eng.n_prefix_hits)),
+                ("serving_prefix_misses_total", "counter", None,
+                 float(eng.n_prefix_misses)),
+                ("serving_prefix_tokens_saved_total", "counter", None,
+                 float(eng.prefill_tokens_saved)),
+                ("serving_prefix_evictions_total", "counter", None,
+                 float(eng.prefix.n_evictions if eng.prefix else 0)),
+                ("serving_prefix_cow_total", "counter", None,
+                 float(eng.kv.n_cow)),
             ]
 
         reg.register_collector(engine_state)
@@ -552,6 +572,18 @@ class ServingServer:
             "n_preemptions": eng.n_preemptions,
             "n_cancelled": eng.n_cancelled,
             "n_expired": eng.n_expired,
+            "prefix_cache": _safe(lambda: {
+                "enabled": eng.prefix is not None,
+                "nodes": eng.prefix.n_nodes if eng.prefix else 0,
+                "cached_pages": int(eng.kv.cached_page_count),
+                "shared_pages_in_use": int(eng.kv.shared_pages_in_use),
+                "private_pages_in_use": int(eng.kv.private_pages_in_use),
+                "hits": eng.n_prefix_hits,
+                "misses": eng.n_prefix_misses,
+                "tokens_saved": eng.prefill_tokens_saved,
+                "evictions": eng.prefix.n_evictions if eng.prefix else 0,
+                "cow": int(eng.kv.n_cow),
+            }),
             "compile_watch": get_compile_watch().snapshot(),
             "hbm": hbm_snapshot(params=eng.params, kv=eng.kv),
         }
@@ -564,6 +596,7 @@ class ServingServer:
             "page_size": int(self.engine.kv.page_size),
             "num_pages": int(self.engine.kv.num_pages),
             "capacity_tokens": int(self.engine.kv.capacity_tokens),
+            "prefix_cache": self.engine.prefix is not None,
             "wedge_threshold_s": self.wedge_threshold_s,
             "postmortem_dir": self.postmortem_dir,
         }
@@ -857,6 +890,12 @@ class ServingServer:
             "preemptions": eng.n_preemptions,
             "cancelled": eng.n_cancelled,
             "expired": eng.n_expired,
+            "prefix_hits": eng.n_prefix_hits,
+            "prefix_misses": eng.n_prefix_misses,
+            "prefix_tokens_saved": eng.prefill_tokens_saved,
+            "prefix_cached_pages": int(eng.kv.cached_page_count),
+            "prefix_evictions": (eng.prefix.n_evictions
+                                 if eng.prefix else 0),
         }
 
     def _stats_msg(self, engine_part: Optional[dict]) -> dict:

@@ -101,6 +101,36 @@ def test_assemble_lkg_stitches_serving_record(tmp_path):
     assert out["serving"]["occupancy"] == 0.9
 
 
+def test_assemble_lkg_stitches_serving_prefix_record(tmp_path):
+    """PR 7 wiring: the prefix-cache record (lm_serving_prefix_hit_rate +
+    the prefill-tokens-saved companion) rides the same per-config queue
+    shape — a top-level BENCH_ONLY=serving_prefix record must stitch into
+    the assembled fallback under the `serving_prefix` key with its
+    companion fields intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_prefix"] == "lm_serving_prefix_hit_rate"
+    assert "serving_prefix" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-01T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-02T10:00:00+00:00",
+         "record": {"metric": M["serving_prefix"], "value": 0.94,
+                    "lm_serving_prefill_tokens_saved_total": 5760,
+                    "first_tok_ms_p50": 449.2,
+                    "baseline_first_tok_ms_p50": 835.5,
+                    "measured_at": "2026-08-02T10:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_prefix"]["value"] == 0.94
+    assert out["serving_prefix"][
+        "lm_serving_prefill_tokens_saved_total"] == 5760
+    assert out["serving_prefix"]["baseline_first_tok_ms_p50"] == 835.5
+
+
 def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
     """PR 4 wiring: the serving record's p99 per-token latency companion
     (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the

@@ -1,0 +1,405 @@
+"""Prefix caching: refcounted copy-on-write page sharing in the paged KV
+cache (serving/prefix_tree.py + the PR-7 allocator/engine changes).
+
+The exactness contract is unchanged and non-negotiable: a prefix-HIT
+request's tokens are bit-identical to a cold `lm_generate(use_cache=True)`
+run — including under LRU eviction, COW divergence mid-page, and
+preemption-with-replay — while `_decode_step._cache_size() == 1` stays
+asserted (all sharing is host-side table/allocator state; the decode jit
+signature never changes)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.graph.lm_decode import lm_generate
+from paddle_tpu.serving import (PagedKVCache, PrefixTree, Request,
+                                ServingEngine)
+from paddle_tpu.trainer.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def tr():
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=23,dim=16,layers=2,heads=2,batch_size=4")
+    return Trainer(cfg, seed=7)
+
+
+def _oracle(tr, req: Request):
+    toks, lens = lm_generate(
+        tr.executor, tr.params, req.prompt_ids[None, :],
+        max_new=req.max_new, temperature=req.temperature, top_k=req.top_k,
+        top_p=req.top_p, eos_id=req.eos_id, rng=req.rng, use_cache=True)
+    return np.asarray(toks)[0, :int(np.asarray(lens)[0])]
+
+
+def _assert_exact(tr, reqs, results):
+    for r in reqs:
+        np.testing.assert_array_equal(
+            _oracle(tr, r), results[r.req_id],
+            err_msg=f"request {r.req_id!r} diverged from the cold "
+                    f"lm_generate oracle")
+
+
+def _pool_reclaimed(eng):
+    eng.kv.check_reclaimed()
+
+
+# ---------------------------------------------------------------------------
+# the token-exactness oracle, extended to the sharing paths
+# ---------------------------------------------------------------------------
+
+def test_shared_prefix_hits_stay_oracle_exact(tr):
+    """A pool of requests sharing one system-prompt prefix with distinct
+    suffixes and mixed sampling knobs: the first pays full prefill, the
+    rest prefix-hit (mapping the committed pages read-only + suffix-only
+    prefill) — every output bit-matches its own cold run, tokens-saved
+    accumulates, and the decode step stays ONE signature."""
+    rng = np.random.default_rng(0)
+    system = rng.integers(2, 23, 19).astype(np.int32)   # spans 2+ pages
+    knobs = [dict(), dict(temperature=0.8, top_k=5),
+             dict(temperature=0.7, top_p=0.9), dict(temperature=1.1)]
+    reqs = [Request(f"r{i}",
+                    np.concatenate([system,
+                                    rng.integers(2, 23, 3 + i)
+                                    .astype(np.int32)]),
+                    max_new=5, rng=jax.random.PRNGKey(40 + i), **kw)
+            for i, kw in enumerate(knobs)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64)
+    results = {}
+    for r in reqs:                        # sequential: each later request
+        results.update(eng.run([r]))      # sees the earlier donations
+    _assert_exact(tr, reqs, results)
+    assert eng.n_prefix_hits >= len(reqs) - 1
+    assert eng.prefill_tokens_saved >= (len(reqs) - 1) * 16, \
+        "hits did not skip the shared full pages"
+    assert eng._decode_step._cache_size() == 1
+    _pool_reclaimed(eng)
+
+
+def test_concurrent_same_prefix_requests_share_pages(tr):
+    """Two live slots mapping the same cached prefix simultaneously:
+    shared pages show refcount > 1 (shared_pages_in_use), neither slot
+    writes them (COW gave each a private boundary), and both outputs stay
+    exact."""
+    rng = np.random.default_rng(1)
+    system = rng.integers(2, 23, 17).astype(np.int32)
+    warm = Request("warm", system.copy(), max_new=9)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64)
+    res = eng.run([warm])
+    a = Request("a", np.concatenate([system, [3, 4, 5]]).astype(np.int32),
+                max_new=6)
+    b = Request("b", np.concatenate([system, [6, 7]]).astype(np.int32),
+                max_new=6)
+    eng.add_request(a)
+    eng.add_request(b)
+    eng.step()                            # both admitted, both hit
+    assert eng.n_prefix_hits == 2
+    assert eng.kv.shared_pages_in_use >= 2, \
+        "concurrent hits did not actually share physical pages"
+    eng.kv.check()
+    res.update(eng.run())
+    _assert_exact(tr, [warm, a, b], res)
+    assert eng._decode_step._cache_size() == 1
+    _pool_reclaimed(eng)
+
+
+def test_cow_divergence_mid_page_and_donor_page_intact(tr):
+    """B's prompt follows A's sequence INTO a page and diverges mid-run:
+    admission maps the boundary page, COWs it, and B's suffix overwrites
+    only its own copy — B is oracle-exact, and a third request repeating
+    A's exact prompt still hits the ORIGINAL page and stays exact (the
+    shared original was never written)."""
+    rng = np.random.default_rng(2)
+    base = rng.integers(2, 23, 13).astype(np.int32)     # 13 = 1.625 pages
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64)
+    a = Request("a", base.copy(), max_new=6)
+    results = eng.run([a])
+    cow0 = eng.kv.n_cow
+    # B: matches page 0 fully, then tokens 8..10 of page 1, then diverges
+    b_prompt = np.concatenate([base[:11],
+                               (base[11:13] + 1) % 23 + 2,
+                               rng.integers(2, 23, 4)]).astype(np.int32)
+    b = Request("b", b_prompt, max_new=6)
+    results.update(eng.run([b]))
+    assert eng.kv.n_cow > cow0, "mid-page divergence never copied-on-write"
+    assert eng.n_prefix_hits >= 1
+    # C repeats A's prompt exactly: the original boundary page must still
+    # hold A's committed K/V bit-for-bit
+    c = Request("c", base.copy(), max_new=6)
+    results.update(eng.run([c]))
+    _assert_exact(tr, [a, b, c], results)
+    assert eng._decode_step._cache_size() == 1
+    _pool_reclaimed(eng)
+
+
+def test_eviction_runs_before_pausing_and_stays_exact(tr):
+    """A tree fat with retired prefixes + a pool with no free pages left:
+    admission and decode growth reclaim via LRU eviction (free list was
+    dry) WITHOUT any preemption, and outputs stay exact."""
+    rng = np.random.default_rng(3)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=1, page_size=4,
+                        max_context=16, num_pages=5)    # 4 real pages
+    filler = [Request(f"f{i}", rng.integers(2, 23, 7).astype(np.int32),
+                      max_new=5) for i in range(2)]
+    results = {}
+    for r in filler:
+        results.update(eng.run([r]))
+    assert eng.kv.cached_page_count > 0
+    assert eng.kv.free_page_count < eng.kv.pages_for(9 + 7 - 1), \
+        "pool not tight enough to force eviction"
+    big = Request("big", rng.integers(2, 23, 9).astype(np.int32), max_new=7)
+    results.update(eng.run([big]))
+    assert eng.prefix.n_evictions > 0, "free list never pressured the tree"
+    assert eng.n_preemptions == 0, \
+        "eviction should have satisfied pressure before any preemption"
+    _assert_exact(tr, filler + [big], results)
+    _pool_reclaimed(eng)
+
+
+def test_eviction_racing_admission_of_the_same_prefix(tr):
+    """The admission that HITS a prefix also triggers eviction for its
+    suffix pages: the matched pages are mapped (refcount > 0) before the
+    pressure hook runs, so LRU eviction must reclaim OTHER nodes and can
+    never steal the prefix out from under the admission using it."""
+    rng = np.random.default_rng(4)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=1, page_size=4,
+                        max_context=16, num_pages=7)    # 6 real pages
+    keep = Request("keep", rng.integers(2, 23, 8).astype(np.int32),
+                   max_new=5)                            # donates 2+ pages
+    other = Request("other", rng.integers(2, 23, 7).astype(np.int32),
+                    max_new=4)
+    results = eng.run([keep])
+    results.update(eng.run([other]))
+    nodes_before = eng.prefix.n_nodes
+    assert eng.kv.cached_page_count >= 4
+    # rerun keep's prompt with a long suffix: hits keep's pages, and the
+    # suffix allocation must evict from `other`'s nodes
+    hit = Request("hit", np.concatenate(
+        [keep.prompt_ids, rng.integers(2, 23, 5)]).astype(np.int32),
+        max_new=3)
+    ev0 = eng.prefix.n_evictions
+    results.update(eng.run([hit]))
+    assert eng.n_prefix_hits >= 1
+    assert eng.prefix.n_evictions > ev0, "no eviction pressure occurred"
+    assert eng.prefix.n_nodes <= nodes_before + 3
+    _assert_exact(tr, [keep, other, hit], results)
+    _pool_reclaimed(eng)
+
+
+def test_preempt_replay_prefix_hits_and_refcounts_balance(tr):
+    """Preemption donates the victim's committed pages; the deterministic
+    replay re-admission prefix-hits its own prompt (skipping the prefill
+    it already paid for), outputs stay exact, and slot-mapping refcounts
+    drop back to zero everywhere at the end."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(2, 23, n).astype(np.int32) for n in (6, 4, 5)]
+    reqs = [Request(i, p, max_new=8) for i, p in enumerate(prompts)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=16, num_pages=6)
+    results = eng.run(reqs)
+    assert eng.n_preemptions > 0, "pool was never overcommitted"
+    assert eng.n_prefix_hits > 0, \
+        "preempt replay never hit the victim's own donated prefix"
+    _assert_exact(tr, reqs, results)
+    assert (eng.kv._ref == 0).all()
+    assert eng._decode_step._cache_size() == 1
+    _pool_reclaimed(eng)
+
+
+def test_overcommit_pool_with_hits_stays_exact_under_churn(tr):
+    """Sustained churn over a small pool with repeated prompts: hits,
+    evictions, COWs, and preemptions all interleave — every request of
+    every wave still matches its cold oracle."""
+    rng = np.random.default_rng(6)
+    bases = [rng.integers(2, 23, 9).astype(np.int32) for _ in range(2)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=16, num_pages=8)
+    reqs = []
+    for w in range(3):
+        for i, base in enumerate(bases):
+            suffix = rng.integers(2, 23, 1 + w).astype(np.int32)
+            reqs.append(Request(f"w{w}b{i}",
+                                np.concatenate([base, suffix]),
+                                max_new=4))
+    results = {}
+    for r in reqs:
+        eng.add_request(r)
+        eng.step()
+    results.update(eng.run())
+    results.update({k: eng.results.pop(k) for k in list(eng.results)})
+    _assert_exact(tr, reqs, results)
+    assert eng.n_prefix_hits > 0
+    assert eng._decode_step._cache_size() == 1
+    _pool_reclaimed(eng)
+
+
+# ---------------------------------------------------------------------------
+# allocator satellites: double-release guard, deterministic reset, COW unit
+# ---------------------------------------------------------------------------
+
+def test_release_is_idempotent_and_guards_double_free(tr):
+    """Releasing a slot twice (or after reset()) must NOT append its pages
+    to the free list twice — the double-free would hand one physical page
+    to two slots and silently corrupt the allocator."""
+    kv = PagedKVCache(tr.executor, num_slots=2, page_size=4,
+                      pages_per_slot=3, num_pages=8)
+    assert kv.try_grow(0, 9)                 # 3 pages
+    assert kv.try_grow(1, 4)                 # 1 page
+    free_before = kv.free_page_count
+    kv.release(0)
+    assert kv.free_page_count == free_before + 3
+    kv.release(0)                            # double release: no-op
+    assert kv.free_page_count == free_before + 3
+    kv.check()
+    kv.reset()
+    kv.release(0)                            # release after reset: no-op
+    kv.release(1)
+    assert kv.free_page_count == kv.num_pages - 1
+    assert len(set(kv._free)) == len(kv._free), "free list holds duplicates"
+    kv.check()
+
+
+def test_reset_rebuilds_canonical_free_list(tr):
+    """After arbitrary grow/release churn, reset() restores the free list
+    to construction order, so page placement is reproducible across
+    restarts (exactness tests and engine.json snapshots stay stable)."""
+    kv = PagedKVCache(tr.executor, num_slots=2, page_size=4,
+                      pages_per_slot=3, num_pages=8)
+    pristine = list(kv._free)
+    assert kv.try_grow(0, 12) and kv.try_grow(1, 7)
+    kv.release(1)
+    kv.cache_page(int(kv.table[0, 0]))       # prefix retention survives...
+    kv.reset()                               # ...until reset forgets it
+    assert kv._free == pristine, \
+        f"reset() free list {kv._free} != canonical {pristine}"
+    assert kv.cached_page_count == 0 and (kv._ref == 0).all()
+    # allocation after reset is bit-reproducible: same pages, same order
+    assert kv.try_grow(0, 12)
+    first = kv.table[0, :3].tolist()
+    kv.reset()
+    assert kv.try_grow(0, 12)
+    assert kv.table[0, :3].tolist() == first
+    kv.check()
+
+
+def test_engine_reset_prefix_cache_restores_cold_start(tr):
+    """ServingEngine.reset_prefix_cache is the engine-level cold start:
+    the index empties, the free list returns to canonical order, and
+    re-running the same workload reproduces the same page placement AND
+    the same tokens (a restart is bit-indistinguishable from a fresh
+    engine)."""
+    rng = np.random.default_rng(5)
+    system = rng.integers(2, 23, 18).astype(np.int32)
+    mk = lambda: [Request(f"r{i}", np.concatenate(
+        [system, rng2.integers(2, 23, 2 + i).astype(np.int32)]), max_new=4)
+        for i, rng2 in ((j, np.random.default_rng(50 + j))
+                        for j in range(3))]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64)
+    first = eng.run(mk())
+    cached1 = np.flatnonzero(eng.kv._cached).tolist()
+    eng.reset_prefix_cache()
+    assert eng.prefix.n_nodes == 0 and eng.kv.cached_page_count == 0
+    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    assert eng.kv._free == eng.kv._canonical_free()
+    assert eng.n_prefix_hits > 0                  # first pass did share
+    again = eng.run(mk())
+    for rid in first:
+        np.testing.assert_array_equal(first[rid], again[rid])
+    # same physical pages ended up prefix-cached: placement reproduced
+    assert np.flatnonzero(eng.kv._cached).tolist() == cached1
+    _pool_reclaimed(eng)
+
+
+def test_map_shared_refcounts_and_cow_unit(tr):
+    """Allocator-level sharing: map_shared bumps refcounts, writes to a
+    shared page COW through ensure_writable (contents preserved), and the
+    last release frees everything exactly once."""
+    import jax.numpy as jnp
+
+    kv = PagedKVCache(tr.executor, num_slots=3, page_size=4,
+                      pages_per_slot=2, num_pages=8)
+    assert kv.try_grow(0, 8)                 # slot 0 owns 2 private pages
+    donor = [int(kv.table[0, 0]), int(kv.table[0, 1])]
+    name = next(iter(kv.pools))
+    kv.pools[name]["k"] = kv.pools[name]["k"].at[donor[0], 0, 0, 0].set(7.5)
+    kv.cache_page(donor[0])
+    kv.cache_page(donor[1])
+    kv.map_shared(1, donor)
+    kv.map_shared(2, donor[:1])
+    assert kv._ref[donor[0]] == 3 and kv._ref[donor[1]] == 2
+    assert kv.shared_pages_in_use == 2 and kv.private_pages_in_use == 0
+    assert not kv.page_writable(donor[0])
+    assert kv.ensure_writable(1, 0) is True            # COW copies
+    fresh = int(kv.table[1, 0])
+    assert fresh != donor[0] and kv.page_writable(fresh)
+    assert float(kv.pools[name]["k"][fresh, 0, 0, 0]) == 7.5, \
+        "COW did not copy the page contents"
+    assert kv._ref[donor[0]] == 2
+    assert kv.ensure_writable(1, 0) is False           # already private
+    kv.check()
+    kv.release(0)
+    kv.release(1)
+    kv.release(2)
+    # cached pages stay out of the free list until uncached
+    assert kv.cached_page_count == 2
+    kv.uncache_page(donor[0])
+    kv.uncache_page(donor[1])
+    assert kv.free_page_count == kv.num_pages - 1
+    kv.check()
+
+
+def test_cow_returns_none_when_pool_dry(tr):
+    """ensure_writable on a shared page with an empty free list and no
+    reclaimer reports None (caller rolls back) instead of corrupting."""
+    kv = PagedKVCache(tr.executor, num_slots=2, page_size=4,
+                      pages_per_slot=2, num_pages=3)    # 2 real pages
+    assert kv.try_grow(0, 8)
+    kv.cache_page(int(kv.table[0, 0]))
+    kv.map_shared(1, [int(kv.table[0, 0])])
+    assert kv.ensure_writable(1, 0) is None
+    kv.check()
+
+
+# ---------------------------------------------------------------------------
+# radix tree unit behavior
+# ---------------------------------------------------------------------------
+
+def test_prefix_tree_match_insert_evict(tr):
+    kv = PagedKVCache(tr.executor, num_slots=1, page_size=4,
+                      pages_per_slot=4, num_pages=12)
+    tree = PrefixTree(kv)
+    kv.on_page_pressure = tree.evict_for
+    toks = np.arange(2, 18, dtype=np.int32)              # 4 full runs
+    assert kv.try_grow(0, 16)
+    pages = [int(kv.table[0, j]) for j in range(4)]
+    assert tree.insert(toks, pages) == 4
+    assert tree.insert(toks, pages) == 0                 # dedupe: no new nodes
+    kv.release(0)
+    assert kv.cached_page_count == 4
+
+    full, partial = tree.match(toks[:11])                # 2 runs + 3 partial
+    assert full == pages[:2]
+    assert partial == (pages[2], 3)
+    full, partial = tree.match(np.asarray([99, 98], np.int32))
+    assert full == [] and partial is None
+
+    # eviction is LRU leaf-first: deepest node goes first, the prefix
+    # property (parents outlive children) holds throughout
+    assert tree.evict_for(1) == 1
+    assert kv.cached_page_count == 3
+    full, partial = tree.match(toks)
+    assert full == pages[:3], "eviction removed a non-leaf node"
+    # a page mapped by a live slot is never evicted
+    kv.map_shared(0, pages[:3])
+    assert tree.evict_for(99) == 0
+    kv.release(0)
+    assert tree.evict_for(99) == 3
+    assert kv.free_page_count == kv.num_pages - 1
+    kv.check()

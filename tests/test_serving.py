@@ -36,6 +36,13 @@ def _oracle(tr, req: Request):
     return np.asarray(toks)[0, :int(np.asarray(lens)[0])]
 
 
+def _assert_pool_reclaimed(eng):
+    """End-of-workload pool accounting under prefix caching — the
+    allocator's own check_reclaimed oracle (free or prefix-cached-only =
+    whole pool; no slot-mapped pages left)."""
+    eng.kv.check_reclaimed()
+
+
 def _assert_all_match(tr, reqs, results):
     for r in reqs:
         np.testing.assert_array_equal(
@@ -145,7 +152,7 @@ def test_overcommitted_pool_preempts_and_stays_exact():
     results = eng.run(reqs)
     _assert_all_match(tr, reqs, results)
     assert eng.n_preemptions > 0, "pool was never actually overcommitted"
-    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    _assert_pool_reclaimed(eng)
     assert eng._decode_step._cache_size() == 1
 
 
@@ -205,7 +212,7 @@ def test_failed_admission_releases_partial_page_grab():
     results = eng.run([a, b])
     assert set(results) == {"a", "b"}, "queued request was dropped"
     _assert_all_match(tr, [a, b], results)
-    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    _assert_pool_reclaimed(eng)
 
 
 def test_run_returns_only_its_own_completions_and_pools_stay_live():
@@ -243,9 +250,16 @@ def test_cancel_inflight_frees_slot_and_pages_and_survivors_stay_exact():
     for _ in range(3):                     # get the first wave mid-flight
         eng.step()
     victim = next(sl.req.req_id for sl in eng.slots if sl is not None)
-    pages_before = eng.kv.pages_in_use
+    # cancel must return the victim's pages to the pool THIS call — free
+    # outright, or donated to the prefix index (cached refcount-zero =
+    # reclaimable by eviction on the very next allocation)
+    reclaimable_before = eng.kv.free_page_count + eng.kv.cached_page_count
+    mapped_before = eng.kv.private_pages_in_use + eng.kv.shared_pages_in_use
     assert eng.cancel(victim)
-    assert eng.kv.pages_in_use < pages_before, "cancel freed no pages"
+    assert eng.kv.free_page_count + eng.kv.cached_page_count \
+        > reclaimable_before, "cancel freed no pages"
+    assert eng.kv.private_pages_in_use + eng.kv.shared_pages_in_use \
+        < mapped_before, "cancel left the victim's pages slot-mapped"
     assert not eng.cancel(victim), "double-cancel must report unknown"
     assert eng.finish_reasons[victim] == "cancelled"
     partial = eng.results[victim]
@@ -258,7 +272,7 @@ def test_cancel_inflight_frees_slot_and_pages_and_survivors_stay_exact():
     results = eng.run()
     survivors = [r for r in reqs if r.req_id != victim]
     _assert_all_match(tr, survivors, results)
-    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    _assert_pool_reclaimed(eng)
     assert eng._decode_step._cache_size() == 1
     assert eng.n_cancelled == 1
 
@@ -287,7 +301,7 @@ def test_deadline_expiry_frees_pages_for_waiting_requests():
     assert partial.size < _oracle(tr, a).size, \
         "deadline request ran to completion — never actually expired"
     _assert_all_match(tr, [b, c], results)
-    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    _assert_pool_reclaimed(eng)
     assert eng._decode_step._cache_size() == 1
 
 
@@ -353,7 +367,7 @@ def test_cancel_of_preempted_queued_request_keeps_streamed_tokens():
     results.update(eng.run())
     survivors = [r for r in reqs if r.req_id != victim.req_id]
     _assert_all_match(tr, survivors, results)
-    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    _assert_pool_reclaimed(eng)
 
 
 def test_cancel_mid_replay_reports_all_previously_streamed_tokens():
@@ -386,7 +400,7 @@ def test_cancel_mid_replay_reports_all_previously_streamed_tokens():
         err_msg="mid-replay cancel dropped already-delivered tokens")
     np.testing.assert_array_equal(toks, _oracle(tr, r)[:toks.size])
     assert eng.tokens_generated == tg + behind
-    assert eng.kv.free_page_count == eng.kv.num_pages - 1
+    _assert_pool_reclaimed(eng)
 
 
 def test_finish_hooks_fire_once_per_token_and_request():

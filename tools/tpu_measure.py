@@ -190,6 +190,8 @@ _REHEARSE_ENV = {
     "BENCH_SERVE_CONTEXT": "48", "BENCH_SERVE_REQS": "6",
     "BENCH_SERVE_PROMPT_LO": "3", "BENCH_SERVE_PROMPT_HI": "12",
     "BENCH_SERVE_MAX_NEW": "4", "BENCH_SERVE_REPS": "2",
+    "BENCH_SERVE_PREFIX_POOL": "2", "BENCH_SERVE_PREFIX_LEN": "16",
+    "BENCH_SERVE_SUFFIX_LO": "3", "BENCH_SERVE_SUFFIX_HI": "8",
 }
 
 
@@ -244,6 +246,15 @@ def main() -> int:
                         "--layers", "1", "--heads", "2",
                         "--dtype", "float32", "--reps", "1",
                         "--rate", "0,20"]
+        serving_prefix_args = ["--prefix-skew", "1.0",
+                               "--num-requests", "6", "--slots", "2",
+                               "--page-size", "8", "--max-context", "48",
+                               "--prefix-pool", "2", "--prefix-len", "16",
+                               "--suffix-lo", "3", "--suffix-hi", "8",
+                               "--max-new", "4", "--vocab", "64",
+                               "--dim", "32", "--layers", "1",
+                               "--heads", "2", "--dtype", "float32",
+                               "--reps", "1"]
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
         tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
                      "--heads", "2", "--target-ms", "5", "--reps", "1"]
@@ -258,6 +269,9 @@ def main() -> int:
         # closed-loop peak + the offered-load curve PERF.md's serving
         # section reads (tokens/s + occupancy vs arrival rate)
         serving_args = ["--rate", "0,4,16,64"]
+        # TPU-sized prefix-skew A/B (defaults: pool 8 x 128-token
+        # prefixes, Zipf 1.0, 16-64-token suffixes)
+        serving_prefix_args = ["--prefix-skew", "1.0"]
         rnn_args = []
         additive_args = []
         profile_args = []
@@ -295,6 +309,12 @@ def main() -> int:
          bench_env("serving", 840),
          lambda: _metric_fresh(_METRIC_OF["serving"], fh,
                                need_field="lm_serving_p99_tok_latency_ms")),
+        # prefix-cache effectiveness record (hit rate headline + prefill
+        # tokens saved + first-token p50 vs the no-cache baseline): the
+        # A/B runs the workload twice, so it gets the serving budget too
+        ("bench_serving_prefix_record", [py, "bench.py"], 900,
+         bench_env("serving_prefix", 840),
+         lambda: _metric_fresh(_METRIC_OF["serving_prefix"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
@@ -319,6 +339,11 @@ def main() -> int:
         ("bench_serving", [py, "tools/bench_serving.py"] + serving_args,
          1200, {},
          lambda: _out_fresh("bench_serving", fh)),
+        # prefix-skew sweep: the full-size A/B with the per-run breakdown
+        # (evictions, COW copies, suffix signatures) banked to OUT
+        ("bench_serving_prefix",
+         [py, "tools/bench_serving.py"] + serving_prefix_args, 1200, {},
+         lambda: _out_fresh("bench_serving_prefix", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
