@@ -570,12 +570,72 @@ def bench_serving_prefix(dtype: str) -> dict:
     }
 
 
+def bench_serving_chunked(dtype: str) -> dict:
+    """Chunked-prefill effectiveness record (mixed prefill/decode steps):
+    the heavy-tail prompt workload through ONE engine, chunking off
+    (legacy whole-prompt prefill — the head-of-line-blocking baseline)
+    then on — tools/bench_serving.py --prompt-dist heavy-tail is the
+    sweep tool, this is the compact record for the driver's BENCH
+    capture.  Headline = chunked-on p99 inter-token latency (LOWER is
+    better — the SLO chunking bounds by construction); companions are
+    the baseline p99s and the first-token tails both sides.  Exactness
+    against lm_generate is tests/test_chunked_prefill.py's job."""
+    import argparse
+
+    from tools.bench_serving import build_engine, measure_chunked
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        dtype=dtype)
+    max_new = int(os.environ.get("BENCH_SERVE_MAX_NEW", "64"))
+    hi = int(os.environ.get("BENCH_SERVE_HT_PROMPT_HI",
+                            str(args.max_context - max_new - 1)))
+    wl = dict(
+        n=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prompt_lo=int(os.environ.get("BENCH_SERVE_PROMPT_LO", "32")),
+        prompt_hi=min(hi, args.max_context - max_new - 1),
+        max_new=max_new,
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")))
+    reps = int(os.environ.get("BENCH_SERVE_REPS", "3"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0")) \
+        or 4 * args.page_size
+
+    eng = build_engine(args)
+    m = measure_chunked(eng, wl, reps, seed=0, prefill_chunk=chunk)
+    return {
+        "metric": "lm_serving_p99_itl_chunked_ms",
+        "value": m["itl_ms_p99"],
+        "unit": "ms (lower is better)",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"vocab={args.vocab} dim={args.dim} L={args.layers} "
+                  f"H={args.heads} slots={args.slots} "
+                  f"page={args.page_size} "
+                  f"prompts={wl['prompt_lo']}-{wl['prompt_hi']}(heavy-tail)"
+                  f" max_new={max_new} chunk={m['prefill_chunk']} "
+                  f"budget={m['max_step_tokens']}",
+        **{k: m[k] for k in (
+            "baseline_itl_ms_p50", "baseline_itl_ms_p99", "itl_ms_p50",
+            "baseline_first_tok_ms_p50", "baseline_first_tok_ms_p99",
+            "first_tok_ms_p50", "first_tok_ms_p99",
+            "baseline_tok_per_sec", "chunked_tok_per_sec",
+            "prefill_chunks", "p99_itl_improved",
+            "p99_first_tok_improved", "sig_stable")},
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
     "lm": bench_lm,
     "serving": bench_serving,
     "serving_prefix": bench_serving_prefix,
+    "serving_chunked": bench_serving_chunked,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -697,6 +757,7 @@ _METRIC_OF = {
     "lm": "transformer_lm_train_tokens_per_sec_per_chip",
     "serving": "lm_serving_tok_per_sec",
     "serving_prefix": "lm_serving_prefix_hit_rate",
+    "serving_chunked": "lm_serving_p99_itl_chunked_ms",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -779,8 +840,8 @@ def _assemble_lkg() -> dict | None:
         "metric": _METRIC_OF["vgg"], "value": 0.0,
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
-    for key in ("lm", "serving", "serving_prefix", "mnist", "sentiment",
-                "recommendation", "seq2seq"):
+    for key in ("lm", "serving", "serving_prefix", "serving_chunked",
+                "mnist", "sentiment", "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:

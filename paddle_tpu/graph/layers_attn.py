@@ -71,10 +71,16 @@ def multi_head_attention_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argumen
     cache = ctx.state_in.get(cfg.name)
     if isinstance(cache, dict) and "k_pages" in cache:
         # continuous-batching decode against the serving engine's paged KV
-        # pool (serving/paged_kv.py): one new token per SLOT, context read
-        # through the per-slot page table — the fixed-signature step the
-        # engine compiles once and reuses for the whole workload
+        # pool (serving/paged_kv.py): context read through the per-slot
+        # page table — the fixed-signature step the engine compiles once
+        # and reuses for the whole workload.  A cache carrying `row_slot`
+        # is the MIXED prefill/decode step: query tokens packed into one
+        # ragged row dimension (decode rows + prompt chunks), each row
+        # addressing its own table row at its own position
         assert causal, f"layer {cfg.name!r}: paged decode requires causal"
+        if "row_slot" in cache:
+            return _paged_ragged_step(ctx, cfg, q_arg, w_q, w_k, w_v, w_o,
+                                      num_heads, cache)
         return _paged_step(ctx, cfg, q_arg, w_q, w_k, w_v, w_o, num_heads,
                            cache)
     if isinstance(cache, dict) and "k" in cache:
@@ -283,6 +289,50 @@ def _paged_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
                                "page_table": cache["page_table"],
                                "pos": pos + 1}
     o = out.reshape(S, 1, model_dim) @ w_o
+    bias = ctx.bias_of(cfg)
+    if bias is not None:
+        o = o + bias
+    return finish_layer(ctx, cfg, o, like=x_arg)
+
+
+def _paged_ragged_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
+                       w_q, w_k, w_v, w_o, num_heads: int,
+                       cache: dict) -> Argument:
+    """One MIXED prefill/decode step against the paged pool: the input is
+    a packed ragged token list [1, T, model_dim] where row r is one token
+    of page-table row `cache["row_slot"][r]` at global position
+    `cache["row_pos"][r]` — live decode rows and in-flight prompt chunks
+    in one dispatch (ops/attention.py:ragged_paged_attention_step; the
+    Pallas row-indirected kernel when supported).  Emits the updated pool
+    through ctx.state_out; table and row maps are host-managed and pass
+    through untouched."""
+    from paddle_tpu.ops.attention import ragged_paged_attention_step, rope
+
+    x = x_arg.value                                   # [1, T, model_dim]
+    B, T, _ = x.shape
+    assert B == 1, (f"layer {cfg.name!r}: the mixed paged step packs all "
+                    f"query rows into one ragged batch row (got B={B})")
+    model_dim = w_q.shape[1]
+    Dh = model_dim // num_heads
+    h_kv = int(cfg.attrs.get("num_kv_heads", 0) or num_heads)
+    row_pos = cache["row_pos"]                        # [T] global positions
+    q = (x @ w_q).reshape(1, T, num_heads, Dh)
+    k = (x @ w_k).reshape(1, T, h_kv, Dh)
+    v = (x @ w_v).reshape(1, T, h_kv, Dh)
+    if bool(cfg.attrs.get("use_rope", False)):
+        theta = float(cfg.attrs.get("rope_theta", 10000.0))
+        q, k = rope(q, row_pos, theta), rope(k, row_pos, theta)
+    window = (int(cfg.attrs["window"]) if "window" in cfg.attrs else None)
+    out, ck, cv = ragged_paged_attention_step(
+        q[0], k[0], v[0], cache["k_pages"], cache["v_pages"],
+        cache["page_table"], cache["row_slot"], row_pos, window=window,
+        use_kernel=(False if str(cfg.attrs.get("attn_impl", "auto"))
+                    in ("dense", "blockwise") else None))
+    ctx.state_out[cfg.name] = {"k_pages": ck, "v_pages": cv,
+                               "page_table": cache["page_table"],
+                               "row_slot": cache["row_slot"],
+                               "row_pos": row_pos}
+    o = out.reshape(1, T, model_dim) @ w_o
     bias = ctx.bias_of(cfg)
     if bias is not None:
         o = o + bias

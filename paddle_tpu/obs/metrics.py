@@ -60,6 +60,18 @@ CATALOG: dict[str, str] = {
     "serving_prefix_cow_total":
         "copy-on-write page copies (divergence inside a shared boundary page)",
     "serving_decode_steps_total": "compiled decode steps executed",
+    # -- chunked prefill / mixed-step token budget -------------------------
+    "serving_step_tokens":
+        "scheduled token rows per compiled step (decode rows + prefill "
+        "chunk rows; bounded by max_step_tokens — the p99 inter-token "
+        "latency bound)",
+    "serving_prefill_chunks_total":
+        "prompt chunks scheduled into mixed prefill/decode steps",
+    "serving_mixed_steps_total":
+        "compiled steps that carried at least one prefill chunk row",
+    "serving_decode_gap_ms":
+        "pump-step gap decoding slots saw (ms between consecutive steps "
+        "advancing decode rows — HOL-blocking prefill shows here)",
     "serving_tokens_generated_total": "tokens emitted across all requests",
     "serving_preemptions_total": "slots preempted by page-pool pressure",
     "serving_cancelled_total": "requests aborted by client cancel/disconnect",

@@ -522,6 +522,92 @@ def paged_attention_step(
     return out, ck, cv
 
 
+def ragged_paged_attention_step(
+    q_new: Array,          # [T, H, D] packed query rows — ONE token each
+    k_new: Array,          # [T, H_kv, D]
+    v_new: Array,          # [T, H_kv, D]
+    k_pages: Array,        # [P, page_size, H_kv, D] shared page pool
+    v_pages: Array,        # [P, page_size, H_kv, D]
+    page_table: Array,     # [S, max_pages] int32 physical page per logical
+                           # page of each table row (0 = unmapped -> trash)
+    row_slot: Array,       # [T] int32 page-table row each query row reads
+    row_pos: Array,        # [T] int32 global position of each query row
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    use_kernel: Optional[bool] = None,
+) -> tuple[Array, Array, Array]:
+    """RAGGED paged attention — the mixed prefill/decode step of the
+    serving engine (the full Ragged Paged Attention shape of
+    arXiv:2604.15464, generalizing `paged_attention_step`'s one-token-per-
+    slot contract): query tokens are PACKED into a flat [T] row dimension
+    where row r is one token of slot `row_slot[r]` at global position
+    `row_pos[r]`.  A decode slot contributes one row; a prompt being
+    chunk-prefilled contributes up to `chunk` consecutive rows — both
+    shapes share this ONE dispatch, so a long cold prompt can no longer
+    stall every decoding slot's inter-token latency behind its own
+    prefill program.
+
+    Contract per row r: its k/v land at logical position row_pos[r] of
+    table row row_slot[r] (physical page page_table[row_slot[r],
+    row_pos[r] // page_size], offset row_pos[r] % page_size), and it
+    attends causally over that slot's logical positions 0..row_pos[r].
+    All writes scatter BEFORE the read, so chunk rows of the same slot
+    see each other's K/V under the causal mask (token i of a chunk
+    attends tokens 0..i — exactly the dense prefill semantics).  Padding
+    rows point `row_slot` at an all-zero table row (every logical page
+    unmapped -> trash page 0) with row_pos 0: their writes land in the
+    trash page and their outputs are garbage the scheduler discards.
+
+    Returns (out [T, H, D], new_k_pages, new_v_pages).  `use_kernel`
+    routes the read through the Pallas ragged-paged kernel with the
+    row->slot indirection (ops/pallas_paged.py); the jnp gather fallback
+    is the exactness oracle (and the sliding-window path)."""
+    T, H, D = q_new.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+
+    # -- write: scatter every row's k/v into its slot's current page -----
+    phys = page_table[row_slot, row_pos // page_size]             # [T]
+    off = row_pos % page_size
+    ck = k_pages.at[phys, off].set(k_new.astype(k_pages.dtype))
+    cv = v_pages.at[phys, off].set(v_new.astype(v_pages.dtype))
+
+    if use_kernel is None:
+        from paddle_tpu.ops import pallas_paged
+        use_kernel = pallas_paged.supported() and window is None
+    if use_kernel:
+        if window is not None:
+            raise ValueError(
+                "ragged_paged_attention_step: the Pallas ragged-paged "
+                "kernel has no sliding-window support — pass "
+                "use_kernel=False (or None for auto) for window attention")
+        from paddle_tpu.ops import pallas_paged
+        out = pallas_paged.paged_attention(q_new, ck, cv, page_table,
+                                           row_pos + 1, scale=scale,
+                                           row_slot=row_slot)
+        return out, ck, cv
+
+    # -- read: per-row page-table gather -> [T, T_ctx] contiguous view ---
+    T_ctx = max_pages * page_size
+    kc = ck[page_table[row_slot]].reshape(T, T_ctx, *ck.shape[2:])
+    vc = cv[page_table[row_slot]].reshape(T, T_ctx, *cv.shape[2:])
+    k_full, v_full = _expand_kv_heads(kc, vc, H)
+    t = jnp.arange(T_ctx)
+    mask = t[None, :] <= row_pos[:, None]                        # causal
+    if window is not None:
+        mask = jnp.logical_and(mask,
+                               t[None, :] > row_pos[:, None] - window)
+    s = jnp.einsum("qhd,qkhd->qhk", q_new, k_full) * scale
+    from paddle_tpu.utils.dtypes import promote_compute
+    s = promote_compute(s)
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_full.dtype)
+    out = jnp.einsum("qhk,qkhd->qhd", p, v_full)
+    return out, ck, cv
+
+
 def additive_attention_step(
     dec_state: Array,      # [B, Ds] decoder state for THIS timestep
     w: Array,              # [Ds, D] state transform

@@ -22,6 +22,15 @@ a static python loop), so the pool stays at H_kv heads and no expanded
 copy is ever materialized.  Sliding-window decode stays on the jnp
 fallback.  Interpret-mode parity with the fallback is the CPU oracle
 (tests/test_serving.py); on-TPU timing rides tools/bench_serving.py.
+
+MIXED prefill/decode (chunked prefill): the optional `row_slot` operand
+generalizes the query dimension from one-token-per-slot to a packed
+ragged row list — row r attends table row `row_slot[r]` up to
+`lengths[r]` tokens, so a prompt chunk (several consecutive rows, same
+slot) and live decode rows share one grid.  `row_slot` rides the same
+scalar-prefetch channel as the page table; everything else (online
+softmax over live pages, pl.when page skipping, in-kernel GQA) is
+unchanged.
 """
 
 from __future__ import annotations
@@ -61,8 +70,8 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _kernel(H, h_kv, ps, scale, table_ref, len_ref, q_ref, k_ref, v_ref,
-            o_ref, m_s, l_s, acc_s):
+def _kernel(H, h_kv, ps, scale, table_ref, len_ref, row_ref, q_ref, k_ref,
+            v_ref, o_ref, m_s, l_s, acc_s):
     p = pl.program_id(1)
     n_pages = pl.num_programs(1)
     s = pl.program_id(0)
@@ -117,22 +126,37 @@ def _kernel(H, h_kv, ps, scale, table_ref, len_ref, q_ref, k_ref, v_ref,
 
 
 def paged_attention(
-    q: Array,               # [S, H, D] one query token per slot
+    q: Array,               # [R, H, D] one query token per ROW
     k_pages: Array,         # [P, page_size, H_kv, D]
     v_pages: Array,         # [P, page_size, H_kv, D]
     page_table: Array,      # [S, max_pages] int32 (0 = unmapped)
-    lengths: Array,         # [S] int32 valid tokens per slot (incl. the
-                            # just-written one: attend t < lengths[s])
+    lengths: Array,         # [R] int32 valid tokens per row (incl. the
+                            # just-written one: attend t < lengths[r])
     scale: Optional[float] = None,
+    row_slot: Optional[Array] = None,   # [R] int32 page-table row each
+                            # query row reads; None = rows ARE slots
+                            # (the classic one-token-per-slot decode)
 ) -> Array:
-    """Ragged paged decode attention -> [S, H, D].  Same math as the jnp
-    fallback's gather path (online softmax re-association aside)."""
-    S, H, D = q.shape
+    """Ragged paged attention -> [R, H, D].  Same math as the jnp
+    fallback's gather path (online softmax re-association aside).
+
+    `row_slot` is the MIXED prefill/decode generalization (the full
+    ragged-query shape of arXiv:2604.15464): the query rows are no longer
+    one-per-slot — a chunk-prefilling prompt packs several consecutive
+    rows against the same page-table row, a decode slot keeps its single
+    row, and padding rows aim at an all-zero table row.  The indirection
+    rides the scalar-prefetch channel next to the page table, so the k/v
+    BlockSpec index map resolves `table[row_slot[r], p]` before the page
+    DMA is issued — same zero-copy pool streaming as the decode-only
+    kernel, one compiled program for any prefill/decode mix."""
+    R, H, D = q.shape
     P, ps, h_kv, _ = k_pages.shape
     maxp = page_table.shape[1]
     assert H % h_kv == 0, f"heads {H} not divisible by kv heads {h_kv}"
     if scale is None:
         scale = D ** -0.5
+    if row_slot is None:
+        row_slot = jnp.arange(R, dtype=jnp.int32)
 
     Hp = _round_up(max(H, 8), 8)
     Dp = _round_up(D, 128)
@@ -142,17 +166,20 @@ def paged_attention(
 
     kernel = functools.partial(_kernel, H, h_kv, ps, scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,               # page_table, lengths
-        grid=(S, maxp),
+        num_scalar_prefetch=3,               # page_table, lengths, row_slot
+        grid=(R, maxp),
         in_specs=[
-            pl.BlockSpec((1, Hp, Dp), lambda s, p, tbl, lens: (s, 0, 0)),
+            pl.BlockSpec((1, Hp, Dp),
+                         lambda s, p, tbl, lens, rows: (s, 0, 0)),
             pl.BlockSpec((1, ps, h_kv, Dp),
-                         lambda s, p, tbl, lens: (tbl[s, p], 0, 0, 0)),
+                         lambda s, p, tbl, lens, rows:
+                         (tbl[rows[s], p], 0, 0, 0)),
             pl.BlockSpec((1, ps, h_kv, Dp),
-                         lambda s, p, tbl, lens: (tbl[s, p], 0, 0, 0)),
+                         lambda s, p, tbl, lens, rows:
+                         (tbl[rows[s], p], 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, Hp, Dp),
-                               lambda s, p, tbl, lens: (s, 0, 0)),
+                               lambda s, p, tbl, lens, rows: (s, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hp, 128), jnp.float32),   # running max (lane 0)
             pltpu.VMEM((Hp, 128), jnp.float32),   # running sum (lane 0)
@@ -162,9 +189,10 @@ def paged_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, Hp, Dp), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((R, Hp, Dp), q.dtype),
         compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), qp, kp, vp)
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      row_slot.astype(jnp.int32), qp, kp, vp)
     return out[:, :H, :D]

@@ -11,9 +11,16 @@ the serving answer (the slot configuration studied in arXiv:2605.25645):
     so the chip never waits for the longest request of a batch;
   * KV context lives in the paged pool (serving/paged_kv.py) behind
     per-slot page tables — HBM proportional to tokens actually held;
-  * prompts PREFILL through the per-request dense cache path at
-    feeder-bucketed lengths (`data/feeder._bucket_len`), so prompt compiles
-    are per-bucket, not per-length;
+  * prompts PREFILL in fixed-size CHUNKS processed INSIDE the regular
+    step (the mixed prefill/decode shape of arXiv:2604.15464): decode
+    rows and prompt-chunk rows pack into one ragged [max_step_tokens]
+    dispatch, so a cold multi-thousand-token prompt no longer stalls
+    every decoding slot's inter-token latency behind its own prefill
+    program, and the per-step token budget bounds p99 inter-token
+    latency by construction.  Chunk count derives from prompt length —
+    any prompt the page pool can hold is admissible, no bucket ceiling.
+    `prefill_chunk=None` restores the legacy whole-prompt bucketed
+    prefill dispatches (`data/feeder._bucket_len`) — the A/B baseline;
   * per-slot rng streams and sampling knobs are preserved EXACTLY: request
     r's tokens are identical to `lm_generate(..., use_cache=True)` run on r
     alone (same rng key schedule, same sampler semantics via
@@ -92,19 +99,32 @@ class Request:
 
 
 class _Slot:
-    """Host-side state of one occupied decode slot."""
+    """Host-side state of one occupied decode slot.
+
+    Two modes, distinguished by `gen`: `gen == 0` is PREFILL mode — the
+    slot is still committing its prompt chunk-by-chunk through the mixed
+    step (`pos` = prompt tokens committed so far, nothing emitted yet);
+    `gen >= 1` is DECODE mode — token 0 was sampled from the last prompt
+    position's logits and the slot advances one token per step.  Legacy
+    (unchunked) admission constructs the slot directly in decode mode
+    with `first_tok` set."""
 
     __slots__ = ("req", "keys", "pos", "gen", "last_tok", "generated",
                  "admit_seq", "replay_until")
 
     def __init__(self, req: Request, keys: np.ndarray, pos: int,
-                 first_tok: int, admit_seq: int):
+                 first_tok: Optional[int], admit_seq: int):
         self.req = req
         self.keys = keys          # [max_new, 2] uint32 — key g samples token g
         self.pos = pos            # tokens resident in the paged cache
-        self.gen = 1              # tokens emitted so far (token 0 at admit)
-        self.last_tok = first_tok # emitted but not yet in the cache
-        self.generated = [first_tok]
+        if first_tok is None:     # prefill mode: nothing emitted yet
+            self.gen = 0
+            self.last_tok = -1
+            self.generated = []
+        else:
+            self.gen = 1          # tokens emitted so far (token 0 at admit)
+            self.last_tok = first_tok  # emitted but not yet in the cache
+            self.generated = [first_tok]
         self.admit_seq = admit_seq  # admission order — preemption victims
                                     # are youngest-first (least work lost)
         # tokens below this generation index are a post-preemption REPLAY
@@ -127,7 +147,9 @@ class ServingEngine:
                  num_pages: Optional[int] = None,
                  input_name: Optional[str] = None,
                  logits_name: Optional[str] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = -1,
+                 max_step_tokens: Optional[int] = None):
         self.executor = executor
         self.params = params
         self.input_name, self.logits_name = _resolve_io_names(
@@ -205,6 +227,41 @@ class ServingEngine:
         self._decode_step = get_compile_watch().wrap_jit(
             "serving.decode_step",
             jax.jit(self._decode_impl, donate_argnums=(1,)))
+        # CHUNKED PREFILL (mixed prefill/decode steps): prompts commit in
+        # `prefill_chunk`-token chunks INSIDE the regular step — decode
+        # rows and chunk rows pack into one ragged [max_step_tokens] row
+        # list (ops/attention.py:ragged_paged_attention_step), so a long
+        # cold prompt can no longer stall every decoding slot behind its
+        # own prefill dispatch, and the per-step token budget bounds p99
+        # inter-token latency BY CONSTRUCTION under adversarial prompt
+        # mixes.  Compiled signatures: the [S,1] decode step (pure-decode
+        # steps keep it) + ONE mixed-step signature per max_step_tokens
+        # value.  prefill_chunk=None disables chunking (legacy bucketed
+        # whole-prompt prefill); -1 (the default) picks 4*page_size.
+        self._mixed_step = get_compile_watch().wrap_jit(
+            "serving.mixed_step",
+            jax.jit(self._mixed_impl, donate_argnums=(1,)))
+        self.prefill_chunk: Optional[int] = None
+        self.max_step_tokens = 0
+        self.set_chunking(4 * self.kv.page_size if prefill_chunk == -1
+                          else prefill_chunk, max_step_tokens)
+        self.n_prefill_chunks = 0
+        self.n_mixed_steps = 0
+        # token-budget observability: per-step scheduled-token histogram
+        # and the pump-step gap decoding slots actually saw (ms) — the
+        # HOL-blocking number chunking exists to bound.  Standalone
+        # Histogram objects (obs/metrics.py shape); the server's engine
+        # collector splices their samples into the metrics frame.
+        from paddle_tpu.obs.metrics import Histogram as _Hist
+        import threading as _threading
+        self.step_tokens_hist = _Hist(
+            "serving_step_tokens", "", (), _threading.Lock(),
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048))
+        self.decode_gap_hist = _Hist(
+            "serving_decode_gap_ms", "", (), _threading.Lock(),
+            buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                     2500, 5000))
+        self._t_prev_decode: Optional[float] = None
 
     # -- lifecycle tracing helpers ----------------------------------------
     def _tr_on(self) -> bool:
@@ -343,30 +400,55 @@ class ServingEngine:
 
     def step(self) -> bool:
         """One scheduler iteration: sweep deadlines -> admit -> one
-        compiled decode step over all slots -> retire.  Returns False when
-        idle (nothing in flight and nothing admittable)."""
+        compiled step over all slots -> retire.  Returns False when idle
+        (nothing in flight and nothing admittable).
+
+        With chunked prefill on, a step with any slot mid-prefill runs
+        the MIXED step: decode rows and prompt-chunk rows pack into one
+        ragged [max_step_tokens] dispatch under the token budget.  Steps
+        with only decoding slots keep the classic [S, 1] decode step —
+        the steady state pays nothing for the chunk machinery."""
         self._sweep_deadlines()
         self._admit_from_queue()
         live = [s for s in range(len(self.slots)) if self.slots[s] is not None]
         if not live:
+            self._t_prev_decode = None   # idle: don't charge the idle gap
             return False
-        runnable = [s for s in live
-                    if self.kv.try_grow(s, self.slots[s].pos + 1)]
-        while not runnable:
-            # overcommitted-pool wedge: every live slot needs its next page
-            # and the free list is dry.  Preempt the YOUNGEST slot (the
-            # recompute policy of arXiv:2605.25645-style engines): release
-            # its pages and requeue its request at the queue front — its
+        while True:
+            # decode-mode slots need their next page; prefill-mode slots
+            # (gen == 0, chunked admission) had their prompt pages secured
+            # at reservation and can always take chunk rows
+            decoding = [s for s in live if self.slots[s].gen > 0]
+            filling = [s for s in live if self.slots[s].gen == 0]
+            runnable = [s for s in decoding
+                        if self.kv.try_grow(s, self.slots[s].pos + 1)]
+            if runnable or (filling and not decoding):
+                # chunk-only steps are progress ONLY while nothing is
+                # decoding: if every decoding slot is page-starved, letting
+                # a filler keep chunking would stall their inter-token
+                # latency for its whole remaining prefill — the exact
+                # HOL blocking the budget exists to bound — and the wedge
+                # preemption below would then evict the filler anyway,
+                # discarding a just-finished prefill
+                break
+            # overcommitted-pool wedge: every decoding slot needs its next
+            # page and the free list is dry (eviction included).  Preempt
+            # the YOUNGEST live slot (the recompute policy of
+            # arXiv:2605.25645-style engines) — usually the mid-prefill
+            # filler holding the reserved pages: release its pages and
+            # requeue its request at the queue front.  A decode victim's
             # deterministic per-request key schedule regenerates the exact
-            # same tokens when it is re-admitted, so preemption is
-            # invisible in the output (and in the parity oracle).
+            # same tokens on re-admission; a mid-prefill victim donates its
+            # committed chunk pages and prefix-hits them on replay — either
+            # way preemption is invisible in the output (and in the parity
+            # oracle).
             victim = max(live, key=lambda s: self.slots[s].admit_seq)
             self._preempt(victim)
             live.remove(victim)
             if not live:
                 return True        # pages freed; next step() re-admits
-            runnable = [s for s in live
-                        if self.kv.try_grow(s, self.slots[s].pos + 1)]
+        if filling:
+            return self._run_mixed_step(live, runnable, filling)
 
         traced = self._tr_on()
         t_step = time.perf_counter() if traced else 0.0
@@ -405,6 +487,7 @@ class ServingEngine:
         self.n_decode_steps += 1
         self.occupancy_sum += len(live) / S
         nxt = np.asarray(nxt)                          # host sync
+        self._note_step_metrics(len(runnable), decoded=True)
         if traced:
             # one engine-lane span per compiled step (dispatch + the host
             # token read = the inter-token latency every live slot paid)
@@ -413,23 +496,152 @@ class ServingEngine:
                             attrs={"live": len(live),
                                    "step": self.n_decode_steps})
         for s in runnable:
+            self._bank_token(s, int(nxt[s]))
+        return True
+
+    def _bank_token(self, s: int, tok: int) -> None:
+        """Record one decoded token for slot `s` (shared by the pure
+        decode step and the mixed step's decode rows): replay-phase flip,
+        stream hook, eos/max_new retirement."""
+        sl = self.slots[s]
+        if sl.replay_until and sl.gen >= sl.replay_until:
+            # the next token is the first FRESH one after a preempt
+            # replay — flip the lifecycle phase
+            sl.replay_until = 0
+            self._tr_end(sl.req.req_id)
+            self._tr_begin(sl.req.req_id, "decode")
+        sl.generated.append(tok)
+        sl.pos += 1
+        sl.gen += 1
+        sl.last_tok = tok
+        self.tokens_generated += 1
+        if self.on_token is not None:
+            self.on_token(sl.req.req_id, tok, sl.gen - 1)
+        if tok == sl.req.eos_id or sl.gen >= sl.req.max_new:
+            self._retire(s)
+
+    def _note_step_metrics(self, n_tokens: int, decoded: bool) -> None:
+        """Token-budget observability: scheduled rows this step, and the
+        pump-step gap decoding slots saw (time between consecutive steps
+        that advanced at least one decode row — the inter-token latency
+        floor HOL-blocking prefill used to blow up)."""
+        self.step_tokens_hist.observe(float(n_tokens))
+        if decoded:
+            now = time.perf_counter()
+            if self._t_prev_decode is not None:
+                self.decode_gap_hist.observe(
+                    (now - self._t_prev_decode) * 1e3)
+            self._t_prev_decode = now
+
+    def _run_mixed_step(self, live, runnable, filling) -> bool:
+        """ONE mixed prefill/decode dispatch: pack each runnable decode
+        slot's single row plus up to `prefill_chunk` prompt rows per
+        mid-prefill slot into a flat [max_step_tokens] ragged row list
+        (padding rows aim at a virtual all-trash table row), run the
+        compiled mixed step, then bank decode tokens and advance chunk
+        cursors.  A slot whose FINAL chunk ran this step emits token 0
+        from the last prompt position's logits (keys[0] — the same key
+        schedule the legacy one-dispatch prefill consumed), so chunk
+        rows emit nothing until their final chunk.
+
+        The per-step token budget is the HOL-blocking bound: decode rows
+        are packed FIRST (every decoding slot advances every step it has
+        pages for), chunk rows only fill what remains — so no single
+        step, whatever the prompt mix, exceeds max_step_tokens rows."""
+        traced = self._tr_on()
+        t_step = time.perf_counter() if traced else 0.0
+        S = len(self.slots)
+        T = self.max_step_tokens
+        ps = self.kv.page_size
+        row_ids = np.zeros(T, np.int32)
+        row_slot = np.full(T, S, np.int32)   # S = the virtual trash row
+        row_pos = np.zeros(T, np.int32)
+        sample_row = np.zeros(S, np.int32)
+        keys = np.zeros((S, 2), np.uint32)
+        temp = np.zeros(S, np.float32)
+        topk = np.zeros(S, np.int32)
+        topp = np.zeros(S, np.float32)
+        r = 0
+        for s in runnable:
             sl = self.slots[s]
-            if sl.replay_until and sl.gen >= sl.replay_until:
-                # the next token is the first FRESH one after a preempt
-                # replay — flip the lifecycle phase
-                sl.replay_until = 0
-                self._tr_end(sl.req.req_id)
-                self._tr_begin(sl.req.req_id, "decode")
-            tok = int(nxt[s])
-            sl.generated.append(tok)
-            sl.pos += 1
-            sl.gen += 1
-            sl.last_tok = tok
-            self.tokens_generated += 1
-            if self.on_token is not None:
-                self.on_token(sl.req.req_id, tok, sl.gen - 1)
-            if tok == sl.req.eos_id or sl.gen >= sl.req.max_new:
-                self._retire(s)
+            # same shared-page write tripwire as the pure decode step
+            assert self.kv.page_writable(
+                int(self.kv.table[s, sl.pos // ps])), \
+                f"slot {s} would write a shared page"
+            row_ids[r] = sl.last_tok
+            row_slot[r] = s
+            row_pos[r] = sl.pos
+            sample_row[s] = r
+            keys[s] = sl.keys[sl.gen]
+            temp[s] = sl.req.temperature
+            topk[s] = sl.req.top_k
+            topp[s] = sl.req.top_p
+            r += 1
+        budget = T - r
+        advanced = []                        # (slot, n_rows, final)
+        for s in sorted(filling, key=lambda s: self.slots[s].admit_seq):
+            if budget <= 0:
+                break
+            sl = self.slots[s]
+            p = sl.req.prompt_ids.size
+            n = min(p - sl.pos, self.prefill_chunk, budget)
+            # every page this chunk writes must be private to the slot
+            # (reservation COW'd the shared boundary page; mapped prefix
+            # pages below the cursor are never written)
+            for j in range(sl.pos // ps, (sl.pos + n - 1) // ps + 1):
+                assert self.kv.page_writable(int(self.kv.table[s, j])), \
+                    f"slot {s} chunk would write shared page " \
+                    f"{int(self.kv.table[s, j])}"
+            row_ids[r:r + n] = sl.req.prompt_ids[sl.pos:sl.pos + n]
+            row_slot[r:r + n] = s
+            row_pos[r:r + n] = np.arange(sl.pos, sl.pos + n)
+            final = sl.pos + n == p
+            if final:
+                # the last prompt position's logits sample token 0 with
+                # keys[0] — identical to the legacy prefill decision
+                sample_row[s] = r + n - 1
+                keys[s] = sl.keys[0]
+                temp[s] = sl.req.temperature
+                topk[s] = sl.req.top_k
+                topp[s] = sl.req.top_p
+            self.n_prefill_chunks += 1
+            self.flight.record("chunk_sched", req=str(sl.req.req_id),
+                               slot=s, start=int(sl.pos), tokens=int(n),
+                               final=final)
+            advanced.append((s, n, final))
+            budget -= n
+            r += n
+        # virtual trash row: padding rows gather/scatter only page 0
+        table2 = np.concatenate(
+            [self.kv.table,
+             np.zeros((1, self.kv.pages_per_slot), np.int32)], axis=0)
+        # the pool buffers were just donated — rebind them on the cache
+        # object too, so no stale (deleted-buffer) aliases survive
+        self.kv.pools, nxt = self._mixed_step(
+            self.params, self.kv.pools, jnp.asarray(table2),
+            jnp.asarray(row_ids), jnp.asarray(row_slot),
+            jnp.asarray(row_pos), jnp.asarray(sample_row),
+            jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(topk),
+            jnp.asarray(topp))
+        self.n_decode_steps += 1
+        self.n_mixed_steps += 1
+        self.occupancy_sum += len(live) / S
+        nxt = np.asarray(nxt)                          # host sync
+        self._note_step_metrics(r, decoded=bool(runnable))
+        if traced:
+            self.tracer.add("decode_step", t_step,
+                            time.perf_counter() - t_step, track="engine",
+                            attrs={"live": len(live),
+                                   "step": self.n_decode_steps,
+                                   "mixed": True, "rows": r,
+                                   "decode_rows": len(runnable)})
+        for s in runnable:
+            self._bank_token(s, int(nxt[s]))
+        for s, n, final in advanced:
+            sl = self.slots[s]
+            sl.pos += n
+            if final:
+                self._emit_first(s, int(nxt[s]))
         return True
 
     def run(self, requests=()) -> dict:
@@ -450,8 +662,13 @@ class ServingEngine:
         return out
 
     def bucket_for(self, prompt_len: int) -> int:
-        """Prefill length for a prompt: the feeder bucket, page-aligned,
-        capped at slot capacity — one compiled prefill per distinct value."""
+        """LEGACY-prefill length for a prompt: the feeder bucket,
+        page-aligned, capped at slot capacity — one compiled prefill per
+        distinct value.  Only the prefill_chunk=None path uses buckets;
+        chunked admission derives chunk count from the prompt length, so
+        prompts beyond the largest feeder bucket admit without growing
+        the signature set (validate() rejects only pool-capacity
+        violations)."""
         ps = self.kv.page_size
         Lb = -(-_bucket_len(int(prompt_len)) // ps) * ps
         return min(Lb, self.kv.capacity_tokens)
@@ -471,7 +688,10 @@ class ServingEngine:
                 # on it would be invisible to a retry on a different slot)
                 return
             self.queue.popleft()
-            self._admit(s, req, *res)
+            if self.prefill_chunk is not None:
+                self._admit_chunked(s, req, *res)
+            else:
+                self._admit(s, req, *res)
 
     def _reserve(self, s: int, req: Request):
         """Map any cached prefix into empty slot `s` and allocate the
@@ -535,18 +755,7 @@ class ServingEngine:
         p = req.prompt_ids.size
         ps = self.kv.page_size
         keys = np.asarray(jax.random.split(req.rng, req.max_new))
-        if self.prefix is not None:
-            if C > 0:
-                self.n_prefix_hits += 1
-                self.prefill_tokens_saved += C
-                self._tr_instant(req.req_id, "prefix_hit", n_pages=n_pp,
-                                 tokens=C)
-                self.flight.record("prefix_hit", req=str(req.req_id),
-                                   pages=n_pp, tokens=C, suffix=p - C)
-            else:
-                self.n_prefix_misses += 1
-                self.flight.record("prefix_miss", req=str(req.req_id),
-                                   prompt_len=int(p))
+        self._count_prefix(req, C, n_pp, p)
         if C > 0:
             # suffix-only prefill: the transformer runs on tokens [C, p)
             # against a cache seeded from the slot's mapped prefix pages
@@ -606,6 +815,32 @@ class ServingEngine:
         self.flight.record("admit", req=str(req.req_id), slot=s,
                            bucket=Lb, prompt_len=p,
                            pages=int(self.kv.pages_for(p)))
+        self._begin_stream(s, tok0)
+
+    def _count_prefix(self, req: Request, C: int, n_pp: int, p: int) -> None:
+        """Prefix-index hit/miss accounting shared by both admission
+        paths (chunked admission counts the SAME tokens-saved: the first
+        `C` prompt tokens never take a chunk row)."""
+        if self.prefix is None:
+            return
+        if C > 0:
+            self.n_prefix_hits += 1
+            self.prefill_tokens_saved += C
+            self._tr_instant(req.req_id, "prefix_hit", n_pages=n_pp,
+                             tokens=C)
+            self.flight.record("prefix_hit", req=str(req.req_id),
+                               pages=n_pp, tokens=C, suffix=p - C)
+        else:
+            self.n_prefix_misses += 1
+            self.flight.record("prefix_miss", req=str(req.req_id),
+                               prompt_len=int(p))
+
+    def _begin_stream(self, s: int, tok0: int) -> None:
+        """Stream token 0 of a freshly-prefilled slot (legacy one-dispatch
+        prefill or the mixed step's final chunk): open the decode/replay
+        lifecycle phase, fire on_token(.., 0), retire on eos/max_new=1."""
+        sl = self.slots[s]
+        req = sl.req
         stash = req._preempted_gen or []
         if stash:
             # tokens 0..len(stash)-1 re-emit deterministically — a replay
@@ -619,6 +854,43 @@ class ServingEngine:
             self.on_token(req.req_id, tok0, 0)
         if tok0 == req.eos_id or req.max_new == 1:
             self._retire(s)
+
+    def _admit_chunked(self, s: int, req: Request, C: int = 0,
+                       n_pp: int = 0) -> None:
+        """Chunk-granular admission — NO prefill dispatch: the slot enters
+        PREFILL mode (gen=0) with its prompt pages already reserved, and
+        the prompt commits in `prefill_chunk`-token rows inside the next
+        mixed steps (_run_mixed_step).  A prefix hit just means the first
+        `C` tokens are already mapped — the chunk cursor starts at C, and
+        a mid-page start writes into the boundary page _reserve COW'd.
+        Token 0 is sampled by the step that runs the FINAL chunk; until
+        then the slot emits nothing."""
+        self._tr_end(req.req_id)                       # queued ends here
+        p = req.prompt_ids.size
+        keys = np.asarray(jax.random.split(req.rng, req.max_new))
+        self._count_prefix(req, C, n_pp, p)
+        self._admit_seq += 1
+        self.slots[s] = _Slot(req, keys, pos=C, first_tok=None,
+                              admit_seq=self._admit_seq)
+        self._tr_begin(req.req_id, "prefill",
+                       chunk=int(self.prefill_chunk), prompt_len=p,
+                       prefix_tokens=C)
+        self.flight.record("admit", req=str(req.req_id), slot=s,
+                           prompt_len=p, chunk=int(self.prefill_chunk),
+                           prefix_tokens=C,
+                           pages=int(self.kv.pages_for(p)))
+
+    def _emit_first(self, s: int, tok0: int) -> None:
+        """Final-chunk emission: the slot's whole prompt is committed and
+        `tok0` was sampled from the last prompt position's logits with
+        keys[0] — the exact decision the legacy one-dispatch prefill
+        made.  Flips the slot into decode mode and streams token 0."""
+        sl = self.slots[s]
+        sl.gen = 1
+        sl.last_tok = tok0
+        sl.generated = [tok0]
+        self._tr_end(sl.req.req_id)                    # prefill ends here
+        self._begin_stream(s, tok0)
 
     def _preempt(self, s: int) -> None:
         sl = self.slots[s]
@@ -675,6 +947,42 @@ class ServingEngine:
         self.kv.reset()
         if self.prefix is not None:
             self.prefix.clear()
+
+    def set_chunking(self, prefill_chunk: Optional[int],
+                     max_step_tokens: Optional[int] = None) -> None:
+        """Configure chunked prefill (idle engine only — a live slot may
+        be mid-chunk).  `prefill_chunk=None` disables chunking: prompts
+        prefill through the legacy bucketed one-dispatch paths — the
+        baseline side of bench_serving's heavy-tail A/B.  Enabled (the
+        default: 4*page_size), prompts commit in chunk rows inside the
+        mixed step under `max_step_tokens` (default prefill_chunk +
+        num_slots): one row per decoding slot plus up to prefill_chunk
+        rows per chunking prompt, never more than the budget per step —
+        the p99 inter-token bound.  Each distinct max_step_tokens value
+        is one mixed-step signature; hold it fixed in production."""
+        assert all(sl is None for sl in self.slots) and not self.queue, \
+            "set_chunking requires an idle engine"
+        if prefill_chunk is None:
+            self.prefill_chunk = None
+            self.max_step_tokens = 0
+            return
+        prefill_chunk = int(prefill_chunk)
+        if prefill_chunk <= 0:
+            raise ValueError(
+                f"prefill_chunk must be positive (or None to disable "
+                f"chunking), got {prefill_chunk}")
+        prefill_chunk = min(prefill_chunk, self.kv.capacity_tokens)
+        S = len(self.slots)
+        mst = (prefill_chunk + S) if max_step_tokens is None \
+            else int(max_step_tokens)
+        if mst <= S:
+            raise ValueError(
+                f"max_step_tokens {mst} must exceed num_slots {S}: every "
+                f"decoding slot takes one row per step, and prefill "
+                f"chunks need at least one row of headroom to ever make "
+                f"progress")
+        self.prefill_chunk = prefill_chunk
+        self.max_step_tokens = mst
 
     def set_prefix_cache(self, enabled: bool) -> None:
         """A/B knob (bench_serving --prefix-skew measures the same engine
@@ -739,6 +1047,36 @@ class ServingEngine:
         outputs, _, state_out = self.executor.forward(params, feed, state,
                                                       TEST, None)
         last = outputs[self.logits_name].value[:, 0, :]
+        nxt = pick_next_per_slot(last, keys, temp, topk, topp,
+                                 is_probs=self._probs)
+        new_pools = {name: {"k": state_out[name]["k_pages"],
+                            "v": state_out[name]["v_pages"]}
+                     for name in pools}
+        return new_pools, nxt
+
+    def _mixed_impl(self, params, pools, table2, row_ids, row_slot,
+                    row_pos, sample_row, keys, temp, topk, topp):
+        """THE mixed prefill/decode step — one signature per
+        max_step_tokens value, whatever the prefill/decode row mix: the
+        packed ragged token rows run the stack as one [1, T] batch (every
+        non-attention layer is per-token; attention routes through
+        layers_attn._paged_ragged_step via the `row_slot` cache marker),
+        then per-slot sampling reads each slot's designated logits row.
+        Non-emitting slots (mid-prefill, paused, empty) aim sample_row at
+        a padding row with temperature 0 — their greedy argmax costs
+        nothing, consumes no key, and the host discards it."""
+        T = row_ids.shape[0]
+        state = {name: {"k_pages": pools[name]["k"],
+                        "v_pages": pools[name]["v"],
+                        "page_table": table2, "row_slot": row_slot,
+                        "row_pos": row_pos}
+                 for name in pools}
+        feed = {self.input_name: Argument(
+            ids=row_ids[None, :], lengths=jnp.full((1,), T, jnp.int32))}
+        outputs, _, state_out = self.executor.forward(params, feed, state,
+                                                      TEST, None)
+        logits = outputs[self.logits_name].value[0]    # [T, V]
+        last = logits[sample_row]                      # [S, V]
         nxt = pick_next_per_slot(last, keys, temp, topk, topp,
                                  is_probs=self._probs)
         new_pools = {name: {"k": state_out[name]["k_pages"],

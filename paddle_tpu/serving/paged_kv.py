@@ -165,6 +165,15 @@ class PagedKVCache:
         # not page-by-page through _alloc_page's single-page fallback
         shortfall = (need - int(self._n_pages[slot])) - len(self._free)
         if shortfall > 0 and self.on_page_pressure is not None:
+            if shortfall > self.cached_page_count:
+                # infeasible even after evicting EVERY reclaimable page:
+                # fail fast WITHOUT evicting.  A doomed retry must not
+                # destroy cached prefixes it cannot use — in particular a
+                # preempted half-chunked prefill's donated pages, which
+                # its own re-admission retries against every step until
+                # another slot frees the remainder (the retry that can
+                # finally succeed still finds them and prefix-hits)
+                return False
             self.on_page_pressure(shortfall)
         while self._n_pages[slot] < need:
             page = self._alloc_page()

@@ -17,6 +17,15 @@ slot's `jax.random.categorical` runs under vmap on a [1, V] row with that
 slot's key — bit-identical to the B=1 oracle call).  That equivalence is
 what makes the serving engine's per-request exactness oracle
 (tests/test_serving.py) hold for sampled decoding, not just greedy.
+
+The MIXED prefill/decode step reuses this unchanged: the engine gathers
+one logits row per slot (a decode row's own logits, or — for a prompt
+whose FINAL chunk ran this step — the last prompt position's row) and
+samples all S slots here.  Chunk rows emit no token until their final
+chunk: mid-prefill slots ride through with temperature 0 and a zero key,
+so they take the greedy branch, consume no randomness, and the host
+discards their output — the per-slot key schedule stays exactly
+lm_generate's (key g samples token g, key 0 at the final chunk).
 """
 
 from __future__ import annotations

@@ -240,7 +240,16 @@ class ServingServer:
                  float(eng.prefix.n_evictions if eng.prefix else 0)),
                 ("serving_prefix_cow_total", "counter", None,
                  float(eng.kv.n_cow)),
-            ]
+                # chunked prefill: mixed-step/chunk counters plus the
+                # engine-owned token-budget histograms (step_tokens_hist /
+                # decode_gap_hist keep their own locks; their samples()
+                # splice straight into the frame)
+                ("serving_prefill_chunks_total", "counter", None,
+                 float(eng.n_prefill_chunks)),
+                ("serving_mixed_steps_total", "counter", None,
+                 float(eng.n_mixed_steps)),
+            ] + eng.step_tokens_hist.samples() \
+              + eng.decode_gap_hist.samples()
 
         reg.register_collector(engine_state)
         reg.register_collector(statset_collector(
@@ -896,6 +905,10 @@ class ServingServer:
             "prefix_cached_pages": int(eng.kv.cached_page_count),
             "prefix_evictions": (eng.prefix.n_evictions
                                  if eng.prefix else 0),
+            "prefill_chunk": eng.prefill_chunk,
+            "max_step_tokens": eng.max_step_tokens,
+            "prefill_chunks": eng.n_prefill_chunks,
+            "mixed_steps": eng.n_mixed_steps,
         }
 
     def _stats_msg(self, engine_part: Optional[dict]) -> dict:

@@ -131,6 +131,37 @@ def test_assemble_lkg_stitches_serving_prefix_record(tmp_path):
     assert out["serving_prefix"]["baseline_first_tok_ms_p50"] == 835.5
 
 
+def test_assemble_lkg_stitches_serving_chunked_record(tmp_path):
+    """PR 8 wiring: the chunked-prefill record (lm_serving_p99_itl_chunked_ms
+    + the baseline/first-token tail companions) rides the same per-config
+    queue shape — a top-level BENCH_ONLY=serving_chunked record must
+    stitch into the assembled fallback under the `serving_chunked` key
+    with the A/B companion fields intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_chunked"] == "lm_serving_p99_itl_chunked_ms"
+    assert "serving_chunked" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-02T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-03T10:00:00+00:00",
+         "record": {"metric": M["serving_chunked"], "value": 12.4,
+                    "baseline_itl_ms_p99": 310.7,
+                    "itl_ms_p50": 9.8,
+                    "baseline_first_tok_ms_p99": 1200.0,
+                    "first_tok_ms_p99": 640.2,
+                    "p99_itl_improved": True,
+                    "measured_at": "2026-08-03T10:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_chunked"]["value"] == 12.4
+    assert out["serving_chunked"]["baseline_itl_ms_p99"] == 310.7
+    assert out["serving_chunked"]["p99_itl_improved"] is True
+
+
 def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
     """PR 4 wiring: the serving record's p99 per-token latency companion
     (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the

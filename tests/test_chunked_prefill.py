@@ -1,0 +1,423 @@
+"""Chunked prefill with mixed prefill/decode steps (serving/engine.py
+`_run_mixed_step` + ops/attention.py `ragged_paged_attention_step`).
+
+The exactness contract is unchanged and non-negotiable: whatever the
+chunk size, token budget, prefix-cache state, or preemption schedule, a
+request's tokens are identical to a cold `lm_generate(use_cache=True)`
+run — while the compiled-step signature set stays small and FIXED (the
+one `[S, 1]` decode signature plus ONE mixed-step signature per
+max_step_tokens value, and zero per-bucket prefill programs)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.graph.lm_decode import lm_generate
+from paddle_tpu.serving import Request, ServingEngine
+from paddle_tpu.trainer.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def tr():
+    # layers=1 keeps every compile in this file cheap (the 2-CPU tier-1
+    # budget is tight); multi-layer state threading through the chunked
+    # path is covered by test_serving/test_prefix_cache, which run the
+    # chunked default on layers=2 models
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=23,dim=16,layers=1,heads=2,batch_size=4")
+    return Trainer(cfg, seed=7)
+
+
+def _oracle(tr, req: Request):
+    toks, lens = lm_generate(
+        tr.executor, tr.params, req.prompt_ids[None, :],
+        max_new=req.max_new, temperature=req.temperature, top_k=req.top_k,
+        top_p=req.top_p, eos_id=req.eos_id, rng=req.rng, use_cache=True)
+    return np.asarray(toks)[0, :int(np.asarray(lens)[0])]
+
+
+def _assert_exact(tr, reqs, results):
+    for r in reqs:
+        np.testing.assert_array_equal(
+            _oracle(tr, r), results[r.req_id],
+            err_msg=f"request {r.req_id!r} diverged from the cold "
+                    f"lm_generate oracle")
+
+
+def _assert_sigs(eng):
+    """The tentpole's signature discipline: one decode signature, at most
+    one mixed signature, NO per-bucket prefill programs."""
+    assert eng._decode_step._cache_size() == 1
+    assert eng._mixed_step._cache_size() <= 1
+    assert not eng._prefill_cache and not eng._pack_cache, \
+        "chunked mode compiled a legacy per-bucket prefill program"
+
+
+# ---------------------------------------------------------------------------
+# the token-exactness oracle under multi-chunk prefill
+# ---------------------------------------------------------------------------
+
+def test_multi_chunk_prompts_stay_oracle_exact_across_knobs(tr):
+    """Prompts spanning 1..5 chunks with mixed sampling knobs, tiny chunk
+    (= page size) and a tight token budget: every request bit-matches its
+    cold run, at least one request decoded WHILE another was still
+    chunking (the mixed step actually mixed), and the signature set is
+    the fixed pair."""
+    rng = np.random.default_rng(0)
+    knobs = [dict(), dict(temperature=0.8, top_k=5),
+             dict(temperature=0.7, top_p=0.9), dict(temperature=1.1)]
+    lens = (3, 19, 9, 17)
+    reqs = [Request(f"r{i}", rng.integers(2, 23, n).astype(np.int32),
+                    max_new=5, rng=jax.random.PRNGKey(40 + i), **kw)
+            for i, (n, kw) in enumerate(zip(lens, knobs))]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, prefill_chunk=4, max_step_tokens=7)
+    results = eng.run(reqs)
+    _assert_exact(tr, reqs, results)
+    assert eng.n_mixed_steps > 0 and eng.n_prefill_chunks >= 4
+    _assert_sigs(eng)
+    eng.kv.check_reclaimed()
+
+
+def test_decode_advances_while_long_prompt_chunks(tr):
+    """The HOL-blocking kill shot: a short request is mid-decode when a
+    long prompt admits — the short request's tokens keep advancing on
+    the very steps that carry the long prompt's chunks (no stall), and
+    both stay exact."""
+    rng = np.random.default_rng(1)
+    short = Request("short", rng.integers(2, 23, 3).astype(np.int32),
+                    max_new=12)
+    long_ = Request("long", rng.integers(2, 23, 25).astype(np.int32),
+                    max_new=4)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, prefill_chunk=4, max_step_tokens=6)
+    eng.add_request(short)
+    eng.step()                       # short: chunk+token0 (mixed step)
+    eng.step()                       # short decoding alone
+    gen_before = next(sl for sl in eng.slots if sl is not None).gen
+    eng.add_request(long_)
+    # 25 prompt tokens / (budget 6 - 1 decode row) = 5 chunk steps
+    stalled = 0
+    while any(sl is not None and sl.req is long_ and sl.gen == 0
+              for sl in eng.slots) or long_ in eng.queue:
+        before = eng.tokens_generated
+        eng.step()
+        if eng.tokens_generated == before:
+            stalled += 1
+    short_sl = next((sl for sl in eng.slots
+                     if sl is not None and sl.req is short), None)
+    assert short_sl is not None and short_sl.gen > gen_before, \
+        "the decoding request stalled behind the long prompt's prefill"
+    assert stalled == 0, \
+        f"{stalled} steps advanced no decode token while chunking"
+    results = eng.run()
+    _assert_exact(tr, [short, long_], results)
+    _assert_sigs(eng)
+
+
+def test_step_token_budget_is_never_exceeded(tr):
+    """max_step_tokens is a hard per-step bound: across a workload
+    saturating every slot with multi-chunk prompts, no recorded step
+    scheduled more rows than the budget (the serving_step_tokens
+    histogram's +Inf bucket equals its <=budget bucket)."""
+    rng = np.random.default_rng(2)
+    reqs = [Request(f"r{i}", rng.integers(2, 23, 14 + i).astype(np.int32),
+                    max_new=4) for i in range(6)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=3, page_size=4,
+                        max_context=24, prefill_chunk=8,
+                        max_step_tokens=16)   # == a histogram bucket edge
+    results = eng.run(reqs)
+    _assert_exact(tr, reqs, results)
+    h = eng.step_tokens_hist
+    counts, _total, n = h._vals[()]
+    over_budget = counts[-1] - counts[h.buckets.index(16.0)]
+    assert n == eng.n_decode_steps and n > 0
+    assert over_budget == 0, \
+        "a step scheduled more rows than max_step_tokens"
+    # and the budget actually bit: some step packed more than one row
+    # per live slot (chunk rows rode along with decodes)
+    assert eng.n_mixed_steps > 0
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill x prefix cache (the PR-7 machinery at chunk granularity)
+# ---------------------------------------------------------------------------
+
+def test_prefix_hit_ending_mid_chunk_stays_exact(tr):
+    """A cached prefix that ends MID-chunk (and mid-page): the follower's
+    chunk cursor starts at the matched token count inside the COW'd
+    boundary page, only the uncached remainder takes chunk rows, and the
+    output bit-matches the cold run."""
+    rng = np.random.default_rng(3)
+    base = rng.integers(2, 23, 13).astype(np.int32)      # 3.25 pages of 4
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, prefill_chunk=8,
+                        max_step_tokens=10)
+    a = Request("a", base.copy(), max_new=6)
+    results = eng.run([a])
+    chunks_a = eng.n_prefill_chunks
+    # b shares 11 of a's 13 tokens (2 full pages + 3 into the boundary
+    # page — the match ends inside b's FIRST chunk), then diverges
+    b_prompt = np.concatenate([base[:11], (base[11:13] + 1) % 23 + 2,
+                               rng.integers(2, 23, 4)]).astype(np.int32)
+    b = Request("b", b_prompt, max_new=6)
+    results.update(eng.run([b]))
+    assert eng.n_prefix_hits >= 1 and eng.kv.n_cow >= 1
+    assert eng.prefill_tokens_saved >= 11
+    # the suffix (17 - 11 = 6 tokens) fits one budget window after the
+    # hit, so b paid fewer chunks than a cold 17-token prompt would
+    assert eng.n_prefill_chunks - chunks_a <= 2
+    # c repeats a exactly: the shared original page was never written
+    c = Request("c", base.copy(), max_new=6)
+    results.update(eng.run([c]))
+    _assert_exact(tr, [a, b, c], results)
+    _assert_sigs(eng)
+    eng.kv.check_reclaimed()
+
+
+def test_cow_divergence_inside_chunk_boundary_stays_exact(tr):
+    """COW divergence landing inside a chunk's page span: two concurrent
+    followers of the same prefix, one diverging mid-page — each writes
+    only its private boundary copy, both bit-match cold runs, and the
+    donor page survives for a later exact repeat."""
+    rng = np.random.default_rng(4)
+    base = rng.integers(2, 23, 10).astype(np.int32)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, prefill_chunk=4, max_step_tokens=6)
+    warm = Request("warm", base.copy(), max_new=5)
+    results = eng.run([warm])
+    x = Request("x", np.concatenate([base[:9], [3, 4, 5]])
+                .astype(np.int32), max_new=5)
+    y = Request("y", np.concatenate([base[:9], [7, 8]])
+                .astype(np.int32), max_new=5)
+    eng.add_request(x)
+    eng.add_request(y)
+    eng.step()                       # both admitted: both hit, both COW
+    assert eng.n_prefix_hits >= 2
+    assert eng.kv.n_cow >= 2, "mid-page divergence never copied-on-write"
+    assert eng.kv.shared_pages_in_use >= 2
+    results.update(eng.run())
+    again = Request("again", base.copy(), max_new=5)
+    results.update(eng.run([again]))
+    _assert_exact(tr, [warm, x, y, again], results)
+    _assert_sigs(eng)
+    eng.kv.check_reclaimed()
+
+
+def test_preempt_of_half_chunked_prefill_replays_exact(tr):
+    """Preempt -> replay of a request whose prefill was HALF-CHUNKED: a
+    decoding slot starves for its next page while `big` is still
+    chunking, so the scheduler preempts `big` MID-PREFILL (gen == 0,
+    chunk cursor inside the prompt — never letting the decoder stall
+    behind the remaining chunks), donates its committed whole pages, and
+    its re-admission prefix-hits its own chunks — both requests finish
+    bit-exact."""
+    rng = np.random.default_rng(5)
+    # 8 real pages, ps=4: a takes 2 (prompt 8) then grows to 4 while
+    # decoding; big reserves 5 (prompt 20) at admission — a's growth at
+    # pos 12 finds the pool dry while big, chunking 4 tokens per
+    # 5-token-budget step, is still mid-prefill.  The preempt donates
+    # big's 4 committed pages; its re-admission retries fail WITHOUT
+    # evicting them (the try_grow feasibility gate) until a finishes,
+    # then prefix-hit.
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=24, num_pages=9,
+                        prefill_chunk=4, max_step_tokens=5)
+    a = Request("a", rng.integers(2, 23, 8).astype(np.int32), max_new=8)
+    big = Request("big", rng.integers(2, 23, 20).astype(np.int32),
+                  max_new=3)
+    eng.add_request(a)
+    eng.step()                        # a: first chunk
+    eng.add_request(big)
+    preempted_mid_prefill = False
+    for _ in range(80):
+        n_pre = eng.n_preemptions
+        busy = eng.step()
+        if eng.n_preemptions > n_pre and big in eng.queue \
+                and (big._preempted_gen or []) == []:
+            preempted_mid_prefill = True
+        if not busy:
+            break
+    results = dict(eng.results)
+    results.update(eng.run())
+    assert eng.n_preemptions > 0, "pool was never overcommitted"
+    assert preempted_mid_prefill, \
+        "big was never preempted mid-prefill — the decoder must not " \
+        "stall behind a filler's remaining chunks"
+    _assert_exact(tr, [a, big], results)
+    # big's replay prefix-hit its own donated chunk pages
+    assert eng.n_prefix_hits > 0
+    assert (eng.kv._ref == 0).all()
+    _assert_sigs(eng)
+
+
+def test_preempt_of_decoding_slot_replays_exact_with_chunks_inflight(tr):
+    """The classic decode-preempt replay, but with the mixed step in the
+    loop: pressure comes from a chunking admission, the decode victim's
+    stash replays through mixed steps, everything stays exact."""
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(2, 23, n).astype(np.int32) for n in (6, 4, 7)]
+    reqs = [Request(i, p, max_new=8) for i, p in enumerate(prompts)]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=16, num_pages=6,
+                        prefill_chunk=4, max_step_tokens=6)
+    results = eng.run(reqs)
+    assert eng.n_preemptions > 0, "pool was never overcommitted"
+    _assert_exact(tr, reqs, results)
+    assert (eng.kv._ref == 0).all()
+    _assert_sigs(eng)
+
+
+# ---------------------------------------------------------------------------
+# admission beyond the feeder-bucket grid (the bucket-ceiling fix)
+# ---------------------------------------------------------------------------
+
+def test_prompts_beyond_the_largest_feeder_bucket_admit_and_serve(tr):
+    """Chunk count derives from prompt length, not a bucket ceiling: a
+    prompt longer than the largest feeder bucket (512) admits, serves
+    oracle-exact through ~bucketless chunk steps, and the signature set
+    does NOT grow with prompt length.  Only pool capacity rejects, with
+    an actionable error."""
+    rng = np.random.default_rng(7)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=16,
+                        max_context=576, prefill_chunk=64,
+                        max_step_tokens=66)
+    long_req = Request("long", rng.integers(2, 23, 520).astype(np.int32),
+                       max_new=3)
+    short = Request("short", rng.integers(2, 23, 5).astype(np.int32),
+                    max_new=3)
+    results = eng.run([long_req, short])
+    _assert_exact(tr, [long_req, short], results)
+    _assert_sigs(eng)
+    # capacity (not bucket) is the only rejection, and it says what to do
+    with pytest.raises(ValueError, match="raise max_context"):
+        eng.add_request(Request("huge",
+                                rng.integers(2, 23, 640).astype(np.int32),
+                                max_new=3))
+
+
+def test_set_chunking_validates_and_toggles(tr):
+    """set_chunking is the A/B knob: budget must exceed num_slots,
+    toggling to None restores the legacy bucketed path, and both modes
+    produce identical tokens for the same request."""
+    rng = np.random.default_rng(8)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, prefix_cache=False)
+    assert eng.prefill_chunk == 16 and eng.max_step_tokens == 18
+    with pytest.raises(ValueError, match="must exceed num_slots"):
+        eng.set_chunking(4, max_step_tokens=2)
+    with pytest.raises(ValueError, match="must be positive"):
+        eng.set_chunking(0)
+    prompt = rng.integers(2, 23, 9).astype(np.int32)
+    chunked = eng.run([Request("r", prompt.copy(), max_new=5)])["r"]
+    eng.set_chunking(None)
+    assert eng.prefill_chunk is None
+    legacy = eng.run([Request("r", prompt.copy(), max_new=5)])["r"]
+    np.testing.assert_array_equal(chunked, legacy)
+    assert len(eng._prefill_cache) > 0, "legacy mode never bucketed"
+
+
+# ---------------------------------------------------------------------------
+# ops-level oracle: the ragged row path vs the per-slot decode path
+# ---------------------------------------------------------------------------
+
+def test_ragged_paged_attention_matches_per_slot_step(tr):
+    """A packed row list holding one decode row per slot reproduces
+    paged_attention_step exactly (same math, row-indirected), and chunk
+    rows of one slot see each other's K/V under the causal mask."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import (paged_attention_step,
+                                          ragged_paged_attention_step)
+
+    rng = np.random.default_rng(1)
+    S, H, Hkv, D, ps, maxp, P = 3, 4, 2, 8, 4, 4, 12
+    pos = np.asarray([5, 9, 2], np.int32)
+    table = np.asarray([[4, 7, 0, 0], [2, 9, 5, 0], [11, 0, 0, 0]],
+                       np.int32)
+
+    def mk(*shape):
+        return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+    q, kn, vn = mk(S, 1, H, D), mk(S, 1, Hkv, D), mk(S, 1, Hkv, D)
+    kp, vp = jnp.zeros((P, ps, Hkv, D)), jnp.zeros((P, ps, Hkv, D))
+    for s in range(S):
+        for t in range(int(pos[s])):
+            kp = kp.at[table[s, t // ps], t % ps].set(mk(Hkv, D))
+            vp = vp.at[table[s, t // ps], t % ps].set(mk(Hkv, D))
+
+    want, wck, wcv = paged_attention_step(
+        q, kn, vn, kp, vp, jnp.asarray(table), jnp.asarray(pos),
+        use_kernel=False)
+    got, gck, gcv = ragged_paged_attention_step(
+        q[:, 0], kn[:, 0], vn[:, 0], kp, vp, jnp.asarray(table),
+        jnp.arange(S, dtype=jnp.int32), jnp.asarray(pos),
+        use_kernel=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want[:, 0]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(gck), np.asarray(wck))
+    np.testing.assert_array_equal(np.asarray(gcv), np.asarray(wcv))
+
+    # intra-chunk causality: two consecutive rows of slot 2 — row 1 must
+    # attend row 0's K/V written THIS call.  Oracle: run the rows one at
+    # a time through the per-slot step.
+    q2 = mk(2, H, D)
+    kn2, vn2 = mk(2, Hkv, D), mk(2, Hkv, D)
+    chunk_out, _, _ = ragged_paged_attention_step(
+        q2, kn2, vn2, kp, vp, jnp.asarray(table),
+        jnp.asarray([2, 2], jnp.int32),
+        jnp.asarray([pos[2], pos[2] + 1], jnp.int32), use_kernel=False)
+    o1, ck1, cv1 = paged_attention_step(
+        q2[0][None, None], kn2[0][None, None], vn2[0][None, None],
+        kp, vp, jnp.asarray(table[2:3]), jnp.asarray(pos[2:3]),
+        use_kernel=False)
+    o2, _, _ = paged_attention_step(
+        q2[1][None, None], kn2[1][None, None], vn2[1][None, None],
+        ck1, cv1, jnp.asarray(table[2:3]), jnp.asarray(pos[2:3] + 1),
+        use_kernel=False)
+    np.testing.assert_allclose(np.asarray(chunk_out[0]),
+                               np.asarray(o1[0, 0]), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(chunk_out[1]),
+                               np.asarray(o2[0, 0]), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_pallas_ragged_kernel_matches_fallback(tr):
+    """Interpret-mode parity of the row-indirected Pallas kernel against
+    the jnp ragged gather fallback over a mixed decode/chunk row list."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import ragged_paged_attention_step
+    from paddle_tpu.ops.pallas_paged import paged_attention
+
+    rng = np.random.default_rng(0)
+    S, H, Hkv, D, ps, maxp = 3, 4, 2, 8, 4, 4
+    P = 1 + S * maxp
+    kp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, ps, Hkv, D)), jnp.float32)
+    table = np.zeros((S + 1, maxp), np.int32)   # + virtual trash row
+    free = list(range(1, P))
+    pos = np.asarray([6, 3, 10], np.int32)
+    for s in range(S):
+        for j in range(-(-int(pos[s] + 4) // ps)):
+            table[s, j] = free.pop()
+    # rows: slot 0 decode, slot 1 a 3-token chunk, slot 2 decode, one pad
+    row_slot = np.asarray([0, 1, 1, 1, 2, S], np.int32)
+    row_pos = np.asarray([pos[0], pos[1], pos[1] + 1, pos[1] + 2,
+                          pos[2], 0], np.int32)
+    T = row_slot.size
+    q = jnp.asarray(rng.normal(size=(T, H, D)), jnp.float32)
+    kn = jnp.asarray(rng.normal(size=(T, Hkv, D)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(T, Hkv, D)), jnp.float32)
+    want, ck, cv = ragged_paged_attention_step(
+        q, kn, vn, kp, vp, jnp.asarray(table), jnp.asarray(row_slot),
+        jnp.asarray(row_pos), use_kernel=False)
+    got = paged_attention(q, ck, cv, jnp.asarray(table),
+                          jnp.asarray(row_pos) + 1,
+                          row_slot=jnp.asarray(row_slot))
+    real = row_slot < S
+    np.testing.assert_allclose(np.asarray(got)[real],
+                               np.asarray(want)[real],
+                               rtol=2e-5, atol=2e-5)

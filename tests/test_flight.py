@@ -144,8 +144,10 @@ def test_bucket_churn_fires_storm_exactly_once(monkeypatch):
     prompts = [rng.integers(2, 31, n).astype(np.int32)
                for n in (3, 12, 20)]
     reqs = [Request(i, p, max_new=2) for i, p in enumerate(prompts)]
+    # prefill_chunk=None: the LEGACY bucketed path is the one that churns
+    # per-bucket compiles (chunked admission has no prefill programs)
     eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
-                        max_context=64)
+                        max_context=64, prefill_chunk=None)
     eng.run(reqs)
 
     snap = fresh.snapshot()

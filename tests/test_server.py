@@ -233,10 +233,16 @@ def test_metrics_frame_and_consistent_stats_over_tcp(tiny_tr):
             assert s2["tokens_generated"] == s["tokens_generated"]
             assert s2["pump_last_step_age_s"] >= 0.0
         # docs lint lockstep: every name the frame rendered is catalogued
+        # (histogram samples render as <family>_bucket/_sum/_count — the
+        # family name is the catalogued one, same mapping the strict
+        # registry applies)
         from paddle_tpu.obs import CATALOG
+        from paddle_tpu.obs.metrics import MetricsRegistry
         for key in vals:
             base = key.split("{", 1)[0]
-            assert base in CATALOG, f"{base} rendered but not in CATALOG"
+            fam = MetricsRegistry._family_of(base, "histogram")
+            assert base in CATALOG or fam in CATALOG, \
+                f"{base} rendered but not in CATALOG"
     finally:
         srv.stop_background(drain=True)
 

@@ -124,18 +124,22 @@ def test_engine_eos_early_stop_refills_slots():
 
 
 def test_prefill_compiles_per_bucket_not_per_length():
-    """Prompts of lengths 3/5/7 share the 8-bucket; 12 lands in the
-    16-bucket — exactly two prefill signatures (the feeder's _bucket_len
-    grid, page-aligned), not four."""
+    """LEGACY (prefill_chunk=None) path: prompts of lengths 3/5/7 share
+    the 8-bucket; 12 lands in the 16-bucket — exactly two prefill
+    signatures (the feeder's _bucket_len grid, page-aligned), not four.
+    The chunked default compiles NO per-bucket prefill programs at all —
+    tests/test_chunked_prefill.py pins that signature discipline."""
     tr = _make("vocab=31,dim=16,layers=1,heads=2,batch_size=4")
     prompts = _prompts((3, 5, 7, 12), 31, seed=2)
     eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
-                        max_context=32)
+                        max_context=32, prefill_chunk=None)
     results = eng.run([Request(i, p, max_new=3)
                        for i, p in enumerate(prompts)])
     assert len(results) == 4
     assert sorted(eng._prefill_cache) == [8, 16]
     assert eng._decode_step._cache_size() == 1
+    assert eng._mixed_step._cache_size() == 0, \
+        "legacy mode must never touch the mixed step"
 
 
 def test_overcommitted_pool_preempts_and_stays_exact():
@@ -381,11 +385,11 @@ def test_cancel_mid_replay_reports_all_previously_streamed_tokens():
                         max_context=16)
     r = Request("r", [3, 4, 5], max_new=8)
     eng.add_request(r)
-    for _ in range(3):                     # admit + decode: gen = 4
+    for _ in range(3):       # mixed(chunk+token 0) + 2 decode: gen = 3
         assert eng.step()
     s = next(i for i, sl in enumerate(eng.slots) if sl is not None)
     stash = list(eng.slots[s].generated)
-    assert len(stash) == 4
+    assert len(stash) == 3
     eng._preempt(s)
     assert r._preempted_gen == stash
     assert eng.step()                      # re-admit; replay at gen = 2

@@ -87,6 +87,33 @@ def make_prefix_requests(n: int, prefix_pool: int, prefix_len: int,
     return reqs
 
 
+def make_heavytail_requests(n: int, prompt_lo: int, prompt_hi: int,
+                            max_new: int, vocab: int, seed: int = 0,
+                            eos_id: int = -1, tail_frac: float = 0.1):
+    """Heavy-tail prompt-length workload (the head-of-line-blocking
+    adversary chunked prefill exists for): most prompts are short —
+    lognormal body around `prompt_lo` — but `tail_frac` of them draw a
+    Pareto tail reaching `prompt_hi` (a few multi-thousand-token prompts
+    amid short ones at production shapes).  Greedy decode; lengths clamp
+    to [2, prompt_hi] so every request fits the configured pool."""
+    import numpy as np
+
+    from paddle_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        if rng.random() < tail_frac:
+            p = prompt_lo * (1.0 + rng.pareto(1.1))      # heavy tail
+        else:
+            p = rng.lognormal(np.log(max(prompt_lo, 2)), 0.5)
+        p = int(np.clip(p, 2, prompt_hi))
+        prompt = rng.integers(2, vocab, p).astype(np.int32)
+        reqs.append(Request(f"h{seed}_{i}", prompt, max_new=max_new,
+                            eos_id=eos_id))
+    return reqs
+
+
 def poisson_arrivals(n: int, rate: float, seed: int = 0):
     """Arrival offsets (seconds from t0): exponential gaps at `rate`
     req/s; rate <= 0 -> everything at t=0 (closed loop)."""
@@ -134,8 +161,12 @@ def run_workload(engine, requests, arrivals=None) -> dict:
             prev_finish(rid, toks, reason)
 
     seen_first: set = set()
+    itl_seconds: list = []
+    last_t: dict = {}
+    last_idx: dict = {}
 
     def _on_token(rid, tok, idx):
+        now = time.perf_counter()
         # index 0 = the prefill-sampled token: admission -> first token is
         # the latency prefix caching exists to cut.  A preempted request's
         # re-admission REPLAYS idx 0 (the engine re-fires on_token for the
@@ -143,7 +174,19 @@ def run_workload(engine, requests, arrivals=None) -> dict:
         # request's real first-token latency, so dedup by rid.
         if idx == 0 and rid in t_add and rid not in seen_first:
             seen_first.add(rid)
-            first_tok_seconds.append(time.perf_counter() - t_add[rid])
+            first_tok_seconds.append(now - t_add[rid])
+        # inter-token latency as the CLIENT sees it: the gap between a
+        # request's consecutive FRESH tokens — the p99 of this is what
+        # chunked prefill bounds.  Replayed tokens (idx <= last seen) are
+        # dropped and do not advance the clock, so a preempt+replay stall
+        # charges one honest big gap at the first fresh token (the same
+        # t_last discipline the server's stats use).
+        prev = last_idx.get(rid, -1)
+        if idx > prev:
+            if prev >= 0:
+                itl_seconds.append(now - last_t[rid])
+            last_t[rid] = now
+            last_idx[rid] = idx
         if prev_token is not None:
             prev_token(rid, tok, idx)
 
@@ -183,6 +226,7 @@ def run_workload(engine, requests, arrivals=None) -> dict:
         "step_seconds": step_seconds,
         "req_seconds": req_seconds,
         "first_tok_seconds": first_tok_seconds,
+        "itl_seconds": itl_seconds,
         "prefix_hits": hits,
         "prefix_misses": misses,
         "prefix_hit_rate": hits / (hits + misses) if hits + misses else 0.0,
@@ -204,6 +248,11 @@ def warm_workload(engine, request_sets) -> None:
     from paddle_tpu.serving import Request
 
     engine.run(request_sets[0])
+    if engine.prefill_chunk is not None:
+        # chunked mode has NO length-dependent prefill programs: one
+        # workload compiles both signatures (the mixed step while chunks
+        # are in flight, the [S,1] decode step once prefill drains)
+        return
     seen = set(engine._prefill_cache)
     for reqs in request_sets[1:]:
         for r in reqs:
@@ -282,6 +331,72 @@ def measure_prefix_skew(eng, wl: dict, reps: int, seed: int) -> dict:
     }
 
 
+def measure_chunked(eng, wl: dict, reps: int, seed: int,
+                    prefill_chunk: int, max_step_tokens=None) -> dict:
+    """Chunked-prefill A/B on ONE engine: the identical heavy-tail
+    workload (fresh Request objects each pass, same seeds) with chunking
+    OFF — legacy whole-prompt bucketed prefill, the head-of-line-blocking
+    baseline — then ON.  Closed loop: arrival jitter would blur the
+    inter-token tail the chunking exists to bound.
+
+    Reports first-token AND inter-token p50/p99 for both sides (the
+    acceptance comparison reads the p99s: a long cold prompt's prefill
+    stalls every decoding slot's inter-token latency on the baseline,
+    and the budgeted mixed step bounds it), plus tokens/s and the
+    signature-stability verdict (the mixed step must hold ONE signature
+    and the decode step its one across the timed region)."""
+    import numpy as np
+
+    def sets():
+        return [make_heavytail_requests(seed=seed + 1 + r, **wl)
+                for r in range(reps)]
+
+    def run_reps():
+        vals, ftok, itl = [], [], []
+        for reqs in sets():
+            rec = run_workload(eng, reqs)
+            vals.append(rec["tokens"] / rec["seconds"])
+            ftok += rec["first_tok_seconds"]
+            itl += rec["itl_seconds"]
+        return vals, ftok, itl
+
+    def pcts(xs):
+        return ([round(float(v) * 1e3, 3)
+                 for v in np.percentile(xs, [50, 99])]
+                if xs else [0.0, 0.0])
+
+    eng.set_chunking(None)
+    warm_workload(eng, [make_heavytail_requests(seed=seed, **wl)] + sets())
+    base_vals, base_ftok, base_itl = run_reps()
+
+    eng.set_chunking(prefill_chunk, max_step_tokens)
+    warm_workload(eng, [make_heavytail_requests(seed=seed, **wl)])
+    decode_sigs = eng._decode_step._cache_size()
+    mixed_sigs = eng._mixed_step._cache_size()
+    chunks0 = eng.n_prefill_chunks
+    vals, ftok, itl = run_reps()
+    eng.kv.check()
+    b_ft, b_itl = pcts(base_ftok), pcts(base_itl)
+    c_ft, c_itl = pcts(ftok), pcts(itl)
+    return {
+        "sig_stable": (eng._decode_step._cache_size() == decode_sigs
+                       and eng._mixed_step._cache_size() == mixed_sigs
+                       and mixed_sigs == 1),
+        "prefill_chunk": int(eng.prefill_chunk),
+        "max_step_tokens": int(eng.max_step_tokens),
+        "prefill_chunks": eng.n_prefill_chunks - chunks0,
+        "baseline_tok_per_sec": float(np.median(base_vals)),
+        "chunked_tok_per_sec": float(np.median(vals)),
+        "baseline_first_tok_ms_p50": b_ft[0],
+        "baseline_first_tok_ms_p99": b_ft[1],
+        "first_tok_ms_p50": c_ft[0], "first_tok_ms_p99": c_ft[1],
+        "baseline_itl_ms_p50": b_itl[0], "baseline_itl_ms_p99": b_itl[1],
+        "itl_ms_p50": c_itl[0], "itl_ms_p99": c_itl[1],
+        "p99_itl_improved": c_itl[1] < b_itl[1],
+        "p99_first_tok_improved": c_ft[1] < b_ft[1],
+    }
+
+
 def build_engine(args):
     from paddle_tpu.config.parser import parse_config
     from paddle_tpu.serving import ServingEngine
@@ -293,9 +408,11 @@ def build_engine(args):
         f"heads={args.heads},batch_size={args.slots},"
         f"compute_dtype={args.dtype}")
     tr = Trainer(cfg, seed=1)
-    eng = ServingEngine(tr.executor, tr.params, num_slots=args.slots,
-                        page_size=args.page_size,
-                        max_context=args.max_context)
+    eng = ServingEngine(
+        tr.executor, tr.params, num_slots=args.slots,
+        page_size=args.page_size, max_context=args.max_context,
+        prefill_chunk=(getattr(args, "prefill_chunk", 0) or -1),
+        max_step_tokens=(getattr(args, "max_step_tokens", 0) or None))
     return eng
 
 
@@ -331,11 +448,53 @@ def main() -> int:
                     help="shared prefix length in tokens")
     ap.add_argument("--suffix-lo", type=int, default=16)
     ap.add_argument("--suffix-hi", type=int, default=64)
+    # chunked prefill (docs/serving.md "Chunked prefill"): --prompt-dist
+    # heavy-tail runs the A/B (legacy whole-prompt prefill vs budgeted
+    # mixed steps) on a Pareto/lognormal prompt-length workload
+    ap.add_argument("--prompt-dist", choices=["uniform", "heavy-tail"],
+                    default="uniform",
+                    help="heavy-tail: lognormal body + Pareto tail prompt "
+                         "lengths, measured chunking off vs on (first-"
+                         "token and inter-token p50/p99 both sides)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunk size in tokens (0 = engine default, "
+                         "4*page_size)")
+    ap.add_argument("--max-step-tokens", type=int, default=0,
+                    help="per-step token budget (0 = engine default, "
+                         "prefill_chunk + slots)")
     args = ap.parse_args()
 
     import numpy as np
 
     eng = build_engine(args)
+    if args.prompt_dist == "heavy-tail":
+        # the tail must FIT the pool: clamp at slot capacity minus the
+        # decode budget (validate() would reject anything bigger anyway)
+        hi = min(args.prompt_hi, args.max_context - args.max_new - 1)
+        wl = dict(n=args.num_requests, prompt_lo=args.prompt_lo,
+                  prompt_hi=hi, max_new=args.max_new, vocab=args.vocab)
+        m = measure_chunked(eng, wl, args.reps, args.seed,
+                            args.prefill_chunk or 4 * args.page_size,
+                            args.max_step_tokens or None)
+        print(json.dumps({
+            "bench": "serving_chunked",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prompt_lens": [args.prompt_lo, hi], "max_new": args.max_new,
+            "dim": args.dim, "layers": args.layers, "dtype": args.dtype,
+            "reps": args.reps,
+            "lm_serving_p99_itl_chunked_ms": m["itl_ms_p99"],
+            **{k: m[k] for k in (
+                "prefill_chunk", "max_step_tokens", "prefill_chunks",
+                "baseline_itl_ms_p50", "baseline_itl_ms_p99",
+                "itl_ms_p50",
+                "baseline_first_tok_ms_p50", "baseline_first_tok_ms_p99",
+                "first_tok_ms_p50", "first_tok_ms_p99",
+                "baseline_tok_per_sec", "chunked_tok_per_sec",
+                "p99_itl_improved", "p99_first_tok_improved",
+                "sig_stable")},
+        }), flush=True)
+        return 0 if m["sig_stable"] else 1
     if args.prefix_skew is not None:
         wl = dict(n=args.num_requests, prefix_pool=args.prefix_pool,
                   prefix_len=args.prefix_len, prefix_skew=args.prefix_skew,
@@ -378,6 +537,7 @@ def main() -> int:
                 for rep in range(args.reps)]
     warm_workload(eng, [make_requests(seed=args.seed, **base)] + rep_sets)
     sigs = eng._decode_step._cache_size()
+    mixed = eng._mixed_step._cache_size()
     buckets = len(eng._prefill_cache)
 
     ok = True
@@ -395,12 +555,13 @@ def main() -> int:
             step_s += rec["step_seconds"]
             req_s += rec["req_seconds"]
         if eng._decode_step._cache_size() != sigs or \
+                eng._mixed_step._cache_size() != mixed or \
                 len(eng._prefill_cache) != buckets:
             ok = False
             print(json.dumps({"bench": "serving",
-                              "error": "decode step or prefill bucket "
-                                       "recompiled during the timed "
-                                       "region"}), flush=True)
+                              "error": "decode/mixed step or prefill "
+                                       "bucket recompiled during the "
+                                       "timed region"}), flush=True)
         q1, med, q3 = np.percentile(vals, [25, 50, 75])
         # per-token latency = busy decode-step duration (each live request
         # advances one token per step); per-request = admit -> finish.

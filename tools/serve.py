@@ -91,10 +91,16 @@ def build_engine(args):
         path = latest_checkpoint(args.checkpoint) or args.checkpoint
         print(f"loading checkpoint {path}", file=sys.stderr)
         tr.load(path)
+    if args.prefill_chunk < 0:
+        chunk = None                 # chunking off: legacy prefill
+    else:
+        chunk = args.prefill_chunk or -1   # 0 = engine default
     return ServingEngine(tr.executor, tr.params, num_slots=args.slots,
                          page_size=args.page_size,
                          max_context=args.max_context,
-                         num_pages=args.num_pages)
+                         num_pages=args.num_pages,
+                         prefill_chunk=chunk,
+                         max_step_tokens=args.max_step_tokens or None)
 
 
 async def amain(args) -> int:
@@ -169,6 +175,13 @@ def main(argv=None) -> int:
     ap.add_argument("--max-context", type=int, default=256)
     ap.add_argument("--num-pages", type=int, default=None,
                     help="overcommit the page pool (default: worst case)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked-prefill chunk size in tokens "
+                         "(0 = engine default 4*page_size, negative = "
+                         "disable chunking: legacy whole-prompt prefill)")
+    ap.add_argument("--max-step-tokens", type=int, default=0,
+                    help="per-step token budget for mixed prefill/decode "
+                         "steps (0 = prefill_chunk + slots)")
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")

@@ -255,6 +255,15 @@ def main() -> int:
                                "--dim", "32", "--layers", "1",
                                "--heads", "2", "--dtype", "float32",
                                "--reps", "1"]
+        serving_chunked_args = ["--prompt-dist", "heavy-tail",
+                                "--num-requests", "6", "--slots", "2",
+                                "--page-size", "8", "--max-context", "48",
+                                "--prompt-lo", "3", "--prompt-hi", "40",
+                                "--prefill-chunk", "8",
+                                "--max-new", "4", "--vocab", "64",
+                                "--dim", "32", "--layers", "1",
+                                "--heads", "2", "--dtype", "float32",
+                                "--reps", "1"]
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
         tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
                      "--heads", "2", "--target-ms", "5", "--reps", "1"]
@@ -272,6 +281,10 @@ def main() -> int:
         # TPU-sized prefix-skew A/B (defaults: pool 8 x 128-token
         # prefixes, Zipf 1.0, 16-64-token suffixes)
         serving_prefix_args = ["--prefix-skew", "1.0"]
+        # heavy-tail chunked-prefill A/B: Pareto tail up to near slot
+        # capacity (768-context default clamps to ~700-token prompts)
+        serving_chunked_args = ["--prompt-dist", "heavy-tail",
+                                "--prompt-hi", "700"]
         rnn_args = []
         additive_args = []
         profile_args = []
@@ -315,6 +328,12 @@ def main() -> int:
         ("bench_serving_prefix_record", [py, "bench.py"], 900,
          bench_env("serving_prefix", 840),
          lambda: _metric_fresh(_METRIC_OF["serving_prefix"], fh)),
+        # chunked-prefill effectiveness record (p99 inter-token latency
+        # with chunking on, vs the whole-prompt-prefill baseline, over
+        # the heavy-tail prompt mix): another two-pass A/B, same budget
+        ("bench_serving_chunked_record", [py, "bench.py"], 900,
+         bench_env("serving_chunked", 840),
+         lambda: _metric_fresh(_METRIC_OF["serving_chunked"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
@@ -344,6 +363,11 @@ def main() -> int:
         ("bench_serving_prefix",
          [py, "tools/bench_serving.py"] + serving_prefix_args, 1200, {},
          lambda: _out_fresh("bench_serving_prefix", fh)),
+        # heavy-tail chunked-prefill sweep: the full-size off/on A/B with
+        # the first-token + inter-token p50/p99 breakdown banked to OUT
+        ("bench_serving_chunked",
+         [py, "tools/bench_serving.py"] + serving_chunked_args, 1200, {},
+         lambda: _out_fresh("bench_serving_chunked", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
