@@ -629,6 +629,59 @@ def bench_serving_chunked(dtype: str) -> dict:
     }
 
 
+def bench_serving_fleet(dtype: str) -> dict:
+    """Fleet-router effectiveness record (paddle_tpu/fleet/): the
+    prefix-skew workload through one router + N replica SUBPROCESSES
+    (tools/serve.py — real processes, real TCP), A/B'd three ways: one
+    replica direct, router with random placement, router with
+    KV-aware affinity placement.  Headline = affinity-arm tokens/s;
+    the acceptance companion is `affinity_hit_gt_random` (the per-replica
+    prefix caches must hit MORE under affinity routing than under random
+    on the same workload — the reason the router is KV-aware at all).
+    tools/bench_serving.py --fleet N is the sweep tool.  Exactness
+    through the router is tests/test_fleet.py's job."""
+    import argparse
+
+    from tools.bench_serving import measure_fleet
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        num_requests=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prefix_pool=int(os.environ.get("BENCH_SERVE_PREFIX_POOL", "8")),
+        prefix_len=int(os.environ.get("BENCH_SERVE_PREFIX_LEN", "128")),
+        prefix_skew=float(os.environ.get("BENCH_SERVE_PREFIX_SKEW", "1.0")),
+        suffix_lo=int(os.environ.get("BENCH_SERVE_SUFFIX_LO", "16")),
+        suffix_hi=int(os.environ.get("BENCH_SERVE_SUFFIX_HI", "64")),
+        max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "64")),
+        fleet=int(os.environ.get("BENCH_SERVE_FLEET", "2")),
+        concurrency=int(os.environ.get("BENCH_SERVE_FLEET_CONC", "8")),
+        seed=0, dtype=dtype)
+    m = measure_fleet(args)
+    return {
+        "metric": "lm_serving_fleet_tok_per_sec",
+        "value": m["tok_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"fleet={m['fleet']} conc={m['concurrency']} "
+                  f"vocab={args.vocab} dim={args.dim} L={args.layers} "
+                  f"slots={args.slots} page={args.page_size} "
+                  f"pool={args.prefix_pool} prefix={args.prefix_len} "
+                  f"reqs={args.num_requests} max_new={args.max_new}",
+        **{k: m[k] for k in (
+            "single_tok_per_sec", "random_tok_per_sec",
+            "speedup_vs_single", "hit_rate_affinity", "hit_rate_random",
+            "hit_rate_single", "affinity_hit_gt_random",
+            "first_tok_ms_p50", "random_first_tok_ms_p50",
+            "router_sheds", "router_retries", "ok", "failures")},
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
@@ -636,6 +689,7 @@ BENCHES = {
     "serving": bench_serving,
     "serving_prefix": bench_serving_prefix,
     "serving_chunked": bench_serving_chunked,
+    "serving_fleet": bench_serving_fleet,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -758,6 +812,7 @@ _METRIC_OF = {
     "serving": "lm_serving_tok_per_sec",
     "serving_prefix": "lm_serving_prefix_hit_rate",
     "serving_chunked": "lm_serving_p99_itl_chunked_ms",
+    "serving_fleet": "lm_serving_fleet_tok_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -841,7 +896,8 @@ def _assemble_lkg() -> dict | None:
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
-                "mnist", "sentiment", "recommendation", "seq2seq"):
+                "serving_fleet", "mnist", "sentiment", "recommendation",
+                "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:

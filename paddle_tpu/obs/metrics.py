@@ -86,8 +86,35 @@ CATALOG: dict[str, str] = {
         "request/first-token/inter-token latency quantiles "
         "(labels: stat, quantile; bounded recent-sample windows)",
     "serving_latency_count": "samples recorded per latency stat (label: stat)",
+    # -- fleet router (paddle_tpu/fleet/router.py) -------------------------
+    "fleet_requests_accepted_total": "generate requests the router placed",
+    "fleet_placements_total":
+        "placements by policy decision (label: policy = "
+        "affinity/least_loaded/random)",
+    "fleet_retries_total":
+        "requests transparently re-placed after replica death/circuit-open "
+        "(only never-streamed requests retry)",
+    "fleet_sheds_total":
+        "requests refused with overload at the fleet level (every healthy "
+        "replica saturated, none registered, or router draining)",
+    "fleet_joins_total": "replica registrations (hello handshake passed)",
+    "fleet_leaves_total":
+        "replica departures (ctl leave, connection lost, heartbeat expiry)",
+    "fleet_inflight": "requests routed and not yet finished",
+    "fleet_replicas_registered": "replicas in the router's table",
+    "fleet_replicas_healthy": "replicas placement may choose from",
+    "fleet_replicas_draining":
+        "replicas finishing in-flight work while refused new placements",
+    "fleet_replicas_broken":
+        "replicas with the circuit open (polled pump wedged/dead)",
+    "fleet_affinity_keys":
+        "prefix-affinity index entries (bounded LRU; first page-run -> "
+        "replica)",
+    "fleet_draining": "1 while the router refuses new work to drain",
     # -- pump-thread heartbeat watchdog -----------------------------------
-    "pump_alive": "1 while the engine pump thread is running",
+    "pump_alive":
+        "1 while the engine pump is running (0 the moment it has fatally "
+        "errored, even mid-unwind)",
     "pump_last_step_age_s":
         "seconds since the pump last completed a loop iteration — a wedged "
         "engine shows here before clients time out",

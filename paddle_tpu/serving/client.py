@@ -15,10 +15,56 @@ which is exactly the shape tests/test_server.py and tools/serve.py's
 
 from __future__ import annotations
 
+import random
 import socket
+import time
 from typing import Callable, Optional
 
 from paddle_tpu.serving import wire
+
+#: connect() errors worth retrying: the server is restarting (rolling
+#: restart's SIGTERM→rebind window shows as ECONNREFUSED — an immediate,
+#: cheap failure) or shed the half-open connection (reset/abort).
+#: Deliberately NOT the generic OSError (a bad hostname or unroutable
+#: address must fail fast) and NOT TimeoutError: a SYN-blackholed host
+#: already burned the FULL I/O timeout discovering nothing — retrying
+#: would multiply that by the attempt count.
+_RETRYABLE_CONNECT = (ConnectionRefusedError, ConnectionResetError,
+                      ConnectionAbortedError)
+
+
+def connect_with_backoff(host: str, port: int, timeout: float,
+                         attempts: int = 5, backoff_s: float = 0.05,
+                         backoff_max_s: float = 2.0,
+                         jitter: Optional[random.Random] = None
+                         ) -> socket.socket:
+    """create_connection with bounded jittered exponential backoff on
+    ECONNREFUSED/reset — a replica mid-rolling-restart must not surface
+    as an instant client failure.  `attempts` caps the total tries; the
+    final failure re-raises the last connect error with an actionable
+    message (same OSError family, so existing `except OSError` callers
+    keep working)."""
+    attempts = max(1, int(attempts))
+    jitter = jitter or random.Random()
+    t0 = time.monotonic()
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        if i:
+            # full jitter on an exponential base: concurrent clients
+            # retrying a restarting server must not stampede in lockstep
+            delay = min(backoff_max_s, backoff_s * (2.0 ** (i - 1)))
+            time.sleep(delay * (0.5 + 0.5 * jitter.random()))
+        try:
+            return socket.create_connection((host, port), timeout=timeout)
+        except _RETRYABLE_CONNECT as e:
+            last = e
+    waited = time.monotonic() - t0
+    raise type(last)(
+        f"connect to {host}:{port} failed after {attempts} attempts over "
+        f"{waited:.1f}s ({type(last).__name__}: {last}) — the server is "
+        f"down, still binding after a restart, or the address is wrong; "
+        f"raise ServingClient(connect_attempts=...) if its restart drain "
+        f"takes longer than the backoff window") from last
 
 
 class OverloadError(RuntimeError):
@@ -36,8 +82,12 @@ class ServerError(RuntimeError):
 
 
 class ServingClient:
-    def __init__(self, host: str, port: int, timeout: float = 300.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+    def __init__(self, host: str, port: int, timeout: float = 300.0,
+                 connect_attempts: int = 5, connect_backoff_s: float = 0.05,
+                 connect_backoff_max_s: float = 2.0):
+        self.sock = connect_with_backoff(
+            host, port, timeout, attempts=connect_attempts,
+            backoff_s=connect_backoff_s, backoff_max_s=connect_backoff_max_s)
         self._next_id = 0
         # frames that arrived while collect() was routing for OTHER ids
         # (e.g. a stats reply read mid-stream) are buffered, never dropped
@@ -198,6 +248,15 @@ class ServingClient:
         if msg["type"] == "error":
             raise ServerError(msg.get("error", "dump failed"))
         return {k: msg[k] for k in ("path", "events", "spans") if k in msg}
+
+    def hello(self) -> dict:
+        """Version/capabilities negotiation: the server's `hello` reply
+        (`proto`, `role` — "replica" for an engine-pump server, "router"
+        for the fleet front tier — `capabilities`, and sizing facts like
+        `page_size`/`max_inflight`).  Safe mid-stream: interleaved frames
+        are buffered like every other RPC."""
+        self.send({"type": "hello"})
+        return self._route(lambda m: m.get("type") == "hello")
 
     def ping(self) -> bool:
         self.send({"type": "ping"})

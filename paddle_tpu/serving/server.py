@@ -87,36 +87,11 @@ class _ReqState:
                                       # are dropped, not re-streamed
 
 
-class _Conn:
-    """One client connection (asyncio side)."""
-
-    _seq = 0
-    #: a client that stops READING while its streams keep producing would
-    #: grow the transport's send buffer without bound (token frames are
-    #: pushed from loop callbacks, never awaiting drain) — past this cap
-    #: the connection is declared dead and its requests get cancelled,
-    #: the same path as a disconnect
-    MAX_WRITE_BUFFER = 8 * 1024 * 1024
-
-    def __init__(self, writer):
-        _Conn._seq += 1
-        self.seq = _Conn._seq
-        self.writer = writer
-        self.dead = False
-        self.rids = {}                # client id -> engine req_id (active)
-
-    def send(self, msg: dict) -> None:
-        if self.dead or self.writer.is_closing():
-            return
-        try:
-            if self.writer.transport.get_write_buffer_size() > \
-                    self.MAX_WRITE_BUFFER:
-                self.dead = True      # slow reader: sever, don't buffer
-                self.writer.close()   # -> reader EOF -> handler cancels
-                return                #    its in-flight requests
-            self.writer.write(wire.encode(msg))
-        except (ConnectionError, RuntimeError):
-            self.dead = True
+#: one client connection (asyncio side): the shared slow-reader-severing
+#: frame connection — hoisted to wire.py so the fleet router's client
+#: face can never drift from this server's (conn.rids maps client id ->
+#: engine req_id here)
+_Conn = wire.FrameConn
 
 
 class ServingServer:
@@ -192,8 +167,7 @@ class ServingServer:
         reg.gauge("serving_draining").set_fn(
             lambda: 1.0 if self._draining else 0.0)
         reg.gauge("pump_alive").set_fn(
-            lambda: 1.0 if (self._pump_thread is not None
-                            and self._pump_thread.is_alive()) else 0.0)
+            lambda: 1.0 if self.pump_alive() else 0.0)
         reg.gauge("pump_last_step_age_s").set_fn(self.pump_last_step_age)
         eng = self.engine
 
@@ -263,6 +237,16 @@ class ServingServer:
         reg.register_collector(hbm_collector(
             params_fn=lambda: eng.params, kv_fn=lambda: eng.kv))
         reg.register_collector(flight_collector(self.flight))
+
+    def pump_alive(self) -> bool:
+        """False the moment the pump has fatally errored, even while its
+        thread is still unwinding (recording the death, writing the
+        bundle): `_pump_error` is written BEFORE the death is announced
+        to the loop, so a client that just saw its routes failed must
+        never read `pump_alive: true` in the next stats frame."""
+        return (self._pump_error is None
+                and self._pump_thread is not None
+                and self._pump_thread.is_alive())
 
     def pump_last_step_age(self) -> float:
         """Seconds since the pump last completed a loop iteration; -1.0
@@ -531,9 +515,10 @@ class ServingServer:
         while True:
             await asyncio.sleep(period)
             age = self.pump_last_step_age()
-            alive = (self._pump_thread is not None
-                     and self._pump_thread.is_alive())
-            if alive and age > self.wedge_threshold_s:
+            # pump_alive() is False once _pump_error is set: a DEAD pump
+            # already froze its own pump_death bundle — the watchdog must
+            # not stack a wedge bundle on top of it
+            if self.pump_alive() and age > self.wedge_threshold_s:
                 if not self._wedge_dumped:
                     self._wedge_dumped = True
                     self.flight.record("wedge", age_s=round(age, 3),
@@ -677,17 +662,21 @@ class ServingServer:
         if st is None:
             return
         st.conn.rids.pop(st.cid, None)
+        # accounting settles BEFORE the terminal frame can reach the
+        # client: asyncio flushes small writes inside send(), so a client
+        # acting on `done` (e.g. polling stats, or a test asserting
+        # inflight) must never observe the request still counted
+        self._dec_inflight()
         st.conn.send({"type": "done", "id": st.cid, "tokens": tokens,
                       "reason": reason})
-        self._dec_inflight()
 
     def _fail_on_loop(self, rid: str, message: str) -> None:
         st = self._routes.pop(rid, None)
         if st is None:
             return
         st.conn.rids.pop(st.cid, None)
-        st.conn.send({"type": "error", "id": st.cid, "error": message})
         self._dec_inflight()
+        st.conn.send({"type": "error", "id": st.cid, "error": message})
 
     def _dec_inflight(self) -> None:
         self._inflight -= 1
@@ -698,15 +687,25 @@ class ServingServer:
     async def _handle(self, reader, writer) -> None:
         conn = _Conn(writer)
         self._conns.add(conn)
+        first_frame = True
         try:
             while True:
                 try:
                     msg = await wire.read_frame(reader)
                 except wire.FrameError as e:
-                    conn.send({"type": "error", "error": str(e)})
+                    # a malformed FIRST frame is usually a peer speaking the
+                    # wrong protocol entirely (an HTTP probe, a bare JSON
+                    # line) — name what this socket expects instead of a
+                    # bare parse error, so the peer (and the fleet router's
+                    # classification path) learns what it reached
+                    err = str(e)
+                    if first_frame:
+                        err += f"; expected the {wire.PROTO_DESC}"
+                    conn.send({"type": "error", "error": err})
                     break
                 if msg is None:
                     break
+                first_frame = False
                 try:
                     self._dispatch(conn, msg)
                 except Exception as e:         # noqa: BLE001 — protocol
@@ -780,6 +779,23 @@ class ServingServer:
                            "path": path,
                            "events": self.flight.recorded,
                            "spans": get_tracer().recorded})
+        elif t == "hello":
+            # version/capabilities negotiation: answered on connect so a
+            # peer (the fleet router, a ctl, a probing operator) can
+            # classify this end before sending work at it.  `page_size`
+            # rides along because the router's prefix-affinity index keys
+            # on the first page_size-aligned token run — the granularity
+            # must match the replica's prefix tree for affinity to pay.
+            conn.send(wire.hello_msg(
+                "replica",
+                server="paddle_tpu-serving",
+                capabilities=sorted(["hello", "generate", "cancel", "stats",
+                                     "metrics", "dump", "ping"]),
+                num_slots=len(self.engine.slots),
+                max_inflight=self.max_inflight,
+                page_size=int(self.engine.kv.page_size),
+                prefix_cache=self.engine.prefix is not None,
+                draining=self._draining))
         elif t == "ping":
             conn.send({"type": "pong"})
         else:
@@ -870,10 +886,7 @@ class ServingServer:
         never started) answers immediately from the loop thread with
         GIL-atomic-but-unsynchronized reads — the watchdog's fast path,
         which must not block behind a wedged or absent pump."""
-        pump_ok = (self._pump_error is None
-                   and self._pump_thread is not None
-                   and self._pump_thread.is_alive())
-        if msg.get("stale_ok") or not pump_ok:
+        if msg.get("stale_ok") or not self.pump_alive():
             conn.send(self._stats_msg(engine_part=None))
             return
         self._cmds.put(("stats", conn))
@@ -926,8 +939,7 @@ class ServingServer:
             "inflight": self._inflight,
             "max_inflight": self.max_inflight,
             "draining": self._draining,
-            "pump_alive": bool(self._pump_thread is not None
-                               and self._pump_thread.is_alive()),
+            "pump_alive": self.pump_alive(),
             "pump_last_step_age_s": round(self.pump_last_step_age(), 3),
             "latency_ms": lat,
         }

@@ -27,6 +27,29 @@ _LEN = struct.Struct(">I")
 #: the receiver allocate gigabytes (64 MiB >> any real request/response)
 MAX_FRAME = 64 * 1024 * 1024
 
+#: wire-protocol version, carried by the `hello` frame both the replica
+#: server and the fleet router answer on connect.  Bump on any change a
+#: v(n-1) peer could not parse; additive message types/fields do NOT bump
+#: it (peers advertise those through `capabilities` instead).
+PROTO = 1
+
+#: one-line protocol description, used by error frames answering a
+#: malformed FIRST frame — a peer that speaks the wrong protocol (an HTTP
+#: client, a bare JSON line, an old binary framing) gets told what this
+#: socket expects instead of a silent close.  The fleet router depends on
+#: this to classify peers.
+PROTO_DESC = (f"paddle_tpu serving wire protocol v{PROTO}: every message "
+              f"is [4-byte big-endian length][UTF-8 JSON object]; open "
+              f"with a {{\"type\": \"hello\"}} frame to negotiate")
+
+
+def hello_msg(role: str, **extra) -> dict:
+    """The version/capabilities frame a server answers on connect:
+    `role` names what kind of peer this is ("replica" for the engine-pump
+    server, "router" for the fleet front tier) so a connecting router/ctl
+    can classify the far end before routing anything at it."""
+    return {"type": "hello", "proto": PROTO, "role": role, **extra}
+
 
 class FrameError(ValueError):
     """Malformed frame: oversized length prefix or non-JSON body."""
@@ -75,6 +98,43 @@ async def read_frame(reader) -> Optional[dict]:
     except (asyncio.IncompleteReadError, ConnectionError) as e:
         raise FrameError(f"stream ended mid-frame ({e})") from e
     return _decode_body(body)
+
+
+class FrameConn:
+    """One accepted client connection on an asyncio frame server — shared
+    by the replica server (serving/server.py) and the fleet router
+    (fleet/router.py), so the slow-reader discipline can never drift
+    between the two front ends:
+
+    a client that stops READING while its streams keep producing would
+    grow the transport's send buffer without bound (token frames are
+    pushed from loop callbacks, never awaiting drain) — past
+    MAX_WRITE_BUFFER the connection is declared dead and closed, which
+    surfaces to the owner's handler as EOF (the same path as a
+    disconnect, where in-flight work gets cancelled)."""
+
+    _seq = 0
+    MAX_WRITE_BUFFER = 8 * 1024 * 1024
+
+    def __init__(self, writer):
+        FrameConn._seq += 1
+        self.seq = FrameConn._seq
+        self.writer = writer
+        self.dead = False
+        self.rids = {}             # client id -> owner's routing id
+
+    def send(self, msg: dict) -> None:
+        if self.dead or self.writer.is_closing():
+            return
+        try:
+            if self.writer.transport.get_write_buffer_size() > \
+                    self.MAX_WRITE_BUFFER:
+                self.dead = True   # slow reader: sever, don't buffer
+                self.writer.close()
+                return
+            self.writer.write(encode(msg))
+        except (ConnectionError, RuntimeError):
+            self.dead = True
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -162,6 +162,38 @@ def test_assemble_lkg_stitches_serving_chunked_record(tmp_path):
     assert out["serving_chunked"]["p99_itl_improved"] is True
 
 
+def test_assemble_lkg_stitches_serving_fleet_record(tmp_path):
+    """ISSUE 10 wiring: the fleet-router record (affinity-arm tok/s +
+    the affinity-vs-random hit-rate comparison companions) rides the
+    same per-config queue shape — a top-level BENCH_ONLY=serving_fleet
+    record must stitch into the assembled fallback under the
+    `serving_fleet` key with the A/B companions intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_fleet"] == "lm_serving_fleet_tok_per_sec"
+    assert "serving_fleet" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-03T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-04T10:00:00+00:00",
+         "record": {"metric": M["serving_fleet"], "value": 5120.4,
+                    "single_tok_per_sec": 2700.1,
+                    "speedup_vs_single": 1.896,
+                    "hit_rate_affinity": 0.91,
+                    "hit_rate_random": 0.55,
+                    "affinity_hit_gt_random": True,
+                    "measured_at": "2026-08-04T10:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_fleet"]["value"] == 5120.4
+    assert out["serving_fleet"]["hit_rate_affinity"] == 0.91
+    assert out["serving_fleet"]["hit_rate_random"] == 0.55
+    assert out["serving_fleet"]["affinity_hit_gt_random"] is True
+
+
 def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
     """PR 4 wiring: the serving record's p99 per-token latency companion
     (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the

@@ -548,6 +548,128 @@ def test_soak_overcommitted_pool_over_tcp_stays_exact(tiny_tr):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 10 satellites: hello negotiation, protocol-naming errors, and the
+# client's reconnect-with-backoff
+# ---------------------------------------------------------------------------
+
+def test_hello_frame_reports_proto_and_capabilities(tiny_tr):
+    """The version/capabilities frame answered on connect — the fleet
+    router classifies peers with it, so role/proto/page_size must hold."""
+    from paddle_tpu.serving import wire
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            h = c.hello()
+            assert h["proto"] == wire.PROTO
+            assert h["role"] == "replica"
+            assert "generate" in h["capabilities"]
+            assert "dump" in h["capabilities"]
+            assert h["page_size"] == 8 and h["num_slots"] == 2
+            assert h["max_inflight"] == 6 and h["draining"] is False
+            # negotiation is just another frame: real work still flows
+            toks, reason = c.generate([3, 4, 5], max_new=3)
+            assert reason == "length" and len(toks) == 6
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_malformed_first_frame_names_expected_protocol(tiny_tr):
+    """A peer speaking the wrong protocol (here: HTTP) gets an `error`
+    frame NAMING the expected protocol, not a silent close — the router
+    depends on this to classify peers."""
+    import socket
+
+    from paddle_tpu.serving import wire
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        s = socket.create_connection((host, port), timeout=10)
+        s.settimeout(10)
+        try:
+            s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+            msg = wire.read_frame_sync(s)
+            assert msg["type"] == "error"
+            assert "4-byte big-endian length" in msg["error"]
+            assert "hello" in msg["error"]
+            assert f"wire protocol v{wire.PROTO}" in msg["error"]
+            # after the error frame the server closes the connection
+            assert wire.read_frame_sync(s) is None
+        finally:
+            s.close()
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_client_connect_backoff_survives_restart_window():
+    """ECONNREFUSED during a rolling restart's rebind window is a WAIT,
+    not an instant failure: the client retries with bounded jittered
+    backoff until the listener binds."""
+    import socket
+
+    from paddle_tpu.serving import wire
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()                      # port free -> connects are refused
+
+    accepted = []
+
+    def late_bind():
+        time.sleep(0.6)                # the restart window
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        conn, _ = srv.accept()
+        accepted.append(True)
+        # answer a pong so the client can prove the connection works
+        f = wire.read_frame_sync(conn)
+        assert f == {"type": "ping"}
+        conn.sendall(wire.encode({"type": "pong"}))
+        time.sleep(0.2)
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=late_bind)
+    t.start()
+    try:
+        c = ServingClient("127.0.0.1", port, timeout=10,
+                          connect_attempts=10)
+        try:
+            assert c.ping()
+        finally:
+            c.close()
+        assert accepted, "client never reached the late-bound listener"
+    finally:
+        t.join(timeout=30)
+
+
+def test_client_connect_backoff_exhaustion_is_actionable():
+    """Capped attempts against a dead address fail with an error that
+    says what was tried and what to do — still an OSError subclass, so
+    existing callers' except clauses keep working."""
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError,
+                       match="after 3 attempts") as ei:
+        ServingClient("127.0.0.1", port, timeout=5, connect_attempts=3,
+                      connect_backoff_s=0.02)
+    assert "restart" in str(ei.value)
+    assert time.monotonic() - t0 < 5.0, "backoff must stay bounded"
+
+
+# ---------------------------------------------------------------------------
 # ISSUE 6: flight recorder + postmortem bundle trigger paths
 # ---------------------------------------------------------------------------
 

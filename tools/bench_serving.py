@@ -56,19 +56,18 @@ def make_requests(n: int, prompt_lo: int, prompt_hi: int, max_new: int,
     return reqs
 
 
-def make_prefix_requests(n: int, prefix_pool: int, prefix_len: int,
-                         prefix_skew: float, suffix_lo: int, suffix_hi: int,
-                         max_new: int, vocab: int, pool_seed: int = 0,
-                         seed: int = 0, eos_id: int = -1):
-    """Prefix-skew workload: each request draws one of `prefix_pool` shared
-    system-prompt prefixes (Zipf-distributed popularity, exponent
-    `prefix_skew` — rank k with probability ∝ 1/(k+1)^skew) and appends a
-    per-request unique suffix.  The POOL is seeded by `pool_seed` alone so
-    every rep shares the same prefixes (that sharing IS the workload);
-    draws and suffixes vary with `seed`."""
+def make_prefix_prompts(n: int, prefix_pool: int, prefix_len: int,
+                        prefix_skew: float, suffix_lo: int, suffix_hi: int,
+                        vocab: int, pool_seed: int = 0, seed: int = 0):
+    """Raw prompts for the prefix-skew workload: each draws one of
+    `prefix_pool` shared system-prompt prefixes (Zipf-distributed
+    popularity, exponent `prefix_skew` — rank k with probability
+    ∝ 1/(k+1)^skew) and appends a per-request unique suffix.  The POOL is
+    seeded by `pool_seed` alone so every rep shares the same prefixes
+    (that sharing IS the workload); draws and suffixes vary with `seed`.
+    Shared by the engine-level A/B (Request objects) and the fleet bench
+    (client prompts over the wire)."""
     import numpy as np
-
-    from paddle_tpu.serving import Request
 
     pool_rng = np.random.default_rng(pool_seed)
     prefixes = [pool_rng.integers(2, vocab, prefix_len).astype(np.int32)
@@ -76,15 +75,27 @@ def make_prefix_requests(n: int, prefix_pool: int, prefix_len: int,
     rng = np.random.default_rng(seed)
     w = 1.0 / np.arange(1, prefix_pool + 1, dtype=np.float64) ** prefix_skew
     w /= w.sum()
-    reqs = []
-    for i in range(n):
+    prompts = []
+    for _ in range(n):
         k = int(rng.choice(prefix_pool, p=w))
         s = int(rng.integers(suffix_lo, suffix_hi + 1))
-        prompt = np.concatenate([prefixes[k],
-                                 rng.integers(2, vocab, s).astype(np.int32)])
-        reqs.append(Request(f"p{seed}_{i}", prompt, max_new=max_new,
-                            eos_id=eos_id))
-    return reqs
+        prompts.append(np.concatenate(
+            [prefixes[k], rng.integers(2, vocab, s).astype(np.int32)]))
+    return prompts
+
+
+def make_prefix_requests(n: int, prefix_pool: int, prefix_len: int,
+                         prefix_skew: float, suffix_lo: int, suffix_hi: int,
+                         max_new: int, vocab: int, pool_seed: int = 0,
+                         seed: int = 0, eos_id: int = -1):
+    """make_prefix_prompts wrapped as engine Request objects."""
+    from paddle_tpu.serving import Request
+
+    prompts = make_prefix_prompts(n, prefix_pool, prefix_len, prefix_skew,
+                                  suffix_lo, suffix_hi, vocab,
+                                  pool_seed=pool_seed, seed=seed)
+    return [Request(f"p{seed}_{i}", prompt, max_new=max_new, eos_id=eos_id)
+            for i, prompt in enumerate(prompts)]
 
 
 def make_heavytail_requests(n: int, prompt_lo: int, prompt_hi: int,
@@ -397,6 +408,238 @@ def measure_chunked(eng, wl: dict, reps: int, seed: int,
     }
 
 
+# ---------------------------------------------------------------------------
+# fleet bench: one router + N replica SUBPROCESSES (tools/serve.py) vs one
+# replica, on the prefix-skew workload, affinity vs random placement
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(args, seed: int = 1):
+    """One tools/serve.py subprocess built from the SAME model recipe as
+    build_engine (identical params across replicas: same config, same
+    seed); returns (proc, host, port) once its SERVE_JSON line prints."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    argv = [sys.executable, os.path.join(repo, "tools", "serve.py"),
+            "--config", "demo/model_zoo/transformer_lm.py",
+            "--config-args",
+            f"vocab={args.vocab},dim={args.dim},layers={args.layers},"
+            f"heads={args.heads},batch_size={args.slots},"
+            f"compute_dtype={args.dtype}",
+            "--slots", str(args.slots), "--page-size", str(args.page_size),
+            "--max-context", str(args.max_context),
+            "--max-queue", "64", "--seed", str(seed), "--port", "0"]
+    env = dict(os.environ, PYTHONPATH=repo)
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True, cwd=repo,
+                            env=env)
+    import select
+
+    t0 = time.time()
+    while time.time() - t0 < 600:
+        # select-gate the pipe: a replica wedged BEFORE printing its bind
+        # line (stuck compile, hung backend init) must trip this watchdog,
+        # not block readline() until the caller's outer timeout kills the
+        # whole bench with no diagnosis
+        ready, _, _ = select.select([proc.stdout], [], [], 5.0)
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError(f"replica died before binding (rc="
+                                   f"{proc.returncode})")
+            continue
+        line = proc.stdout.readline()
+        if not line and proc.poll() is not None:
+            raise RuntimeError(f"replica died before binding (rc="
+                               f"{proc.returncode})")
+        if line.startswith("SERVE_JSON:"):
+            addr = json.loads(line[len("SERVE_JSON:"):])
+            return proc, addr["host"], addr["port"]
+    proc.kill()
+    raise RuntimeError("replica never printed SERVE_JSON within 600s")
+
+
+def _stop_procs(procs) -> None:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()           # serve.py's SIGTERM drain path
+    for proc in procs:
+        try:
+            proc.wait(timeout=60)
+        except Exception:              # noqa: BLE001 — wedged child
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def run_client_workload(host: str, port: int, prompts, max_new: int,
+                        concurrency: int) -> dict:
+    """Closed-loop client-side drive: `concurrency` threads, each with
+    its own ServingClient connection, pulling prompts off one shared
+    list.  Returns wall seconds, generated tokens, first-token p50 (ms),
+    and the failure list (must be empty for a valid measurement)."""
+    import queue as _queue
+    import threading
+
+    from paddle_tpu.serving.client import ServingClient
+
+    work: _queue.Queue = _queue.Queue()
+    for i, p in enumerate(prompts):
+        work.put((i, [int(t) for t in p]))
+    tokens = [0] * max(1, concurrency)
+    first_tok: list = []
+    failures: list = []
+    lock = threading.Lock()
+
+    def worker(wid: int):
+        try:
+            with ServingClient(host, port, timeout=600) as c:
+                while True:
+                    try:
+                        i, p = work.get_nowait()
+                    except _queue.Empty:
+                        return
+                    t0 = time.perf_counter()
+                    seen = []
+
+                    def on_tok(rid, tok, idx, _t0=t0, _seen=seen):
+                        if idx == 0:
+                            _seen.append(time.perf_counter() - _t0)
+
+                    toks, reason = c.generate(p, max_new=max_new,
+                                              on_token=on_tok)
+                    tokens[wid] += len(toks) - len(p)
+                    with lock:
+                        first_tok.extend(seen)
+                        if reason not in ("length", "stop"):
+                            failures.append(f"req {i}: reason={reason}")
+        except Exception as e:             # noqa: BLE001 — a failed
+            with lock:                     # worker is a failed bench
+                failures.append(f"worker {wid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    import numpy as np
+
+    return {"seconds": dt, "tokens": int(sum(tokens)),
+            "tok_per_sec": sum(tokens) / dt if dt else 0.0,
+            "first_tok_ms_p50": round(float(
+                np.percentile(first_tok, 50)) * 1e3, 3) if first_tok
+            else 0.0,
+            "failures": failures}
+
+
+def _replica_prefix_counts(addrs) -> tuple[int, int]:
+    """Aggregate (prefix_hits, prefix_misses) polled DIRECTLY from each
+    replica (the router's stats are fleet-shaped)."""
+    from paddle_tpu.serving.client import ServingClient
+
+    hits = misses = 0
+    for host, port in addrs:
+        with ServingClient(host, port, timeout=60) as c:
+            s = c.stats(stale_ok=True)
+        hits += int(s.get("prefix_hits") or 0)
+        misses += int(s.get("prefix_misses") or 0)
+    return hits, misses
+
+
+def measure_fleet(args) -> dict:
+    """The fleet A/B (ISSUE 10): the SAME prefix-skew workload through
+    (a) ONE replica, connected directly — the no-router baseline;
+    (b) a router + N replica subprocesses, policy=random — fan-out with
+        the prefix cache sharded blindly (the placement strawman);
+    (c) a router + N replicas, policy=affinity — the KV-aware placement.
+
+    Every arm gets FRESH replica processes (a warm prefix tree from the
+    previous arm would corrupt the hit-rate comparison) and an untimed
+    warmup pass over a DIFFERENT prefix pool (same shapes: compiles the
+    mixed/decode signatures and settles the engines without pre-seeding
+    the measured prefixes).  Reported: tokens/s per arm, aggregate
+    prefix-cache hit rate per arm (polled from the replicas directly),
+    and `affinity_hit_gt_random` — the acceptance comparison: affinity
+    routing must beat random routing's hit rate on the same workload."""
+    wl = dict(n=args.num_requests, prefix_pool=args.prefix_pool,
+              prefix_len=args.prefix_len, prefix_skew=args.prefix_skew,
+              suffix_lo=args.suffix_lo, suffix_hi=args.suffix_hi,
+              vocab=args.vocab)
+    timed_prompts = make_prefix_prompts(pool_seed=args.seed,
+                                        seed=args.seed + 1, **wl)
+    warm_prompts = make_prefix_prompts(pool_seed=args.seed + 1000,
+                                       seed=args.seed + 1001, **wl)
+
+    def one_arm(n_replicas: int, policy):
+        from paddle_tpu.fleet import FleetRouter
+
+        procs, addrs = [], []
+        rt = None
+        try:
+            for _ in range(n_replicas):
+                proc, host, port = _spawn_replica(args)
+                procs.append(proc)
+                addrs.append((host, port))
+            if policy is None:
+                host, port = addrs[0]
+            else:
+                rt = FleetRouter(port=0, replicas=addrs, policy=policy)
+                host, port = rt.start_background()
+            warm = run_client_workload(host, port, warm_prompts,
+                                       args.max_new, args.concurrency)
+            if warm["failures"]:
+                raise RuntimeError(f"warmup failed: {warm['failures'][:3]}")
+            h0, m0 = _replica_prefix_counts(addrs)
+            rec = run_client_workload(host, port, timed_prompts,
+                                      args.max_new, args.concurrency)
+            h1, m1 = _replica_prefix_counts(addrs)
+            dh, dm = h1 - h0, m1 - m0
+            rec["prefix_hits"] = dh
+            rec["prefix_misses"] = dm
+            rec["hit_rate"] = dh / (dh + dm) if dh + dm else 0.0
+            if rt is not None:
+                from paddle_tpu.serving.client import ServingClient
+
+                with ServingClient(host, port, timeout=60) as c:
+                    s = c.stats()
+                rec["sheds"] = s["sheds"]
+                rec["retries"] = s["retries"]
+            return rec
+        finally:
+            if rt is not None:
+                rt.stop_background(drain=True)
+            _stop_procs(procs)
+
+    single = one_arm(1, None)
+    random_arm = one_arm(args.fleet, "random")
+    affinity = one_arm(args.fleet, "affinity")
+    ok = not (single["failures"] or random_arm["failures"]
+              or affinity["failures"])
+    return {
+        "fleet": args.fleet,
+        "concurrency": args.concurrency,
+        "ok": ok,
+        "failures": (single["failures"] + random_arm["failures"]
+                     + affinity["failures"])[:5],
+        "tok_per_sec": round(affinity["tok_per_sec"], 1),
+        "single_tok_per_sec": round(single["tok_per_sec"], 1),
+        "random_tok_per_sec": round(random_arm["tok_per_sec"], 1),
+        "speedup_vs_single": round(
+            affinity["tok_per_sec"] / single["tok_per_sec"], 3)
+        if single["tok_per_sec"] else 0.0,
+        "hit_rate_affinity": round(affinity["hit_rate"], 4),
+        "hit_rate_random": round(random_arm["hit_rate"], 4),
+        "hit_rate_single": round(single["hit_rate"], 4),
+        "affinity_hit_gt_random":
+            affinity["hit_rate"] > random_arm["hit_rate"],
+        "first_tok_ms_p50": affinity["first_tok_ms_p50"],
+        "random_first_tok_ms_p50": random_arm["first_tok_ms_p50"],
+        "router_sheds": affinity.get("sheds", 0.0),
+        "router_retries": affinity.get("retries", 0.0),
+    }
+
+
 def build_engine(args):
     from paddle_tpu.config.parser import parse_config
     from paddle_tpu.serving import ServingEngine
@@ -451,6 +694,15 @@ def main() -> int:
     # chunked prefill (docs/serving.md "Chunked prefill"): --prompt-dist
     # heavy-tail runs the A/B (legacy whole-prompt prefill vs budgeted
     # mixed steps) on a Pareto/lognormal prompt-length workload
+    # fleet (docs/serving.md "Fleet"): --fleet N runs the router A/B —
+    # one replica direct vs router+N replica subprocesses, prefix-skew
+    # workload, affinity vs random placement hit rates
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="run the fleet A/B with N replica subprocesses "
+                         "(reports tok/s vs one replica and affinity-vs-"
+                         "random prefix hit rates)")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="client threads driving the fleet workload")
     ap.add_argument("--prompt-dist", choices=["uniform", "heavy-tail"],
                     default="uniform",
                     help="heavy-tail: lognormal body + Pareto tail prompt "
@@ -465,6 +717,31 @@ def main() -> int:
     args = ap.parse_args()
 
     import numpy as np
+
+    if args.fleet > 0:
+        if args.prefix_skew is None:
+            args.prefix_skew = 1.0     # --prefix-skew doubles as the
+        m = measure_fleet(args)        # engine-A/B trigger; fleet mode
+                                       # just needs a Zipf exponent
+        print(json.dumps({
+            "bench": "serving_fleet",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prefix_pool": args.prefix_pool, "prefix_len": args.prefix_len,
+            "prefix_skew": args.prefix_skew,
+            "suffix_lens": [args.suffix_lo, args.suffix_hi],
+            "max_new": args.max_new, "dim": args.dim,
+            "layers": args.layers, "dtype": args.dtype,
+            "lm_serving_fleet_tok_per_sec": m["tok_per_sec"],
+            **{k: m[k] for k in (
+                "fleet", "concurrency", "single_tok_per_sec",
+                "random_tok_per_sec", "speedup_vs_single",
+                "hit_rate_affinity", "hit_rate_random", "hit_rate_single",
+                "affinity_hit_gt_random", "first_tok_ms_p50",
+                "random_first_tok_ms_p50", "router_sheds",
+                "router_retries", "ok", "failures")},
+        }), flush=True)
+        return 0 if m["ok"] else 1
 
     eng = build_engine(args)
     if args.prompt_dist == "heavy-tail":

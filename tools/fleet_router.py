@@ -1,0 +1,105 @@
+"""Serve a fleet router over N serving replicas (paddle_tpu/fleet/).
+
+Router (foreground; SIGTERM or SIGINT drains — finish routed requests,
+refuse new ones, exit 0).  Replicas are ordinary `tools/serve.py`
+processes; list them up front and/or join them live with the ctl:
+
+  # replicas (each prints SERVE_JSON:{"port": ...})
+  python tools/serve.py --config ... --port 8431 &
+  python tools/serve.py --config ... --port 8432 &
+
+  # the router (stdlib-only: runs fine on a box with no accelerator)
+  python tools/fleet_router.py --port 8440 \
+      --replica 127.0.0.1:8431 --replica 127.0.0.1:8432 \
+      [--policy affinity] [--postmortem-dir runs/postmortems]
+
+On bind it prints one machine-readable line (same contract as serve.py):
+
+  FLEET_JSON:{"host": "127.0.0.1", "port": 8440, "pid": 12345}
+
+Clients connect to the router exactly as to one replica — serving/client.py,
+`tools/serve.py --client HOST:PORT`, same frames, streaming preserved.
+Operate the fleet with `python -m paddle_tpu.fleet.ctl --router HOST:PORT
+join|leave|drain|undrain|list|wait-drained` (the rolling-restart runbook
+lives in docs/serving.md "Fleet").
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_addr(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def amain(args) -> int:
+    from paddle_tpu.fleet import FleetRouter
+
+    rt = FleetRouter(host=args.host, port=args.port,
+                     replicas=[parse_addr(s) for s in args.replica],
+                     policy=args.policy,
+                     poll_interval_s=args.poll_interval_s,
+                     heartbeat_misses=args.heartbeat_misses,
+                     wedge_age_s=args.wedge_age_s,
+                     retry_limit=args.retry_limit,
+                     postmortem_dir=args.postmortem_dir or None)
+    host, port = await rt.start()
+    print("FLEET_JSON:" + json.dumps(
+        {"host": host, "port": port, "pid": os.getpid()}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("draining: refusing new requests, finishing routed ones...",
+          file=sys.stderr, flush=True)
+    await rt.drain()
+    print("drained; bye", file=sys.stderr, flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (see the FLEET_JSON line)")
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="replica to join at start (repeatable); one not "
+                         "up yet is retried until it is — or join live "
+                         "via `python -m paddle_tpu.fleet.ctl`")
+    ap.add_argument("--policy", default="affinity",
+                    choices=["affinity", "least_loaded", "random"],
+                    help="placement policy (random exists for the "
+                         "fleet bench's hit-rate A/B baseline)")
+    ap.add_argument("--poll-interval-s", type=float, default=0.5,
+                    help="stats-poll (= heartbeat) period per replica")
+    ap.add_argument("--heartbeat-misses", type=int, default=10,
+                    help="consecutive missed polls before a replica is "
+                         "declared dead and leaves the fleet")
+    ap.add_argument("--wedge-age-s", type=float, default=30.0,
+                    help="polled pump_last_step_age_s past which the "
+                         "replica's circuit opens (placement stops)")
+    ap.add_argument("--retry-limit", type=int, default=2,
+                    help="max transparent re-placements of a "
+                         "never-streamed request after replica failures")
+    ap.add_argument("--postmortem-dir", default="",
+                    help="arm the flight recorder: total-fleet-unhealthy "
+                         "or a client dump frame freezes an atomic "
+                         "bundle here")
+    args = ap.parse_args(argv)
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -192,6 +192,7 @@ _REHEARSE_ENV = {
     "BENCH_SERVE_MAX_NEW": "4", "BENCH_SERVE_REPS": "2",
     "BENCH_SERVE_PREFIX_POOL": "2", "BENCH_SERVE_PREFIX_LEN": "16",
     "BENCH_SERVE_SUFFIX_LO": "3", "BENCH_SERVE_SUFFIX_HI": "8",
+    "BENCH_SERVE_FLEET": "2", "BENCH_SERVE_FLEET_CONC": "2",
 }
 
 
@@ -264,6 +265,14 @@ def main() -> int:
                                 "--dim", "32", "--layers", "1",
                                 "--heads", "2", "--dtype", "float32",
                                 "--reps", "1"]
+        serving_fleet_args = ["--fleet", "2", "--concurrency", "2",
+                              "--num-requests", "8", "--slots", "2",
+                              "--page-size", "8", "--max-context", "48",
+                              "--prefix-pool", "2", "--prefix-len", "16",
+                              "--suffix-lo", "3", "--suffix-hi", "8",
+                              "--max-new", "4", "--vocab", "64",
+                              "--dim", "32", "--layers", "1",
+                              "--heads", "2", "--dtype", "float32"]
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
         tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
                      "--heads", "2", "--target-ms", "5", "--reps", "1"]
@@ -285,6 +294,10 @@ def main() -> int:
         # capacity (768-context default clamps to ~700-token prompts)
         serving_chunked_args = ["--prompt-dist", "heavy-tail",
                                 "--prompt-hi", "700"]
+        # fleet A/B at TPU size: one router + 2 serve.py subprocesses vs
+        # one replica, on the prefix-skew defaults (each arm spawns fresh
+        # replicas, so this is the longest serving step)
+        serving_fleet_args = ["--fleet", "2"]
         rnn_args = []
         additive_args = []
         profile_args = []
@@ -334,6 +347,12 @@ def main() -> int:
         ("bench_serving_chunked_record", [py, "bench.py"], 900,
          bench_env("serving_chunked", 840),
          lambda: _metric_fresh(_METRIC_OF["serving_chunked"], fh)),
+        # fleet-router record (affinity-arm tok/s + the affinity-vs-
+        # random hit-rate comparison): three arms, each spawning fresh
+        # replica subprocesses — the largest serving budget in the queue
+        ("bench_serving_fleet_record", [py, "bench.py"], 1500,
+         bench_env("serving_fleet", 1440),
+         lambda: _metric_fresh(_METRIC_OF["serving_fleet"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
@@ -368,6 +387,11 @@ def main() -> int:
         ("bench_serving_chunked",
          [py, "tools/bench_serving.py"] + serving_chunked_args, 1200, {},
          lambda: _out_fresh("bench_serving_chunked", fh)),
+        # fleet sweep: the full three-arm A/B banked to OUT (per-arm
+        # tok/s, hit rates, router shed/retry counters)
+        ("bench_serving_fleet",
+         [py, "tools/bench_serving.py"] + serving_fleet_args, 1800, {},
+         lambda: _out_fresh("bench_serving_fleet", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
