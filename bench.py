@@ -682,6 +682,55 @@ def bench_serving_fleet(dtype: str) -> dict:
     }
 
 
+def bench_serving_tp(dtype: str) -> dict:
+    """Tensor-parallel sharded-decode record (docs/serving.md "Sharded
+    decode"): the same closed-loop workload on a single-device engine vs
+    attention-head/KV-pool sharding over `BENCH_SERVE_TP` devices
+    (default 2) — tools/bench_serving.py --mesh-model N is the sweep
+    tool, this is the compact record.  Headline = sharded-arm tokens/s;
+    companions are the single-device arm, the speedup, and the KV pool
+    bytes PER SHARD (the per-chip HBM split that lets one replica serve
+    a model bigger than a chip).  Needs >= N local devices — on the CPU
+    rehearse the tpu_measure step injects
+    XLA_FLAGS=--xla_force_host_platform_device_count.  Token exactness
+    across shard counts is tests/test_serving_tp.py's job."""
+    import argparse
+
+    from tools.bench_serving import measure_tp
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        num_requests=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prompt_lo=int(os.environ.get("BENCH_SERVE_PROMPT_LO", "32")),
+        prompt_hi=int(os.environ.get("BENCH_SERVE_PROMPT_HI", "256")),
+        max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "64")),
+        reps=int(os.environ.get("BENCH_SERVE_REPS", "3")),
+        mesh_model=int(os.environ.get("BENCH_SERVE_TP", "2")),
+        seed=0, dtype=dtype)
+    m = measure_tp(args)
+    return {
+        "metric": "lm_serving_tp_tok_per_sec",
+        "value": m["tok_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"tp={m['mesh_model']} vocab={args.vocab} "
+                  f"dim={args.dim} L={args.layers} H={args.heads} "
+                  f"slots={args.slots} page={args.page_size} "
+                  f"prompts={args.prompt_lo}-{args.prompt_hi} "
+                  f"max_new={args.max_new}",
+        **{k: m[k] for k in (
+            "mesh_model", "single_tok_per_sec", "speedup_vs_single",
+            "pool_bytes_per_shard", "single_pool_bytes",
+            "pool_shrink_vs_single", "sig_stable")},
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
@@ -690,6 +739,7 @@ BENCHES = {
     "serving_prefix": bench_serving_prefix,
     "serving_chunked": bench_serving_chunked,
     "serving_fleet": bench_serving_fleet,
+    "serving_tp": bench_serving_tp,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -813,6 +863,7 @@ _METRIC_OF = {
     "serving_prefix": "lm_serving_prefix_hit_rate",
     "serving_chunked": "lm_serving_p99_itl_chunked_ms",
     "serving_fleet": "lm_serving_fleet_tok_per_sec",
+    "serving_tp": "lm_serving_tp_tok_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -896,8 +947,8 @@ def _assemble_lkg() -> dict | None:
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
-                "serving_fleet", "mnist", "sentiment", "recommendation",
-                "seq2seq"):
+                "serving_fleet", "serving_tp", "mnist", "sentiment",
+                "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:

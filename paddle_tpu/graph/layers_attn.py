@@ -280,11 +280,14 @@ def _paged_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
         qpos = pos[:, None]
         q, k = rope(q, qpos, theta), rope(k, qpos, theta)
     window = (int(cfg.attrs["window"]) if "window" in cfg.attrs else None)
+    # a mesh with a `model` axis > 1 = tensor-parallel serving: the op
+    # runs the write+read core under shard_map over the head shards
     out, ck, cv = paged_attention_step(
         q, k, v, cache["k_pages"], cache["v_pages"], cache["page_table"],
         pos, window=window,
         use_kernel=(False if str(cfg.attrs.get("attn_impl", "auto"))
-                    in ("dense", "blockwise") else None))
+                    in ("dense", "blockwise") else None),
+        mesh=ctx.mesh)
     ctx.state_out[cfg.name] = {"k_pages": ck, "v_pages": cv,
                                "page_table": cache["page_table"],
                                "pos": pos + 1}
@@ -323,11 +326,13 @@ def _paged_ragged_step(ctx: ForwardContext, cfg: LayerConfig, x_arg: Argument,
         theta = float(cfg.attrs.get("rope_theta", 10000.0))
         q, k = rope(q, row_pos, theta), rope(k, row_pos, theta)
     window = (int(cfg.attrs["window"]) if "window" in cfg.attrs else None)
+    # mesh `model` axis > 1 = tensor-parallel mixed step (shard_map core)
     out, ck, cv = ragged_paged_attention_step(
         q[0], k[0], v[0], cache["k_pages"], cache["v_pages"],
         cache["page_table"], cache["row_slot"], row_pos, window=window,
         use_kernel=(False if str(cfg.attrs.get("attn_impl", "auto"))
-                    in ("dense", "blockwise") else None))
+                    in ("dense", "blockwise") else None),
+        mesh=ctx.mesh)
     ctx.state_out[cfg.name] = {"k_pages": ck, "v_pages": cv,
                                "page_table": cache["page_table"],
                                "row_slot": cache["row_slot"],

@@ -60,6 +60,12 @@ CATALOG: dict[str, str] = {
     "serving_prefix_cow_total":
         "copy-on-write page copies (divergence inside a shared boundary page)",
     "serving_decode_steps_total": "compiled decode steps executed",
+    # -- tensor-parallel sharded decode (docs/serving.md "Sharded decode")
+    "serving_tp_shards":
+        "tensor-parallel shards (mesh model-axis size; 1 = unsharded)",
+    "serving_kv_pool_bytes_per_shard":
+        "KV page-pool bytes resident PER DEVICE (kv-head axis split over "
+        "the mesh model axis)",
     # -- chunked prefill / mixed-step token budget -------------------------
     "serving_step_tokens":
         "scheduled token rows per compiled step (decode rows + prefill "

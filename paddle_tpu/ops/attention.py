@@ -436,6 +436,41 @@ def cached_attention_step(
     return out, ck, cv, pos + n_new
 
 
+def _tp_shards(mesh) -> int:
+    """Size of a mesh's `model` axis (1 = no tensor parallelism); reads
+    the mesh's own shape map — no parallel.mesh import, keeping this
+    module cycle-free."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get("model", 1))
+    except (AttributeError, TypeError):
+        return 1
+
+
+def _tp_paged_call(mesh, body, head_args, pool_args, repl_args,
+                   head_axis: int):
+    """Run a paged-attention body under shard_map over the mesh `model`
+    axis: query/key/value shard on their head axis, the page pools on
+    their kv-head axis (axis 2), tables/positions replicate.  Each device
+    reads and writes ONLY its own head shard of the pools — the pools are
+    never all-gathered (tools/hlo_shard_check.py asserts it on the
+    lowered HLO), and since no reduction ever crosses heads inside
+    attention, the sharded math is the single-device math per head.
+    Returns (out [head-sharded], k_pages', v_pages' [pool-sharded])."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.utils.jax_compat import shard_map
+
+    head = P(*([None] * head_axis + ["model", None]))
+    pool = P(None, None, "model", None)
+    in_specs = tuple([head] * len(head_args) + [pool] * len(pool_args)
+                     + [P()] * len(repl_args))
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=(head, pool, pool), check_vma=False)
+    return fn(*head_args, *pool_args, *repl_args)
+
+
 def paged_attention_step(
     q_new: Array,          # [S, 1, H, D] one new-token query per slot
     k_new: Array,          # [S, 1, H_kv, D]
@@ -448,6 +483,7 @@ def paged_attention_step(
     scale: Optional[float] = None,
     window: Optional[int] = None,
     use_kernel: Optional[bool] = None,
+    mesh=None,
 ) -> tuple[Array, Array, Array]:
     """One continuous-batching decode micro-step against a PAGED KV cache —
     the serving analog of `cached_attention_step`: instead of one dense
@@ -480,6 +516,20 @@ def paged_attention_step(
     max_pages = page_table.shape[1]
     if scale is None:
         scale = D ** -0.5
+
+    if _tp_shards(mesh) > 1:
+        # tensor-parallel decode: heads partition over the mesh `model`
+        # axis — the whole write+read core runs per head shard under
+        # shard_map (each device's local H/h_kv keep the same grouped-
+        # query ratio; the engine validated divisibility)
+        def body(q, k, v, kp, vp, tbl, p):
+            return paged_attention_step(q, k, v, kp, vp, tbl, p,
+                                        scale=scale, window=window,
+                                        use_kernel=use_kernel, mesh=None)
+
+        return _tp_paged_call(mesh, body, (q_new, k_new, v_new),
+                              (k_pages, v_pages), (page_table, pos),
+                              head_axis=2)
 
     # -- write: scatter each slot's new k/v into its current page --------
     phys = jnp.take_along_axis(page_table, (pos // page_size)[:, None],
@@ -535,6 +585,7 @@ def ragged_paged_attention_step(
     scale: Optional[float] = None,
     window: Optional[int] = None,
     use_kernel: Optional[bool] = None,
+    mesh=None,
 ) -> tuple[Array, Array, Array]:
     """RAGGED paged attention — the mixed prefill/decode step of the
     serving engine (the full Ragged Paged Attention shape of
@@ -567,6 +618,20 @@ def ragged_paged_attention_step(
     max_pages = page_table.shape[1]
     if scale is None:
         scale = D ** -0.5
+
+    if _tp_shards(mesh) > 1:
+        # mixed prefill/decode under tensor parallelism: same head-shard
+        # partition as the decode step, row indirection replicated
+        def body(q, k, v, kp, vp, tbl, rs, rp):
+            return ragged_paged_attention_step(q, k, v, kp, vp, tbl, rs,
+                                               rp, scale=scale,
+                                               window=window,
+                                               use_kernel=use_kernel,
+                                               mesh=None)
+
+        return _tp_paged_call(mesh, body, (q_new, k_new, v_new),
+                              (k_pages, v_pages),
+                              (page_table, row_slot, row_pos), head_axis=1)
 
     # -- write: scatter every row's k/v into its slot's current page -----
     phys = page_table[row_slot, row_pos // page_size]             # [T]

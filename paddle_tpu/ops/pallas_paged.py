@@ -31,6 +31,16 @@ slot) and live decode rows share one grid.  `row_slot` rides the same
 scalar-prefetch channel as the page table; everything else (online
 softmax over live pages, pl.when page skipping, in-kernel GQA) is
 unchanged.
+
+TENSOR PARALLELISM (the serving engine's `--mesh model=N` sharded
+decode): this kernel is always invoked on LOCAL head shards — the
+shard_map wrapper in ops/attention.py partitions q over its head axis
+and the pools over their kv-head axis before calling in, so H and h_kv
+here are the per-device counts (H/N and h_kv/N of the model; the engine
+validates divisibility, and the grouped-query ratio H/h_kv is shard-
+invariant).  The kernel itself needs no collective and no change: page
+tables and lengths arrive replicated, every DMA stays on-chip, and the
+head padding below (`max(H, 8)`) applies to the LOCAL count.
 """
 
 from __future__ import annotations

@@ -64,6 +64,26 @@ def axis_size(mesh: Optional[Mesh], axis: str) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
 
 
+def model_mesh(n: int, devices=None) -> Optional[Mesh]:
+    """A SERVING tensor-parallel mesh: exactly the first `n` devices on the
+    `model` axis, every other axis 1 (the `--mesh model=N` flag of
+    tools/serve.py / bench_serving).  Unlike make_mesh's data=0 remainder
+    rule this never swallows spare devices into a data axis — replicating
+    the KV pools over an unused data axis would defeat the per-chip HBM
+    win sharding exists for.  n <= 1 returns None (no mesh: the engine
+    keeps its single-device path)."""
+    n = int(n)
+    if n <= 1:
+        return None
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < n:
+        raise ValueError(
+            f"--mesh model={n} needs {n} devices, have {len(devs)} — on a "
+            f"CPU host use XLA_FLAGS=--xla_force_host_platform_device_"
+            f"count={n} (set BEFORE jax initializes)")
+    return make_mesh(data=1, model=n, seq=1, pipe=1, devices=devs[:n])
+
+
 def mesh_from_flag(spec: str, devices=None) -> Optional[Mesh]:
     """Parse 'data:8' / 'data:4,model:2' / 'data:2,seq:2,model:2'
     (the --mesh_shape flag)."""

@@ -33,13 +33,44 @@ finished slots, repeat.  A slot that cannot get its next page (overcommitted
 pool) is PAUSED — excluded from that step's key consumption and token
 banking — and resumes bit-identically once a page frees, because its key
 schedule is indexed by its own generation counter, not by wall-clock steps.
+
+ENGINE STATE AS A PYTREE: everything the compiled steps read or write is an
+explicit, jittable `EngineState` — the per-layer KV page pools, the page
+table (with the mixed step's virtual trash row), and the per-slot
+pos/last-token/generation/rng-key/sampling-knob arrays, all device-resident
+with donated in/out buffers so pools and slot arrays update IN PLACE.  The
+steps are pure functions (state, run-mask) -> (state', next-tokens): pos,
+gen and last-token advance ON DEVICE for the slots the run mask marks, and
+each slot's sampling key is state.keys[s, gen[s]] — so a steady pure-decode
+run re-stages NOTHING from the host.  All host-side scheduling (allocator,
+prefix tree, preemption, admission) mutates host mirrors that sync to the
+pytree only at boundaries: a page-table write bumps `PagedKVCache.version`,
+a slot lifecycle event (admit/retire/preempt/abort/restore) sets the
+slots-dirty flag, and the run mask re-uploads only when its membership
+changes.  `n_host_stages` counts every host->device staging transfer —
+tests/test_engine_state.py asserts it stays flat across pure-decode steps.
+The same pytree is the serving checkpoint/restore + fleet-migration unit:
+`checkpoint_state()` / `restore_state()` freeze and resume an engine
+MID-FLIGHT (queued + decoding + mid-chunk slots) bit-exactly.
+
+TENSOR-PARALLEL DECODE (`mesh=` with a `model` axis of size > 1): attention
+heads and the per-layer KV pools partition over the mesh's `model` axis —
+w_q/w_k/w_v column-shard, the pools shard on their kv-head axis, w_o
+row-shards so the out-projection's partial sums meet in ONE all-reduce per
+layer (the Megatron split), and everything else (page tables, slot arrays,
+non-attention params, logits, sampling) stays replicated.  The paged
+attention core runs under shard_map (ops/attention.py), so the pools are
+NEVER all-gathered — each device reads and writes only its head shard
+(tools/hlo_shard_check.py proves it on the lowered HLO).  One replica then
+serves a model larger than a chip's HBM and decodes with every chip's
+FLOPs, still through ONE compiled decode signature.
 """
 
 from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,10 +83,31 @@ from paddle_tpu.graph.lm_decode import (_is_probs, _resolve_io_names,
 from paddle_tpu.obs.compile_watch import get_compile_watch
 from paddle_tpu.obs.flight import get_flight_recorder
 from paddle_tpu.obs.trace import get_tracer
+from paddle_tpu.parallel.mesh import MODEL_AXIS, axis_size
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.serving.paged_kv import PagedKVCache
 from paddle_tpu.serving.prefix_tree import PrefixTree
 from paddle_tpu.serving.sampler import pick_next_per_slot
+
+
+class EngineState(NamedTuple):
+    """The decode/mixed steps' ENTIRE device state — one jittable pytree.
+
+    Donated into every compiled step and rebound from its output, so pools
+    and slot arrays update in place (no copies, no stale aliases).  Under
+    tensor parallelism the pools shard on their kv-head axis over the mesh
+    `model` axis; every other leaf is replicated."""
+
+    pools: dict      # {layer: {"k"/"v": [num_pages, page_size, h_kv, dh]}}
+    table: jax.Array  # [S+1, pages_per_slot] int32 — row S is the mixed
+                      # step's virtual all-trash row (always zeros)
+    pos: jax.Array    # [S] int32 tokens resident in the paged cache
+    toks: jax.Array   # [S] int32 last emitted token (decode-step input)
+    gen: jax.Array    # [S] int32 tokens emitted — indexes `keys`
+    keys: jax.Array   # [S, capacity_tokens, 2] uint32 per-slot key schedule
+    temp: jax.Array   # [S] float32 sampling temperature
+    topk: jax.Array   # [S] int32
+    topp: jax.Array   # [S] float32
 
 
 class Request:
@@ -149,15 +201,45 @@ class ServingEngine:
                  logits_name: Optional[str] = None,
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = -1,
-                 max_step_tokens: Optional[int] = None):
+                 max_step_tokens: Optional[int] = None,
+                 mesh=None):
         self.executor = executor
-        self.params = params
         self.input_name, self.logits_name = _resolve_io_names(
             executor.model, input_name, logits_name)
         self._probs = _is_probs(executor.model, self.logits_name)
+        # tensor parallelism: a mesh whose `model` axis exceeds 1 shards
+        # attention heads + KV pools over it (docs/serving.md "Sharded
+        # decode").  The executor must see the same mesh — layers_attn
+        # routes the paged attention core through shard_map off ctx.mesh.
+        self.mesh = mesh if mesh is not None else getattr(executor, "mesh",
+                                                          None)
+        self.tp = axis_size(self.mesh, MODEL_AXIS)
+        self._repl_sharding = None
+        self._param_shardings_tree = None
+        if self.tp > 1:
+            if executor.mesh is not None and executor.mesh is not self.mesh:
+                raise ValueError(
+                    "ServingEngine(mesh=...) conflicts with the executor's "
+                    "own mesh — build the executor meshless (or with the "
+                    "same mesh) for tensor-parallel serving")
+            executor.mesh = self.mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._repl_sharding = NamedSharding(self.mesh, PartitionSpec())
+            self._validate_tp(executor.model)
+            # params placed ONCE: attention projections sharded (w_q/w_k/
+            # w_v by column = head, w_o by row), everything else
+            # replicated — the tree is reused verbatim as the compiled
+            # steps' in_shardings, so placement and jit can never diverge
+            self._param_shardings_tree = self._tp_param_shardings(params)
+            params = jax.device_put(params, self._param_shardings_tree)
+        self.params = params
         pages_per_slot = -(-int(max_context) // int(page_size))
         self.kv = PagedKVCache(executor, num_slots, page_size,
-                               pages_per_slot, num_pages)
+                               pages_per_slot, num_pages,
+                               mesh=self.mesh if self.tp > 1 else None)
+        # the ONE canonical pool sharding, derived by the cache that owns
+        # the pools — every jit that hands pools back pins to it
+        self._pool_sharding = self.kv.pool_sharding
         # prefix caching (serving/prefix_tree.py): retired requests donate
         # their fully-committed pages to a radix index keyed on token-id
         # runs; admission walks it and prefills ONLY the uncached suffix.
@@ -221,12 +303,29 @@ class ServingEngine:
         # distinct prefix lengths
         self._prefix_prefill_cache: dict[tuple, object] = {}
         self._prefix_pack_cache: dict[int, object] = {}
+        # -- device-resident EngineState + its host sync machinery --------
+        # The compiled steps advance pos/gen/toks on device, so the hot
+        # path re-stages NOTHING: the page table re-uploads only when a
+        # host-side table write bumps kv.version, the per-slot arrays only
+        # when a slot lifecycle event sets _slots_dirty, and the run mask
+        # only when its membership changes.  n_host_stages counts every
+        # host->device transfer (the test_engine_state.py regression).
+        self.n_host_stages = 0
+        S = num_slots
+        self._kk = self.kv.capacity_tokens     # keys per slot (> max_new)
+        self._kv_synced = -1                   # kv.version last uploaded
+        self._slots_dirty = True
+        self._run_host: Optional[np.ndarray] = None
+        self._d_run = None
+        self._d_table = self._d_pos = self._d_toks = self._d_gen = None
+        self._d_keys = self._d_temp = self._d_topk = self._d_topp = None
         # every engine jit reports to the compile watcher (obs/
         # compile_watch.py): the decode step must stay at ONE signature,
         # per-bucket prefill compiles feed the recompile-storm detector
+        dec_jit = jax.jit(self._decode_impl, donate_argnums=(1,),
+                          **self._step_sharding_kwargs(n_extra=1))
         self._decode_step = get_compile_watch().wrap_jit(
-            "serving.decode_step",
-            jax.jit(self._decode_impl, donate_argnums=(1,)))
+            "serving.decode_step", dec_jit)
         # CHUNKED PREFILL (mixed prefill/decode steps): prompts commit in
         # `prefill_chunk`-token chunks INSIDE the regular step — decode
         # rows and chunk rows pack into one ragged [max_step_tokens] row
@@ -238,9 +337,10 @@ class ServingEngine:
         # steps keep it) + ONE mixed-step signature per max_step_tokens
         # value.  prefill_chunk=None disables chunking (legacy bucketed
         # whole-prompt prefill); -1 (the default) picks 4*page_size.
+        mix_jit = jax.jit(self._mixed_impl, donate_argnums=(1,),
+                          **self._step_sharding_kwargs(n_extra=6))
         self._mixed_step = get_compile_watch().wrap_jit(
-            "serving.mixed_step",
-            jax.jit(self._mixed_impl, donate_argnums=(1,)))
+            "serving.mixed_step", mix_jit)
         self.prefill_chunk: Optional[int] = None
         self.max_step_tokens = 0
         self.set_chunking(4 * self.kv.page_size if prefill_chunk == -1
@@ -262,6 +362,160 @@ class ServingEngine:
             buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
                      2500, 5000))
         self._t_prev_decode: Optional[float] = None
+
+    # -- tensor-parallel sharding trees ------------------------------------
+    def _validate_tp(self, model) -> None:
+        """Head counts must divide over the `model` axis: each device owns
+        whole query heads and whole kv heads (the shard_map attention core
+        and the pool's kv-head partition both depend on it)."""
+        for l in model.layers:
+            if l.type != "multi_head_attention":
+                continue
+            heads = int(l.attrs["num_heads"])
+            h_kv = int(l.attrs.get("num_kv_heads", 0) or heads)
+            if heads % self.tp or h_kv % self.tp:
+                raise ValueError(
+                    f"layer {l.name!r}: num_heads={heads} / "
+                    f"num_kv_heads={h_kv} must both divide the mesh model "
+                    f"axis ({self.tp}) — tensor-parallel decode gives each "
+                    f"device whole heads")
+
+    def _tp_param_shardings(self, params) -> dict:
+        """NamedSharding per parameter: attention projections partition
+        over `model` (w_q/w_k/w_v by output column — whole heads per
+        device; w_o by input row, so the out-projection is partial sums
+        meeting in one all-reduce), everything else replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        col = NamedSharding(self.mesh, P(None, "model"))
+        row = NamedSharding(self.mesh, P("model", None))
+        sh = {name: self._repl_sharding for name in params}
+        for l in self.executor.model.layers:
+            if l.type != "multi_head_attention":
+                continue
+            names = [l.inputs[i].input_parameter_name for i in range(4)]
+            for n in names[:3]:                       # w_q, w_k, w_v
+                sh[n] = col
+            sh[names[3]] = row                        # w_o
+        return sh
+
+    def _state_shardings(self) -> "EngineState":
+        pool = {name: {"k": self._pool_sharding, "v": self._pool_sharding}
+                for name in self.kv.pools}
+        r = self._repl_sharding
+        return EngineState(pools=pool, table=r, pos=r, toks=r, gen=r,
+                           keys=r, temp=r, topk=r, topp=r)
+
+    def _step_sharding_kwargs(self, n_extra: int) -> dict:
+        """Explicit in/out sharding trees for the compiled steps (the
+        compile_step_with_plan discipline): (params, EngineState,
+        n_extra replicated operands) -> (EngineState, replicated tokens).
+        Empty off-mesh — the single-device jits stay exactly as before."""
+        if self.tp <= 1:
+            return {}
+        st = self._state_shardings()
+        r = self._repl_sharding
+        return {"in_shardings": (self._param_shardings_tree, st)
+                + (r,) * n_extra,
+                "out_shardings": (st, r)}
+
+    def _pools_out_kwargs(self) -> dict:
+        """out_shardings pinning a pool-writing jit's output to the
+        canonical pool sharding (tensor-parallel only): prefill packs and
+        COW copies must hand pools back in the exact layout the donated
+        decode-step state expects."""
+        if self.tp <= 1:
+            return {}
+        return {"out_shardings": {
+            name: {"k": self._pool_sharding, "v": self._pool_sharding}
+            for name in self.kv.pools}}
+
+    # -- host mirror -> device pytree sync ---------------------------------
+    def _stage(self, x):
+        """Host -> device staging chokepoint: every upload the engine ever
+        performs goes through here, so `n_host_stages` is an exact
+        transfer count (the zero-restaging regression reads it) and
+        tensor-parallel runs commit replicated copies up front instead of
+        paying a reshard inside the step dispatch."""
+        self.n_host_stages += 1
+        if self._repl_sharding is not None:
+            return jax.device_put(np.asarray(x), self._repl_sharding)
+        return jnp.asarray(x)
+
+    def _sync_device_state(self) -> None:
+        """Re-upload exactly the device arrays whose HOST mirrors changed:
+        the page table when any allocator write bumped kv.version
+        (admission/COW/preempt/retire), the per-slot arrays when a slot
+        lifecycle event set _slots_dirty.  A steady pure-decode run
+        re-stages nothing."""
+        if self.kv.version != self._kv_synced:
+            # the mixed step's virtual trash row (row S, all pages
+            # unmapped -> physical page 0) rides permanently at the end
+            tbl = np.concatenate(
+                [self.kv.table,
+                 np.zeros((1, self.kv.pages_per_slot), np.int32)], axis=0)
+            self._d_table = self._stage(tbl)
+            self._kv_synced = self.kv.version
+        if self._slots_dirty:
+            S = len(self.slots)
+            pos = np.zeros(S, np.int32)
+            toks = np.zeros(S, np.int32)
+            gen = np.zeros(S, np.int32)
+            keys = np.zeros((S, self._kk, 2), np.uint32)
+            temp = np.zeros(S, np.float32)
+            topk = np.zeros(S, np.int32)
+            topp = np.zeros(S, np.float32)
+            for s, sl in enumerate(self.slots):
+                if sl is None:
+                    continue
+                pos[s], toks[s], gen[s] = sl.pos, sl.last_tok, sl.gen
+                keys[s, :sl.keys.shape[0]] = sl.keys
+                temp[s] = sl.req.temperature
+                topk[s] = sl.req.top_k
+                topp[s] = sl.req.top_p
+            self._d_pos = self._stage(pos)
+            self._d_toks = self._stage(toks)
+            self._d_gen = self._stage(gen)
+            self._d_keys = self._stage(keys)
+            self._d_temp = self._stage(temp)
+            self._d_topk = self._stage(topk)
+            self._d_topp = self._stage(topp)
+            self._slots_dirty = False
+
+    def _sync_run_mask(self, runnable) -> None:
+        """The step's advance mask, device-cached: re-uploaded only when
+        which slots advance actually changes (a pause, an admission, a
+        retire) — constant across a steady decode run."""
+        mask = np.zeros(len(self.slots), bool)
+        mask[list(runnable)] = True
+        if self._run_host is None or not np.array_equal(mask,
+                                                        self._run_host):
+            self._run_host = mask
+            self._d_run = self._stage(mask)
+
+    def _build_state(self) -> EngineState:
+        """Assemble the step's state pytree from the current device
+        components — pure host-side tuple construction, no transfers
+        (pools enter via kv.pools so admission-time pack/COW rebinds are
+        picked up automatically)."""
+        return EngineState(pools=self.kv.pools, table=self._d_table,
+                           pos=self._d_pos, toks=self._d_toks,
+                           gen=self._d_gen, keys=self._d_keys,
+                           temp=self._d_temp, topk=self._d_topk,
+                           topp=self._d_topp)
+
+    def _unpack_state(self, st: EngineState) -> None:
+        """Rebind every component from a step's (donated-buffer) output —
+        the old arrays were just consumed, no stale aliases may survive."""
+        self.kv.pools = st.pools
+        self._d_table = st.table
+        self._d_pos = st.pos
+        self._d_toks = st.toks
+        self._d_gen = st.gen
+        self._d_keys = st.keys
+        self._d_temp = st.temp
+        self._d_topk = st.topk
+        self._d_topp = st.topp
 
     # -- lifecycle tracing helpers ----------------------------------------
     def _tr_on(self) -> bool:
@@ -373,6 +627,7 @@ class ServingEngine:
                 self._donate(s)
                 self.kv.release(s)
                 self.slots[s] = None
+                self._slots_dirty = True
                 self._count_abort(reason)
                 self._finish(request_id, toks, reason)
                 return True
@@ -453,37 +708,25 @@ class ServingEngine:
         traced = self._tr_on()
         t_step = time.perf_counter() if traced else 0.0
         S = len(self.slots)
-        pos = np.zeros(S, np.int32)
-        toks = np.zeros(S, np.int32)
-        keys = np.zeros((S, 2), np.uint32)
-        temp = np.zeros(S, np.float32)
-        topk = np.zeros(S, np.int32)
-        topp = np.zeros(S, np.float32)
-        run_set = set(runnable)
-        for s in live:
+        for s in runnable:
             sl = self.slots[s]
-            pos[s], toks[s] = sl.pos, sl.last_tok
-            if s in run_set:
-                # a shared page is never written: the page receiving this
-                # step's K/V write must be private to the slot (admission's
-                # COW guarantees it — this tripwire catches refcount bugs
-                # before they corrupt a cached prefix)
-                assert self.kv.page_writable(
-                    int(self.kv.table[s, sl.pos // self.kv.page_size])), \
-                    f"slot {s} would write a shared page"
-                # key g samples token g — indexing by the slot's own
-                # generation counter is what keeps a paused slot's stream
-                # intact (a pause consumes no key)
-                keys[s] = sl.keys[sl.gen]
-                temp[s] = sl.req.temperature
-                topk[s] = sl.req.top_k
-                topp[s] = sl.req.top_p
-        # the pool buffers were just donated — rebind them on the cache
-        # object too, so no stale (deleted-buffer) aliases survive
-        self.kv.pools, nxt = self._decode_step(
-            self.params, self.kv.pools, jnp.asarray(self.kv.table),
-            jnp.asarray(pos), jnp.asarray(toks), jnp.asarray(keys),
-            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp))
+            # a shared page is never written: the page receiving this
+            # step's K/V write must be private to the slot (admission's
+            # COW guarantees it — this tripwire catches refcount bugs
+            # before they corrupt a cached prefix)
+            assert self.kv.page_writable(
+                int(self.kv.table[s, sl.pos // self.kv.page_size])), \
+                f"slot {s} would write a shared page"
+        # per-slot pos/toks/gen/keys/knobs already live on device; a
+        # steady decode run enters the compiled step with ZERO host
+        # staging (sync uploads only what admissions/retires/pauses
+        # actually changed).  The state buffers are donated — rebind
+        # every component so no stale (deleted-buffer) aliases survive.
+        self._sync_run_mask(runnable)
+        self._sync_device_state()
+        st, nxt = self._decode_step(self.params, self._build_state(),
+                                    self._d_run)
+        self._unpack_state(st)
         self.n_decode_steps += 1
         self.occupancy_sum += len(live) / S
         nxt = np.asarray(nxt)                          # host sync
@@ -557,10 +800,14 @@ class ServingEngine:
         row_slot = np.full(T, S, np.int32)   # S = the virtual trash row
         row_pos = np.zeros(T, np.int32)
         sample_row = np.zeros(S, np.int32)
-        keys = np.zeros((S, 2), np.uint32)
-        temp = np.zeros(S, np.float32)
-        topk = np.zeros(S, np.int32)
-        topp = np.zeros(S, np.float32)
+        # device-state advance masks: adv[s] = tokens slot s commits this
+        # step (1 per decode row, chunk length per chunk run), emit[s] =
+        # slot s banks a sampled token (decode rows + final chunks).  The
+        # compiled step advances pos/gen/toks from these; keys and knobs
+        # already live in the EngineState (keys[s, gen[s]] — gen 0 at a
+        # final chunk IS the legacy keys[0] decision).
+        adv = np.zeros(S, np.int32)
+        emit = np.zeros(S, bool)
         r = 0
         for s in runnable:
             sl = self.slots[s]
@@ -572,10 +819,8 @@ class ServingEngine:
             row_slot[r] = s
             row_pos[r] = sl.pos
             sample_row[s] = r
-            keys[s] = sl.keys[sl.gen]
-            temp[s] = sl.req.temperature
-            topk[s] = sl.req.top_k
-            topp[s] = sl.req.top_p
+            adv[s] = 1
+            emit[s] = True
             r += 1
         budget = T - r
         advanced = []                        # (slot, n_rows, final)
@@ -596,14 +841,12 @@ class ServingEngine:
             row_slot[r:r + n] = s
             row_pos[r:r + n] = np.arange(sl.pos, sl.pos + n)
             final = sl.pos + n == p
+            adv[s] = n
             if final:
                 # the last prompt position's logits sample token 0 with
-                # keys[0] — identical to the legacy prefill decision
+                # keys[gen=0] — identical to the legacy prefill decision
                 sample_row[s] = r + n - 1
-                keys[s] = sl.keys[0]
-                temp[s] = sl.req.temperature
-                topk[s] = sl.req.top_k
-                topp[s] = sl.req.top_p
+                emit[s] = True
             self.n_prefill_chunks += 1
             self.flight.record("chunk_sched", req=str(sl.req.req_id),
                                slot=s, start=int(sl.pos), tokens=int(n),
@@ -611,18 +854,16 @@ class ServingEngine:
             advanced.append((s, n, final))
             budget -= n
             r += n
-        # virtual trash row: padding rows gather/scatter only page 0
-        table2 = np.concatenate(
-            [self.kv.table,
-             np.zeros((1, self.kv.pages_per_slot), np.int32)], axis=0)
-        # the pool buffers were just donated — rebind them on the cache
-        # object too, so no stale (deleted-buffer) aliases survive
-        self.kv.pools, nxt = self._mixed_step(
-            self.params, self.kv.pools, jnp.asarray(table2),
-            jnp.asarray(row_ids), jnp.asarray(row_slot),
-            jnp.asarray(row_pos), jnp.asarray(sample_row),
-            jnp.asarray(keys), jnp.asarray(temp), jnp.asarray(topk),
-            jnp.asarray(topp))
+        # the state table already carries the virtual trash row (row S) —
+        # padding rows gather/scatter only page 0.  Row packing is this
+        # step's scheduling decision, so the six row/mask operands stage
+        # per mixed step; the EngineState (donated, rebound) does not.
+        self._sync_device_state()
+        st, nxt = self._mixed_step(
+            self.params, self._build_state(), self._stage(row_ids),
+            self._stage(row_slot), self._stage(row_pos),
+            self._stage(sample_row), self._stage(adv), self._stage(emit))
+        self._unpack_state(st)
         self.n_decode_steps += 1
         self.n_mixed_steps += 1
         self.occupancy_sum += len(live) / S
@@ -812,6 +1053,7 @@ class ServingEngine:
         sl = _Slot(req, keys, pos=p, first_tok=tok0,
                    admit_seq=self._admit_seq)
         self.slots[s] = sl
+        self._slots_dirty = True
         self.flight.record("admit", req=str(req.req_id), slot=s,
                            bucket=Lb, prompt_len=p,
                            pages=int(self.kv.pages_for(p)))
@@ -872,6 +1114,7 @@ class ServingEngine:
         self._admit_seq += 1
         self.slots[s] = _Slot(req, keys, pos=C, first_tok=None,
                               admit_seq=self._admit_seq)
+        self._slots_dirty = True
         self._tr_begin(req.req_id, "prefill",
                        chunk=int(self.prefill_chunk), prompt_len=p,
                        prefix_tokens=C)
@@ -915,6 +1158,7 @@ class ServingEngine:
         self._donate(s)
         self.kv.release(s)
         self.slots[s] = None
+        self._slots_dirty = True
 
     def _donate(self, s: int) -> None:
         """Offer the slot's fully-committed clean pages to the prefix
@@ -1005,6 +1249,190 @@ class ServingEngine:
         self.prefix = None
         self.kv.on_page_pressure = None
 
+    # -- serving-state checkpoint/restore (fleet-migration primitive) ------
+    def checkpoint_state(self) -> dict:
+        """Freeze the ENTIRE serving state MID-FLIGHT — device pytree
+        (pools as host copies), allocator, slots, queue, prefix index,
+        scheduling counters — as one picklable dict.  A fresh engine of
+        the same configuration restored from it resumes and finishes
+        BIT-EXACTLY what the uninterrupted engine would have produced
+        (tests/test_engine_state.py): per-slot key schedules, admit_seq
+        preemption order, free-list order and page placement all survive.
+        Call between steps on the step()-driving thread (the pump), like
+        every other scheduler access.  This is the checkpoint/restore +
+        live-replica-migration unit the EngineState refactor unlocks."""
+
+        def req_snap(r: Request) -> dict:
+            return {"req_id": r.req_id, "prompt_ids": r.prompt_ids.copy(),
+                    "max_new": r.max_new, "temperature": r.temperature,
+                    "top_k": r.top_k, "top_p": r.top_p, "eos_id": r.eos_id,
+                    "deadline": r.deadline,
+                    "preempted_gen": (None if r._preempted_gen is None
+                                      else list(r._preempted_gen)),
+                    "rng": np.asarray(r.rng).copy()}
+
+        kv = self.kv
+        prefix = None
+        if self.prefix is not None:
+            nodes = []
+            stack = [(self.prefix.root, -1)]
+            while stack:
+                node, pidx = stack.pop()
+                idx = len(nodes)
+                nodes.append({"run": list(node.run), "page": node.page,
+                              "last_use": node.last_use, "parent": pidx})
+                stack.extend((ch, idx) for ch in node.children.values())
+            prefix = {"nodes": nodes, "clock": self.prefix._clock,
+                      "n_evictions": self.prefix.n_evictions}
+        return {
+            "config": {"num_slots": len(self.slots),
+                       "page_size": kv.page_size,
+                       "pages_per_slot": kv.pages_per_slot,
+                       "num_pages": kv.num_pages,
+                       "prefill_chunk": self.prefill_chunk,
+                       "max_step_tokens": self.max_step_tokens,
+                       "prefix_cache": self.prefix is not None,
+                       "layer_specs": dict(kv.layer_specs)},
+            "pools": {name: {p: np.asarray(kv.pools[name][p]).copy()
+                             for p in ("k", "v")} for name in kv.pools},
+            "kv": {"table": kv.table.copy(), "free": list(kv._free),
+                   "n_pages": kv._n_pages.copy(), "ref": kv._ref.copy(),
+                   "cached": kv._cached.copy(), "n_cow": kv.n_cow},
+            "slots": [None if sl is None else
+                      {"req": req_snap(sl.req),
+                       "keys": np.asarray(sl.keys).copy(),
+                       "pos": int(sl.pos), "gen": int(sl.gen),
+                       "last_tok": int(sl.last_tok),
+                       "generated": list(sl.generated),
+                       "admit_seq": int(sl.admit_seq),
+                       "replay_until": int(sl.replay_until)}
+                      for sl in self.slots],
+            "queue": [req_snap(r) for r in self.queue],
+            "prefix": prefix,
+            "counters": {k: getattr(self, k) for k in (
+                "_admit_seq", "n_decode_steps", "n_preemptions",
+                "n_cancelled", "n_expired", "tokens_generated",
+                "occupancy_sum", "n_prefix_hits", "n_prefix_misses",
+                "prefill_tokens_saved", "n_prefill_chunks",
+                "n_mixed_steps")},
+            "results": {k: np.asarray(v).copy()
+                        for k, v in self.results.items()},
+            "finish_reasons": dict(self.finish_reasons),
+        }
+
+    def restore_state(self, snap: dict) -> None:
+        """Resume a `checkpoint_state()` snapshot on THIS engine (fresh or
+        idle; its construction-time configuration must match the donor's
+        — restoring onto a differently-shaped engine would silently
+        corrupt page accounting, so it raises instead).  Device state
+        re-uploads lazily through the ordinary dirty-sync paths."""
+        cfg = snap["config"]
+        mine = {"num_slots": len(self.slots),
+                "page_size": self.kv.page_size,
+                "pages_per_slot": self.kv.pages_per_slot,
+                "num_pages": self.kv.num_pages,
+                "prefill_chunk": self.prefill_chunk,
+                "max_step_tokens": self.max_step_tokens,
+                "prefix_cache": self.prefix is not None,
+                "layer_specs": dict(self.kv.layer_specs)}
+        if mine != cfg:
+            diff = {k: (cfg[k], mine[k]) for k in cfg if cfg[k] != mine[k]}
+            raise ValueError(
+                f"restore_state: engine configuration mismatch "
+                f"(snapshot vs this engine): {diff}")
+        if any(sl is not None for sl in self.slots) or self.queue:
+            raise ValueError("restore_state requires an idle engine — it "
+                             "replaces every slot and queue entry")
+
+        def req_restore(d: dict) -> Request:
+            r = Request(d["req_id"], d["prompt_ids"],
+                        max_new=d["max_new"], temperature=d["temperature"],
+                        top_k=d["top_k"], top_p=d["top_p"],
+                        eos_id=d["eos_id"], deadline=d["deadline"])
+            r.rng = jnp.asarray(d["rng"])
+            r._preempted_gen = (None if d["preempted_gen"] is None
+                                else list(d["preempted_gen"]))
+            return r
+
+        kv = self.kv
+        for name in kv.pools:
+            put = ((lambda a: jax.device_put(a, self._pool_sharding))
+                   if self._pool_sharding is not None else jnp.asarray)
+            dtype = kv.pools[name]["k"].dtype
+            kv.pools[name] = {
+                p: put(np.asarray(snap["pools"][name][p], dtype))
+                for p in ("k", "v")}
+        kv.table[:, :] = snap["kv"]["table"]
+        kv._free = list(snap["kv"]["free"])
+        kv._n_pages[:] = snap["kv"]["n_pages"]
+        kv._ref[:] = snap["kv"]["ref"]
+        kv._cached[:] = snap["kv"]["cached"]
+        kv.n_cow = snap["kv"]["n_cow"]
+        kv.version += 1
+        self.slots = [None if d is None else
+                      _Slot.__new__(_Slot) for d in snap["slots"]]
+        for sl, d in zip(self.slots, snap["slots"]):
+            if sl is None:
+                continue
+            sl.req = req_restore(d["req"])
+            sl.keys = np.asarray(d["keys"], np.uint32)
+            sl.pos, sl.gen = d["pos"], d["gen"]
+            sl.last_tok = d["last_tok"]
+            sl.generated = list(d["generated"])
+            sl.admit_seq = d["admit_seq"]
+            sl.replay_until = d["replay_until"]
+        self.queue = deque(req_restore(d) for d in snap["queue"])
+        if self.prefix is not None:
+            self.prefix.clear()
+            if snap["prefix"] is not None:
+                from paddle_tpu.serving.prefix_tree import _Node
+                built = []
+                for nd in snap["prefix"]["nodes"]:
+                    node = _Node(tuple(nd["run"]), nd["page"],
+                                 None if nd["parent"] < 0
+                                 else built[nd["parent"]])
+                    node.last_use = nd["last_use"]
+                    if node.parent is not None:
+                        node.parent.add_child(node)
+                    built.append(node)
+                self.prefix.root = built[0]
+                self.prefix.n_nodes = len(built) - 1
+                self.prefix._clock = snap["prefix"]["clock"]
+                self.prefix.n_evictions = snap["prefix"]["n_evictions"]
+        for k, v in snap["counters"].items():
+            setattr(self, k, v)
+        self.results = {k: np.asarray(v).copy()
+                        for k, v in snap["results"].items()}
+        self.finish_reasons = dict(snap["finish_reasons"])
+        self._slots_dirty = True
+        self._run_host = None
+        self._t_prev_decode = None
+        kv.check()                      # allocator oracle on the restored
+                                        # tables/refcounts — fail loudly
+        self.flight.record("restore", slots=sum(
+            1 for sl in self.slots if sl is not None),
+            queued=len(self.queue))
+
+    def save_state(self, path: str) -> None:
+        """checkpoint_state() to disk with the repo's atomic-commit
+        discipline (stage + fsync + os.replace): a crash mid-save leaves
+        the previous checkpoint intact, never a torn one."""
+        import os
+        import pickle
+
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self.checkpoint_state(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load_state(self, path: str) -> None:
+        import pickle
+
+        with open(path, "rb") as f:
+            self.restore_state(pickle.load(f))
+
     def _retire(self, s: int) -> None:
         sl = self.slots[s]
         toks = np.concatenate(
@@ -1014,6 +1442,7 @@ class ServingEngine:
         self._donate(s)
         self.kv.release(s)
         self.slots[s] = None
+        self._slots_dirty = True
         self._finish(sl.req.req_id, toks, reason)
 
     def _finish(self, req_id, toks: np.ndarray, reason: str) -> None:
@@ -1032,57 +1461,83 @@ class ServingEngine:
             self.on_finish(req_id, toks, reason)
 
     # -- compiled pieces --------------------------------------------------
-    def _decode_impl(self, params, pools, table, pos, toks, keys, temp,
-                     topk, topp):
+    def _slot_keys(self, st: EngineState) -> jnp.ndarray:
+        """Each slot's key for THIS step: keys[s, gen[s]] — key g samples
+        token g, so a paused slot (gen frozen) consumes nothing and a
+        final prompt chunk (gen still 0) samples with keys[0], exactly the
+        legacy prefill decision."""
+        g = jnp.clip(st.gen, 0, st.keys.shape[1] - 1)
+        return jnp.take_along_axis(st.keys, g[:, None, None], axis=1)[:, 0]
+
+    def _decode_impl(self, params, st: EngineState, run):
         """THE decode step — one signature for the whole workload: every
         slot advances one token against its paged context; per-slot
-        knobs/keys make sampling data-dependent, not program-dependent."""
-        S = toks.shape[0]
-        state = {name: {"k_pages": pools[name]["k"],
-                        "v_pages": pools[name]["v"],
-                        "page_table": table, "pos": pos}
-                 for name in pools}
-        feed = {self.input_name: Argument(ids=toks[:, None],
+        knobs/keys make sampling data-dependent, not program-dependent.
+        A pure function over the EngineState pytree: slots the run mask
+        marks advance pos/gen/last-token ON DEVICE (non-running slots'
+        sampled values are computed-and-discarded garbage — their rows are
+        batch-independent and their writes land in the trash page)."""
+        S = st.toks.shape[0]
+        table = st.table[:S]                  # drop the virtual trash row
+        state = {name: {"k_pages": st.pools[name]["k"],
+                        "v_pages": st.pools[name]["v"],
+                        "page_table": table, "pos": st.pos}
+                 for name in st.pools}
+        feed = {self.input_name: Argument(ids=st.toks[:, None],
                                           lengths=jnp.ones((S,), jnp.int32))}
         outputs, _, state_out = self.executor.forward(params, feed, state,
                                                       TEST, None)
         last = outputs[self.logits_name].value[:, 0, :]
-        nxt = pick_next_per_slot(last, keys, temp, topk, topp,
-                                 is_probs=self._probs)
+        nxt = pick_next_per_slot(last, self._slot_keys(st), st.temp,
+                                 st.topk, st.topp, is_probs=self._probs)
         new_pools = {name: {"k": state_out[name]["k_pages"],
                             "v": state_out[name]["v_pages"]}
-                     for name in pools}
-        return new_pools, nxt
+                     for name in st.pools}
+        runi = run.astype(jnp.int32)
+        new_st = EngineState(pools=new_pools, table=st.table,
+                             pos=st.pos + runi,
+                             toks=jnp.where(run, nxt, st.toks),
+                             gen=st.gen + runi, keys=st.keys, temp=st.temp,
+                             topk=st.topk, topp=st.topp)
+        return new_st, nxt
 
-    def _mixed_impl(self, params, pools, table2, row_ids, row_slot,
-                    row_pos, sample_row, keys, temp, topk, topp):
+    def _mixed_impl(self, params, st: EngineState, row_ids, row_slot,
+                    row_pos, sample_row, adv, emit):
         """THE mixed prefill/decode step — one signature per
         max_step_tokens value, whatever the prefill/decode row mix: the
         packed ragged token rows run the stack as one [1, T] batch (every
         non-attention layer is per-token; attention routes through
         layers_attn._paged_ragged_step via the `row_slot` cache marker),
         then per-slot sampling reads each slot's designated logits row.
-        Non-emitting slots (mid-prefill, paused, empty) aim sample_row at
-        a padding row with temperature 0 — their greedy argmax costs
-        nothing, consumes no key, and the host discards it."""
+        `adv`/`emit` are the host scheduler's advance masks: pos moves by
+        the rows each slot committed, gen/last-token move where a token
+        was banked (decode rows and final chunks).  Non-emitting slots
+        (mid-prefill, paused, empty) sample a padding/decode row's logits
+        — computed and discarded, their state frozen by the masks."""
         T = row_ids.shape[0]
-        state = {name: {"k_pages": pools[name]["k"],
-                        "v_pages": pools[name]["v"],
-                        "page_table": table2, "row_slot": row_slot,
+        state = {name: {"k_pages": st.pools[name]["k"],
+                        "v_pages": st.pools[name]["v"],
+                        "page_table": st.table, "row_slot": row_slot,
                         "row_pos": row_pos}
-                 for name in pools}
+                 for name in st.pools}
         feed = {self.input_name: Argument(
             ids=row_ids[None, :], lengths=jnp.full((1,), T, jnp.int32))}
         outputs, _, state_out = self.executor.forward(params, feed, state,
                                                       TEST, None)
         logits = outputs[self.logits_name].value[0]    # [T, V]
         last = logits[sample_row]                      # [S, V]
-        nxt = pick_next_per_slot(last, keys, temp, topk, topp,
-                                 is_probs=self._probs)
+        nxt = pick_next_per_slot(last, self._slot_keys(st), st.temp,
+                                 st.topk, st.topp, is_probs=self._probs)
         new_pools = {name: {"k": state_out[name]["k_pages"],
                             "v": state_out[name]["v_pages"]}
-                     for name in pools}
-        return new_pools, nxt
+                     for name in st.pools}
+        new_st = EngineState(pools=new_pools, table=st.table,
+                             pos=st.pos + adv,
+                             toks=jnp.where(emit, nxt, st.toks),
+                             gen=st.gen + emit.astype(jnp.int32),
+                             keys=st.keys, temp=st.temp, topk=st.topk,
+                             topp=st.topp)
+        return new_st, nxt
 
     def _prefill_fn(self, Lb: int):
         """Jitted prompt prefill for bucket length Lb — compiled once per
@@ -1133,7 +1588,8 @@ class ServingEngine:
                 return out
 
             fn = self._pack_cache[Lb] = get_compile_watch().wrap_jit(
-                "serving.pack", jax.jit(pack, donate_argnums=(0,)))
+                "serving.pack", jax.jit(pack, donate_argnums=(0,),
+                                        **self._pools_out_kwargs()))
         return fn
 
     def _prefix_prefill_fn(self, n_pp: int, Lb: int):
@@ -1222,5 +1678,7 @@ class ServingEngine:
                 return out
 
             fn = self._prefix_pack_cache[Lb] = get_compile_watch().wrap_jit(
-                "serving.prefix_pack", jax.jit(pack, donate_argnums=(0,)))
+                "serving.prefix_pack",
+                jax.jit(pack, donate_argnums=(0,),
+                        **self._pools_out_kwargs()))
         return fn

@@ -34,6 +34,16 @@ prefix index, serving/prefix_tree.py, between requests).  The contract:
     `on_page_pressure(n)` (the prefix index's LRU eviction) to reclaim
     cached refcount-zero pages — eviction before pausing slots, preemption
     stays last resort.
+
+TENSOR PARALLELISM (PR 11): constructed with a mesh whose `model` axis
+exceeds 1, the pools shard on their kv-head axis (`PartitionSpec(None,
+None, "model", None)`) — each device's HBM holds only its heads' slice of
+every page, so the servable KV grows with the mesh while the ALLOCATOR is
+untouched: tables, refcounts, the free list and the prefix index are
+host-side and shard-agnostic (a physical page is one logical unit whose
+storage happens to be split).  `version` stamps every host table write so
+the engine re-uploads its device-resident table only when something
+actually changed (the hot decode loop's zero-restaging contract).
 """
 
 from __future__ import annotations
@@ -55,7 +65,8 @@ class PagedKVCache:
     defers admission when the free list runs dry)."""
 
     def __init__(self, executor, num_slots: int, page_size: int,
-                 pages_per_slot: int, num_pages: Optional[int] = None):
+                 pages_per_slot: int, num_pages: Optional[int] = None,
+                 mesh=None):
         assert page_size > 0 and pages_per_slot > 0
         self.page_size = int(page_size)
         self.pages_per_slot = int(pages_per_slot)
@@ -63,6 +74,22 @@ class PagedKVCache:
         self.num_pages = int(num_pages) if num_pages else \
             1 + num_slots * pages_per_slot
         assert self.num_pages >= 2, "pool needs the trash page + 1 real page"
+
+        # tensor parallelism: pools shard on their kv-head axis over the
+        # mesh `model` axis — each device's HBM holds only its heads'
+        # pages, so the servable KV grows with the mesh (the engine
+        # validates h_kv divisibility; tables stay host/replicated).
+        # `pool_sharding` is THE canonical pool placement — the engine's
+        # step in_shardings and every pool-writing jit pin to it.
+        from paddle_tpu.parallel.mesh import MODEL_AXIS, axis_size
+
+        self.mesh = mesh
+        self.pool_sharding = None
+        self.tp_shards = axis_size(mesh, MODEL_AXIS)
+        if self.tp_shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self.pool_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, MODEL_AXIS, None))
 
         dtype = jnp.dtype(executor.compute_dtype) if executor.compute_dtype \
             else jnp.float32
@@ -75,15 +102,26 @@ class PagedKVCache:
             h_kv = int(l.attrs.get("num_kv_heads", 0) or heads)
             dh = int(l.size) // heads
             self.layer_specs[l.name] = (h_kv, dh)
-            self.pools[l.name] = {
-                "k": jnp.zeros((self.num_pages, page_size, h_kv, dh), dtype),
-                "v": jnp.zeros((self.num_pages, page_size, h_kv, dh), dtype),
-            }
+            shape = (self.num_pages, page_size, h_kv, dh)
+
+            def _pool():
+                # distinct buffers per part — k and v are donated side by
+                # side, and XLA refuses to donate one buffer twice
+                z = jnp.zeros(shape, dtype)
+                return jax.device_put(z, self.pool_sharding) \
+                    if self.pool_sharding is not None else z
+
+            self.pools[l.name] = {"k": _pool(), "v": _pool()}
         assert self.pools, "model has no multi_head_attention layers to page"
 
         # host allocator state: table[s, j] = physical page backing logical
         # page j of slot s (0 = unmapped -> trash)
         self.table = np.zeros((num_slots, pages_per_slot), np.int32)
+        # monotone table-write stamp: every host-side table/allocator
+        # mutation bumps it, and the engine re-uploads its device-resident
+        # table ONLY when it moved — the hot decode loop's zero-restaging
+        # contract hangs off this counter
+        self.version = 0
         self._free = self._canonical_free()
         self._n_pages = np.zeros(num_slots, np.int32)
         # per-physical-page slot-mapping refcount + prefix-index membership
@@ -135,6 +173,18 @@ class PagedKVCache:
         """Pages held ONLY by the prefix index — reclaimable by eviction."""
         return int(np.sum((self._ref == 0) & self._cached))
 
+    @property
+    def pool_bytes(self) -> int:
+        """Total device bytes of the K/V page pools (all shards)."""
+        return sum(int(p[part].size) * p[part].dtype.itemsize
+                   for p in self.pools.values() for part in ("k", "v"))
+
+    @property
+    def pool_bytes_per_shard(self) -> int:
+        """Pool bytes resident PER DEVICE: the kv-head axis splits over
+        the mesh model axis, so each shard holds 1/tp of every page."""
+        return self.pool_bytes // self.tp_shards
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.page_size)
 
@@ -182,6 +232,7 @@ class PagedKVCache:
             self._ref[page] = 1
             self.table[slot, self._n_pages[slot]] = page
             self._n_pages[slot] += 1
+            self.version += 1
         return True
 
     def map_shared(self, slot: int, pages) -> None:
@@ -200,6 +251,7 @@ class PagedKVCache:
             self._ref[page] += 1
             self.table[slot, j] = page
         self._n_pages[slot] = len(pages)
+        self.version += 1
 
     def page_writable(self, page: int) -> bool:
         return self._ref[page] == 1 and not self._cached[page]
@@ -220,6 +272,7 @@ class PagedKVCache:
         self.pools = self._page_copy()(self.pools, fresh, page)
         self._ref[fresh] = 1
         self.table[slot, j] = fresh
+        self.version += 1
         self._unref(page)
         self.n_cow += 1
         return True
@@ -241,6 +294,7 @@ class PagedKVCache:
             self._unref(int(self.table[slot, j]))
         self.table[slot, :] = 0
         self._n_pages[slot] = 0
+        self.version += 1
 
     def reset(self) -> None:
         """Release every slot AND forget all prefix-index retention, then
@@ -256,6 +310,7 @@ class PagedKVCache:
         self._ref[:] = 0
         self._cached[:] = False
         self._free = self._canonical_free()
+        self.version += 1
 
     # -- prefix-index retention -------------------------------------------
     def cache_page(self, page: int) -> None:
@@ -286,8 +341,17 @@ class PagedKVCache:
                 } for name in pools}
 
             from paddle_tpu.obs.compile_watch import get_compile_watch
+            kw = {}
+            if self.pool_sharding is not None:
+                # sharded pools must come back in the canonical pool
+                # sharding — a drifted layout would force the next decode
+                # step's explicit in_shardings to reshard every pool
+                kw["out_shardings"] = {
+                    name: {"k": self.pool_sharding,
+                           "v": self.pool_sharding}
+                    for name in self.pools}
             self._copy_fn = get_compile_watch().wrap_jit(
-                "serving.cow_copy", jax.jit(copy, donate_argnums=(0,)))
+                "serving.cow_copy", jax.jit(copy, donate_argnums=(0,), **kw))
         return self._copy_fn
 
     # -- debugging / test oracle ------------------------------------------

@@ -222,6 +222,11 @@ class ServingServer:
                  float(eng.n_prefill_chunks)),
                 ("serving_mixed_steps_total", "counter", None,
                  float(eng.n_mixed_steps)),
+                # tensor-parallel sharded decode: shard count + per-device
+                # pool residency (the HBM split sharding exists for)
+                ("serving_tp_shards", "gauge", None, float(eng.tp)),
+                ("serving_kv_pool_bytes_per_shard", "gauge", None,
+                 float(eng.kv.pool_bytes_per_shard)),
             ] + eng.step_tokens_hist.samples() \
               + eng.decode_gap_hist.samples()
 
@@ -561,6 +566,9 @@ class ServingServer:
             "num_pages": int(eng.kv.num_pages),
             "page_size": int(eng.kv.page_size),
             "num_slots": len(eng.slots),
+            "tp_shards": int(eng.tp),
+            "kv_pool_bytes_per_shard": _safe(
+                lambda: int(eng.kv.pool_bytes_per_shard)),
             "n_decode_steps": eng.n_decode_steps,
             "tokens_generated": eng.tokens_generated,
             "n_preemptions": eng.n_preemptions,
@@ -591,6 +599,7 @@ class ServingServer:
             "num_pages": int(self.engine.kv.num_pages),
             "capacity_tokens": int(self.engine.kv.capacity_tokens),
             "prefix_cache": self.engine.prefix is not None,
+            "tp_shards": int(self.engine.tp),
             "wedge_threshold_s": self.wedge_threshold_s,
             "postmortem_dir": self.postmortem_dir,
         }
@@ -795,6 +804,7 @@ class ServingServer:
                 max_inflight=self.max_inflight,
                 page_size=int(self.engine.kv.page_size),
                 prefix_cache=self.engine.prefix is not None,
+                tp_shards=int(self.engine.tp),
                 draining=self._draining))
         elif t == "ping":
             conn.send({"type": "pong"})
@@ -922,6 +932,9 @@ class ServingServer:
             "max_step_tokens": eng.max_step_tokens,
             "prefill_chunks": eng.n_prefill_chunks,
             "mixed_steps": eng.n_mixed_steps,
+            # sharding: model-axis shard count + per-device pool bytes
+            "tp_shards": eng.tp,
+            "kv_pool_bytes_per_shard": int(eng.kv.pool_bytes_per_shard),
         }
 
     def _stats_msg(self, engine_part: Optional[dict]) -> dict:

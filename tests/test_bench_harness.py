@@ -194,6 +194,41 @@ def test_assemble_lkg_stitches_serving_fleet_record(tmp_path):
     assert out["serving_fleet"]["affinity_hit_gt_random"] is True
 
 
+def test_assemble_lkg_stitches_serving_tp_record(tmp_path):
+    """ISSUE 11 wiring: the tensor-parallel sharded-decode record
+    (lm_serving_tp_tok_per_sec + the 1-vs-N-shard A/B companions incl.
+    the per-shard pool bytes) rides the same per-config queue shape —
+    a top-level BENCH_ONLY=serving_tp record must stitch into the
+    assembled fallback under the `serving_tp` key with the companions
+    intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_tp"] == "lm_serving_tp_tok_per_sec"
+    assert "serving_tp" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-03T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-04T11:00:00+00:00",
+         "record": {"metric": M["serving_tp"], "value": 8412.9,
+                    "mesh_model": 2,
+                    "single_tok_per_sec": 5100.3,
+                    "speedup_vs_single": 1.65,
+                    "pool_bytes_per_shard": 402653184,
+                    "single_pool_bytes": 805306368,
+                    "pool_shrink_vs_single": 2.0,
+                    "sig_stable": True,
+                    "measured_at": "2026-08-04T11:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_tp"]["value"] == 8412.9
+    assert out["serving_tp"]["pool_shrink_vs_single"] == 2.0
+    assert out["serving_tp"]["speedup_vs_single"] == 1.65
+    assert out["serving_tp"]["sig_stable"] is True
+
+
 def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
     """PR 4 wiring: the serving record's p99 per-token latency companion
     (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the

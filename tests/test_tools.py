@@ -58,6 +58,33 @@ def test_hlo_gather_detector_anchors_to_shapes():
     assert gather_spans_table(grouped, tables)
 
 
+def test_hlo_shard_check_decode_has_no_pool_allgather():
+    """tools/hlo_shard_check.py on the real engine over a 2-shard host
+    mesh: the tensor-parallel decode AND mixed steps must contain zero
+    all-gathers of the KV pools or attention projections, and exactly
+    the per-layer post-attention all-reduce — the acceptance evidence
+    for the sharded-decode HBM/FLOPs split (docs/serving.md)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    from tools.hlo_shard_check import run_check
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs >= 2 devices (conftest provides 8 host devices)")
+    out = run_check(model=2, save="")
+    assert out["ok"], out["verdict"]
+    for step in ("decode", "mixed"):
+        rec = out["steps"][step]
+        assert rec["table_all_gathers"] == [], (step, rec)
+        assert rec["n_all_gathers"] == 0, \
+            (step, "unexpected all-gather — sharded decode must keep ALL "
+                   "activations head-local until the out-projection reduce")
+        assert rec["n_all_reduces"] == rec["expected_all_reduces"], rec
+
+
 def test_check_metrics_names_lint(tmp_path):
     """ISSUE 5 tier-1 lint: obs.metrics.CATALOG and docs/observability.md
     must agree both ways — plus the drift detectors actually detect."""

@@ -640,7 +640,7 @@ def measure_fleet(args) -> dict:
     }
 
 
-def build_engine(args):
+def build_engine(args, mesh=None):
     from paddle_tpu.config.parser import parse_config
     from paddle_tpu.serving import ServingEngine
     from paddle_tpu.trainer.trainer import Trainer
@@ -655,8 +655,81 @@ def build_engine(args):
         tr.executor, tr.params, num_slots=args.slots,
         page_size=args.page_size, max_context=args.max_context,
         prefill_chunk=(getattr(args, "prefill_chunk", 0) or -1),
-        max_step_tokens=(getattr(args, "max_step_tokens", 0) or None))
+        max_step_tokens=(getattr(args, "max_step_tokens", 0) or None),
+        mesh=mesh)
     return eng
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel bench: the SAME closed-loop workload on a single-device
+# engine vs a mesh model=N sharded engine (docs/serving.md "Sharded decode")
+# ---------------------------------------------------------------------------
+
+def measure_tp(args) -> dict:
+    """1-vs-N-shard A/B: identical request sets (same seeds) through a
+    single-device engine and a tensor-parallel engine over `--mesh-model`
+    devices, closed loop.  Reports tokens/s both arms plus the number
+    sharding exists for — KV pool bytes resident PER SHARD (the sharded
+    arm's per-chip HBM is 1/N of the single-chip pool) — and the
+    signature-stability verdict (ONE decode + ONE mixed signature on the
+    sharded engine too).  Token exactness across shard counts is
+    tests/test_serving_tp.py's job.  On a CPU host run under
+    XLA_FLAGS=--xla_force_host_platform_device_count=N (rehearse mode
+    sets it); real speedups need real chips."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.parallel.mesh import model_mesh
+
+    n = int(args.mesh_model)
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"--mesh-model {n} needs {n} devices, have "
+            f"{len(jax.devices())} — on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n}")
+    base = dict(n=args.num_requests, prompt_lo=args.prompt_lo,
+                prompt_hi=min(args.prompt_hi,
+                              args.max_context - args.max_new - 1),
+                max_new=args.max_new, vocab=args.vocab)
+
+    def rep_sets():
+        return [make_requests(seed=args.seed + 1 + r, **base)
+                for r in range(args.reps)]
+
+    arms = {}
+    for label, shards in (("single", 1), ("tp", n)):
+        eng = build_engine(args,
+                           mesh=model_mesh(n) if shards > 1 else None)
+        warm_workload(eng, [make_requests(seed=args.seed, **base)]
+                      + rep_sets())
+        sigs = eng._decode_step._cache_size()
+        mixed = eng._mixed_step._cache_size()
+        vals = []
+        for reqs in rep_sets():
+            rec = run_workload(eng, reqs)
+            vals.append(rec["tokens"] / rec["seconds"])
+        arms[label] = {
+            "tok_per_sec": float(np.median(vals)),
+            "pool_bytes_per_shard": int(eng.kv.pool_bytes_per_shard),
+            "sig_stable": (eng._decode_step._cache_size() == sigs == 1
+                           and eng._mixed_step._cache_size() == mixed),
+            "tp_shards": eng.tp,
+        }
+        eng.executor.mesh = None       # arms must not inherit the mesh
+    single, tp = arms["single"], arms["tp"]
+    return {
+        "mesh_model": n,
+        "tok_per_sec": tp["tok_per_sec"],
+        "single_tok_per_sec": single["tok_per_sec"],
+        "speedup_vs_single": (tp["tok_per_sec"] / single["tok_per_sec"]
+                              if single["tok_per_sec"] else 0.0),
+        "pool_bytes_per_shard": tp["pool_bytes_per_shard"],
+        "single_pool_bytes": single["pool_bytes_per_shard"],
+        "pool_shrink_vs_single": (
+            single["pool_bytes_per_shard"] / tp["pool_bytes_per_shard"]
+            if tp["pool_bytes_per_shard"] else 0.0),
+        "sig_stable": single["sig_stable"] and tp["sig_stable"],
+    }
 
 
 def main() -> int:
@@ -714,9 +787,33 @@ def main() -> int:
     ap.add_argument("--max-step-tokens", type=int, default=0,
                     help="per-step token budget (0 = engine default, "
                          "prefill_chunk + slots)")
+    # tensor-parallel A/B (docs/serving.md "Sharded decode"): the same
+    # closed-loop workload on one device vs a mesh model=N sharded engine
+    ap.add_argument("--mesh-model", type=int, default=0, metavar="N",
+                    help="run the 1-vs-N-shard A/B: tokens/s + KV pool "
+                         "bytes per shard, single-device engine vs "
+                         "attention-head/KV-pool sharding over N devices")
     args = ap.parse_args()
 
     import numpy as np
+
+    if args.mesh_model > 1:
+        m = measure_tp(args)
+        print(json.dumps({
+            "bench": "serving_tp",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prompt_lens": [args.prompt_lo, args.prompt_hi],
+            "max_new": args.max_new, "dim": args.dim,
+            "layers": args.layers, "heads": args.heads,
+            "dtype": args.dtype, "reps": args.reps,
+            "lm_serving_tp_tok_per_sec": m["tok_per_sec"],
+            **{k: m[k] for k in (
+                "mesh_model", "single_tok_per_sec", "speedup_vs_single",
+                "pool_bytes_per_shard", "single_pool_bytes",
+                "pool_shrink_vs_single", "sig_stable")},
+        }), flush=True)
+        return 0 if m["sig_stable"] else 1
 
     if args.fleet > 0:
         if args.prefix_skew is None:

@@ -95,12 +95,30 @@ def build_engine(args):
         chunk = None                 # chunking off: legacy prefill
     else:
         chunk = args.prefill_chunk or -1   # 0 = engine default
+    mesh = None
+    if args.mesh:
+        # tensor-parallel serving: '--mesh model=N' shards attention heads
+        # and the KV page pools over the first N devices (docs/serving.md
+        # "Sharded decode"); only the model axis is meaningful here
+        from paddle_tpu.parallel.mesh import model_mesh
+
+        name, _, num = args.mesh.replace(":", "=").partition("=")
+        if name.strip() != "model" or not num.strip().isdigit():
+            raise SystemExit(
+                f"--mesh expects 'model=N' (serving shards over the model "
+                f"axis only), got {args.mesh!r}")
+        mesh = model_mesh(int(num))
+        if mesh is not None:
+            print(f"sharded decode: model={int(num)} "
+                  f"(attention heads + KV pools partitioned)",
+                  file=sys.stderr)
     return ServingEngine(tr.executor, tr.params, num_slots=args.slots,
                          page_size=args.page_size,
                          max_context=args.max_context,
                          num_pages=args.num_pages,
                          prefill_chunk=chunk,
-                         max_step_tokens=args.max_step_tokens or None)
+                         max_step_tokens=args.max_step_tokens or None,
+                         mesh=mesh)
 
 
 async def amain(args) -> int:
@@ -182,6 +200,11 @@ def main(argv=None) -> int:
     ap.add_argument("--max-step-tokens", type=int, default=0,
                     help="per-step token budget for mixed prefill/decode "
                          "steps (0 = prefill_chunk + slots)")
+    ap.add_argument("--mesh", default="",
+                    help="tensor-parallel serving mesh, e.g. 'model=2': "
+                         "shard attention heads + KV pools over the first "
+                         "N devices — one replica serves a model bigger "
+                         "than a chip (docs/serving.md 'Sharded decode')")
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")

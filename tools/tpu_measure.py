@@ -273,6 +273,17 @@ def main() -> int:
                               "--max-new", "4", "--vocab", "64",
                               "--dim", "32", "--layers", "1",
                               "--heads", "2", "--dtype", "float32"]
+        serving_tp_args = ["--mesh-model", "2", "--num-requests", "6",
+                           "--slots", "2", "--page-size", "8",
+                           "--max-context", "48", "--prompt-lo", "3",
+                           "--prompt-hi", "10", "--max-new", "4",
+                           "--vocab", "64", "--dim", "32",
+                           "--layers", "1", "--heads", "2",
+                           "--dtype", "float32", "--reps", "1"]
+        # the CPU rehearse has one host device by default — the sharded
+        # arm needs a virtual 2-device mesh (harmless on real TPU steps,
+        # which never see this env)
+        tp_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
         tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
                      "--heads", "2", "--target-ms", "5", "--reps", "1"]
@@ -298,6 +309,10 @@ def main() -> int:
         # one replica, on the prefix-skew defaults (each arm spawns fresh
         # replicas, so this is the longest serving step)
         serving_fleet_args = ["--fleet", "2"]
+        # tensor-parallel A/B: needs >= 2 real chips; a 1-chip tunnel
+        # records the actionable device-count error instead of wedging
+        serving_tp_args = ["--mesh-model", "2"]
+        tp_env = {}
         rnn_args = []
         additive_args = []
         profile_args = []
@@ -353,6 +368,12 @@ def main() -> int:
         ("bench_serving_fleet_record", [py, "bench.py"], 1500,
          bench_env("serving_fleet", 1440),
          lambda: _metric_fresh(_METRIC_OF["serving_fleet"], fh)),
+        # tensor-parallel sharded-decode record (tokens/s 1 vs 2 shards +
+        # KV pool bytes per shard): another two-engine A/B, same budget;
+        # the rehearse env injects the 2-virtual-device XLA flag
+        ("bench_serving_tp_record", [py, "bench.py"], 900,
+         bench_env("serving_tp", 840, tp_env),
+         lambda: _metric_fresh(_METRIC_OF["serving_tp"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
@@ -392,6 +413,11 @@ def main() -> int:
         ("bench_serving_fleet",
          [py, "tools/bench_serving.py"] + serving_fleet_args, 1800, {},
          lambda: _out_fresh("bench_serving_fleet", fh)),
+        # tensor-parallel sweep: the full-size 1-vs-N-shard A/B banked to
+        # OUT (tok/s both arms, per-shard pool bytes, sig stability)
+        ("bench_serving_tp",
+         [py, "tools/bench_serving.py"] + serving_tp_args, 1200, tp_env,
+         lambda: _out_fresh("bench_serving_tp", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
