@@ -731,6 +731,62 @@ def bench_serving_tp(dtype: str) -> dict:
     }
 
 
+def bench_serving_spec(dtype: str) -> dict:
+    """Speculative-decoding effectiveness record (docs/serving.md
+    "Speculative decoding"): the locally-repetitive workload through ONE
+    engine, speculation off (sequential decode — the baseline) then on
+    at `BENCH_SERVE_SPEC_K` drafts/slot/step — tools/bench_serving.py
+    --spec-k is the sweep tool, this is the compact record for the
+    driver's BENCH capture.  Headline = spec-on tokens/s; companions
+    are the baseline arm, the accept rate, and the drafted/accepted/
+    emitted reconciliation (`reconcile_ok` — the counters must account
+    for every token).  Token exactness spec-on vs spec-off is
+    tests/test_spec_decode.py's job."""
+    import argparse
+
+    from tools.bench_serving import build_engine, measure_spec
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        dtype=dtype)
+    max_new = int(os.environ.get("BENCH_SERVE_MAX_NEW", "64"))
+    spec_k = int(os.environ.get("BENCH_SERVE_SPEC_K", "4"))
+    wl = dict(
+        n=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prompt_lo=int(os.environ.get("BENCH_SERVE_PROMPT_LO", "32")),
+        prompt_hi=min(int(os.environ.get("BENCH_SERVE_PROMPT_HI", "256")),
+                      args.max_context - max_new - 1),
+        max_new=max_new,
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")))
+    reps = int(os.environ.get("BENCH_SERVE_REPS", "3"))
+
+    eng = build_engine(args)
+    m = measure_spec(eng, wl, reps, seed=0, spec_k=spec_k)
+    return {
+        "metric": "lm_serving_spec_tok_per_sec",
+        "value": round(m["spec_tok_per_sec"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"spec_k={spec_k} vocab={args.vocab} dim={args.dim} "
+                  f"L={args.layers} H={args.heads} slots={args.slots} "
+                  f"page={args.page_size} "
+                  f"prompts={wl['prompt_lo']}-{wl['prompt_hi']}(repetitive)"
+                  f" max_new={max_new} budget={m['max_step_tokens']}",
+        "lm_serving_spec_accept_rate": round(m["accept_rate"], 4),
+        **{k: m[k] for k in (
+            "baseline_tok_per_sec", "speedup_vs_baseline", "drafted",
+            "accepted", "chains", "spec_tokens", "tokens",
+            "baseline_decode_steps", "spec_decode_steps",
+            "reconcile_ok", "sig_stable")},
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
@@ -740,6 +796,7 @@ BENCHES = {
     "serving_chunked": bench_serving_chunked,
     "serving_fleet": bench_serving_fleet,
     "serving_tp": bench_serving_tp,
+    "serving_spec": bench_serving_spec,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -864,6 +921,7 @@ _METRIC_OF = {
     "serving_chunked": "lm_serving_p99_itl_chunked_ms",
     "serving_fleet": "lm_serving_fleet_tok_per_sec",
     "serving_tp": "lm_serving_tp_tok_per_sec",
+    "serving_spec": "lm_serving_spec_tok_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -947,8 +1005,8 @@ def _assemble_lkg() -> dict | None:
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
-                "serving_fleet", "serving_tp", "mnist", "sentiment",
-                "recommendation", "seq2seq"):
+                "serving_fleet", "serving_tp", "serving_spec", "mnist",
+                "sentiment", "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:

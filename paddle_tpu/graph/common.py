@@ -53,5 +53,43 @@ def finish_layer(
         mask = (jnp.arange(value.shape[1])[None, :] < lengths[:, None])
     out = activation(cfg.active_type, value, mask=mask)
     out = apply_dropout(ctx, cfg, out)
+    out = tp_constrain(ctx, cfg, out)
     sub_lengths = like.sub_lengths if like is not None else None
     return Argument(value=out, lengths=lengths, sub_lengths=sub_lengths, nhwc=nhwc)
+
+
+def tp_constrain(ctx: ForwardContext, cfg: LayerConfig, x: Array) -> Array:
+    """Pin a layer output's tensor-parallel layout when the serving
+    engine stamped `tp_out` on it (ServingEngine._tp_param_shardings —
+    the Megatron split): 'model' keeps the FFN up-projection's wide
+    hidden activation COLUMN-SHARDED on its last axis (it must never
+    materialize whole on a device), 'replicated' forces row-sharded
+    partial sums (FFN down-projection, LM head) to meet in ONE
+    all-reduce right here and keeps the residual stream / layer norms
+    replicated.  Without the pins GSPMD propagation is free to shard
+    the residual instead — same bytes, but it strews activation
+    all-gathers and partial layer-norm reductions through a
+    latency-bound decode step (observed on the 2-shard host mesh;
+    tools/hlo_shard_check.py counts exactly the pinned collectives).
+    No-op off-mesh, when the mesh has no model axis, or when the engine
+    never stamped the layer."""
+    tp = cfg.attrs.get("tp_out")
+    mesh = ctx.mesh
+    if not tp or mesh is None:
+        return x
+    from paddle_tpu.parallel.mesh import MODEL_AXIS
+
+    if tp not in ("replicated", MODEL_AXIS):
+        # an unknown stamp silently falling through to some default is
+        # exactly the layout drift the pin exists to prevent
+        raise ValueError(
+            f"layer {cfg.name!r}: unknown tp_out {tp!r} (expected "
+            f"'replicated' or {MODEL_AXIS!r})")
+    if int(dict(zip(mesh.axis_names, mesh.devices.shape))
+           .get(MODEL_AXIS, 1)) < 2:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P() if tp == "replicated" else \
+        P(*([None] * (x.ndim - 1) + [MODEL_AXIS]))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.config.schema import LayerConfig, OperatorConfig, ProjectionConfig
-from paddle_tpu.graph.common import finish_layer
+from paddle_tpu.graph.common import finish_layer, tp_constrain
 from paddle_tpu.graph.context import ForwardContext
 from paddle_tpu.graph.registry import register_layer
 from paddle_tpu.ops import sequence as seqops
@@ -52,13 +52,20 @@ def _input_matmul(arg: Argument, w: Array) -> Array:
 @register_layer("fc")
 def fc_layer(ctx: ForwardContext, cfg: LayerConfig) -> Argument:
     """Fully connected: sum_i x_i @ W_i + b, then activation
-    (ref: FullyConnectedLayer.cpp forward: Matrix::mul per input + addBias)."""
+    (ref: FullyConnectedLayer.cpp forward: Matrix::mul per input + addBias).
+
+    Under tensor-parallel serving the engine may stamp `tp_out` on this
+    layer (the Megatron FFN/LM-head split) — the pre-bias pin forces a
+    row-sharded matmul's partial sums into their all-reduce BEFORE the
+    (replicated) bias adds, and finish_layer's tp_constrain re-pins the
+    activated output."""
     inputs = ctx.get_inputs(cfg)
     acc = None
     for i, arg in enumerate(inputs):
         w = ctx.param_of(cfg, i)
         y = _input_matmul(arg, w)
         acc = y if acc is None else acc + y
+    acc = tp_constrain(ctx, cfg, acc)
     b = ctx.bias_of(cfg)
     if b is not None:
         acc = acc + b

@@ -66,6 +66,16 @@ CATALOG: dict[str, str] = {
     "serving_kv_pool_bytes_per_shard":
         "KV page-pool bytes resident PER DEVICE (kv-head axis split over "
         "the mesh model axis)",
+    # -- speculative decoding (docs/serving.md "Speculative decoding") ----
+    "serving_spec_drafted_total":
+        "draft tokens scored by the verify step (host drafter proposals "
+        "the target model checked)",
+    "serving_spec_accepted_total":
+        "draft tokens accepted exactly (the sampled chain matched the "
+        "draft) — each one is a decode step the engine did not pay",
+    "serving_spec_accept_rate":
+        "accepted / drafted over the engine lifetime (0 before any "
+        "draft; PERF.md 'Reading the accept rate')",
     # -- chunked prefill / mixed-step token budget -------------------------
     "serving_step_tokens":
         "scheduled token rows per compiled step (decode rows + prefill "

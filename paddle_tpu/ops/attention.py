@@ -609,6 +609,19 @@ def ragged_paged_attention_step(
     unmapped -> trash page 0) with row_pos 0: their writes land in the
     trash page and their outputs are garbage the scheduler discards.
 
+    The SPECULATIVE verify step (serving/engine.py `_spec_impl`) rides
+    this same contract with a third row flavor: a decoding slot's
+    draft CHAIN — its committed last token at row_pos = pos plus k
+    drafted tokens at pos+1..pos+k — so draft row i attends the
+    committed context plus drafts 1..i-1, exactly the context a
+    sequential engine would have if the drafts were true.  The scatter
+    is ROLLBACK-SAFE by construction: a rejected draft's K/V sits at
+    positions beyond the slot's committed length, where the causal
+    mask excludes it from every live query, and the next step's rows
+    overwrite those positions before the slot's pos can ever reach
+    them — so the device state needs no undo, and the host merely
+    returns the unjustified tail pages (paged_kv.uncommit_tail).
+
     Returns (out [T, H, D], new_k_pages, new_v_pages).  `use_kernel`
     routes the read through the Pallas ragged-paged kernel with the
     row->slot indirection (ops/pallas_paged.py); the jnp gather fallback

@@ -32,6 +32,17 @@ scalar-prefetch channel as the page table; everything else (online
 softmax over live pages, pl.when page skipping, in-kernel GQA) is
 unchanged.
 
+SPECULATIVE verify rows (the engine's `--spec-k` draft chains) are the
+same row-indirected shape from this kernel's point of view: a chain is
+several consecutive rows of one slot at positions pos..pos+k, each
+attending that slot's pages up to its own row — identical to a prompt
+chunk except the K/V it reads at pos+1..pos+k was scattered
+optimistically by the caller.  Rejection needs nothing from the
+kernel: rejected positions sit beyond the slot's committed length,
+masked for every later query and overwritten by the next chain before
+pos can reach them (the rollback-safe-scatter contract documented on
+ops/attention.py:ragged_paged_attention_step).
+
 TENSOR PARALLELISM (the serving engine's `--mesh model=N` sharded
 decode): this kernel is always invoked on LOCAL head shards — the
 shard_map wrapper in ops/attention.py partitions q over its head axis

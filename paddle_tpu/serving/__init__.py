@@ -5,13 +5,15 @@ deadlines, cancellation, bounded admission, and graceful drain
 (serving/server.py; protocol in serving/wire.py, blocking client in
 serving/client.py, CLI in tools/serve.py)."""
 
+from paddle_tpu.serving.drafter import NgramDrafter
 from paddle_tpu.serving.engine import Request, ServingEngine
 from paddle_tpu.serving.paged_kv import PagedKVCache
 from paddle_tpu.serving.prefix_tree import PrefixTree
 from paddle_tpu.serving.sampler import pick_next_per_slot
 
 __all__ = ["Request", "ServingEngine", "PagedKVCache", "PrefixTree",
-           "pick_next_per_slot", "ServingServer", "ServingClient"]
+           "NgramDrafter", "pick_next_per_slot", "ServingServer",
+           "ServingClient"]
 
 
 def __getattr__(name):

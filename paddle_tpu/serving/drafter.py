@@ -1,0 +1,77 @@
+"""Host-side draft-token proposers for speculative decoding.
+
+The serving engine's speculative path (docs/serving.md "Speculative
+decoding") multiplies decode tokens/s by letting a cheap DRAFTER guess
+the next k tokens of a slot and having the target model score all k+1
+positions in ONE ragged dispatch — exactly the mixed-step machinery of
+PR 8, pointed at the future instead of the prompt.  Verification is
+exact (the engine samples every chain position with the slot's own key
+schedule and accepts only the matching prefix), so a drafter can NEVER
+change a single emitted token — only how many compiled steps it takes
+to emit them.  A useless drafter costs some wasted verify rows; a good
+one collapses k+1 sequential steps into one.
+
+The drafter interface is deliberately tiny so a small draft MODEL can
+slot in later:
+
+    class Drafter:
+        def propose(self, ctx: np.ndarray, k: int) -> np.ndarray:
+            '''Up to `k` int32 draft tokens continuing `ctx` (the slot's
+            prompt + everything generated so far, newest last).  May
+            return fewer (or zero) tokens; must be DETERMINISTIC in ctx
+            — the engine consults it on the scheduling hot path, between
+            compiled steps, on the pump thread.'''
+
+The default is prompt-lookup / n-gram drafting (the "no second model"
+scheme of arXiv-era LLMA/prompt-lookup decoding): the continuation of
+the most recent earlier occurrence of the slot's own trailing n-gram.
+Free to compute, surprisingly strong on the workloads serving actually
+sees (retrieval contexts, code, templated text, and the repetitive
+regimes of constrained decoding), and exactly zero-cost to correctness
+by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: propose the continuation of the most
+    recent earlier occurrence of the context's trailing n-gram.
+
+    Tries match lengths `max_ngram` down to `min_ngram`; the FIRST
+    length with a hit wins, and among hits the MOST RECENT occurrence
+    is used (recency tracks local repetition best).  Pure numpy over
+    the slot's own tokens — no model, no device work, deterministic.
+
+    `window` bounds the searched context to its most recent tokens: the
+    lookup runs on the scheduling hot path (pump thread, between
+    compiled steps, once per decoding slot), so its cost must stay O(1)
+    in generation length — and recency is the signal anyway.  The
+    engine reads this attribute to hand over only the tail.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 window: int = 256):
+        assert 1 <= min_ngram <= max_ngram
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.window = int(window)
+
+    def propose(self, ctx: np.ndarray, k: int) -> np.ndarray:
+        ctx = np.asarray(ctx, np.int32).reshape(-1)[-self.window:]
+        n_ctx = ctx.size
+        if k <= 0 or n_ctx < 2:
+            return np.zeros(0, np.int32)
+        for n in range(min(self.max_ngram, n_ctx - 1),
+                       self.min_ngram - 1, -1):
+            pat = ctx[n_ctx - n:]
+            # windows over ctx[:-1]: every start whose continuation has
+            # at least one token to propose
+            wins = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+            hits = np.flatnonzero((wins == pat[None, :]).all(axis=1))
+            if hits.size:
+                start = int(hits[-1]) + n          # most recent match
+                return ctx[start:start + k].copy()
+        return np.zeros(0, np.int32)

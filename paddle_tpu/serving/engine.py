@@ -53,6 +53,31 @@ The same pytree is the serving checkpoint/restore + fleet-migration unit:
 `checkpoint_state()` / `restore_state()` freeze and resume an engine
 MID-FLIGHT (queued + decoding + mid-chunk slots) bit-exactly.
 
+SPECULATIVE DECODING (`spec_k > 0`): decode is one token per step per slot
+— the dispatch rate is the throughput ceiling.  The speculative path lifts
+it without changing a single emitted token: a host-side DRAFTER
+(serving/drafter.py — prompt-lookup n-grams over the slot's own committed
+tokens by default, pluggable for a small draft model) proposes up to k
+tokens per decoding slot, and the target model scores ALL k+1 positions
+per slot in ONE ragged dispatch (the verify step — the PR 8 packed-row
+machinery pointed at the future instead of the prompt).  Draft K/V is
+written optimistically; every chain position samples with the slot's OWN
+key for that generation index (`keys[s, gen+i]` — sampler.py
+`pick_next_chain`), so position i's sample IS the token the sequential
+engine would emit there, and acceptance is exact by construction: the
+emitted stream is the accepted draft prefix plus the first mismatching
+sample — token-for-token identical to spec-off across greedy/top-k/
+nucleus/full sampling, prefix hits, chunked mixed steps, preempt/replay
+and tensor parallelism (the rejection-sampling equivalence degenerates to
+prefix agreement once the randomness is a fixed per-slot key schedule).
+Rollback: rejected-suffix K/V on device needs NO cleanup (causally masked
+now, overwritten before it could ever be attended); the host returns the
+unjustified tail pages via `kv.uncommit_tail` — the same page-granular
+rollback preempt/replay already exercises.  Chunk rows coexist with spec
+chains under the same token budget (mode-aware packing), and the compiled
+set stays bounded: ONE verify signature per budget next to the one decode
++ one mixed signature.  `set_speculation()` is the idle A/B toggle.
+
 TENSOR-PARALLEL DECODE (`mesh=` with a `model` axis of size > 1): attention
 heads and the per-layer KV pools partition over the mesh's `model` axis —
 w_q/w_k/w_v column-shard, the pools shard on their kv-head axis, w_o
@@ -87,7 +112,7 @@ from paddle_tpu.parallel.mesh import MODEL_AXIS, axis_size
 from paddle_tpu.parameter.argument import Argument
 from paddle_tpu.serving.paged_kv import PagedKVCache
 from paddle_tpu.serving.prefix_tree import PrefixTree
-from paddle_tpu.serving.sampler import pick_next_per_slot
+from paddle_tpu.serving.sampler import pick_next_chain, pick_next_per_slot
 
 
 class EngineState(NamedTuple):
@@ -202,6 +227,7 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = -1,
                  max_step_tokens: Optional[int] = None,
+                 spec_k: int = 0, drafter=None,
                  mesh=None):
         self.executor = executor
         self.input_name, self.logits_name = _resolve_io_names(
@@ -216,6 +242,8 @@ class ServingEngine:
         self.tp = axis_size(self.mesh, MODEL_AXIS)
         self._repl_sharding = None
         self._param_shardings_tree = None
+        self._tp_ffn_pairs: list = []
+        self._tp_lm_head: Optional[str] = None
         if self.tp > 1:
             if executor.mesh is not None and executor.mesh is not self.mesh:
                 raise ValueError(
@@ -347,6 +375,29 @@ class ServingEngine:
                           else prefill_chunk, max_step_tokens)
         self.n_prefill_chunks = 0
         self.n_mixed_steps = 0
+        # SPECULATIVE DECODING (the verify step): ONE extra compiled
+        # signature per (token budget, spec_k) — created lazily like the
+        # others, compiled only when speculation is actually on.  The
+        # drafter runs on the host between steps; the verify step scores
+        # every slot's k+1-position chain (plus any prefill chunk rows)
+        # in one ragged dispatch and computes acceptance ON DEVICE, so
+        # pos/gen advance by the accepted length without a host round
+        # trip inside the step.
+        spec_jit = jax.jit(self._spec_impl, donate_argnums=(1,),
+                           **self._step_sharding_kwargs(n_extra=9,
+                                                        n_out=2))
+        self._spec_step = get_compile_watch().wrap_jit(
+            "serving.spec_step", spec_jit)
+        self.spec_k = 0
+        self.drafter = None
+        self.n_spec_steps = 0       # verify dispatches run
+        self.n_spec_chains = 0      # (slot, step) chains that emitted
+        self.n_spec_drafted = 0     # draft tokens scored by the target
+        self.n_spec_accepted = 0    # draft tokens that matched exactly
+        self.n_spec_tokens = 0      # tokens banked through chains —
+                                    # == accepted + chains unless an eos
+                                    # truncated a chain (reconciliation)
+        self.set_speculation(spec_k, drafter)
         # token-budget observability: per-step scheduled-token histogram
         # and the pump-step gap decoding slots actually saw (ms) — the
         # HOL-blocking number chunking exists to bound.  Standalone
@@ -384,19 +435,95 @@ class ServingEngine:
         """NamedSharding per parameter: attention projections partition
         over `model` (w_q/w_k/w_v by output column — whole heads per
         device; w_o by input row, so the out-projection is partial sums
-        meeting in one all-reduce), everything else replicated."""
+        meeting in one all-reduce), the FFN pairs get the same Megatron
+        column/row split (first fc by output column — its bias and the
+        elementwise activation stay column-local; second fc by input
+        row — one more all-reduce per layer, and the wide [dim, 4*dim]
+        hidden activation never materializes whole on any device), the
+        LM head row-shards (partial logits meet in one all-reduce —
+        replicated logits with ZERO all-gathers, so sampling is
+        untouched), and everything else is replicated.
+
+        FFN pairs are detected structurally: an fc layer feeding
+        directly into another fc layer is the Megatron pattern; the
+        hidden dim must divide the mesh (skipped — left replicated —
+        otherwise, same divisibility discipline as the head counts).
+        `_tp_ffn_pairs` / `_tp_lm_head` record what actually sharded so
+        tools/hlo_shard_check.py can derive the exact expected
+        all-reduce count instead of guessing."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         col = NamedSharding(self.mesh, P(None, "model"))
         row = NamedSharding(self.mesh, P("model", None))
         sh = {name: self._repl_sharding for name in params}
-        for l in self.executor.model.layers:
+        self._tp_ffn_pairs: list[tuple[str, str]] = []
+        self._tp_lm_head: Optional[str] = None
+        layers = {l.name: l for l in self.executor.model.layers}
+        for l in layers.values():
             if l.type != "multi_head_attention":
                 continue
             names = [l.inputs[i].input_parameter_name for i in range(4)]
             for n in names[:3]:                       # w_q, w_k, w_v
                 sh[n] = col
             sh[names[3]] = row                        # w_o
+        for l in layers.values():                     # Megatron FFN pairs
+            if l.type != "fc" or len(l.inputs) != 1:
+                continue
+            src = layers.get(l.inputs[0].input_layer_name)
+            if src is None or src.type != "fc" or len(src.inputs) != 1:
+                continue
+            w1 = src.inputs[0].input_parameter_name
+            w2 = l.inputs[0].input_parameter_name
+            hidden = int(params[w1].shape[1])
+            if hidden % self.tp or sh[w1] is not self._repl_sharding \
+                    or sh[w2] is not self._repl_sharding:
+                continue
+            sh[w1] = col                              # up-projection
+            if src.bias_parameter_name:
+                # the bias adds to a column-sharded activation — shard
+                # its LAST axis the same way (biases are stored
+                # [1, out]) so the add stays collective-free
+                b = src.bias_parameter_name
+                sh[b] = NamedSharding(self.mesh, P(
+                    *([None] * (params[b].ndim - 1) + ["model"])))
+            sh[w2] = row                              # down-projection
+            # stamp the Megatron layout on the layers themselves —
+            # fc_layer pins the activations (hidden stays sharded, the
+            # down-projection's partial sums all-reduce immediately), so
+            # GSPMD cannot trade the one clean all-reduce for a
+            # reduce-scattered residual stream full of small collectives
+            src.attrs["tp_out"] = MODEL_AXIS
+            l.attrs["tp_out"] = "replicated"
+            self._tp_ffn_pairs.append((w1, w2))
+        head = layers.get(self.logits_name)           # vocab projection
+        if head is not None and head.type == "fc" and \
+                len(head.inputs) == 1:
+            w = head.inputs[0].input_parameter_name
+            if int(params[w].shape[0]) % self.tp == 0 and \
+                    sh[w] is self._repl_sharding:
+                sh[w] = row
+                head.attrs["tp_out"] = "replicated"
+                feed_l = layers.get(head.inputs[0].input_layer_name)
+                if feed_l is not None:
+                    # pin the head's INPUT sharded on the contraction
+                    # axis: with only the output pinned, GSPMD's cost
+                    # model may satisfy it by ALL-GATHERING the weight —
+                    # at production vocab the head is the largest param
+                    # in the model, and reassembling it per step is the
+                    # exact failure this sharding exists to prevent.  A
+                    # replicated input slices locally for free, the dot
+                    # goes partial, and the pinned-replicated output
+                    # forces the one all-reduce.
+                    feed_l.attrs["tp_out"] = MODEL_AXIS
+                self._tp_lm_head = w
+        if self._tp_ffn_pairs or self._tp_lm_head:
+            # the residual stream and its layer norms are REPLICATED in
+            # the Megatron layout — pin them, or GSPMD propagation will
+            # happily shard the residual and pay partial-LN reductions
+            # plus activation all-gathers at every projection input
+            for l in layers.values():
+                if l.type in ("layer_norm", "addto"):
+                    l.attrs.setdefault("tp_out", "replicated")
         return sh
 
     def _state_shardings(self) -> "EngineState":
@@ -406,18 +533,20 @@ class ServingEngine:
         return EngineState(pools=pool, table=r, pos=r, toks=r, gen=r,
                            keys=r, temp=r, topk=r, topp=r)
 
-    def _step_sharding_kwargs(self, n_extra: int) -> dict:
+    def _step_sharding_kwargs(self, n_extra: int, n_out: int = 1) -> dict:
         """Explicit in/out sharding trees for the compiled steps (the
         compile_step_with_plan discipline): (params, EngineState,
-        n_extra replicated operands) -> (EngineState, replicated tokens).
-        Empty off-mesh — the single-device jits stay exactly as before."""
+        n_extra replicated operands) -> (EngineState, n_out replicated
+        outputs — sampled tokens, and for the verify step the accepted
+        count too).  Empty off-mesh — the single-device jits stay
+        exactly as before."""
         if self.tp <= 1:
             return {}
         st = self._state_shardings()
         r = self._repl_sharding
         return {"in_shardings": (self._param_shardings_tree, st)
                 + (r,) * n_extra,
-                "out_shardings": (st, r)}
+                "out_shardings": (st,) + (r,) * n_out}
 
     def _pools_out_kwargs(self) -> dict:
         """out_shardings pinning a pool-writing jit's output to the
@@ -702,7 +831,17 @@ class ServingEngine:
             live.remove(victim)
             if not live:
                 return True        # pages freed; next step() re-admits
-        if filling:
+        if self.spec_k > 0:
+            # speculative mode: the drafter proposes per decoding slot;
+            # any drafts (or chunk rows) route through the verify step —
+            # a zero-draft pure-decode step keeps the cheap [S, 1]
+            # signature, so an unhelpful drafter costs nothing steady-
+            # state beyond the host-side lookup
+            drafts = self._propose_drafts(runnable)
+            if drafts or filling:
+                return self._run_spec_step(live, runnable, filling,
+                                           drafts)
+        elif filling:
             return self._run_mixed_step(live, runnable, filling)
 
         traced = self._tr_on()
@@ -822,38 +961,9 @@ class ServingEngine:
             adv[s] = 1
             emit[s] = True
             r += 1
-        budget = T - r
-        advanced = []                        # (slot, n_rows, final)
-        for s in sorted(filling, key=lambda s: self.slots[s].admit_seq):
-            if budget <= 0:
-                break
-            sl = self.slots[s]
-            p = sl.req.prompt_ids.size
-            n = min(p - sl.pos, self.prefill_chunk, budget)
-            # every page this chunk writes must be private to the slot
-            # (reservation COW'd the shared boundary page; mapped prefix
-            # pages below the cursor are never written)
-            for j in range(sl.pos // ps, (sl.pos + n - 1) // ps + 1):
-                assert self.kv.page_writable(int(self.kv.table[s, j])), \
-                    f"slot {s} chunk would write shared page " \
-                    f"{int(self.kv.table[s, j])}"
-            row_ids[r:r + n] = sl.req.prompt_ids[sl.pos:sl.pos + n]
-            row_slot[r:r + n] = s
-            row_pos[r:r + n] = np.arange(sl.pos, sl.pos + n)
-            final = sl.pos + n == p
-            adv[s] = n
-            if final:
-                # the last prompt position's logits sample token 0 with
-                # keys[gen=0] — identical to the legacy prefill decision
-                sample_row[s] = r + n - 1
-                emit[s] = True
-            self.n_prefill_chunks += 1
-            self.flight.record("chunk_sched", req=str(sl.req.req_id),
-                               slot=s, start=int(sl.pos), tokens=int(n),
-                               final=final)
-            advanced.append((s, n, final))
-            budget -= n
-            r += n
+        advanced, r = self._pack_chunk_rows(
+            filling, row_ids, row_slot, row_pos, sample_row, adv, emit,
+            r, T - r)
         # the state table already carries the virtual trash row (row S) —
         # padding rows gather/scatter only page 0.  Row packing is this
         # step's scheduling decision, so the six row/mask operands stage
@@ -878,11 +988,270 @@ class ServingEngine:
                                    "decode_rows": len(runnable)})
         for s in runnable:
             self._bank_token(s, int(nxt[s]))
+        self._advance_chunks(advanced, lambda s: int(nxt[s]))
+        return True
+
+    def _pack_chunk_rows(self, filling, row_ids, row_slot, row_pos,
+                         sample_row, adv, emit, r: int, budget: int):
+        """Pack up to `prefill_chunk` prompt rows per mid-prefill slot
+        (admit order) into the ragged row list, starting at row `r`,
+        never exceeding `budget` rows — the chunk-scheduling half SHARED
+        by the mixed and speculative verify steps, so the final-chunk
+        emission rule, the shared-page tripwire, and the chunk_sched
+        accounting can never diverge between them.  A slot whose FINAL
+        chunk lands this step gets its sampling row pointed at the last
+        prompt position (`sample_row[s]`; the verify step's chain
+        position 0) and `emit[s]` set — token 0 sampled with keys[gen=0],
+        the legacy prefill decision.  Returns (advanced, r')."""
+        ps = self.kv.page_size
+        advanced = []                        # (slot, n_rows, final)
+        for s in sorted(filling, key=lambda s: self.slots[s].admit_seq):
+            if budget <= 0:
+                break
+            sl = self.slots[s]
+            p = sl.req.prompt_ids.size
+            n = self._chunk_rows_for(s, budget)
+            # every page this chunk writes must be private to the slot
+            # (reservation COW'd the shared boundary page; mapped prefix
+            # pages below the cursor are never written)
+            for j in range(sl.pos // ps, (sl.pos + n - 1) // ps + 1):
+                assert self.kv.page_writable(int(self.kv.table[s, j])), \
+                    f"slot {s} chunk would write shared page " \
+                    f"{int(self.kv.table[s, j])}"
+            row_ids[r:r + n] = sl.req.prompt_ids[sl.pos:sl.pos + n]
+            row_slot[r:r + n] = s
+            row_pos[r:r + n] = np.arange(sl.pos, sl.pos + n)
+            final = sl.pos + n == p
+            adv[s] = n
+            if final:
+                sample_row[s] = r + n - 1
+                emit[s] = True
+            self.n_prefill_chunks += 1
+            self.flight.record("chunk_sched", req=str(sl.req.req_id),
+                               slot=s, start=int(sl.pos), tokens=int(n),
+                               final=final)
+            advanced.append((s, n, final))
+            budget -= n
+            r += n
+        return advanced, r
+
+    def _chunk_rows_for(self, s: int, budget: int) -> int:
+        """Rows slot `s`'s next prefill chunk takes under `budget` — the
+        ONE scheduling formula, shared by _pack_chunk_rows and the
+        verify step's chunk-reserve computation so the reserve can never
+        under-count what the packing will actually schedule."""
+        sl = self.slots[s]
+        return min(sl.req.prompt_ids.size - sl.pos, self.prefill_chunk,
+                   budget)
+
+    def _advance_chunks(self, advanced, tok0_of) -> None:
+        """Post-step chunk bookkeeping shared by the mixed and verify
+        steps: advance each chunked slot's cursor, and emit token 0
+        (`tok0_of(s)` — that slot's sampled row) for final chunks."""
         for s, n, final in advanced:
             sl = self.slots[s]
             sl.pos += n
             if final:
-                self._emit_first(s, int(nxt[s]))
+                self._emit_first(s, tok0_of(s))
+
+    # -- speculative decoding (docs/serving.md "Speculative decoding") ----
+    def _propose_drafts(self, runnable) -> dict:
+        """Ask the drafter for up to `spec_k` lookahead tokens per
+        decoding slot (host side, between steps).  The per-slot cap is
+        exact-by-construction: a chain emits at most k+1 tokens, so k
+        never exceeds the tokens the request may still emit
+        (max_new - gen - 1), and the deepest draft write (pos + k) never
+        exceeds slot capacity — the same `p + max_new - 2` bound
+        validate() already guarantees pages for.  Empty proposals drop
+        out entirely (their slot rides the plain decode row)."""
+        out = {}
+        cap = self.kv.capacity_tokens
+        # hand the drafter only its search window's tail — this runs on
+        # the pump thread between compiled steps, so the host cost must
+        # stay O(window) per slot, not O(context) as generation grows
+        W = int(getattr(self.drafter, "window", 0)) or cap
+        for s in runnable:
+            sl = self.slots[s]
+            k = min(self.spec_k, sl.req.max_new - sl.gen - 1,
+                    cap - 1 - sl.pos)
+            if k <= 0:
+                continue
+            gen_tail = sl.generated[-W:]
+            need = W - len(gen_tail)
+            if need > 0 and sl.req.prompt_ids.size:
+                ctx = np.concatenate(
+                    [sl.req.prompt_ids[-need:],
+                     np.asarray(gen_tail, np.int32)])
+            else:
+                ctx = np.asarray(gen_tail, np.int32)
+            d = np.asarray(self.drafter.propose(ctx, k),
+                           np.int32).reshape(-1)
+            if d.size:
+                out[s] = d[:k]
+        return out
+
+    def _run_spec_step(self, live, runnable, filling, drafts) -> bool:
+        """ONE speculative verify dispatch: every decoding slot packs a
+        CHAIN of consecutive rows — its regular next-token row at `pos`
+        plus up to k draft rows at pos+1..pos+k — and mid-prefill slots'
+        chunk rows share the same dispatch (mode-aware packing).  Budget
+        priority: decode base rows first (every decoder advances), then
+        the chunk rows' RESERVE (exactly what the mixed step would have
+        scheduled — drafting can never starve a prompt's first token),
+        and drafts spend only what is left.  The
+        ragged attention core scatters ALL rows' K/V before reading, so
+        draft row i attends the committed context plus drafts 1..i-1
+        under the causal mask — precisely the context the sequential
+        engine would have if those drafts were the true tokens.
+
+        Acceptance is computed ON DEVICE (no host round trip inside the
+        step): every chain position samples with the slot's own key for
+        that generation index, the accepted length is the leading run of
+        draft agreement, and pos/gen/last-token advance by accepted+1.
+        The host then banks the emitted tokens through the ordinary
+        `_bank_token` path (eos/max_new semantics unchanged — a chain
+        truncates at eos exactly where the sequential stream would) and
+        rolls back the page tail the rejection left unjustified
+        (`kv.uncommit_tail` — the allocator's preempt-grade rollback).
+
+        Chains need page cover for their deepest write; a page-starved
+        slot verifies fewer drafts instead of stalling (the plain row
+        needs only the page the runnable check already secured)."""
+        traced = self._tr_on()
+        t_step = time.perf_counter() if traced else 0.0
+        S = len(self.slots)
+        K = self.spec_k
+        T = self.max_step_tokens if self.prefill_chunk is not None \
+            else S * (K + 1)
+        ps = self.kv.page_size
+        row_ids = np.zeros(T, np.int32)
+        row_slot = np.full(T, S, np.int32)   # S = the virtual trash row
+        row_pos = np.zeros(T, np.int32)
+        first_row = np.zeros(S, np.int32)
+        n_draft = np.zeros(S, np.int32)
+        draft_toks = np.zeros((S, K), np.int32)
+        spec = np.zeros(S, bool)
+        emit = np.zeros(S, bool)
+        adv_chunk = np.zeros(S, np.int32)
+        r = 0
+        # every decoding slot's base row is reserved BEFORE any draft or
+        # chunk row spends budget — decoders advance every step whatever
+        # the speculation does (the mixed step's HOL discipline)
+        budget = T - len(runnable)
+        assert budget >= 0, \
+            "token budget below the decoding slot count (set_chunking " \
+            "guarantees max_step_tokens > num_slots)"
+        # ...and the chunk rows' share is reserved BEFORE any draft row:
+        # speculation spends only what prefill leaves over, so drafting
+        # decoders can never starve a mid-prefill prompt's chunks — the
+        # first-token HOL bound chunked prefill exists for.  The reserve
+        # is exactly what the mixed step would have scheduled them.
+        chunk_reserve = 0
+        if filling:
+            left = budget
+            for s in sorted(filling,
+                            key=lambda s: self.slots[s].admit_seq):
+                if left <= 0:
+                    break
+                n = self._chunk_rows_for(s, left)
+                chunk_reserve += n
+                left -= n
+        budget -= chunk_reserve
+        for s in runnable:
+            sl = self.slots[s]
+            d = drafts.get(s)
+            nd = 0 if d is None else min(int(d.size), budget)
+            if nd > 0 and not self.kv.try_grow(s, sl.pos + nd + 1,
+                                               evict=False):
+                # page-starved chain: verify only what the slot's pages
+                # cover (pages already grabbed stay with the slot — the
+                # post-step uncommit returns whatever acceptance cannot
+                # justify, so a dry pool shrinks ambition, never
+                # wedges).  evict=False: optimistic draft pages must
+                # never cost a committed cached prefix its retention —
+                # a rejection would hand them back this very step
+                nd = min(nd, max(0, int(self.kv._n_pages[s]) * ps
+                                 - sl.pos - 1))
+            for j in range(sl.pos // ps, (sl.pos + nd) // ps + 1):
+                # the chain's whole write span must be private pages
+                # (the decode tripwire, stretched over the draft tail)
+                assert self.kv.page_writable(int(self.kv.table[s, j])), \
+                    f"slot {s} chain would write shared page " \
+                    f"{int(self.kv.table[s, j])}"
+            row_ids[r] = sl.last_tok
+            row_slot[r] = s
+            row_pos[r] = sl.pos
+            first_row[s] = r
+            spec[s] = True
+            emit[s] = True
+            r += 1
+            if nd > 0:
+                row_ids[r:r + nd] = d[:nd]
+                row_slot[r:r + nd] = s
+                row_pos[r:r + nd] = np.arange(sl.pos + 1,
+                                              sl.pos + 1 + nd)
+                draft_toks[s, :nd] = d[:nd]
+                n_draft[s] = nd
+                self.n_spec_drafted += nd
+                self.flight.record("spec_propose",
+                                   req=str(sl.req.req_id), slot=s,
+                                   k=int(nd), pos=int(sl.pos))
+                budget -= nd
+                r += nd
+        # chunk rows take their reserve plus whatever the drafts left
+        # unspent (T - r is exactly that); a final chunk's chain
+        # position 0 is its last prompt row, sampled with keys[gen=0]
+        advanced, r = self._pack_chunk_rows(
+            filling, row_ids, row_slot, row_pos, first_row, adv_chunk,
+            emit, r, T - r)
+        self._sync_device_state()
+        st, sampled, acc = self._spec_step(
+            self.params, self._build_state(), self._stage(row_ids),
+            self._stage(row_slot), self._stage(row_pos),
+            self._stage(first_row), self._stage(n_draft),
+            self._stage(draft_toks), self._stage(spec),
+            self._stage(emit), self._stage(adv_chunk))
+        self._unpack_state(st)
+        self.n_decode_steps += 1
+        self.n_spec_steps += 1
+        if advanced:
+            self.n_mixed_steps += 1
+        self.occupancy_sum += len(live) / S
+        sampled = np.asarray(sampled)                  # host sync
+        acc = np.asarray(acc)
+        self._note_step_metrics(r, decoded=bool(runnable))
+        if traced:
+            self.tracer.add("decode_step", t_step,
+                            time.perf_counter() - t_step, track="engine",
+                            attrs={"live": len(live),
+                                   "step": self.n_decode_steps,
+                                   "spec": True, "rows": r,
+                                   "decode_rows": len(runnable)})
+        for s in runnable:
+            sl = self.slots[s]
+            a = int(acc[s])
+            nd = int(n_draft[s])
+            self.n_spec_accepted += a
+            self.n_spec_chains += 1
+            if nd:
+                rid = str(sl.req.req_id)
+                if a:
+                    self.flight.record("spec_accept", req=rid, slot=s,
+                                       accepted=a, drafted=nd)
+                if nd > a:
+                    self.flight.record("spec_reject", req=rid, slot=s,
+                                       rejected=nd - a, drafted=nd)
+            # host page rollback BEFORE banking: banking may retire the
+            # slot (eos / max_new), and retire releases every mapping —
+            # while the slot is live, pages past pages_for(pos + a + 1)
+            # hold only rejected-draft garbage
+            self.kv.uncommit_tail(s, sl.pos + a + 1)
+            for i in range(a + 1):
+                self._bank_token(s, int(sampled[s, i]))
+                self.n_spec_tokens += 1
+                if self.slots[s] is None:     # retired mid-chain (eos)
+                    break
+        self._advance_chunks(advanced, lambda s: int(sampled[s, 0]))
         return True
 
     def run(self, requests=()) -> dict:
@@ -1206,6 +1575,7 @@ class ServingEngine:
         is one mixed-step signature; hold it fixed in production."""
         assert all(sl is None for sl in self.slots) and not self.queue, \
             "set_chunking requires an idle engine"
+        self._mst_explicit = max_step_tokens is not None
         if prefill_chunk is None:
             self.prefill_chunk = None
             self.max_step_tokens = 0
@@ -1217,8 +1587,8 @@ class ServingEngine:
                 f"chunking), got {prefill_chunk}")
         prefill_chunk = min(prefill_chunk, self.kv.capacity_tokens)
         S = len(self.slots)
-        mst = (prefill_chunk + S) if max_step_tokens is None \
-            else int(max_step_tokens)
+        mst = self._default_budget(prefill_chunk) \
+            if max_step_tokens is None else int(max_step_tokens)
         if mst <= S:
             raise ValueError(
                 f"max_step_tokens {mst} must exceed num_slots {S}: every "
@@ -1227,6 +1597,55 @@ class ServingEngine:
                 f"progress")
         self.prefill_chunk = prefill_chunk
         self.max_step_tokens = mst
+
+    def _default_budget(self, prefill_chunk: int) -> int:
+        """The defaulted token budget: one chunk of prefill headroom
+        plus a FULL chain per slot — `chunk + S` with speculation off
+        (the classic default), `chunk + S*(spec_k+1)` with it on, so a
+        default deployment's draft depth is never silently throttled to
+        the chunk headroom (the bench pins the same formula)."""
+        return prefill_chunk + len(self.slots) * (
+            int(getattr(self, "spec_k", 0)) + 1)
+
+    def set_speculation(self, spec_k: int, drafter=None) -> None:
+        """Configure speculative decoding (idle engine only — a live
+        chain would straddle the toggle).  `spec_k=0` disables — the
+        baseline side of bench_serving's --spec-k A/B; `spec_k > 0`
+        drafts up to k lookahead tokens per decoding slot per step
+        (serving/drafter.py's prompt-lookup NgramDrafter by default;
+        pass `drafter` for anything with a `.propose(ctx, k)` — a small
+        draft model slots in here).  Emitted tokens are IDENTICAL either
+        way; only steps-per-token changes.  Each distinct (token budget,
+        spec_k) pair is ONE verify-step signature — hold both fixed in
+        production."""
+        assert all(sl is None for sl in self.slots) and not self.queue, \
+            "set_speculation requires an idle engine"
+        spec_k = int(spec_k)
+        if spec_k < 0:
+            raise ValueError(
+                f"spec_k must be >= 0 (0 = speculation off), got {spec_k}")
+        self.spec_k = spec_k
+        if self.prefill_chunk is not None and not self._mst_explicit:
+            # a DEFAULTED budget follows the speculation depth (chunk +
+            # S*(k+1)): otherwise `--spec-k` deployments would silently
+            # throttle draft rows to the chunk headroom, and the banked
+            # bench number would not represent a default deployment.
+            # An explicit budget is the operator's pin — untouched.
+            self.max_step_tokens = self._default_budget(
+                self.prefill_chunk)
+        if drafter is not None:
+            self.drafter = drafter
+        elif self.drafter is None and spec_k > 0:
+            from paddle_tpu.serving.drafter import NgramDrafter
+            self.drafter = NgramDrafter()
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Accepted / drafted over the engine lifetime (0.0 before any
+        draft was scored) — the number PERF.md 'Reading the accept
+        rate' interprets."""
+        return (self.n_spec_accepted / self.n_spec_drafted
+                if self.n_spec_drafted else 0.0)
 
     def set_prefix_cache(self, enabled: bool) -> None:
         """A/B knob (bench_serving --prefix-skew measures the same engine
@@ -1291,6 +1710,7 @@ class ServingEngine:
                        "num_pages": kv.num_pages,
                        "prefill_chunk": self.prefill_chunk,
                        "max_step_tokens": self.max_step_tokens,
+                       "spec_k": self.spec_k,
                        "prefix_cache": self.prefix is not None,
                        "layer_specs": dict(kv.layer_specs)},
             "pools": {name: {p: np.asarray(kv.pools[name][p]).copy()
@@ -1314,7 +1734,8 @@ class ServingEngine:
                 "n_cancelled", "n_expired", "tokens_generated",
                 "occupancy_sum", "n_prefix_hits", "n_prefix_misses",
                 "prefill_tokens_saved", "n_prefill_chunks",
-                "n_mixed_steps")},
+                "n_mixed_steps", "n_spec_steps", "n_spec_chains",
+                "n_spec_drafted", "n_spec_accepted", "n_spec_tokens")},
             "results": {k: np.asarray(v).copy()
                         for k, v in self.results.items()},
             "finish_reasons": dict(self.finish_reasons),
@@ -1333,6 +1754,7 @@ class ServingEngine:
                 "num_pages": self.kv.num_pages,
                 "prefill_chunk": self.prefill_chunk,
                 "max_step_tokens": self.max_step_tokens,
+                "spec_k": self.spec_k,
                 "prefix_cache": self.prefix is not None,
                 "layer_specs": dict(self.kv.layer_specs)}
         if mine != cfg:
@@ -1538,6 +1960,70 @@ class ServingEngine:
                              keys=st.keys, temp=st.temp, topk=st.topk,
                              topp=st.topp)
         return new_st, nxt
+
+    def _spec_impl(self, params, st: EngineState, row_ids, row_slot,
+                   row_pos, first_row, n_draft, draft_toks, spec, emit,
+                   adv_chunk):
+        """THE speculative verify step — one signature per (token
+        budget, spec_k), whatever the chain/chunk row mix: the packed
+        ragged rows run the stack exactly like the mixed step (all K/V
+        scattered before the read, so draft rows see each other
+        causally), then every slot samples its k+1-position CHAIN —
+        position i's logits row is `first_row[s] + i` and its key is
+        `keys[s, gen[s] + i]` (sampler.py pick_next_chain), making
+        sample i bit-equal to the token the sequential engine would
+        emit at generation gen+i given the prefix matched.
+
+        Acceptance on device: `acc[s]` = leading run of draft agreement
+        (`sampled[:, :k] == draft_toks`, masked to the real draft
+        count), and chain slots commit acc+1 tokens — pos/gen advance
+        by it, last-token becomes sampled[s, acc] (the first
+        non-drafted sample: the bonus token on full acceptance, the
+        corrected token on a rejection).  Chunk slots advance by their
+        host-scheduled masks exactly as in the mixed step.  Rejected
+        rows' K/V stays in the pools as causally-invisible garbage the
+        next chain overwrites — the device needs no rollback; the host
+        returns the unjustified page tail (kv.uncommit_tail).
+
+        Returns (state', sampled [S, k+1], acc [S])."""
+        T = row_ids.shape[0]
+        S = st.toks.shape[0]
+        K = draft_toks.shape[1]
+        state = {name: {"k_pages": st.pools[name]["k"],
+                        "v_pages": st.pools[name]["v"],
+                        "page_table": st.table, "row_slot": row_slot,
+                        "row_pos": row_pos}
+                 for name in st.pools}
+        feed = {self.input_name: Argument(
+            ids=row_ids[None, :], lengths=jnp.full((1,), T, jnp.int32))}
+        outputs, _, state_out = self.executor.forward(params, feed, state,
+                                                      TEST, None)
+        logits = outputs[self.logits_name].value[0]    # [T, V]
+        idx = jnp.clip(first_row[:, None] + jnp.arange(K + 1)[None, :],
+                       0, T - 1)
+        chain = logits[idx]                            # [S, K+1, V]
+        g = jnp.clip(st.gen[:, None] + jnp.arange(K + 1)[None, :], 0,
+                     st.keys.shape[1] - 1)
+        keys = st.keys[jnp.arange(S)[:, None], g]      # [S, K+1, 2]
+        sampled = pick_next_chain(chain, keys, st.temp, st.topk,
+                                  st.topp, is_probs=self._probs)
+        ok = jnp.logical_and(sampled[:, :K] == draft_toks,
+                             jnp.arange(K)[None, :] < n_draft[:, None])
+        acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+        n_new = (acc + 1) * spec.astype(jnp.int32)
+        committed = jnp.where(spec, n_new, adv_chunk)
+        gen_adv = jnp.where(spec, n_new, emit.astype(jnp.int32))
+        last = sampled[jnp.arange(S), acc]
+        toks_new = jnp.where(spec, last,
+                             jnp.where(emit, sampled[:, 0], st.toks))
+        new_pools = {name: {"k": state_out[name]["k_pages"],
+                            "v": state_out[name]["v_pages"]}
+                     for name in st.pools}
+        new_st = EngineState(pools=new_pools, table=st.table,
+                             pos=st.pos + committed, toks=toks_new,
+                             gen=st.gen + gen_adv, keys=st.keys,
+                             temp=st.temp, topk=st.topk, topp=st.topp)
+        return new_st, sampled, acc
 
     def _prefill_fn(self, Lb: int):
         """Jitted prompt prefill for bucket length Lb — compiled once per

@@ -201,12 +201,19 @@ class PagedKVCache:
             f"free list held a referenced page {page}"
         return page
 
-    def try_grow(self, slot: int, n_tokens: int) -> bool:
+    def try_grow(self, slot: int, n_tokens: int, evict: bool = True) -> bool:
         """Ensure `slot` has pages covering `n_tokens` tokens, allocating
         from the free list on demand (evicting cached prefixes under
         pressure).  False (and no change beyond pages already grabbed —
         they stay with the slot for the retry) when the pool is genuinely
-        dry: the caller pauses the slot or defers the admission."""
+        dry: the caller pauses the slot or defers the admission.
+
+        `evict=False` takes FREE pages only — the speculative draft-tail
+        growth uses it, because optimistic pages that a rejection hands
+        straight back the same step must never cost a committed cached
+        prefix its retention (a low-accept spec workload would otherwise
+        churn the prefix index to back K/V it immediately discards); the
+        caller shrinks its draft ambition to what is genuinely free."""
         need = self.pages_for(n_tokens)
         assert need <= self.pages_per_slot, \
             f"slot {slot}: {n_tokens} tokens exceed the " \
@@ -214,7 +221,7 @@ class PagedKVCache:
         # ask for the whole shortfall in ONE pressure call (one tree walk),
         # not page-by-page through _alloc_page's single-page fallback
         shortfall = (need - int(self._n_pages[slot])) - len(self._free)
-        if shortfall > 0 and self.on_page_pressure is not None:
+        if shortfall > 0 and evict and self.on_page_pressure is not None:
             if shortfall > self.cached_page_count:
                 # infeasible even after evicting EVERY reclaimable page:
                 # fail fast WITHOUT evicting.  A doomed retry must not
@@ -226,6 +233,8 @@ class PagedKVCache:
                 return False
             self.on_page_pressure(shortfall)
         while self._n_pages[slot] < need:
+            if not self._free and not evict:
+                return False
             page = self._alloc_page()
             if page is None:
                 return False
@@ -295,6 +304,37 @@ class PagedKVCache:
         self.table[slot, :] = 0
         self._n_pages[slot] = 0
         self.version += 1
+
+    def uncommit_tail(self, slot: int, n_tokens: int) -> int:
+        """Release the slot's trailing pages beyond `pages_for(n_tokens)`
+        — the SPECULATIVE-DECODE page rollback: the verify step wrote
+        draft K/V optimistically into pages grown past the slot's
+        committed length, and a rejected suffix leaves those tail pages
+        holding only garbage the causal mask already excludes.  The
+        on-device state needs no cleanup (future writes overwrite the
+        garbage positions before any query can attend them); THIS is the
+        host half — hand the unjustified pages back to the pool so a
+        rejection never inflates occupancy past what preempt/replay
+        would charge.  Tail pages are always PRIVATE (drafts never write
+        shared pages; growth allocates fresh ones) — asserted, since
+        releasing a shared page here would corrupt a cached prefix.
+        Returns the number of pages released."""
+        keep = self.pages_for(n_tokens)
+        freed = 0
+        while int(self._n_pages[slot]) > keep:
+            j = int(self._n_pages[slot]) - 1
+            page = int(self.table[slot, j])
+            assert self.page_writable(page), \
+                f"slot {slot}: uncommit_tail hit shared page {page} at " \
+                f"logical index {j} — draft writes must never target " \
+                f"shared pages"
+            self.table[slot, j] = 0
+            self._n_pages[slot] -= 1
+            self._unref(page)
+            freed += 1
+        if freed:
+            self.version += 1
+        return freed
 
     def reset(self) -> None:
         """Release every slot AND forget all prefix-index retention, then

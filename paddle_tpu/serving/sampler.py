@@ -36,6 +36,33 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def pick_next_chain(last: Array, keys: Array, temperature: Array,
+                    top_k: Array, top_p: Array,
+                    is_probs: bool = False) -> Array:
+    """[S, K, V] chain scores + per-position keys [S, K, 2] + per-slot
+    knobs [S] -> [S, K] int32 — the SPECULATIVE verify step's vectorized
+    accept/resample core.
+
+    Chain position (s, i) holds the logits the target model produced at
+    the slot's generation index gen[s] + i (position 0 = the regular
+    next token, positions 1..k = the drafted lookahead), and samples
+    with the slot's key for THAT index — so entry (s, i) is bit-equal to
+    what `pick_next_per_slot` would return for slot s on the step that
+    reaches generation gen[s] + i.  Acceptance then needs no separate
+    resample: position i's sample IS the exact token the non-speculative
+    engine would emit there (given the prefix matched), so the accepted
+    prefix plus the first mismatching sample reproduce the sequential
+    stream token-for-token.  Rows are independent (the per-row contract
+    of pick_next_per_slot), so flattening [S, K] -> [S*K] changes
+    nothing."""
+    S, K, V = last.shape
+    flat = pick_next_per_slot(
+        last.reshape(S * K, V), keys.reshape(S * K, 2),
+        jnp.repeat(temperature, K), jnp.repeat(top_k, K),
+        jnp.repeat(top_p, K), is_probs=is_probs)
+    return flat.reshape(S, K)
+
+
 def pick_next_per_slot(last: Array, keys: Array, temperature: Array,
                        top_k: Array, top_p: Array,
                        is_probs: bool = False) -> Array:

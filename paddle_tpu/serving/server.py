@@ -222,6 +222,14 @@ class ServingServer:
                  float(eng.n_prefill_chunks)),
                 ("serving_mixed_steps_total", "counter", None,
                  float(eng.n_mixed_steps)),
+                # speculative decoding: drafted/accepted counters + the
+                # lifetime accept rate (the throughput-multiplier dial)
+                ("serving_spec_drafted_total", "counter", None,
+                 float(eng.n_spec_drafted)),
+                ("serving_spec_accepted_total", "counter", None,
+                 float(eng.n_spec_accepted)),
+                ("serving_spec_accept_rate", "gauge", None,
+                 float(eng.spec_accept_rate)),
                 # tensor-parallel sharded decode: shard count + per-device
                 # pool residency (the HBM split sharding exists for)
                 ("serving_tp_shards", "gauge", None, float(eng.tp)),
@@ -574,6 +582,15 @@ class ServingServer:
             "n_preemptions": eng.n_preemptions,
             "n_cancelled": eng.n_cancelled,
             "n_expired": eng.n_expired,
+            "speculation": _safe(lambda: {
+                "spec_k": eng.spec_k,
+                "steps": eng.n_spec_steps,
+                "chains": eng.n_spec_chains,
+                "drafted": eng.n_spec_drafted,
+                "accepted": eng.n_spec_accepted,
+                "tokens": eng.n_spec_tokens,
+                "accept_rate": round(eng.spec_accept_rate, 4),
+            }),
             "prefix_cache": _safe(lambda: {
                 "enabled": eng.prefix is not None,
                 "nodes": eng.prefix.n_nodes if eng.prefix else 0,
@@ -600,6 +617,7 @@ class ServingServer:
             "capacity_tokens": int(self.engine.kv.capacity_tokens),
             "prefix_cache": self.engine.prefix is not None,
             "tp_shards": int(self.engine.tp),
+            "spec_k": int(self.engine.spec_k),
             "wedge_threshold_s": self.wedge_threshold_s,
             "postmortem_dir": self.postmortem_dir,
         }
@@ -805,6 +823,7 @@ class ServingServer:
                 page_size=int(self.engine.kv.page_size),
                 prefix_cache=self.engine.prefix is not None,
                 tp_shards=int(self.engine.tp),
+                spec_k=int(self.engine.spec_k),
                 draining=self._draining))
         elif t == "ping":
             conn.send({"type": "pong"})
@@ -932,6 +951,12 @@ class ServingServer:
             "max_step_tokens": eng.max_step_tokens,
             "prefill_chunks": eng.n_prefill_chunks,
             "mixed_steps": eng.n_mixed_steps,
+            # speculative decoding: the A/B-able knob + the counters the
+            # accept rate reconciles from
+            "spec_k": eng.spec_k,
+            "spec_drafted": eng.n_spec_drafted,
+            "spec_accepted": eng.n_spec_accepted,
+            "spec_accept_rate": round(eng.spec_accept_rate, 4),
             # sharding: model-axis shard count + per-device pool bytes
             "tp_shards": eng.tp,
             "kv_pool_bytes_per_shard": int(eng.kv.pool_bytes_per_shard),

@@ -229,6 +229,41 @@ def test_assemble_lkg_stitches_serving_tp_record(tmp_path):
     assert out["serving_tp"]["sig_stable"] is True
 
 
+def test_assemble_lkg_stitches_serving_spec_record(tmp_path):
+    """ISSUE 12 wiring: the speculative-decoding record
+    (lm_serving_spec_tok_per_sec + the accept rate and the drafted/
+    accepted/emitted reconciliation companions) rides the same
+    per-config queue shape — a top-level BENCH_ONLY=serving_spec record
+    must stitch into the assembled fallback under the `serving_spec`
+    key with the companions intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_spec"] == "lm_serving_spec_tok_per_sec"
+    assert "serving_spec" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-03T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-04T12:00:00+00:00",
+         "record": {"metric": M["serving_spec"], "value": 9120.7,
+                    "lm_serving_spec_accept_rate": 0.62,
+                    "baseline_tok_per_sec": 4100.2,
+                    "speedup_vs_baseline": 2.22,
+                    "drafted": 12000, "accepted": 7440,
+                    "chains": 4210, "spec_tokens": 11650,
+                    "reconcile_ok": True, "sig_stable": True,
+                    "measured_at": "2026-08-04T12:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_spec"]["value"] == 9120.7
+    assert out["serving_spec"]["lm_serving_spec_accept_rate"] == 0.62
+    assert out["serving_spec"]["speedup_vs_baseline"] == 2.22
+    assert out["serving_spec"]["reconcile_ok"] is True
+    assert out["serving_spec"]["sig_stable"] is True
+
+
 def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
     """PR 4 wiring: the serving record's p99 per-token latency companion
     (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the

@@ -76,7 +76,7 @@ def test_hlo_shard_check_decode_has_no_pool_allgather():
         pytest.skip("needs >= 2 devices (conftest provides 8 host devices)")
     out = run_check(model=2, save="")
     assert out["ok"], out["verdict"]
-    for step in ("decode", "mixed"):
+    for step in ("decode", "mixed", "spec"):
         rec = out["steps"][step]
         assert rec["table_all_gathers"] == [], (step, rec)
         assert rec["n_all_gathers"] == 0, \

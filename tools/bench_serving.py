@@ -125,6 +125,33 @@ def make_heavytail_requests(n: int, prompt_lo: int, prompt_hi: int,
     return reqs
 
 
+def make_repetitive_requests(n: int, prompt_lo: int, prompt_hi: int,
+                             max_new: int, vocab: int, seed: int = 0,
+                             motif_lo: int = 4, motif_hi: int = 12,
+                             eos_id: int = -1):
+    """Locally-repetitive prompts — the workload speculative decoding
+    targets: each prompt tiles a short random motif to a mixed length
+    (the structure of templated text, code, and retrieval contexts,
+    where the next tokens often repeat an earlier span).  Greedy decode
+    (spec changes steps-per-token, never the tokens), eos off so every
+    request emits exactly max_new and the drafted/accepted/emitted
+    reconciliation is exact."""
+    import numpy as np
+
+    from paddle_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        m = int(rng.integers(motif_lo, motif_hi + 1))
+        p = int(rng.integers(prompt_lo, prompt_hi + 1))
+        motif = rng.integers(2, vocab, m).astype(np.int32)
+        prompt = np.tile(motif, -(-p // m))[:p]
+        reqs.append(Request(f"s{seed}_{i}", prompt, max_new=max_new,
+                            eos_id=eos_id))
+    return reqs
+
+
 def poisson_arrivals(n: int, rate: float, seed: int = 0):
     """Arrival offsets (seconds from t0): exponential gaps at `rate`
     req/s; rate <= 0 -> everything at t=0 (closed loop)."""
@@ -405,6 +432,82 @@ def measure_chunked(eng, wl: dict, reps: int, seed: int,
         "itl_ms_p50": c_itl[0], "itl_ms_p99": c_itl[1],
         "p99_itl_improved": c_itl[1] < b_itl[1],
         "p99_first_tok_improved": c_ft[1] < b_ft[1],
+    }
+
+
+def measure_spec(eng, wl: dict, reps: int, seed: int, spec_k: int) -> dict:
+    """Speculative-decoding A/B on ONE engine: the identical
+    locally-repetitive workload (fresh Request objects each pass, same
+    seeds) with speculation OFF (the sequential baseline) then ON at
+    `--spec-k` via set_speculation — emitted tokens are identical by
+    construction (tests/test_spec_decode.py's oracle), so the ONLY
+    deltas are steps-per-token and wall time.  Closed loop: spec's win
+    is raw decode throughput, arrival jitter would only blur it.
+
+    The token budget is pinned ONCE before both arms (chunk + one full
+    chain per slot) so the signature sets stay fixed across the A/B.
+    Reports tok/s both sides, the accept rate, the raw drafted/accepted
+    counters, compiled steps both sides, and `reconcile_ok` — the
+    counters must reconcile exactly to tokens emitted: with eos off no
+    chain ever truncates, so every chain banks its accepted drafts plus
+    one sampled token — `spec_tokens == accepted + chains` — and both
+    arms emit the identical n * max_new total."""
+    import numpy as np
+
+    def sets():
+        return [make_repetitive_requests(seed=seed + 1 + r, **wl)
+                for r in range(reps)]
+
+    S = len(eng.slots)
+    if eng.prefill_chunk is not None:
+        eng.set_chunking(eng.prefill_chunk,
+                         eng.prefill_chunk + S * (spec_k + 1))
+    eng.set_speculation(0)
+    warm_workload(eng, [make_repetitive_requests(seed=seed, **wl)]
+                  + sets())
+    base_vals, base_steps = [], 0
+    for reqs in sets():
+        rec = run_workload(eng, reqs)
+        base_vals.append(rec["tokens"] / rec["seconds"])
+        base_steps += rec["decode_steps"]
+
+    eng.set_speculation(spec_k)
+    eng.run(make_repetitive_requests(seed=seed, **wl))  # verify-sig warm
+    decode_sigs = eng._decode_step._cache_size()
+    spec_sigs = eng._spec_step._cache_size()
+    d0, a0 = eng.n_spec_drafted, eng.n_spec_accepted
+    c0, t0 = eng.n_spec_chains, eng.n_spec_tokens
+    vals, toks, steps = [], 0, 0
+    for reqs in sets():
+        rec = run_workload(eng, reqs)
+        vals.append(rec["tokens"] / rec["seconds"])
+        toks += rec["tokens"]
+        steps += rec["decode_steps"]
+    eng.kv.check()
+    drafted = eng.n_spec_drafted - d0
+    accepted = eng.n_spec_accepted - a0
+    chains = eng.n_spec_chains - c0
+    spec_tokens = eng.n_spec_tokens - t0
+    base_med, spec_med = float(np.median(base_vals)), float(np.median(vals))
+    return {
+        "sig_stable": (eng._decode_step._cache_size() == decode_sigs
+                       and eng._spec_step._cache_size() == spec_sigs
+                       and spec_sigs == 1),
+        "spec_k": int(spec_k),
+        "max_step_tokens": int(eng.max_step_tokens),
+        "baseline_tok_per_sec": base_med,
+        "spec_tok_per_sec": spec_med,
+        "speedup_vs_baseline": spec_med / base_med if base_med else 0.0,
+        "accept_rate": accepted / drafted if drafted else 0.0,
+        "drafted": int(drafted),
+        "accepted": int(accepted),
+        "chains": int(chains),
+        "spec_tokens": int(spec_tokens),
+        "tokens": int(toks),
+        "baseline_decode_steps": int(base_steps),
+        "spec_decode_steps": int(steps),
+        "reconcile_ok": (spec_tokens == accepted + chains
+                         and toks == reps * wl["n"] * wl["max_new"]),
     }
 
 
@@ -793,6 +896,14 @@ def main() -> int:
                     help="run the 1-vs-N-shard A/B: tokens/s + KV pool "
                          "bytes per shard, single-device engine vs "
                          "attention-head/KV-pool sharding over N devices")
+    # speculative decoding A/B (docs/serving.md "Speculative decoding"):
+    # spec-off vs spec-on at k on ONE engine, locally-repetitive prompts
+    ap.add_argument("--spec-k", type=int, default=0, metavar="K",
+                    help="run the speculative-decoding A/B: the same "
+                         "locally-repetitive workload with speculation "
+                         "off then on at K drafts/slot/step (reports "
+                         "tok/s both arms, accept rate, drafted/"
+                         "accepted counters reconciled to tokens)")
     args = ap.parse_args()
 
     import numpy as np
@@ -839,6 +950,29 @@ def main() -> int:
                 "router_retries", "ok", "failures")},
         }), flush=True)
         return 0 if m["ok"] else 1
+
+    if args.spec_k > 0:
+        eng = build_engine(args)
+        hi = min(args.prompt_hi, args.max_context - args.max_new - 1)
+        wl = dict(n=args.num_requests, prompt_lo=args.prompt_lo,
+                  prompt_hi=hi, max_new=args.max_new, vocab=args.vocab)
+        m = measure_spec(eng, wl, args.reps, args.seed, args.spec_k)
+        print(json.dumps({
+            "bench": "serving_spec",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prompt_lens": [args.prompt_lo, hi], "max_new": args.max_new,
+            "dim": args.dim, "layers": args.layers, "dtype": args.dtype,
+            "reps": args.reps,
+            "lm_serving_spec_tok_per_sec": round(m["spec_tok_per_sec"], 1),
+            "lm_serving_spec_accept_rate": round(m["accept_rate"], 4),
+            **{k: m[k] for k in (
+                "spec_k", "max_step_tokens", "baseline_tok_per_sec",
+                "speedup_vs_baseline", "drafted", "accepted", "chains",
+                "spec_tokens", "tokens", "baseline_decode_steps",
+                "spec_decode_steps", "reconcile_ok", "sig_stable")},
+        }), flush=True)
+        return 0 if m["sig_stable"] and m["reconcile_ok"] else 1
 
     eng = build_engine(args)
     if args.prompt_dist == "heavy-tail":

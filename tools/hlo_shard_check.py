@@ -12,10 +12,14 @@ forfeit both the HBM win (a model bigger than one chip) and the FLOPs win
 
 This tool compiles the REAL engine's decode AND mixed steps over an
 N-device mesh, inventories every collective in the optimized HLO, flags
-any all-gather whose shape+gather-dim matches a KV pool (kv-head axis) or
-an attention projection (its sharded axis) — the same shape-anchored
-detector hlo_sparse_check uses — and prints a JSON verdict.  Run under
-the virtual CPU mesh (the SPMD partitioning decision is backend-agnostic):
+any all-gather whose shape+gather-dim matches a KV pool (kv-head axis),
+an attention projection, a Megatron-split FFN weight, or the row-sharded
+LM head (each on its sharded axis) — the same shape-anchored detector
+hlo_sparse_check uses — and prints a JSON verdict.  The expected
+all-reduce count is derived from what the engine actually sharded: one
+per attention layer (w_o row split) + one per FFN pair (down-projection
+row split) + one for the LM head's partial logits.  Run under the
+virtual CPU mesh (the SPMD partitioning decision is backend-agnostic):
 
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python tools/hlo_shard_check.py [--model 2] [--save PATH.hlo]
@@ -71,24 +75,36 @@ def run_check(model: int = 2, config_args: str = "vocab=61,dim=32,"
     cfg = parse_config("demo/model_zoo/transformer_lm.py", config_args)
     tr = Trainer(cfg, seed=1)
     eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
-                        max_context=64, mesh=model_mesh(model))
+                        max_context=64, spec_k=2, mesh=model_mesh(model))
 
     # the shapes the tool is anchored to: every KV pool sharded on its
-    # kv-head axis (2), every attention projection on its sharded axis
+    # kv-head axis (2), every attention projection on its sharded axis,
+    # the Megatron FFN pairs (up-projection column 1, down-projection
+    # row 0), and the row-sharded LM head — reassembling ANY of them on
+    # every chip would forfeit the sharding's HBM/FLOPs split
     tables = []
     pool_shapes = {}
     for name, pool in eng.kv.pools.items():
         pool_shapes[name] = list(pool["k"].shape)
         tables.append((tuple(pool["k"].shape), 2))
     params_sharded = {}
+
+    def _anchor(pn: str, axis: int) -> None:
+        tables.append((tuple(eng.params[pn].shape), axis))
+        params_sharded[pn] = {"shape": list(eng.params[pn].shape),
+                              "sharded_axis": axis}
+
     for l in tr.executor.model.layers:
         if l.type != "multi_head_attention":
             continue
         names = [l.inputs[i].input_parameter_name for i in range(4)]
         for pn, axis in zip(names, (1, 1, 1, 0)):       # wq wk wv | wo
-            tables.append((tuple(eng.params[pn].shape), axis))
-            params_sharded[pn] = {"shape": list(eng.params[pn].shape),
-                                  "sharded_axis": axis}
+            _anchor(pn, axis)
+    for w1, w2 in eng._tp_ffn_pairs:                    # ffn up | down
+        _anchor(w1, 1)
+        _anchor(w2, 0)
+    if eng._tp_lm_head:
+        _anchor(eng._tp_lm_head, 0)                     # vocab projection
 
     # drive one real request so both compiled paths exist with live state,
     # then lower them exactly as the pump dispatches them
@@ -111,12 +127,33 @@ def run_check(model: int = 2, config_args: str = "vocab=61,dim=32,"
         eng._stage(np.zeros(S, np.int32)),
         eng._stage(np.zeros(S, np.int32)),
         eng._stage(np.zeros(S, bool))).compile().as_text()
+    # the speculative VERIFY step is a third sharded program — the one
+    # nearly every dispatch runs when --spec-k is on, so its layout
+    # discipline needs the same proof as decode/mixed (the chain gather
+    # over replicated logits must not tempt GSPMD into anything new)
+    hlo_spec = eng._spec_step.lower(
+        eng.params, eng._build_state(), eng._stage(z),
+        eng._stage(np.full(T, S, np.int32)), eng._stage(z),
+        eng._stage(np.zeros(S, np.int32)),
+        eng._stage(np.zeros(S, np.int32)),
+        eng._stage(np.zeros((S, eng.spec_k), np.int32)),
+        eng._stage(np.zeros(S, bool)), eng._stage(np.zeros(S, bool)),
+        eng._stage(np.zeros(S, np.int32))).compile().as_text()
 
-    n_attn = len(eng.kv.pools)
+    # the ONLY acceptable collectives: one post-attention all-reduce per
+    # attention layer (Megatron w_o row split), one per sharded FFN pair
+    # (down-projection row split), and one for the row-sharded LM head's
+    # partial logits — derived from what the engine ACTUALLY sharded, so
+    # a divisibility skip can never desynchronize tool and engine
+    n_expected = (len(eng.kv.pools) + len(eng._tp_ffn_pairs)
+                  + (1 if eng._tp_lm_head else 0))
     out = {"mesh": {"model": model}, "pool_shapes": pool_shapes,
-           "sharded_params": params_sharded, "steps": {}}
+           "sharded_params": params_sharded,
+           "ffn_pairs_sharded": len(eng._tp_ffn_pairs),
+           "lm_head_sharded": bool(eng._tp_lm_head), "steps": {}}
     bad = []
-    for step, hlo in (("decode", hlo_decode), ("mixed", hlo_mixed)):
+    for step, hlo in (("decode", hlo_decode), ("mixed", hlo_mixed),
+                      ("spec", hlo_spec)):
         colls, gathers, reduces = _collectives(hlo)
         table_gathers = [ln[:200] for ln in gathers
                         if gather_spans_table(ln, tables)]
@@ -125,12 +162,12 @@ def run_check(model: int = 2, config_args: str = "vocab=61,dim=32,"
             "collectives": colls,
             "n_all_gathers": len(gathers),
             "n_all_reduces": len(reduces),
-            "expected_all_reduces": n_attn,
+            "expected_all_reduces": n_expected,
             "table_all_gathers": table_gathers,
         }
         if save:
             path = save if step == "decode" else \
-                re.sub(r"(\.[^.]*)?$", r".mixed\1", save, count=1)
+                re.sub(r"(\.[^.]*)?$", rf".{step}\1", save, count=1)
             try:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
                 with open(path, "w") as f:

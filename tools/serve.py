@@ -112,12 +112,17 @@ def build_engine(args):
             print(f"sharded decode: model={int(num)} "
                   f"(attention heads + KV pools partitioned)",
                   file=sys.stderr)
+    if args.spec_k > 0:
+        print(f"speculative decoding: up to {args.spec_k} drafts/slot/"
+              f"step (prompt-lookup drafter; emitted tokens unchanged)",
+              file=sys.stderr)
     return ServingEngine(tr.executor, tr.params, num_slots=args.slots,
                          page_size=args.page_size,
                          max_context=args.max_context,
                          num_pages=args.num_pages,
                          prefill_chunk=chunk,
                          max_step_tokens=args.max_step_tokens or None,
+                         spec_k=args.spec_k,
                          mesh=mesh)
 
 
@@ -205,6 +210,12 @@ def main(argv=None) -> int:
                          "shard attention heads + KV pools over the first "
                          "N devices — one replica serves a model bigger "
                          "than a chip (docs/serving.md 'Sharded decode')")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding: up to K drafted tokens "
+                         "per decoding slot per step, verified exactly "
+                         "in one ragged dispatch (0 = off; emitted "
+                         "tokens are identical either way — "
+                         "docs/serving.md 'Speculative decoding')")
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")

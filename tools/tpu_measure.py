@@ -79,21 +79,71 @@ def run_step(name: str, argv: list[str], timeout_s: float,
 
 _REHEARSE = False   # --rehearse: CPU dry-run of the whole queue (tiny shapes)
 
+#: the backend-init probe every preflight runs — one import + device list,
+#: the exact call a wedged axon tunnel blocks forever
+_PROBE_CODE = ("import jax; d = jax.devices()[0]; "
+               "print('HEALTH', d.platform, d.device_kind)")
+
+
+def _probe_backend(timeout_s: float, code: str = _PROBE_CODE) -> dict:
+    """Run the backend-init probe in its OWN process group under a HARD
+    timeout, SIGKILLing the whole group on expiry.  subprocess.run's
+    timeout kills only the direct child — a wedged jax init can leave a
+    helper process holding the pipe, so the post-kill communicate()
+    blocks forever and the 'health check' itself wedges the queue (the
+    r04/r05 degraded-window shape).  Returns {ok, detail}."""
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = ""
+        return {"ok": False,
+                "detail": f"backend init hung > {timeout_s:.0f}s "
+                          f"(SIGKILLed probe group)"}
+    ok = "HEALTH tpu" in (out or "") or \
+        (_REHEARSE and "HEALTH cpu" in (out or ""))
+    return {"ok": ok, "detail": (out or "")[-200:].strip()}
+
+
+def stamp_degraded(reason: str) -> str:
+    """Mark THIS measurement window degraded — an atomic `window.json`
+    under OUT carrying the reason and timestamp, written the moment the
+    preflight (or a mid-queue health recheck) finds the backend
+    unusable.  The driver and the next session read it instead of
+    inferring a dead window from a pile of per-step timeouts, and the
+    queue stops burning its remaining steps' timeouts against a wedged
+    backend — the fast-fail half of the PERF.md wedge-avoidance
+    design."""
+    os.makedirs(OUT, exist_ok=True)
+    path = os.path.join(OUT, "window.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"degraded": True, "reason": reason,
+                   "ts": datetime.datetime.now(datetime.timezone.utc)
+                   .isoformat(timespec="seconds")}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    print(json.dumps({"window": "degraded", "reason": reason,
+                      "stamp": path}), flush=True)
+    return path
+
 
 def health(timeout_s: float = 90) -> bool:
-    code = ("import jax; d = jax.devices()[0]; "
-            "print('HEALTH', d.platform, d.device_kind)")
-    try:
-        p = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
-                           capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return False
-    ok = "HEALTH tpu" in (p.stdout or "") or \
-        (_REHEARSE and "HEALTH cpu" in (p.stdout or ""))
-    print(json.dumps({"step": "health", "ok": ok,
-                      "detail": (p.stdout or p.stderr or "")[-200:].strip()}),
-          flush=True)
-    return ok
+    r = _probe_backend(timeout_s)
+    print(json.dumps({"step": "health", "ok": r["ok"],
+                      "detail": r["detail"]}), flush=True)
+    return r["ok"]
 
 
 # ---------------------------------------------------------------------------
@@ -193,6 +243,7 @@ _REHEARSE_ENV = {
     "BENCH_SERVE_PREFIX_POOL": "2", "BENCH_SERVE_PREFIX_LEN": "16",
     "BENCH_SERVE_SUFFIX_LO": "3", "BENCH_SERVE_SUFFIX_HI": "8",
     "BENCH_SERVE_FLEET": "2", "BENCH_SERVE_FLEET_CONC": "2",
+    "BENCH_SERVE_SPEC_K": "3",
 }
 
 
@@ -217,8 +268,18 @@ def main() -> int:
         os.environ["BENCH_PERF_LOG"] = os.path.join(OUT, "PERF_LOG.jsonl")
         os.makedirs(OUT, exist_ok=True)
     if not health():
+        # PREFLIGHT: the probe just proved backend init hangs or fails —
+        # stamp the window degraded NOW and exit fast, instead of
+        # spawning bench children that would each burn a full hard
+        # timeout against the same wedged backend (the r04/r05 cause)
+        stamp_degraded("preflight: backend init probe failed or hung")
         print(json.dumps({"fatal": "TPU not healthy; nothing run"}))
         return 1
+    try:
+        # a healthy preflight supersedes any stale degraded stamp
+        os.remove(os.path.join(OUT, "window.json"))
+    except OSError:
+        pass
 
     py = sys.executable
     fh = fresh_hours
@@ -280,6 +341,13 @@ def main() -> int:
                            "--vocab", "64", "--dim", "32",
                            "--layers", "1", "--heads", "2",
                            "--dtype", "float32", "--reps", "1"]
+        serving_spec_args = ["--spec-k", "3", "--num-requests", "6",
+                             "--slots", "2", "--page-size", "8",
+                             "--max-context", "48", "--prompt-lo", "6",
+                             "--prompt-hi", "16", "--max-new", "8",
+                             "--vocab", "64", "--dim", "32",
+                             "--layers", "1", "--heads", "2",
+                             "--dtype", "float32", "--reps", "1"]
         # the CPU rehearse has one host device by default — the sharded
         # arm needs a virtual 2-device mesh (harmless on real TPU steps,
         # which never see this env)
@@ -312,6 +380,9 @@ def main() -> int:
         # tensor-parallel A/B: needs >= 2 real chips; a 1-chip tunnel
         # records the actionable device-count error instead of wedging
         serving_tp_args = ["--mesh-model", "2"]
+        # speculative-decoding A/B at TPU size: spec-off vs spec-on k=4
+        # on the locally-repetitive workload (defaults)
+        serving_spec_args = ["--spec-k", "4"]
         tp_env = {}
         rnn_args = []
         additive_args = []
@@ -374,6 +445,12 @@ def main() -> int:
         ("bench_serving_tp_record", [py, "bench.py"], 900,
          bench_env("serving_tp", 840, tp_env),
          lambda: _metric_fresh(_METRIC_OF["serving_tp"], fh)),
+        # speculative-decoding record (spec-on tokens/s + accept rate +
+        # the drafted/accepted/emitted reconciliation): another two-arm
+        # A/B on one engine, same budget as the other serving A/Bs
+        ("bench_serving_spec_record", [py, "bench.py"], 900,
+         bench_env("serving_spec", 840),
+         lambda: _metric_fresh(_METRIC_OF["serving_spec"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},
@@ -418,6 +495,11 @@ def main() -> int:
         ("bench_serving_tp",
          [py, "tools/bench_serving.py"] + serving_tp_args, 1200, tp_env,
          lambda: _out_fresh("bench_serving_tp", fh)),
+        # speculative-decoding sweep: the full-size spec-off/on A/B with
+        # the per-arm step counts and counter reconciliation banked
+        ("bench_serving_spec",
+         [py, "tools/bench_serving.py"] + serving_spec_args, 1200, {},
+         lambda: _out_fresh("bench_serving_spec", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
@@ -463,9 +545,11 @@ def main() -> int:
             continue
         ok = run_step(name, argv, to, env)
         if not ok and not health(90):
-            # a failed step + dead tunnel: stop burning the remaining
-            # steps' timeouts against a wedged backend (everything
-            # measured so far is already persisted under MEASURE/)
+            # a failed step + dead tunnel: stamp the window degraded and
+            # stop burning the remaining steps' timeouts against a
+            # wedged backend (everything measured so far is already
+            # persisted under MEASURE/)
+            stamp_degraded(f"tunnel died during step {name!r}")
             print(json.dumps({"fatal": f"tunnel died during {name}"}))
             return 1
     return 0
