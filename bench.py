@@ -661,6 +661,8 @@ def bench_serving_fleet(dtype: str) -> dict:
         max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "64")),
         fleet=int(os.environ.get("BENCH_SERVE_FLEET", "2")),
         concurrency=int(os.environ.get("BENCH_SERVE_FLEET_CONC", "8")),
+        trace_overhead=os.environ.get("BENCH_SERVE_FLEET_TRACE",
+                                      "1") != "0",
         seed=0, dtype=dtype)
     m = measure_fleet(args)
     return {
@@ -673,12 +675,22 @@ def bench_serving_fleet(dtype: str) -> dict:
                   f"slots={args.slots} page={args.page_size} "
                   f"pool={args.prefix_pool} prefix={args.prefix_len} "
                   f"reqs={args.num_requests} max_new={args.max_new}",
+        # tok/s cost of the FULL fleet tracing stack (router ingress/
+        # place/relay spans + replica tracing, flipped LIVE over the
+        # trace RPC on the SAME fleet, interleaved off/on cycles)
+        # through the router path — the single-engine
+        # lm_serving_trace_overhead_pct's fleet sibling, same <= 2%
+        # budget; read it against the spread (negative / within
+        # spread = noise)
+        "lm_serving_fleet_trace_overhead_pct": m["trace_overhead_pct"],
         **{k: m[k] for k in (
             "single_tok_per_sec", "random_tok_per_sec",
             "speedup_vs_single", "hit_rate_affinity", "hit_rate_random",
             "hit_rate_single", "affinity_hit_gt_random",
             "first_tok_ms_p50", "random_first_tok_ms_p50",
-            "router_sheds", "router_retries", "ok", "failures")},
+            "router_sheds", "router_retries", "trace_off_tok_per_sec",
+            "trace_on_tok_per_sec", "trace_overhead_spread_pct",
+            "ok", "failures")},
     }
 
 

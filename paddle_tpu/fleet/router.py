@@ -70,7 +70,8 @@ from paddle_tpu.fleet.policy import PlacementPolicy
 from paddle_tpu.fleet.replica import Replica, ReplicaTable
 from paddle_tpu.obs import MetricsRegistry, tracer_collector
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
-from paddle_tpu.obs.trace import get_tracer
+from paddle_tpu.obs.trace import (get_tracer, new_span_id, new_trace_id,
+                                  process_info)
 from paddle_tpu.serving import wire
 
 
@@ -85,7 +86,8 @@ class _RoutedReq:
     """One accepted generate, across however many placements it takes."""
 
     __slots__ = ("conn", "cid", "msg", "grid", "rid", "stream", "streamed",
-                 "retries", "t_submit")
+                 "retries", "t_submit", "trace_id", "span_id",
+                 "client_parent", "t0")
 
     def __init__(self, conn, cid, msg, grid):
         self.conn = conn
@@ -97,6 +99,19 @@ class _RoutedReq:
         self.streamed = 0              # token frames the CLIENT has seen
         self.retries = 0
         self.t_submit = time.monotonic()
+        # distributed-trace identity, stamped at ingress: one trace_id per
+        # request (adopted from the client's frame when it sent one), and
+        # the router's ingress span id — the `parent` every router-side
+        # span AND the replica's lifecycle spans point back at
+        tc = msg.get("trace") if isinstance(msg.get("trace"), dict) else {}
+        self.trace_id = tc.get("trace_id") if \
+            isinstance(tc.get("trace_id"), str) else new_trace_id()
+        # a tracing CLIENT's own span id: the ingress span parents on it,
+        # so the client's span stitches above the router's in a merge
+        self.client_parent = tc.get("parent") if \
+            isinstance(tc.get("parent"), str) else None
+        self.span_id = new_span_id()
+        self.t0 = time.perf_counter()  # ingress-span base (tracer timebase)
 
 
 class _Backend:
@@ -110,7 +125,14 @@ class _Backend:
         self.dead = False
         self.expected_down = False     # intentional close (leave/shutdown):
         self._task = None              # skip the death-handling path
-        self._stats_fut: Optional[asyncio.Future] = None
+        # one outstanding router-originated RPC per REPLY TYPE (stats/
+        # metrics/trace carry no ids the replica echoes back usefully on
+        # a multiplexed backend connection, so the reply type IS the
+        # correlation key); one lock PER TYPE — a slow metrics/trace
+        # collection must never hold up the heartbeat stats poll, whose
+        # cadence is the dead-replica detector
+        self._rpc_futs: dict[str, asyncio.Future] = {}
+        self._rpc_locks: dict[str, asyncio.Lock] = {}
 
     async def connect(self, timeout_s: float = 20.0) -> dict:
         """Open + hello handshake; returns the replica's hello reply.
@@ -153,20 +175,32 @@ class _Backend:
             self.dead = True
             return False
 
+    async def rpc(self, msg: dict, reply_type: str,
+                  timeout_s: float) -> Optional[dict]:
+        """One router-originated round trip correlated by reply type
+        (stats poll, metrics aggregation, trace collection).  Returns
+        None on a dead connection or timeout — callers treat that as
+        'replica did not answer', never an error."""
+        lock = self._rpc_locks.get(reply_type)
+        if lock is None:
+            lock = self._rpc_locks[reply_type] = asyncio.Lock()
+        async with lock:
+            fut = asyncio.get_running_loop().create_future()
+            self._rpc_futs[reply_type] = fut
+            if not self.send(msg):
+                return None
+            try:
+                return await asyncio.wait_for(fut, timeout_s)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                return None
+            finally:
+                if self._rpc_futs.get(reply_type) is fut:
+                    del self._rpc_futs[reply_type]
+
     async def poll_stats(self, timeout_s: float) -> Optional[dict]:
-        """One stale-ok stats round trip (stats frames carry no id, so
-        exactly one may be outstanding — the caller serializes)."""
-        fut = asyncio.get_running_loop().create_future()
-        self._stats_fut = fut
-        if not self.send({"type": "stats", "stale_ok": True}):
-            return None
-        try:
-            return await asyncio.wait_for(fut, timeout_s)
-        except (asyncio.TimeoutError, asyncio.CancelledError):
-            return None
-        finally:
-            if self._stats_fut is fut:
-                self._stats_fut = None
+        """One stale-ok stats round trip (the heartbeat probe)."""
+        return await self.rpc({"type": "stats", "stale_ok": True},
+                              "stats", timeout_s)
 
     async def _read_loop(self) -> None:
         try:
@@ -179,8 +213,9 @@ class _Backend:
             pass
         finally:
             self.dead = True
-            if self._stats_fut is not None and not self._stats_fut.done():
-                self._stats_fut.set_result(None)
+            for fut in list(self._rpc_futs.values()):
+                if not fut.done():
+                    fut.set_result(None)
             if not self.expected_down:
                 self.router._backend_lost(self.replica, self)
 
@@ -223,9 +258,17 @@ class FleetRouter:
                  heartbeat_misses: int = 10,
                  wedge_age_s: float = 30.0,
                  retry_limit: int = 2,
-                 postmortem_dir: Optional[str] = None):
+                 postmortem_dir: Optional[str] = None,
+                 tracer=None):
         self.host = host
         self.port = port
+        # router-side distributed tracing: every router action for a
+        # traced request (ingress, placement, token relay, retry, shed)
+        # records on this ring carrying the request's trace_id, so a
+        # merged trace threads client -> router -> replica.  Off by
+        # default like every tracer; `tracer=` gives an in-process
+        # embedder (tests, bench) a private ring.
+        self.tracer = tracer if tracer is not None else get_tracer()
         self._initial = [(h, int(p)) for h, p in replicas]
         self.table = ReplicaTable()
         self.policy = PlacementPolicy(policy, window=0,
@@ -246,6 +289,7 @@ class FleetRouter:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._poll_task = None
+        self._dump_task = None        # in-flight fleet_unhealthy dump
         self._idle: Optional[asyncio.Event] = None
         self._closed: Optional[asyncio.Event] = None
         self._bg_thread: Optional[threading.Thread] = None
@@ -277,7 +321,7 @@ class FleetRouter:
             lambda: float(len(self.policy.index)))
         reg.gauge("fleet_draining").set_fn(
             lambda: 1.0 if self._draining else 0.0)
-        reg.register_collector(tracer_collector(get_tracer()))
+        reg.register_collector(tracer_collector(self.tracer))
         reg.register_collector(flight_collector(self.flight))
 
     # -- lifecycle ---------------------------------------------------------
@@ -329,6 +373,15 @@ class FleetRouter:
         if self._poll_task is not None:
             self._poll_task.cancel()
             self._poll_task = None
+        if self._dump_task is not None and not self._dump_task.done():
+            # a fleet_unhealthy dump in flight (it pulls replica traces
+            # asynchronously) must commit before the loop dies — losing
+            # the black box to the shutdown race would defeat it
+            try:
+                await asyncio.wait_for(self._dump_task, 10.0)
+            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                pass
+        self._dump_task = None
         for r in list(self.table):
             if r.backend is not None:
                 r.backend.close(expected=True)
@@ -511,7 +564,9 @@ class FleetRouter:
         """Freeze ONE postmortem bundle per total-fleet-unhealthy episode
         (zero healthy replicas while any are registered) — the black-box
         moment for the fleet tier, mirroring the replica server's
-        pump-death dump."""
+        pump-death dump.  The dump itself runs as a task so it can first
+        pull span snapshots from the still-connected (wedged/draining)
+        replicas — a fleet_unhealthy bundle is cross-process."""
         counts = self.table.counts()
         if counts[rep.HEALTHY] > 0 or not self.table.ever_registered:
             return
@@ -520,9 +575,57 @@ class FleetRouter:
         self._unhealthy_dumped = True
         self.flight.record("fleet_unhealthy", counts=counts,
                            inflight=len(self._routes))
-        self._write_bundle("fleet_unhealthy",
-                           error=f"no healthy replicas "
-                                 f"({len(self.table)} registered: {counts})")
+        err = (f"no healthy replicas "
+               f"({len(self.table)} registered: {counts})")
+        if self._loop is not None and self._loop.is_running():
+            self._dump_task = self._loop.create_task(
+                self._dump_unhealthy(err))
+        else:
+            self._write_bundle("fleet_unhealthy", error=err)
+
+    async def _dump_unhealthy(self, error: str) -> None:
+        self._write_bundle("fleet_unhealthy", error=error,
+                           replica_traces=await
+                           self._collect_replica_traces())
+
+    async def _collect_replica_traces(self, timeout_s: float = 2.0) -> dict:
+        """Span-ring snapshots from every replica whose backend
+        connection still answers (a BROKEN replica's loop thread does —
+        the trace RPC is loop-side like stats stale_ok; a dead one is
+        skipped).  Keyed by rid; embedded in the bundle's engine.json."""
+        targets = [r for r in self.table
+                   if r.backend is not None and not r.backend.dead]
+        if not targets:
+            return {}
+        replies = await asyncio.gather(
+            *[r.backend.rpc({"type": "trace"}, "trace", timeout_s)
+              for r in targets])
+        out = {}
+        for r, msg in zip(targets, replies):
+            if isinstance(msg, dict):
+                out[r.rid] = {"process": msg.get("process"),
+                              "recorded": msg.get("recorded"),
+                              "dropped": msg.get("dropped"),
+                              "spans": msg.get("spans") or []}
+        return out
+
+    async def _aggregate_metrics(self) -> tuple[str, int]:
+        """The router's render + each answering replica's metrics frame,
+        merged into one Prometheus text with replica samples labeled
+        `replica="rN"` (families regrouped so HELP/TYPE render once even
+        for names both tiers emit, e.g. the tracer/flight accounting)."""
+        targets = [r for r in self.table
+                   if r.backend is not None and not r.backend.dead]
+        replies = await asyncio.gather(
+            *[r.backend.rpc({"type": "metrics"}, "metrics", 5.0)
+              for r in targets]) if targets else []
+        parts = [(None, self.metrics.render())]
+        answered = 0
+        for r, msg in zip(targets, replies):
+            if isinstance(msg, dict) and isinstance(msg.get("text"), str):
+                answered += 1
+                parts.append((r.rid, msg["text"]))
+        return _merge_prometheus(parts), answered
 
     # -- postmortem --------------------------------------------------------
     def _router_snapshot(self) -> dict:
@@ -550,15 +653,22 @@ class FleetRouter:
             "postmortem_dir": self.postmortem_dir,
         }
 
-    def _write_bundle(self, reason: str,
-                      error: Optional[str] = None) -> Optional[str]:
+    def _write_bundle(self, reason: str, error: Optional[str] = None,
+                      replica_traces: Optional[dict] = None
+                      ) -> Optional[str]:
         if not self.postmortem_dir:
             return None
         try:
+            engine = self._router_snapshot()
+            if replica_traces:
+                # per-replica span snapshots (pulled over the trace RPC
+                # just before this dump), tagged with process identity:
+                # the fleet bundle holds every tier's view of the episode
+                engine["replica_traces"] = replica_traces
             path = self.flight.dump(
                 self.postmortem_dir, reason,
-                spans=get_tracer().snapshot(),
-                engine=self._router_snapshot(),
+                spans=self.tracer.snapshot(),
+                engine=engine,
                 metrics=self.metrics.snapshot(),
                 config=self._config_snapshot(),
                 error=error)
@@ -575,8 +685,8 @@ class FleetRouter:
     def _on_backend_frame(self, r: Replica, backend: _Backend,
                           msg: dict) -> None:
         t = msg.get("type")
-        if t == "stats":
-            fut = backend._stats_fut
+        if t in ("stats", "metrics", "trace"):
+            fut = backend._rpc_futs.get(t)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
             return
@@ -595,6 +705,18 @@ class FleetRouter:
             # it forwards per-token — but only st.stream clients receive)
             if st.stream:
                 st.streamed += 1
+                if self.tracer.enabled and st.streamed == 1:
+                    # FIRST-token relay only: the router-side TTFT stitch
+                    # point.  A marker per token here would put python
+                    # dict+ring work on the loop thread's per-token
+                    # critical path (measured ~3-5% tok/s at CPU rates,
+                    # blowing the <= 2% tracing budget); the per-token
+                    # cadence is already on the replica's engine lane,
+                    # and the ingress span carries the relayed count.
+                    self.tracer.instant(
+                        "relay", track=f"req:{st.trace_id[:12]}",
+                        index=msg.get("index"), trace_id=st.trace_id,
+                        parent=st.span_id)
                 st.conn.send({"type": "token", "id": st.cid,
                               "token": msg.get("token"),
                               "index": msg.get("index")})
@@ -602,7 +724,8 @@ class FleetRouter:
             r.pending.discard(grid)
             self._finish(st, {"type": "done", "id": st.cid,
                               "tokens": msg.get("tokens"),
-                              "reason": msg.get("reason")})
+                              "reason": msg.get("reason"),
+                              "timing": self._merge_timing(st, msg)})
         elif t == "error":
             r.pending.discard(grid)
             self._finish(st, {"type": "error", "id": st.cid,
@@ -618,9 +741,37 @@ class FleetRouter:
             self._requeue(st, why=f"replica {r.rid} answered overload",
                           count_retry=False)
 
+    def _merge_timing(self, st: _RoutedReq, msg: dict) -> dict:
+        """Extend the replica's per-request timing breakdown with the
+        router-side attribution: hops (placements) and retries, the
+        replica that finally served it, and the router-observed request
+        wall — so the `done` frame alone answers "where did this
+        request's seconds go" across the fleet."""
+        timing = dict(msg.get("timing") or {})
+        timing["router"] = {
+            "hops": st.retries + 1,
+            "retries": st.retries,
+            "replica": st.rid,
+            "total_ms": round((time.perf_counter() - st.t0) * 1e3, 3),
+        }
+        return timing
+
     def _finish(self, st: _RoutedReq, frame: dict) -> None:
         self._routes.pop(st.grid, None)
         st.conn.rids.pop(st.cid, None)
+        if self.tracer.enabled:
+            # the ingress span: the request's whole router-side lifetime,
+            # ending at the terminal frame (done/error/overload) — the
+            # parent of every place/relay/retry span and of the replica's
+            # lifecycle spans
+            attrs = {"trace_id": st.trace_id, "span_id": st.span_id,
+                     "terminal": frame.get("type"),
+                     "streamed": st.streamed, "retries": st.retries}
+            if st.client_parent:
+                attrs["parent"] = st.client_parent
+            self.tracer.add(
+                "ingress", st.t0, time.perf_counter() - st.t0,
+                track=f"req:{st.trace_id[:12]}", attrs=attrs)
         st.conn.send(frame)
         if not self._routes and self._idle is not None:
             self._idle.set()
@@ -659,6 +810,11 @@ class FleetRouter:
                 self._m_sheds.inc()
                 self.flight.record("shed", reason="replica_overload",
                                    inflight=len(self._routes))
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "shed", track=f"req:{st.trace_id[:12]}",
+                        reason="replica_overload", trace_id=st.trace_id,
+                        parent=st.span_id)
                 self._finish(st, {"type": "overload", "id": st.cid,
                                   "reason": "fleet_saturated",
                                   "inflight": len(self._routes),
@@ -675,6 +831,11 @@ class FleetRouter:
             self._m_retries.inc()
             self.flight.record("retry", req=st.grid, to=replica.rid,
                                why=why, attempt=st.retries)
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "retry", track=f"req:{st.trace_id[:12]}",
+                    to=replica.rid, why=why, attempt=st.retries,
+                    trace_id=st.trace_id, parent=st.span_id)
         self._send_to(st, replica, policy)
 
     def _send_to(self, st: _RoutedReq, replica: Replica,
@@ -682,8 +843,14 @@ class FleetRouter:
         # anything that can raise runs BEFORE the routing state mutates:
         # an exception after routes/rids/pending were touched would leak
         # a phantom in-flight request (inflated load, drain wedged)
+        t_place = time.perf_counter()
         akey = self.policy.index.key_of(st.msg.get("prompt", []))
-        fwd = dict(st.msg, id=None, stream=True)
+        # wire-level trace context: the forwarded frame carries the
+        # request's trace_id with the router's ingress span as parent —
+        # the replica server adopts it (serving/server.py), which is the
+        # whole cross-process stitch
+        fwd = dict(st.msg, id=None, stream=True,
+                   trace={"trace_id": st.trace_id, "parent": st.span_id})
         grid = f"g{self._seq}"
         self._seq += 1
         fwd["id"] = grid
@@ -698,7 +865,17 @@ class FleetRouter:
                            policy=policy,
                            akey=None if akey is None else
                            (hash(akey) & 0xFFFFFFFF))
-        if not replica.backend.send(fwd):
+        ok = replica.backend.send(fwd)
+        if self.tracer.enabled:
+            # placement decision + backend send, as one span: which
+            # replica, under which policy, and whether the send stuck
+            self.tracer.add(
+                "place", t_place, time.perf_counter() - t_place,
+                track=f"req:{st.trace_id[:12]}",
+                attrs={"replica": replica.rid, "policy": policy,
+                       "sent": ok, "trace_id": st.trace_id,
+                       "parent": st.span_id})
+        if not ok:
             # the connection died under us before the reader task noticed;
             # take the leave path NOW so this request retries immediately
             self._leave(replica.rid, "connection_lost")
@@ -767,8 +944,33 @@ class FleetRouter:
         elif t == "stats":
             conn.send(self._stats_msg())
         elif t == "metrics":
-            conn.send({"type": "metrics", "text": self.metrics.render(),
-                       "content_type": "text/plain; version=0.0.4"})
+            if msg.get("aggregate"):
+                # the fleet scrape endpoint: the router's own fleet_*
+                # rows plus every reachable replica's families under a
+                # `replica` label — one text blob for the whole fleet
+                text, answered = await self._aggregate_metrics()
+                conn.send({"type": "metrics", "text": text,
+                           "aggregate": True, "replicas": answered,
+                           "content_type": "text/plain; version=0.0.4"})
+            else:
+                conn.send({"type": "metrics",
+                           "text": self.metrics.render(),
+                           "content_type": "text/plain; version=0.0.4"})
+        elif t == "trace":
+            # the router's own span ring, same shape as a replica's
+            # trace reply — trace_dump --pull treats both alike, and
+            # `enable` flips router-side tracing live (see server.py)
+            if isinstance(msg.get("enable"), bool):
+                self.tracer.enabled = msg["enable"]
+            conn.send({"type": "trace", "id": msg.get("id"),
+                       "process": process_info("router", self.host,
+                                               self.port),
+                       "clock": {"perf_counter": time.perf_counter(),
+                                 "unix": time.time()},
+                       "enabled": self.tracer.enabled,
+                       "recorded": self.tracer.recorded,
+                       "dropped": self.tracer.dropped,
+                       "spans": self.tracer.snapshot()})
         elif t == "dump":
             self.flight.record("dump_rpc", router=True)
             if not self.postmortem_dir:
@@ -778,7 +980,8 @@ class FleetRouter:
                                     "tools/fleet_router.py "
                                     "--postmortem-dir)"})
                 return
-            path = self._write_bundle("rpc")
+            path = self._write_bundle(
+                "rpc", replica_traces=await self._collect_replica_traces())
             if path is None:
                 conn.send({"type": "error", "id": msg.get("id"),
                            "error": f"postmortem dump failed: "
@@ -786,13 +989,14 @@ class FleetRouter:
             else:
                 conn.send({"type": "dump", "id": msg.get("id"),
                            "path": path, "events": self.flight.recorded,
-                           "spans": get_tracer().recorded})
+                           "spans": self.tracer.recorded})
         elif t == "hello":
             conn.send(wire.hello_msg(
                 "router",
                 server="paddle_tpu-fleet-router",
                 capabilities=sorted(["hello", "generate", "cancel", "stats",
-                                     "metrics", "dump", "ping", "fleet"]),
+                                     "metrics", "dump", "ping", "fleet",
+                                     "trace"]),
                 replicas=len(self.table),
                 policy=self.policy.mode,
                 page_size=self.policy.index.window,
@@ -845,6 +1049,9 @@ class FleetRouter:
             self._m_sheds.inc()
             self.flight.record("shed", reason=reason,
                                inflight=len(self._routes))
+            if self.tracer.enabled:
+                self.tracer.instant("shed", track="router", reason=reason,
+                                    inflight=len(self._routes))
             conn.send({"type": "overload", "id": cid, "reason": reason,
                        "inflight": len(self._routes),
                        "max_inflight": sum(
@@ -882,7 +1089,13 @@ class FleetRouter:
                 if r.state in (rep.HEALTHY, rep.DRAINING):
                     r.state = rep.DRAINING if r.drain_requested \
                         else rep.HEALTHY
-                self.flight.record("replica_" + op, replica=r.rid)
+                # literal kinds on both branches: the event-table lint
+                # (tools/check_metrics_names.py) reads first-arg string
+                # literals, so a computed kind could ship undocumented
+                if op == "drain":
+                    self.flight.record("replica_drain", replica=r.rid)
+                else:
+                    self.flight.record("replica_undrain", replica=r.rid)
                 conn.send({**base, "ok": True, "replica": r.rid,
                            "state": r.state,
                            "pending": len(r.pending)})
@@ -918,3 +1131,68 @@ class FleetRouter:
             "sheds": self._m_sheds.value(),
             "replicas": [r.summary() for r in self.table],
         }
+
+
+def _merge_prometheus(parts: list[tuple[Optional[str], str]]) -> str:
+    """Merge several Prometheus text expositions into one.
+
+    `parts` is [(replica_label_or_None, text), ...] — the router's own
+    render first (unlabeled), then each replica's frame.  Labeled parts
+    get `replica="<label>"` injected into every sample, and families are
+    REGROUPED so each base name renders exactly one HELP/TYPE pair even
+    when both tiers emit it (the tracer/flight accounting does): a
+    scraper must never see a family's TYPE declared twice.
+
+    Relies on the renderer's contract (obs/metrics.py render()): samples
+    follow their family's HELP/TYPE header contiguously, histogram
+    samples (`_bucket`/`_sum`/`_count`) under the base-name header."""
+    families: dict = {}            # base -> {"kind", "help", "samples"}
+    order: list[str] = []
+
+    def family(base: str) -> dict:
+        fam = families.get(base)
+        if fam is None:
+            fam = families[base] = {"kind": "untyped", "help": "",
+                                    "samples": []}
+            order.append(base)
+        return fam
+
+    for label, text in parts:
+        current = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP "):
+                base, _, help_ = line[len("# HELP "):].partition(" ")
+                fam = family(base)
+                fam["help"] = fam["help"] or help_
+                current = base
+            elif line.startswith("# TYPE "):
+                base, _, kind = line[len("# TYPE "):].partition(" ")
+                fam = family(base)
+                if fam["kind"] == "untyped" and kind:
+                    fam["kind"] = kind
+                current = base
+            elif line.startswith("#"):
+                continue
+            else:
+                head, _, value = line.rpartition(" ")
+                if not head:
+                    continue
+                if label is not None:
+                    if head.endswith("}"):
+                        head = head[:-1] + f',replica="{label}"}}'
+                    else:
+                        head = head + f'{{replica="{label}"}}'
+                name = head.partition("{")[0]
+                base = (current if current and name.startswith(current)
+                        else name)
+                family(base)["samples"].append(f"{head} {value}")
+    lines = []
+    for base in order:
+        fam = families[base]
+        if fam["help"]:
+            lines.append(f"# HELP {base} {fam['help']}")
+        lines.append(f"# TYPE {base} {fam['kind']}")
+        lines.extend(fam["samples"])
+    return "\n".join(lines) + ("\n" if lines else "")

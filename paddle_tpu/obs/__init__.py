@@ -43,9 +43,11 @@ from paddle_tpu.obs.metrics import (CATALOG, Counter,  # noqa: F401
                                     barrier_collector, statset_collector,
                                     tracer_collector)
 from paddle_tpu.obs.trace import (Tracer, get_tracer,  # noqa: F401
-                                  spans_to_chrome)
+                                  merge_chrome, new_span_id, new_trace_id,
+                                  process_info, spans_to_chrome)
 
-__all__ = ["Tracer", "get_tracer", "spans_to_chrome", "MetricsRegistry",
+__all__ = ["Tracer", "get_tracer", "spans_to_chrome", "merge_chrome",
+           "new_trace_id", "new_span_id", "process_info", "MetricsRegistry",
            "Counter", "Gauge", "Histogram", "CATALOG", "statset_collector",
            "barrier_collector", "tracer_collector", "CompileWatch",
            "get_compile_watch", "compile_collector", "FlightRecorder",

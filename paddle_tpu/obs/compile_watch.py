@@ -199,16 +199,17 @@ class CompileWatch:
         if tracer.enabled:
             tracer.add(site, t0 or (now - seconds), seconds,
                        track="compile", attrs={"sig": sig})
-        fr = get_flight_recorder()
-        fr.record("compile", site=site, sig=sig,
-                  seconds=round(seconds, 4))
+        flight = get_flight_recorder()
+        flight.record("compile", site=site, sig=sig,
+                      seconds=round(seconds, 4))
         if storm is not None:
             if tracer.enabled:
                 tracer.instant("recompile_storm", track="compile",
                                site=site, signatures=storm,
                                window_s=self.storm_window_s)
-            fr.record("recompile_storm", site=site, signatures=storm,
-                      window_s=self.storm_window_s)
+            flight.record("recompile_storm", site=site,
+                          signatures=storm,
+                          window_s=self.storm_window_s)
 
     # -- reading -----------------------------------------------------------
     def signature_count(self, site: str) -> int:

@@ -151,6 +151,9 @@ CATALOG: dict[str, str] = {
     # -- tracer ------------------------------------------------------------
     "trace_spans_recorded_total": "spans recorded since enable (incl. wrapped)",
     "trace_spans_dropped_total": "spans overwritten by ring wrap-around",
+    "trace_ring_capacity":
+        "span-ring capacity — dropped_total climbing against it means the "
+        "trace window is shorter than the workload being debugged",
     # -- compile observability (obs/compile_watch.py) ----------------------
     "jit_compiles_total":
         "jit compiles observed per instrumented entry point (label: site)",
@@ -461,7 +464,10 @@ def barrier_collector(bt, metric: str = "trainer_barrier_seconds"):
 
 
 def tracer_collector(tracer):
-    """Expose the span tracer's ring accounting."""
+    """Expose the span tracer's ring accounting: recorded/dropped totals
+    plus the ring capacity they are read against — the Tracer overwrites
+    silently when full, so the dropped counter (regression-tested in
+    tests/test_obs.py) is the ONLY place that loss is visible."""
 
     def collect():
         return [
@@ -469,6 +475,8 @@ def tracer_collector(tracer):
              float(tracer.recorded)),
             ("trace_spans_dropped_total", "counter", None,
              float(tracer.dropped)),
+            ("trace_ring_capacity", "gauge", None,
+             float(tracer.capacity)),
         ]
 
     return collect

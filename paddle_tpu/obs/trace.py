@@ -28,14 +28,49 @@ horizontal lane the viewer shows — one per request (`req:<id>`), one for
 the engine (`engine`), one for the trainer (`trainer`).  `dur` 0.0 with
 `instant=True` renders as an instant marker (preempt, done).  Times are
 `time.perf_counter()` seconds; exports convert to microseconds.
+
+Distributed tracing (docs/observability.md "Distributed tracing"): a
+request that crosses processes (client → fleet router → replica) carries
+a wire-level trace context — `trace_id` (one per request, minted at the
+router's ingress unless the client supplied one) and `parent` (the
+sending side's span id) — which every process records as span ATTRS, so
+stitching needs no tracer-core change.  `merge_chrome()` stitches span
+sets pulled from several processes (the `trace` RPC, or `--trace-out`
+files) into ONE Chrome trace with a named process group per source,
+applying each source's clock offset (perf_counter epochs are
+per-process; the puller measures the offset via ping-RTT midpointing).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import socket
 import time
 from typing import Optional
+
+
+def new_trace_id() -> str:
+    """One id per cross-process request — 16 hex chars, collision-safe at
+    fleet request rates (os.urandom, no seeding to leak)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """Parent-pointer currency for cross-process span stitching."""
+    return os.urandom(4).hex()
+
+
+def process_info(role: str, host: Optional[str] = None,
+                 port: Optional[int] = None) -> dict:
+    """The process-identity stamp a `trace` RPC reply (and a --trace-out
+    file's meta line) carries, so a merged trace can name its tracks:
+    role (replica/router/...), pid, hostname, and the bind address."""
+    out = {"role": role, "pid": os.getpid(),
+           "hostname": socket.gethostname()}
+    if host is not None:
+        out["addr"] = f"{host}:{port}"
+    return out
 
 
 class _NullSpan:
@@ -162,10 +197,16 @@ class Tracer:
                  **({"instant": True} if r[6] else {})}
                 for r in recs]
 
-    def export_jsonl(self, path: str) -> int:
-        """Write retained spans as JSON-lines; returns the span count."""
+    def export_jsonl(self, path: str, meta: Optional[dict] = None) -> int:
+        """Write retained spans as JSON-lines; returns the span count.
+        `meta` (e.g. {"process": process_info(...)}) prepends one
+        identity record — tools/trace_dump.py skips it when summarizing
+        and uses it to label the process track when merging."""
         spans = self.snapshot()
         with open(path, "w") as f:
+            if meta:
+                f.write(json.dumps({"meta": meta},
+                                   separators=(",", ":")) + "\n")
             for s in spans:
                 f.write(json.dumps(s, separators=(",", ":")) + "\n")
         return len(spans)
@@ -188,10 +229,23 @@ def spans_to_chrome(spans: list[dict]) -> dict:
     spans are "X" events, instants are "i" (thread-scoped).  Times convert
     from perf_counter seconds to integer-friendly microseconds, rebased to
     the earliest span so the viewer opens at t=0."""
-    pid = os.getpid()
+    events = _chrome_events(spans, pid=os.getpid(),
+                            t_base=min((s["ts"] for s in spans),
+                                       default=0.0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _chrome_events(spans: list[dict], pid: int, t_base: float,
+                   offset_s: float = 0.0,
+                   process_name: Optional[str] = None) -> list[dict]:
+    """One source's spans as Chrome events under process `pid`, with its
+    clock offset applied (local = source ts + offset) and all times
+    rebased to `t_base` (already in the merged/local timebase)."""
     tids: dict[str, int] = {}
     events: list[dict] = []
-    t_base = min((s["ts"] for s in spans), default=0.0)
+    if process_name:
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": process_name}})
     for s in spans:
         track = s.get("track", "main")
         tid = tids.get(track)
@@ -200,7 +254,7 @@ def spans_to_chrome(spans: list[dict]) -> dict:
             events.append({"ph": "M", "name": "thread_name", "pid": pid,
                            "tid": tid, "args": {"name": track}})
         ev = {"name": s["name"], "pid": pid, "tid": tid,
-              "ts": round((s["ts"] - t_base) * 1e6, 3),
+              "ts": round((s["ts"] + offset_s - t_base) * 1e6, 3),
               "cat": track.split(":", 1)[0]}
         if s.get("attrs"):
             ev["args"] = s["attrs"]
@@ -211,6 +265,34 @@ def spans_to_chrome(spans: list[dict]) -> dict:
             ev["ph"] = "X"
             ev["dur"] = round(s["dur"] * 1e6, 3)
         events.append(ev)
+    return events
+
+
+def merge_chrome(sources: list[dict]) -> dict:
+    """Stitch span sets from SEVERAL processes into one Chrome trace.
+
+    Each source is {"spans": [...], "process": {...}|None,
+    "offset_s": float} — spans in that process's perf_counter timebase,
+    `offset_s` mapping them onto the merger's timebase (local ≈ remote +
+    offset; 0.0 for local files).  Every source becomes its own process
+    track group (synthetic pids — two replicas on one host, or an
+    in-process test fleet, must not collapse into one group), named from
+    its process identity; all events rebase to the earliest aligned span
+    so the merged trace opens at t=0 with the processes side by side."""
+    t_base = min((s["ts"] + src.get("offset_s", 0.0)
+                  for src in sources for s in src.get("spans", ())),
+                 default=0.0)
+    events: list[dict] = []
+    for i, src in enumerate(sources):
+        proc = src.get("process") or {}
+        name = " ".join(
+            str(x) for x in (proc.get("role"), proc.get("addr"),
+                             f"pid={proc['pid']}" if "pid" in proc
+                             else None, src.get("label"))
+            if x) or f"process-{i + 1}"
+        events.extend(_chrome_events(
+            src.get("spans", []), pid=i + 1, t_base=t_base,
+            offset_s=float(src.get("offset_s", 0.0)), process_name=name))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -139,15 +139,21 @@ class ServingClient:
     def submit(self, prompt, max_new: int = 32, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 0.0, eos_id: int = -1,
                seed: Optional[int] = None, timeout_s: Optional[float] = None,
-               stream: bool = True, req_id=None):
+               stream: bool = True, req_id=None,
+               trace: Optional[dict] = None):
         """Fire one generate; returns the request id (auto-assigned unless
-        given).  Does NOT wait — pair with collect()."""
+        given).  Does NOT wait — pair with collect().  `trace`
+        ({"trace_id": ..., "parent": ...?}) threads a client-originated
+        distributed-trace context through the router/replica spans
+        (docs/observability.md "Distributed tracing")."""
         if req_id is None:
             req_id = f"q{self._next_id}"
             self._next_id += 1
         msg = {"type": "generate", "id": req_id,
                "prompt": [int(t) for t in prompt],
                "max_new": int(max_new), "stream": bool(stream)}
+        if trace is not None:
+            msg["trace"] = dict(trace)
         if temperature:
             msg["temperature"] = float(temperature)
         if top_k:
@@ -175,8 +181,8 @@ class ServingClient:
         token, index)` observes streaming tokens as they arrive.  Raises
         OverloadError / ServerError on those terminal frames."""
         want = set(req_ids)
-        out = {rid: {"tokens": None, "reason": None, "stream": []}
-               for rid in want}
+        out = {rid: {"tokens": None, "reason": None, "stream": [],
+                     "timing": None} for rid in want}
         mine = ("token", "done", "overload", "error")
         while any(out[rid]["reason"] is None for rid in want):
             msg = self._route(lambda m: m.get("id") in want
@@ -190,6 +196,10 @@ class ServingClient:
             elif t == "done":
                 out[rid]["tokens"] = list(msg["tokens"])
                 out[rid]["reason"] = msg["reason"]
+                # per-request latency attribution (queue/prefill/decode/
+                # replay ms + preempt/spec counts; the router adds its
+                # hop/retry fields) — docs/serving.md "Message schemas"
+                out[rid]["timing"] = msg.get("timing")
             elif t == "overload":
                 raise OverloadError(msg)
             else:
@@ -221,13 +231,53 @@ class ServingClient:
         self.send(msg)
         return self._route(lambda m: m.get("type") == "stats")
 
-    def metrics(self) -> str:
+    def metrics(self, aggregate: bool = False) -> str:
         """The server's Prometheus-style text exposition (the `metrics`
         frame; answered on the loop thread, readable even while the
-        engine pump is wedged).  Metric reference:
-        docs/observability.md."""
-        self.send({"type": "metrics"})
+        engine pump is wedged).  Against a fleet router,
+        `aggregate=True` asks for the FLEET view: the router's own
+        fleet_* rows plus every reachable replica's serving_* families
+        relabeled with `replica="rN"` — one scrape endpoint for the
+        whole fleet.  Metric reference: docs/observability.md."""
+        msg = {"type": "metrics"}
+        if aggregate:
+            msg["aggregate"] = True
+        self.send(msg)
         return self._route(lambda m: m.get("type") == "metrics")["text"]
+
+    def trace(self, pings: int = 3, enable: Optional[bool] = None) -> dict:
+        """Pull the server's span-ring snapshot (the `trace` RPC —
+        answered on the loop thread, so it works against a wedged pump)
+        and measure this connection's clock offset: `pings` ping round
+        trips estimate the minimum RTT, and the reply's perf_counter
+        sample midpoints to `offset_s` with local ≈ remote + offset —
+        what trace_dump --merge/--pull uses to align process tracks.
+        `enable` flips the server's tracing LIVE before the snapshot
+        (True to start tracing a running replica without a restart,
+        False to stop and collect what it froze).  Returns the reply
+        frame plus `offset_s`/`rtt_s`."""
+        rtts = []
+        for _ in range(max(1, int(pings))):
+            t0 = time.perf_counter()
+            self.ping()
+            rtts.append(time.perf_counter() - t0)
+        rtt = min(rtts)
+        rid = f"trace{self._next_id}"
+        self._next_id += 1
+        msg = {"type": "trace", "id": rid}
+        if enable is not None:
+            msg["enable"] = bool(enable)
+        t_send = time.perf_counter()
+        self.send(msg)
+        msg = self._route(lambda m: m.get("type") in ("trace", "error")
+                          and m.get("id") == rid)
+        if msg["type"] == "error":
+            raise ServerError(msg.get("error", "trace pull failed"))
+        remote = (msg.get("clock") or {}).get("perf_counter")
+        msg["rtt_s"] = rtt
+        msg["offset_s"] = ((t_send + rtt / 2.0) - float(remote)
+                           if remote is not None else 0.0)
+        return msg
 
     def dump(self) -> dict:
         """Ask the server to freeze a postmortem bundle NOW (answered on

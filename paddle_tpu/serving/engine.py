@@ -141,8 +141,14 @@ class Request:
     def __init__(self, req_id, prompt_ids, max_new: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: int = -1, rng=None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace: Optional[dict] = None):
         self.req_id = req_id
+        # inbound distributed-trace context ({"trace_id": ..., "parent":
+        # ...}, normally stamped by the fleet router at ingress): the
+        # engine's lifecycle spans carry it as attrs, so one trace_id
+        # threads the request through every process it crossed
+        self.trace = dict(trace) if trace else None
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -228,7 +234,7 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = -1,
                  max_step_tokens: Optional[int] = None,
                  spec_k: int = 0, drafter=None,
-                 mesh=None):
+                 mesh=None, tracer=None):
         self.executor = executor
         self.input_name, self.logits_name = _resolve_io_names(
             executor.model, input_name, logits_name)
@@ -306,10 +312,23 @@ class ServingEngine:
         # ONLY while tracer.enabled — every emission site checks first, so
         # the disabled cost is one attribute read.  All spans record on
         # the step()-driving thread (the pump), matching the tracer's
-        # single-writer ring contract.
-        self.tracer = get_tracer()
+        # single-writer ring contract.  `tracer=` lets an embedder (or an
+        # in-process test fleet) give each engine its own ring, so a
+        # per-process `trace` RPC snapshot stays per-process.
+        self.tracer = tracer if tracer is not None else get_tracer()
         self._obs_open: dict = {}   # req_id -> open span handle (one phase
                                     # open per request at any moment)
+        self._req_trace: dict = {}  # req_id -> inbound trace context
+        # per-request latency attribution (ALWAYS on — the phase
+        # transitions below are a handful of clock reads per request
+        # LIFECYCLE, never per token, so there is no flag to forget):
+        # _req_phase holds the open phase, _req_attr the per-phase wall
+        # accumulators + occurrence counters; _finish folds them into
+        # finish_timing[req_id] — the `done` frame's `timing` breakdown
+        # (docs/serving.md), popped by the server/run() like results.
+        self._req_phase: dict = {}
+        self._req_attr: dict = {}
+        self.finish_timing: dict = {}
         # black box (obs/flight.py): request-lifecycle transitions recorded
         # when the front end (or a test) enables the process-global
         # recorder — events are per-request, never per-token, so the
@@ -651,22 +670,74 @@ class ServingEngine:
         t = self.tracer
         return t is not None and t.enabled
 
+    def _trace_attrs(self, req_id, attrs: dict) -> dict:
+        """Merge the request's inbound trace context (trace_id + the
+        sender's span id) into span attrs — the cross-process stitch."""
+        tc = self._req_trace.get(req_id)
+        if tc:
+            attrs = dict(attrs)
+            attrs.setdefault("trace_id", tc.get("trace_id"))
+            if tc.get("parent"):
+                attrs.setdefault("parent", tc["parent"])
+        return attrs
+
     def _tr_begin(self, req_id, phase: str, **attrs) -> None:
-        """Open the request's next lifecycle phase (queued / decode /
-        replay).  At most one phase is open per request; the previous one
-        must have been closed by _tr_end."""
+        """Open the request's next lifecycle phase (queued / prefill /
+        decode / replay).  At most one phase is open per request; the
+        previous one must have been closed by _tr_end.  The phase clock
+        runs UNCONDITIONALLY (per-request latency attribution is always
+        on); the span records only while the tracer is enabled."""
+        now = time.perf_counter()
+        self._req_phase[req_id] = (phase, now)
         if self._tr_on():
-            self._obs_open[req_id] = self.tracer.begin(
-                phase, track=f"req:{req_id}", **attrs)
+            self._obs_open[req_id] = [
+                phase, f"req:{req_id}", now,
+                self._trace_attrs(req_id, attrs) or None]
 
     def _tr_end(self, req_id, **attrs) -> None:
+        now = time.perf_counter()
+        ph = self._req_phase.pop(req_id, None)
+        if ph is not None:
+            a = self._req_attr.setdefault(req_id, {})
+            a[ph[0]] = a.get(ph[0], 0.0) + (now - ph[1])
         h = self._obs_open.pop(req_id, None)
         if h is not None:
-            self.tracer.end(h, **attrs)
+            name, track, t0, sattrs = h
+            if attrs:
+                sattrs = dict(sattrs or (), **attrs)
+            self.tracer.add(name, t0, now - t0, track=track, attrs=sattrs)
 
     def _tr_instant(self, req_id, name: str, **attrs) -> None:
         if self._tr_on():
-            self.tracer.instant(name, track=f"req:{req_id}", **attrs)
+            self.tracer.instant(name, track=f"req:{req_id}",
+                                **self._trace_attrs(req_id, attrs))
+
+    def _bump_attr(self, req_id, key: str, by: int = 1) -> None:
+        """Occurrence counter feeding the timing breakdown (preempts,
+        prefill chunks, spec drafted/accepted)."""
+        a = self._req_attr.setdefault(req_id, {})
+        a[key] = a.get(key, 0) + by
+
+    def _finish_timing(self, req_id) -> dict:
+        """Fold the request's phase accumulators into the `timing`
+        breakdown the done frame carries: per-phase wall ms + occurrence
+        counts.  The phases are contiguous (each _tr_end is immediately
+        followed by the next _tr_begin), so their sum IS the engine-side
+        request wall time — `total_ms` restates it for SLO debugging
+        without a trace viewer."""
+        self._req_phase.pop(req_id, None)     # closed by the final _tr_end
+        a = self._req_attr.pop(req_id, {})
+        ms = {k: round(a.get(p, 0.0) * 1e3, 3) for k, p in
+              (("queue_ms", "queued"), ("prefill_ms", "prefill"),
+               ("decode_ms", "decode"), ("replay_ms", "replay"))}
+        ms["total_ms"] = round(sum(ms.values()), 3)
+        for k, src in (("prefill_chunks", "chunks"),
+                       ("preempts", "preempts"),
+                       ("spec_drafted", "spec_drafted"),
+                       ("spec_accepted", "spec_accepted")):
+            if a.get(src):
+                ms[k] = int(a[src])
+        return ms
 
     # -- public API -------------------------------------------------------
     def validate(self, req: Request) -> None:
@@ -700,6 +771,8 @@ class ServingEngine:
     def add_request(self, req: Request) -> None:
         """Enqueue; admission happens inside step()/run()."""
         self.validate(req)
+        if req.trace:
+            self._req_trace[req.req_id] = req.trace
         if req.max_new == 0:
             # lm_generate(max_new=0) returns the prompt unchanged whatever
             # its length — resolve before any capacity/page validation,
@@ -1027,6 +1100,7 @@ class ServingEngine:
                 sample_row[s] = r + n - 1
                 emit[s] = True
             self.n_prefill_chunks += 1
+            self._bump_attr(sl.req.req_id, "chunks")
             self.flight.record("chunk_sched", req=str(sl.req.req_id),
                                slot=s, start=int(sl.pos), tokens=int(n),
                                final=final)
@@ -1235,7 +1309,9 @@ class ServingEngine:
             self.n_spec_chains += 1
             if nd:
                 rid = str(sl.req.req_id)
+                self._bump_attr(sl.req.req_id, "spec_drafted", nd)
                 if a:
+                    self._bump_attr(sl.req.req_id, "spec_accepted", a)
                     self.flight.record("spec_accept", req=rid, slot=s,
                                        accepted=a, drafted=nd)
                 if nd > a:
@@ -1269,6 +1345,7 @@ class ServingEngine:
                if k not in done_before}
         for k in out:
             self.finish_reasons.pop(k, None)
+            self.finish_timing.pop(k, None)
         return out
 
     def bucket_for(self, prompt_len: int) -> int:
@@ -1376,48 +1453,49 @@ class ServingEngine:
             suf = p - C
             Lb = min(-(-_bucket_len(suf) // ps) * ps,
                      self.kv.capacity_tokens - C)
-            with self.tracer.span("prefill", track=f"req:{req.req_id}",
-                                  bucket=Lb, prefix_tokens=C):
-                ids = np.zeros((1, Lb), np.int32)
-                ids[0, :suf] = req.prompt_ids[C:]
-                last, kv_suffix = self._prefix_prefill_fn(n_pp, Lb)(
-                    self.params, self.kv.pools,
-                    jnp.asarray(self.kv.table[s, :n_pp].copy()),
-                    jnp.asarray(ids), jnp.asarray([suf], np.int32),
-                    jnp.asarray([C], np.int32))
-                tok0 = int(np.asarray(pick_next(
-                    last, jnp.asarray(keys[0]),
-                    temperature=req.temperature, top_k=req.top_k,
-                    top_p=req.top_p, is_probs=self._probs))[0])
-                # suffix K/V scatter from in-page offset C % ps across the
-                # slot's remaining pages (trash page 0 beyond the prompt)
-                n_span = Lb // ps + 1
-                pages = np.zeros(n_span, np.int32)
-                m_b = C // ps
-                span = min(n_span, self.kv.pages_for(p) - m_b)
-                pages[:span] = self.kv.table[s, m_b:m_b + span]
-                self.kv.pools = self._prefix_pack_fn(Lb)(
-                    self.kv.pools, kv_suffix, jnp.asarray(pages),
-                    jnp.asarray(C % ps, np.int32))
+            self._tr_begin(req.req_id, "prefill", bucket=Lb,
+                           prefix_tokens=C)
+            ids = np.zeros((1, Lb), np.int32)
+            ids[0, :suf] = req.prompt_ids[C:]
+            last, kv_suffix = self._prefix_prefill_fn(n_pp, Lb)(
+                self.params, self.kv.pools,
+                jnp.asarray(self.kv.table[s, :n_pp].copy()),
+                jnp.asarray(ids), jnp.asarray([suf], np.int32),
+                jnp.asarray([C], np.int32))
+            tok0 = int(np.asarray(pick_next(
+                last, jnp.asarray(keys[0]),
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, is_probs=self._probs))[0])
+            # suffix K/V scatter from in-page offset C % ps across the
+            # slot's remaining pages (trash page 0 beyond the prompt)
+            n_span = Lb // ps + 1
+            pages = np.zeros(n_span, np.int32)
+            m_b = C // ps
+            span = min(n_span, self.kv.pages_for(p) - m_b)
+            pages[:span] = self.kv.table[s, m_b:m_b + span]
+            self.kv.pools = self._prefix_pack_fn(Lb)(
+                self.kv.pools, kv_suffix, jnp.asarray(pages),
+                jnp.asarray(C % ps, np.int32))
+            self._tr_end(req.req_id)
         else:
             Lb = self.bucket_for(p)
-            with self.tracer.span("prefill", track=f"req:{req.req_id}",
-                                  bucket=Lb):
-                ids = np.zeros((1, Lb), np.int32)
-                ids[0, :p] = req.prompt_ids
-                last, kv_prompt = self._prefill_fn(Lb)(
-                    self.params, jnp.asarray(ids),
-                    jnp.asarray([p], np.int32))
-                tok0 = int(np.asarray(pick_next(
-                    last, jnp.asarray(keys[0]),
-                    temperature=req.temperature, top_k=req.top_k,
-                    top_p=req.top_p, is_probs=self._probs))[0])
+            self._tr_begin(req.req_id, "prefill", bucket=Lb)
+            ids = np.zeros((1, Lb), np.int32)
+            ids[0, :p] = req.prompt_ids
+            last, kv_prompt = self._prefill_fn(Lb)(
+                self.params, jnp.asarray(ids),
+                jnp.asarray([p], np.int32))
+            tok0 = int(np.asarray(pick_next(
+                last, jnp.asarray(keys[0]),
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, is_probs=self._probs))[0])
 
-                pages = np.zeros(Lb // ps, np.int32)   # 0 = trash for pad
-                n_real = self.kv.pages_for(p)
-                pages[:n_real] = self.kv.table[s, :n_real]
-                self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
-                                                  jnp.asarray(pages))
+            pages = np.zeros(Lb // ps, np.int32)   # 0 = trash for pad
+            n_real = self.kv.pages_for(p)
+            pages[:n_real] = self.kv.table[s, :n_real]
+            self.kv.pools = self._pack_fn(Lb)(self.kv.pools, kv_prompt,
+                                              jnp.asarray(pages))
+            self._tr_end(req.req_id)
         self._admit_seq += 1
         sl = _Slot(req, keys, pos=p, first_tok=tok0,
                    admit_seq=self._admit_seq)
@@ -1515,6 +1593,7 @@ class ServingEngine:
         if len(sl.generated) >= len(old):     # a re-preempt mid-replay
             sl.req._preempted_gen = list(sl.generated)  # keeps the longer
         self.tokens_generated -= sl.gen       # the restart re-emits them
+        self._bump_attr(rid, "preempts")
         self.n_preemptions += 1
         self.flight.record("preempt", req=str(rid), slot=s,
                            tokens=sl.gen,
@@ -1685,7 +1764,7 @@ class ServingEngine:
             return {"req_id": r.req_id, "prompt_ids": r.prompt_ids.copy(),
                     "max_new": r.max_new, "temperature": r.temperature,
                     "top_k": r.top_k, "top_p": r.top_p, "eos_id": r.eos_id,
-                    "deadline": r.deadline,
+                    "deadline": r.deadline, "trace": r.trace,
                     "preempted_gen": (None if r._preempted_gen is None
                                       else list(r._preempted_gen)),
                     "rng": np.asarray(r.rng).copy()}
@@ -1770,7 +1849,8 @@ class ServingEngine:
             r = Request(d["req_id"], d["prompt_ids"],
                         max_new=d["max_new"], temperature=d["temperature"],
                         top_k=d["top_k"], top_p=d["top_p"],
-                        eos_id=d["eos_id"], deadline=d["deadline"])
+                        eos_id=d["eos_id"], deadline=d["deadline"],
+                        trace=d.get("trace"))
             r.rng = jnp.asarray(d["rng"])
             r._preempted_gen = (None if d["preempted_gen"] is None
                                 else list(d["preempted_gen"]))
@@ -1829,6 +1909,28 @@ class ServingEngine:
         self._slots_dirty = True
         self._run_host = None
         self._t_prev_decode = None
+        # latency attribution across a migration: perf_counter epochs are
+        # per-process, so pre-restore phase clocks cannot carry over —
+        # re-open each live request's CURRENT phase at now (the breakdown
+        # charges post-restore time only; the donor's time was reported
+        # by the donor had it finished there)
+        now = time.perf_counter()
+        self._req_phase = {}
+        self._req_attr = {}
+        self._req_trace = {}
+        for r in self.queue:
+            self._req_phase[r.req_id] = ("queued", now)
+            if r.trace:
+                self._req_trace[r.req_id] = r.trace
+        for sl in self.slots:
+            if sl is None:
+                continue
+            phase = ("prefill" if sl.gen == 0 else
+                     "replay" if sl.replay_until and
+                     sl.gen < sl.replay_until else "decode")
+            self._req_phase[sl.req.req_id] = (phase, now)
+            if sl.req.trace:
+                self._req_trace[sl.req.req_id] = sl.req.trace
         kv.check()                      # allocator oracle on the restored
                                         # tables/refcounts — fail loudly
         self.flight.record("restore", slots=sum(
@@ -1877,6 +1979,8 @@ class ServingEngine:
                          reason=reason, tokens=int(toks.size))
         self.flight.record("finish", req=str(req_id), reason=reason,
                            tokens=int(toks.size))
+        self.finish_timing[req_id] = self._finish_timing(req_id)
+        self._req_trace.pop(req_id, None)
         self.results[req_id] = toks
         self.finish_reasons[req_id] = reason
         if self.on_finish is not None:

@@ -64,7 +64,7 @@ from paddle_tpu.obs import (MetricsRegistry, statset_collector,
 from paddle_tpu.obs.compile_watch import compile_collector, get_compile_watch
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
 from paddle_tpu.obs.hbm import hbm_collector, hbm_snapshot
-from paddle_tpu.obs.trace import get_tracer
+from paddle_tpu.obs.trace import process_info
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.engine import Request, ServingEngine
 from paddle_tpu.utils.stat import StatSet
@@ -115,6 +115,11 @@ class ServingServer:
         self.host = host
         self.port = port
         self.max_inflight = len(engine.slots) + int(max_queue)
+        # the server exports/dumps the ENGINE's tracer (the process-global
+        # one unless the embedder gave the engine its own ring), so the
+        # `trace` RPC snapshot, the metrics accounting, and the
+        # postmortem spans all describe the same spans
+        self.tracer = engine.tracer
         self.stats = StatSet("serving_server")
         # flight recorder (obs/flight.py): lifecycle events always record
         # while a server exists (they are per-request, not per-token);
@@ -241,7 +246,7 @@ class ServingServer:
         reg.register_collector(engine_state)
         reg.register_collector(statset_collector(
             self.stats, "serving_latency_seconds", "serving_latency_count"))
-        reg.register_collector(tracer_collector(get_tracer()))
+        reg.register_collector(tracer_collector(self.tracer))
         # deep introspection: per-site jit compile counters (the recompile-
         # storm fuel), device-memory accounting (KV pool / param / live-
         # array bytes, CPU-safe), and flight-recorder ring accounting —
@@ -630,10 +635,9 @@ class ServingServer:
         if not self.postmortem_dir:
             return None
         try:
-            tracer = get_tracer()
             path = self.flight.dump(
                 self.postmortem_dir, reason,
-                spans=tracer.snapshot(),
+                spans=self.tracer.snapshot(),
                 engine=self._engine_snapshot(),
                 metrics=self.metrics.snapshot(),
                 config=self._config_snapshot(),
@@ -675,16 +679,26 @@ class ServingServer:
         # long-lived process holds no unbounded result map
         self.engine.results.pop(rid, None)
         self.engine.finish_reasons.pop(rid, None)
+        timing = self.engine.finish_timing.pop(rid, None)
         st = self._routes.get(rid)
         if st is None:
             return
-        self.stats.get("request_latency").add(time.monotonic() - st.t_submit)
+        wall = time.monotonic() - st.t_submit
+        self.stats.get("request_latency").add(wall)
+        if timing is not None:
+            # the server-observed request wall time (accept -> finish)
+            # rides next to the engine-phase sum: the gap between them is
+            # command-queue/pump-pickup latency, and the gap between
+            # request_ms and the CLIENT's wall time is the wire + front
+            # tier — per-hop attribution with no trace viewer needed
+            timing["request_ms"] = round(wall * 1e3, 3)
         self._loop.call_soon_threadsafe(
             self._finish_on_loop, rid,
-            np.asarray(toks).astype(int).tolist(), reason)
+            np.asarray(toks).astype(int).tolist(), reason, timing)
 
     # -- loop-side completion/error delivery -------------------------------
-    def _finish_on_loop(self, rid: str, tokens: list, reason: str) -> None:
+    def _finish_on_loop(self, rid: str, tokens: list, reason: str,
+                        timing: Optional[dict] = None) -> None:
         st = self._routes.pop(rid, None)
         if st is None:
             return
@@ -694,8 +708,11 @@ class ServingServer:
         # acting on `done` (e.g. polling stats, or a test asserting
         # inflight) must never observe the request still counted
         self._dec_inflight()
-        st.conn.send({"type": "done", "id": st.cid, "tokens": tokens,
-                      "reason": reason})
+        out = {"type": "done", "id": st.cid, "tokens": tokens,
+               "reason": reason}
+        if timing is not None:
+            out["timing"] = timing
+        st.conn.send(out)
 
     def _fail_on_loop(self, rid: str, message: str) -> None:
         st = self._routes.pop(rid, None)
@@ -805,7 +822,30 @@ class ServingServer:
                 conn.send({"type": "dump", "id": msg.get("id"),
                            "path": path,
                            "events": self.flight.recorded,
-                           "spans": get_tracer().recorded})
+                           "spans": self.tracer.recorded})
+        elif t == "trace":
+            # trace collection over the wire (loop thread, stale-ok like
+            # `metrics` — snapshot() is safe concurrent with the pump, so
+            # this answers even against a wedged engine): the retained
+            # span ring plus the process identity a merger needs to put
+            # these spans on their own track group, and a perf_counter
+            # sample for ping-RTT clock alignment (the span timebase is
+            # THIS process's perf_counter epoch).  `enable` flips tracing
+            # LIVE (no restart — the operator's "start tracing NOW on the
+            # misbehaving replica" move, and the bench overhead probe's
+            # same-fleet A/B switch); the flip applies before the
+            # snapshot, so enable:false returns the spans it just froze.
+            if isinstance(msg.get("enable"), bool):
+                self.tracer.enabled = msg["enable"]
+            conn.send({"type": "trace", "id": msg.get("id"),
+                       "process": process_info("replica", self.host,
+                                               self.port),
+                       "clock": {"perf_counter": time.perf_counter(),
+                                 "unix": time.time()},
+                       "enabled": self.tracer.enabled,
+                       "recorded": self.tracer.recorded,
+                       "dropped": self.tracer.dropped,
+                       "spans": self.tracer.snapshot()})
         elif t == "hello":
             # version/capabilities negotiation: answered on connect so a
             # peer (the fleet router, a ctl, a probing operator) can
@@ -817,7 +857,7 @@ class ServingServer:
                 "replica",
                 server="paddle_tpu-serving",
                 capabilities=sorted(["hello", "generate", "cancel", "stats",
-                                     "metrics", "dump", "ping"]),
+                                     "metrics", "dump", "ping", "trace"]),
                 num_slots=len(self.engine.slots),
                 max_inflight=self.max_inflight,
                 page_size=int(self.engine.kv.page_size),
@@ -895,6 +935,17 @@ class ServingServer:
             # absolute on the ENGINE clock — the deadline sweep in step()
             # compares against engine.clock(), not the server's wall clock
             deadline = self.engine.clock() + float(msg["timeout_s"])
+        # distributed-trace context: a router (or a tracing client)
+        # stamps {"trace": {"trace_id", "parent"?}} on the generate frame;
+        # adopting it here is what joins the engine's lifecycle spans to
+        # the sender's trace.  Malformed contexts are dropped, not fatal —
+        # tracing must never fail a request.
+        trace = None
+        tc = msg.get("trace")
+        if isinstance(tc, dict) and isinstance(tc.get("trace_id"), str):
+            trace = {"trace_id": tc["trace_id"]}
+            if isinstance(tc.get("parent"), str):
+                trace["parent"] = tc["parent"]
         # engine req_ids are namespaced per connection so two clients
         # picking "0" can never collide inside the scheduler; the type tag
         # keeps JSON id 1 and id "1" distinct too (conn.rids already does)
@@ -905,7 +956,7 @@ class ServingServer:
                        top_k=int(msg.get("top_k", 0)),
                        top_p=float(msg.get("top_p", 0.0)),
                        eos_id=int(msg.get("eos_id", -1)),
-                       rng=rng, deadline=deadline)
+                       rng=rng, deadline=deadline, trace=trace)
 
     def _handle_stats(self, conn: _Conn, msg: dict) -> None:
         """Default path: the engine-state half of the snapshot is built

@@ -163,11 +163,12 @@ def test_assemble_lkg_stitches_serving_chunked_record(tmp_path):
 
 
 def test_assemble_lkg_stitches_serving_fleet_record(tmp_path):
-    """ISSUE 10 wiring: the fleet-router record (affinity-arm tok/s +
-    the affinity-vs-random hit-rate comparison companions) rides the
-    same per-config queue shape — a top-level BENCH_ONLY=serving_fleet
-    record must stitch into the assembled fallback under the
-    `serving_fleet` key with the A/B companions intact."""
+    """ISSUE 10 wiring (+ ISSUE 13's fleet trace-overhead probe): the
+    fleet-router record (affinity-arm tok/s + the affinity-vs-random
+    hit-rate comparison companions + the router-path tracing-overhead
+    pct) rides the same per-config queue shape — a top-level
+    BENCH_ONLY=serving_fleet record must stitch into the assembled
+    fallback under the `serving_fleet` key with the companions intact."""
     bench = _load_bench()
     M = bench._METRIC_OF
     assert M["serving_fleet"] == "lm_serving_fleet_tok_per_sec"
@@ -183,6 +184,8 @@ def test_assemble_lkg_stitches_serving_fleet_record(tmp_path):
                     "hit_rate_affinity": 0.91,
                     "hit_rate_random": 0.55,
                     "affinity_hit_gt_random": True,
+                    "lm_serving_fleet_trace_overhead_pct": 0.7,
+                    "trace_on_tok_per_sec": 5084.6,
                     "measured_at": "2026-08-04T10:00:00+00:00"}},
     ]
     log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
@@ -192,6 +195,11 @@ def test_assemble_lkg_stitches_serving_fleet_record(tmp_path):
     assert out["serving_fleet"]["hit_rate_affinity"] == 0.91
     assert out["serving_fleet"]["hit_rate_random"] == 0.55
     assert out["serving_fleet"]["affinity_hit_gt_random"] is True
+    # the fleet trace-overhead probe (router + replica tracing ON through
+    # the router path, <= 2% budget) survives the per-part stitch
+    assert out["serving_fleet"][
+        "lm_serving_fleet_trace_overhead_pct"] == 0.7
+    assert out["serving_fleet"]["trace_on_tok_per_sec"] == 5084.6
 
 
 def test_assemble_lkg_stitches_serving_tp_record(tmp_path):

@@ -1,0 +1,285 @@
+"""Fleet-scope distributed tracing (ISSUE 13 acceptance).
+
+One traced request through a 2-replica fleet must yield ONE merged,
+Perfetto-loadable trace in which the router's placement/relay spans and
+the replica's engine lifecycle spans share a trace_id on DISTINCT
+process tracks, with the replica spans parented on the router's ingress
+span — and the `done` frame's timing breakdown must reconcile: phases
+sum to the engine total, the totals nest engine <= server <= router <=
+client-observed wall time.  Replicas here are in-process ServingServer
+instances, each with its OWN Tracer ring (the per-process shape the
+`trace` RPC snapshots in a real deployment), so the cross-process stitch
+is exercised without subprocess cost.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.fleet import FleetRouter
+from paddle_tpu.obs import Tracer, merge_chrome
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.client import ServingClient
+from paddle_tpu.serving.server import ServingServer
+from paddle_tpu.trainer.trainer import Trainer
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def tiny_tr():
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    return Trainer(cfg, seed=7)
+
+
+def _traced_fleet(tr, n):
+    """n in-process replicas (each with a private enabled Tracer) + a
+    router (its own enabled Tracer) joined to all of them."""
+    reps = []
+    for _ in range(n):
+        tracer = Tracer()
+        tracer.enabled = True
+        eng = ServingEngine(tr.executor, tr.params, num_slots=2,
+                            page_size=PAGE, max_context=64, tracer=tracer)
+        srv = ServingServer(eng, max_queue=16)
+        host, port = srv.start_background()
+        reps.append((srv, host, port))
+    rt_tracer = Tracer()
+    rt_tracer.enabled = True
+    rt = FleetRouter(port=0, replicas=[(h, p) for _, h, p in reps],
+                     poll_interval_s=0.1, heartbeat_misses=100,
+                     tracer=rt_tracer)
+    host, port = rt.start_background()
+    return rt, host, port, reps
+
+
+def _stop_all(rt, reps):
+    rt.stop_background(drain=True)
+    for srv, _, _ in reps:
+        srv.stop_background(drain=True)
+
+
+def _spans_for_trace(pull, tid):
+    return [s for s in pull["spans"]
+            if (s.get("attrs") or {}).get("trace_id") == tid]
+
+
+def test_fleet_e2e_one_trace_id_and_timing_reconciles(tiny_tr):
+    """The ISSUE 13 acceptance path, end to end over real TCP."""
+    rt, host, port, reps = _traced_fleet(tiny_tr, 2)
+    try:
+        with ServingClient(host, port) as c:
+            t0 = time.perf_counter()
+            rid = c.submit([2, 7, 9, 4, 5], max_new=8, seed=3)
+            res = c.collect([rid])[rid]
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            router_pull = c.trace()
+            agg = c.metrics(aggregate=True)
+        replica_pulls = []
+        for _, h, p in reps:
+            with ServingClient(h, p) as rc:
+                replica_pulls.append(rc.trace())
+
+        # -- (c) the timing breakdown, no trace viewer needed -------------
+        timing = res["timing"]
+        assert timing is not None
+        phase_sum = (timing["queue_ms"] + timing["prefill_ms"]
+                     + timing["decode_ms"] + timing["replay_ms"])
+        assert abs(phase_sum - timing["total_ms"]) < 1.0
+        # totals nest: engine <= server <= router <= client wall (each
+        # gap is a real hop; generous slack only for scheduler jitter)
+        assert timing["total_ms"] <= timing["request_ms"] + 1.0
+        assert timing["request_ms"] <= timing["router"]["total_ms"] + 50.0
+        assert timing["router"]["total_ms"] <= wall_ms + 50.0
+        # ...and the breakdown accounts for the client-observed latency:
+        # the unattributed remainder (wire + pump pickup) is bounded
+        assert wall_ms - timing["total_ms"] < 1500.0
+        assert timing["router"]["hops"] == 1
+        assert timing["router"]["retries"] == 0
+        assert timing["router"]["replica"] in ("r0", "r1")
+
+        # -- (a) one trace_id threads router + replica spans --------------
+        ingress = [s for s in router_pull["spans"]
+                   if s["name"] == "ingress"]
+        assert len(ingress) == 1
+        tid = ingress[0]["attrs"]["trace_id"]
+        sid = ingress[0]["attrs"]["span_id"]
+        place = [s for s in _spans_for_trace(router_pull, tid)
+                 if s["name"] == "place"]
+        assert len(place) == 1 and place[0]["attrs"]["parent"] == sid
+        assert place[0]["attrs"]["policy"] in ("affinity", "least_loaded")
+        served_rid = timing["router"]["replica"]
+        assert place[0]["attrs"]["replica"] == served_rid
+        # relay marks the FIRST streamed token only (the router-side
+        # TTFT stitch point; per-token markers would put tracer work on
+        # the loop thread's per-token critical path) — the relayed count
+        # rides on the ingress span instead
+        relays = [s for s in _spans_for_trace(router_pull, tid)
+                  if s["name"] == "relay"]
+        assert len(relays) == 1 and relays[0]["attrs"]["index"] == 0
+        assert ingress[0]["attrs"]["streamed"] == len(res["stream"])
+
+        # exactly ONE replica carries the trace; its lifecycle spans are
+        # parented on the router's ingress span
+        carrying = [p for p in replica_pulls if _spans_for_trace(p, tid)]
+        assert len(carrying) == 1
+        rep_spans = _spans_for_trace(carrying[0], tid)
+        names = [s["name"] for s in rep_spans]
+        assert names == ["queued", "prefill", "decode", "done"]
+        assert all(s["attrs"]["parent"] == sid for s in rep_spans)
+
+        # -- (b) the merged trace is Perfetto-loadable, per-process ------
+        pulls = [router_pull] + replica_pulls
+        merged = merge_chrome([{"spans": p["spans"],
+                                "process": p["process"],
+                                "offset_s": p["offset_s"]}
+                               for p in pulls])
+        assert set(merged) == {"traceEvents", "displayTimeUnit"}
+        procs = [e for e in merged["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert len(procs) == 3
+        assert len({p["pid"] for p in procs}) == 3     # distinct tracks
+        roles = [p["args"]["name"].split()[0] for p in procs]
+        assert sorted(roles) == ["replica", "replica", "router"]
+        for ev in merged["traceEvents"]:
+            assert ev["ph"] in ("M", "X", "i")
+            if ev["ph"] != "M":
+                assert ev["ts"] >= 0.0                 # global rebase
+        # the same request's router and replica spans sit on different
+        # pids but aligned clocks: the replica's queued span starts
+        # within the router's ingress span (offsets applied)
+        by_pid = {}
+        for ev in merged["traceEvents"]:
+            if ev["ph"] == "X" and (ev.get("args") or {}).get(
+                    "trace_id") == tid:
+                by_pid.setdefault(ev["pid"], []).append(ev)
+        assert len(by_pid) == 2
+        ing_ev = next(e for pid in by_pid for e in by_pid[pid]
+                      if e["name"] == "ingress")
+        q_ev = next(e for pid in by_pid for e in by_pid[pid]
+                    if e["name"] == "queued")
+        assert ing_ev["pid"] != q_ev["pid"]
+        assert ing_ev["ts"] - 1e5 <= q_ev["ts"] <= \
+            ing_ev["ts"] + ing_ev["dur"] + 1e5         # 100ms clock slack
+
+        # -- (d) one scrape for the whole fleet ---------------------------
+        assert 'replica="r0"' in agg and 'replica="r1"' in agg
+        assert "fleet_inflight" in agg
+        assert "serving_tokens_generated_total" in agg
+        # families both tiers emit render ONE TYPE header
+        assert agg.count("# TYPE trace_spans_recorded_total counter") == 1
+    finally:
+        _stop_all(rt, reps)
+
+
+def test_retry_and_shed_spans_carry_the_trace(tiny_tr):
+    """Router-side retry/shed instrumentation, unit-level: a fake
+    backend lets _handle_generate -> _send_to -> _requeue run without
+    sockets, asserting the retry span is parented on the ingress span
+    and the re-placement keeps the SAME trace_id (a retried request is
+    one trace, not two)."""
+    import paddle_tpu.fleet.replica as rep
+    from paddle_tpu.fleet.router import FleetRouter as FR
+
+    class _FakeBackend:
+        dead = False
+
+        def send(self, msg):
+            self.last = msg
+            return True
+
+    class _FakeConn:
+        def __init__(self):
+            self.sent = []
+            self.rids = {}
+
+        def send(self, msg):
+            self.sent.append(msg)
+
+    tracer = Tracer()
+    tracer.enabled = True
+    rt = FR(port=0, tracer=tracer)
+    for _ in range(2):
+        r = rt.table.add("h", 0)
+        r.state = rep.HEALTHY
+        r.hello = {"max_inflight": 8}
+        r.backend = _FakeBackend()
+    conn = _FakeConn()
+    rt._handle_generate(conn, {"type": "generate", "id": "q0",
+                               "prompt": [1, 2, 3], "max_new": 4,
+                               "trace": {"trace_id": "feedc0de",
+                                         "parent": "cli01"}})
+    st = next(iter(rt._routes.values()))
+    assert st.trace_id == "feedc0de"       # client context adopted
+    assert st.client_parent == "cli01"
+    first_rid = st.rid
+    fwd = rt.table.get(first_rid).backend.last
+    assert fwd["trace"] == {"trace_id": "feedc0de",
+                            "parent": st.span_id}
+    rt._requeue(st, why="replica died under test")
+    assert st.rid != first_rid             # re-placed on the survivor
+    fwd2 = rt.table.get(st.rid).backend.last
+    assert fwd2["trace"]["trace_id"] == "feedc0de"
+    spans = tracer.snapshot()
+    retry = [s for s in spans if s["name"] == "retry"]
+    assert len(retry) == 1
+    assert retry[0]["attrs"]["trace_id"] == "feedc0de"
+    assert retry[0]["attrs"]["parent"] == st.span_id
+    places = [s for s in spans if s["name"] == "place"]
+    assert len(places) == 2 and all(
+        s["attrs"]["trace_id"] == "feedc0de" for s in places)
+    # terminal frame closes the ingress span, which parents on the
+    # CLIENT's span id — the client's own span stitches above the
+    # router's in a merged trace
+    rt._on_backend_frame(rt.table.get(st.rid),
+                         rt.table.get(st.rid).backend,
+                         {"type": "done", "id": st.grid,
+                          "tokens": [1, 2, 3, 9], "reason": "length"})
+    ingress = [s for s in tracer.snapshot() if s["name"] == "ingress"]
+    assert len(ingress) == 1
+    assert ingress[0]["attrs"]["parent"] == "cli01"
+    assert ingress[0]["attrs"]["span_id"] == st.span_id
+    assert conn.sent[-1]["type"] == "done"
+    assert conn.sent[-1]["timing"]["router"]["retries"] == 1
+
+    # shed: drop both replicas, a new generate records a shed instant
+    for r in list(rt.table):
+        rt.table.replicas.pop(r.rid)
+    rt._handle_generate(conn, {"type": "generate", "id": "q1",
+                               "prompt": [1], "max_new": 1})
+    assert conn.sent[-1]["type"] == "overload"
+    sheds = [s for s in tracer.snapshot() if s["name"] == "shed"]
+    assert sheds and sheds[-1]["attrs"]["reason"] == "no_replicas"
+
+
+def test_replica_timing_rides_preempt_and_seed_paths(tiny_tr):
+    """Direct (no-router) server: the done frame's timing breakdown is
+    present, phase-complete, and counts preemptions when the pool forces
+    them."""
+    eng = ServingEngine(tiny_tr.executor, tiny_tr.params, num_slots=2,
+                        page_size=PAGE, max_context=64,
+                        num_pages=11)        # tight pool: preempt likely
+    srv = ServingServer(eng, max_queue=16)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            rids = [c.submit(list(range(2, 10)), max_new=24, seed=i)
+                    for i in range(3)]
+            res = c.collect(rids)
+        total_preempts = 0
+        for rid in rids:
+            t = res[rid]["timing"]
+            assert t is not None
+            s = t["queue_ms"] + t["prefill_ms"] + t["decode_ms"] + \
+                t["replay_ms"]
+            assert abs(s - t["total_ms"]) < 1.0
+            assert t["total_ms"] <= t["request_ms"] + 1.0
+            total_preempts += t.get("preempts", 0)
+            if t.get("preempts"):
+                assert t["replay_ms"] >= 0.0
+        assert total_preempts == eng.n_preemptions
+    finally:
+        srv.stop_background(drain=True)

@@ -41,6 +41,63 @@ def test_ring_overflow_keeps_newest_in_order():
     assert t.dropped == 0
 
 
+def test_tracer_overflow_surfaces_through_collector():
+    """ISSUE 13 satellite: the ring drops spans SILENTLY when full — the
+    only visibility is tracer_collector's accounting, so a strict
+    registry must render recorded/dropped totals plus the capacity they
+    are read against after an overflow."""
+    from paddle_tpu.obs import tracer_collector
+
+    t = Tracer(capacity=4)
+    t.enabled = True
+    for i in range(10):
+        t.add(f"s{i}", float(i), 0.1)
+    assert len(t.snapshot()) == 4          # the drop is silent...
+    reg = MetricsRegistry(strict=True)
+    reg.register_collector(tracer_collector(t))
+    snap = reg.snapshot()                  # ...but not invisible
+    assert snap["trace_spans_recorded_total"] == 10.0
+    assert snap["trace_spans_dropped_total"] == 6.0
+    assert snap["trace_ring_capacity"] == 4.0
+    text = reg.render()
+    assert "trace_spans_dropped_total 6" in text
+    assert "trace_ring_capacity 4" in text
+
+
+def test_merge_chrome_aligns_clocks_across_process_tracks():
+    """ISSUE 13: merge_chrome applies each source's offset before the
+    global rebase, gives every source its own pid + process_name, and
+    two spans simultaneous in wall time land at the same merged ts even
+    when the source perf_counter epochs differ wildly."""
+    from paddle_tpu.obs import merge_chrome
+
+    # process A's epoch: event at local t=100.0; process B's epoch is
+    # 90s behind (same wall moment reads 10.0 there) -> offset_s=+90
+    src_a = {"spans": [{"seq": 0, "name": "ingress", "track": "req:x",
+                        "ts": 100.0, "dur": 2.0}],
+             "process": {"role": "router", "pid": 11,
+                         "addr": "h:1"}, "offset_s": 0.0}
+    src_b = {"spans": [{"seq": 0, "name": "queued", "track": "req:x",
+                        "ts": 10.0, "dur": 1.0},
+                       {"seq": 1, "name": "done", "track": "req:x",
+                        "ts": 11.5, "dur": 0.0, "instant": True}],
+             "process": {"role": "replica", "pid": 11,
+                         "addr": "h:2"}, "offset_s": 90.0}
+    merged = merge_chrome([src_a, src_b])
+    evs = merged["traceEvents"]
+    procs = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert len(procs) == 2                 # same OS pid, distinct tracks
+    assert "router" in procs[1] and "replica" in procs[2]
+    ing = next(e for e in evs if e["name"] == "ingress")
+    qd = next(e for e in evs if e["name"] == "queued")
+    done = next(e for e in evs if e["name"] == "done")
+    assert ing["ts"] == 0.0                # global rebase to earliest
+    assert qd["ts"] == 0.0                 # same wall moment, aligned
+    assert done["ts"] == pytest.approx(1.5e6)
+    assert done["ph"] == "i" and qd["ph"] == "X"
+
+
 def test_disabled_tracer_records_nothing():
     t = Tracer(capacity=8)
     t.add("x", 0.0, 1.0)

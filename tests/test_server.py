@@ -576,6 +576,48 @@ def test_hello_frame_reports_proto_and_capabilities(tiny_tr):
         srv.stop_background(drain=True)
 
 
+def test_trace_rpc_live_flip_and_context_adoption(tiny_tr):
+    """ISSUE 13: the `trace` RPC snapshots the span ring with process
+    identity + a clock sample, flips tracing LIVE via `enable` (no
+    restart — the operator move and the bench probe's A/B switch), and
+    a generate frame's trace context is adopted into the engine's
+    lifecycle spans."""
+    from paddle_tpu.obs import Tracer
+
+    tracer = Tracer()
+    eng = _engine(tiny_tr, tracer=tracer)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        with ServingClient(host, port) as c:
+            assert "trace" in c.hello()["capabilities"]
+            t0 = c.trace()
+            assert t0["enabled"] is False and t0["spans"] == []
+            assert t0["process"]["role"] == "replica"
+            assert t0["process"]["addr"].endswith(f":{port}")
+            assert abs(t0["offset_s"]) < 1.0     # same-process clocks
+            # flip on live, run one traced request with a CLIENT context
+            assert c.trace(enable=True)["enabled"] is True
+            toks, reason = c.generate(
+                [2, 7, 9], max_new=4,
+                trace={"trace_id": "cafe01", "parent": "p9"})
+            assert reason == "length"
+            # flip off + collect what it froze
+            t1 = c.trace(enable=False)
+            assert t1["enabled"] is False and tracer.enabled is False
+            req = [s for s in t1["spans"]
+                   if (s.get("attrs") or {}).get("trace_id") == "cafe01"]
+            assert [s["name"] for s in req] == \
+                ["queued", "prefill", "decode", "done"]
+            assert all(s["attrs"]["parent"] == "p9" for s in req)
+            # the done frame carried the timing breakdown too
+            rid = c.submit([2, 3, 4], max_new=3)
+            timing = c.collect([rid])[rid]["timing"]
+            assert timing["total_ms"] <= timing["request_ms"] + 1.0
+    finally:
+        srv.stop_background(drain=True)
+
+
 def test_malformed_first_frame_names_expected_protocol(tiny_tr):
     """A peer speaking the wrong protocol (here: HTTP) gets an `error`
     frame NAMING the expected protocol, not a silent close — the router

@@ -137,6 +137,109 @@ def test_check_metrics_names_catches_dead_catalog_rows(tmp_path):
     assert "CATALOG" in blanked                # only the assignment went
 
 
+def test_check_metrics_names_event_table_lint(tmp_path):
+    """ISSUE 13 satellite: the FOURTH lint direction — every flight-event
+    kind emitted under paddle_tpu/ has a row in the doc's flight-event
+    table and vice versa, with non-literal kinds themselves flagged (a
+    computed kind could ship undocumented)."""
+    from tools.check_metrics_names import (EVENT_SECTION, check_events,
+                                           doc_event_kinds,
+                                           emitted_event_kinds)
+
+    # the current tree is clean in both directions
+    undoc, stale, problems = check_events()
+    assert undoc == set() and stale == set() and problems == []
+    kinds, _ = emitted_event_kinds()
+    assert {"queued", "route", "retry", "shed", "pump_death",
+            "fleet_unhealthy", "replica_drain"} <= kinds
+
+    # drift detection: a doc with one bogus row and none of the real ones
+    fake = tmp_path / "observability.md"
+    fake.write_text(f"# x\n\n{EVENT_SECTION}\n\n| Kind | Meaning |\n"
+                    f"|---|---|\n| `made_up_event` | ? |\n")
+    undoc, stale, _ = check_events(str(fake))
+    assert stale == {"made_up_event"}
+    assert "queued" in undoc
+
+    # a computed kind is a lint error, not a silent gap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        'flight.record("documented_kind", a=1)\n'
+        'self.flight.record("undocumented_kind")\n'
+        'flight.record("prefix_" + op)\n'
+        'other.record("not_a_flight_event")\n')
+    fake.write_text(f"# x\n\n{EVENT_SECTION}\n\n| Kind | Meaning |\n"
+                    f"|---|---|\n| `documented_kind` | ok |\n")
+    undoc, stale, problems = check_events(str(fake), str(root))
+    assert undoc == {"undocumented_kind"}
+    assert stale == set()
+    assert len(problems) == 1 and "not a string literal" in problems[0]
+
+    # a doc without the anchor section is a loud error
+    nosec = tmp_path / "empty.md"
+    nosec.write_text("# nothing\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="Flight event reference"):
+        doc_event_kinds(str(nosec))
+
+
+def test_trace_dump_merge_stitches_processes_with_offsets(tmp_path,
+                                                         capsys):
+    """ISSUE 13: --merge stitches span FILES (meta identity line + clock
+    offset applied) into one Chrome trace with a process group per file,
+    and load_spans still reads a meta-bearing file transparently."""
+    import json as _json
+
+    from tools.trace_dump import load_spans, load_trace_file, main
+
+    router = tmp_path / "router.jsonl"
+    with open(router, "w") as f:
+        f.write(_json.dumps({"meta": {"process": {
+            "role": "router", "pid": 1, "addr": "h:1"},
+            "offset_s": 0.0}}) + "\n")
+        f.write(_json.dumps({"seq": 0, "name": "ingress",
+                             "track": "req:t", "ts": 50.0, "dur": 2.0,
+                             "attrs": {"trace_id": "aa"}}) + "\n")
+    replica = tmp_path / "replica.jsonl"
+    with open(replica, "w") as f:
+        f.write(_json.dumps({"meta": {"process": {
+            "role": "replica", "pid": 2, "addr": "h:2"},
+            "offset_s": 45.0}}) + "\n")           # epoch 45s behind
+        f.write(_json.dumps({"seq": 0, "name": "decode",
+                             "track": "req:t", "ts": 5.5, "dur": 1.0,
+                             "attrs": {"trace_id": "aa"}}) + "\n")
+
+    # meta line is transparent to the single-file loaders
+    assert [s["name"] for s in load_spans(str(router))] == ["ingress"]
+    meta, spans = load_trace_file(str(replica))
+    assert meta["process"]["role"] == "replica" and len(spans) == 1
+
+    out = tmp_path / "fleet.json"
+    assert main([str(router), str(replica), "--merge",
+                 "-o", str(out)]) == 0
+    assert "2 processes" in capsys.readouterr().out
+    merged = _json.loads(out.read_text())
+    evs = merged["traceEvents"]
+    procs = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert len(procs) == 2
+    ing = next(e for e in evs if e["name"] == "ingress")
+    dec = next(e for e in evs if e["name"] == "decode")
+    assert ing["pid"] != dec["pid"]
+    # offset applied then globally rebased: decode starts 0.5s into
+    # the ingress span (50.5 vs 50.0 in the aligned timebase)
+    assert ing["ts"] == 0.0
+    assert dec["ts"] == 0.5e6
+    assert dec["args"]["trace_id"] == ing["args"]["trace_id"]
+
+    # several files WITHOUT --merge is an explicit error, not a guess
+    assert main([str(router), str(replica)]) == 2
+    # single-file path unchanged (no --merge needed)
+    assert main([str(router), "-o", str(tmp_path / "one.json")]) == 0
+
+
 def test_trace_dump_summary_lanes_and_compile_breakdown(tmp_path, capsys):
     """ISSUE 6: --summary must make a recompile storm visible from the
     trace file alone — per-lane counts plus a compile-lane table with

@@ -674,7 +674,7 @@ def measure_fleet(args) -> dict:
     warm_prompts = make_prefix_prompts(pool_seed=args.seed + 1000,
                                        seed=args.seed + 1001, **wl)
 
-    def one_arm(n_replicas: int, policy):
+    def one_arm(n_replicas: int, policy, trace_probe: bool = False):
         from paddle_tpu.fleet import FleetRouter
 
         procs, addrs = [], []
@@ -687,7 +687,16 @@ def measure_fleet(args) -> dict:
             if policy is None:
                 host, port = addrs[0]
             else:
-                rt = FleetRouter(port=0, replicas=addrs, policy=policy)
+                rkw = {}
+                if trace_probe:
+                    # the probe arm gets a PRIVATE router tracer ring so
+                    # flipping it cannot touch the bench process's
+                    # global tracer state
+                    from paddle_tpu.obs import Tracer
+
+                    rkw["tracer"] = Tracer()
+                rt = FleetRouter(port=0, replicas=addrs, policy=policy,
+                                 **rkw)
                 host, port = rt.start_background()
             warm = run_client_workload(host, port, warm_prompts,
                                        args.max_new, args.concurrency)
@@ -708,6 +717,94 @@ def measure_fleet(args) -> dict:
                     s = c.stats()
                 rec["sheds"] = s["sheds"]
                 rec["retries"] = s["retries"]
+            if trace_probe and rt is not None:
+                # the fleet trace-overhead probe, through the ROUTER
+                # path on the SAME fleet (fresh replicas per pass would
+                # drown the signal in process jitter — the lesson of
+                # bench.py's single-engine probe, which reuses one
+                # engine): an off pass and an on pass back to back on
+                # the warmed fleet, tracing flipped LIVE between them —
+                # the trace RPC's `enable` switch on every replica plus
+                # the router's private ring.  Each pass draws a FRESH
+                # prefix pool so both see cold measured prefixes.
+                # Budget: <= 2% tok/s cost (negative = noise).
+                import numpy as np
+
+                from paddle_tpu.serving.client import ServingClient
+
+                def set_tracing(on: bool):
+                    for h_, p_ in addrs:
+                        with ServingClient(h_, p_, timeout=60) as c:
+                            c.trace(pings=1, enable=on)
+                    rt.tracer.enabled = on
+
+                # interleaved cycles with ALTERNATING order (off,on then
+                # on,off): the fleet keeps warming monotonically across
+                # passes (allocator, trees, host JIT), so a fixed order
+                # reads the warming trend as tracing cost — alternation
+                # cancels a linear drift exactly out of the means
+                offs, ons, cycle_pcts = [], [], []
+                # probe passes are sized UP from the arm workload (4x,
+                # floor 128): the off/on delta is a couple percent at
+                # most, so each pass must be long enough that client/
+                # thread setup jitter sits well under it
+                pwl = dict(wl, n=max(int(wl["n"]) * 4, 128))
+                # probe passes SATURATE the fleet (closed loop, enough
+                # client threads to keep every slot busy): an
+                # underutilized fleet measures OS thread scheduling, not
+                # serving throughput — saturation is where a tracing
+                # cost would show and where the rate is stable
+                pconc = max(args.concurrency, 8)
+                # one DISCARDED pass at probe scale first: the arm's
+                # warmup ran at workload scale, and the first probe-
+                # scale pass is itself a warmup (fuller pools, new
+                # allocation pattern) — its transient would otherwise
+                # land entirely on whichever side runs first
+                run_client_workload(
+                    host, port, make_prefix_prompts(
+                        pool_seed=args.seed + 1900,
+                        seed=args.seed + 1901, **pwl),
+                    args.max_new, pconc)
+                for cyc in range(max(1, int(getattr(
+                        args, "trace_overhead_cycles", 5)))):
+                    order = (False, True) if cyc % 2 == 0 \
+                        else (True, False)
+                    pair = {}
+                    for on_pass in order:
+                        prompts = make_prefix_prompts(
+                            pool_seed=args.seed + 2000 + 10 * cyc
+                            + int(on_pass),
+                            seed=args.seed + 2500 + 10 * cyc
+                            + int(on_pass), **pwl)
+                        set_tracing(on_pass)
+                        r = run_client_workload(host, port, prompts,
+                                                args.max_new, pconc)
+                        rec["failures"] = rec["failures"] + r["failures"]
+                        pair[on_pass] = r["tok_per_sec"]
+                        (ons if on_pass else offs).append(
+                            r["tok_per_sec"])
+                    if pair.get(False):
+                        # per-cycle pairwise overhead: the two passes of
+                        # a cycle are adjacent in time, so slow machine
+                        # drift cancels within each pair; the MEDIAN
+                        # over cycles then discards a contended outlier
+                        cycle_pcts.append(
+                            100.0 * (pair[False] - pair[True])
+                            / pair[False])
+                set_tracing(False)
+                rec["trace_off_tok_per_sec"] = round(
+                    float(np.mean(offs)), 1)
+                rec["trace_on_tok_per_sec"] = round(
+                    float(np.mean(ons)), 1)
+                rec["trace_overhead_pct"] = round(
+                    float(np.median(cycle_pcts)), 2) \
+                    if cycle_pcts else 0.0
+                # per-cycle spread, so a reader can tell a real cost
+                # from machine noise (the CPU-rehearse caveat PERF.md
+                # applies to every serving number)
+                rec["trace_overhead_spread_pct"] = round(
+                    float(np.max(cycle_pcts) - np.min(cycle_pcts)), 2) \
+                    if cycle_pcts else 0.0
             return rec
         finally:
             if rt is not None:
@@ -716,7 +813,8 @@ def measure_fleet(args) -> dict:
 
     single = one_arm(1, None)
     random_arm = one_arm(args.fleet, "random")
-    affinity = one_arm(args.fleet, "affinity")
+    affinity = one_arm(args.fleet, "affinity",
+                       trace_probe=getattr(args, "trace_overhead", True))
     ok = not (single["failures"] or random_arm["failures"]
               or affinity["failures"])
     return {
@@ -725,6 +823,11 @@ def measure_fleet(args) -> dict:
         "ok": ok,
         "failures": (single["failures"] + random_arm["failures"]
                      + affinity["failures"])[:5],
+        "trace_off_tok_per_sec": affinity.get("trace_off_tok_per_sec"),
+        "trace_on_tok_per_sec": affinity.get("trace_on_tok_per_sec"),
+        "trace_overhead_pct": affinity.get("trace_overhead_pct"),
+        "trace_overhead_spread_pct":
+            affinity.get("trace_overhead_spread_pct"),
         "tok_per_sec": round(affinity["tok_per_sec"], 1),
         "single_tok_per_sec": round(single["tok_per_sec"], 1),
         "random_tok_per_sec": round(random_arm["tok_per_sec"], 1),
@@ -879,6 +982,11 @@ def main() -> int:
                          "random prefix hit rates)")
     ap.add_argument("--concurrency", type=int, default=8,
                     help="client threads driving the fleet workload")
+    ap.add_argument("--no-trace-overhead", dest="trace_overhead",
+                    action="store_false", default=True,
+                    help="skip the fleet trace-overhead arm (a fourth "
+                         "affinity arm with router + replica tracing ON "
+                         "through the router path; <= 2%% tok/s budget)")
     ap.add_argument("--prompt-dist", choices=["uniform", "heavy-tail"],
                     default="uniform",
                     help="heavy-tail: lognormal body + Pareto tail prompt "
@@ -941,13 +1049,16 @@ def main() -> int:
             "max_new": args.max_new, "dim": args.dim,
             "layers": args.layers, "dtype": args.dtype,
             "lm_serving_fleet_tok_per_sec": m["tok_per_sec"],
+            "lm_serving_fleet_trace_overhead_pct": m["trace_overhead_pct"],
             **{k: m[k] for k in (
                 "fleet", "concurrency", "single_tok_per_sec",
                 "random_tok_per_sec", "speedup_vs_single",
                 "hit_rate_affinity", "hit_rate_random", "hit_rate_single",
                 "affinity_hit_gt_random", "first_tok_ms_p50",
                 "random_first_tok_ms_p50", "router_sheds",
-                "router_retries", "ok", "failures")},
+                "router_retries", "trace_off_tok_per_sec",
+                "trace_on_tok_per_sec", "trace_overhead_spread_pct",
+                "ok", "failures")},
         }), flush=True)
         return 0 if m["ok"] else 1
 

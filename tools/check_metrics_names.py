@@ -4,7 +4,7 @@ The metric catalog (paddle_tpu/obs/metrics.py CATALOG) is the single
 source of truth for every metric name this repo emits — the strict
 registries (serving server, trainer) refuse names outside it at runtime,
 so any metric that actually renders is catalogued.  This lint closes the
-loop in all three directions:
+loop in FOUR directions:
 
   * every CATALOG name must appear as a `` `name` `` row in the
     "## Metric reference" section of docs/observability.md (a metric
@@ -15,7 +15,15 @@ loop in all three directions:
     `paddle_tpu/` outside the CATALOG block itself (a dead catalog row —
     a metric nothing declares or collects — cannot linger and mislead
     dashboards; the CATALOG assignment in obs/metrics.py is excluded via
-    ast so a row cannot vouch for itself).
+    ast so a row cannot vouch for itself);
+  * every flight-recorder event `kind` emitted under `paddle_tpu/` must
+    have a row in the doc's "## Flight event reference" table, and every
+    row there must name an emitted kind — the metric lint's sibling:
+    before this, event names had no lockstep check at all.  Emission
+    sites are found by AST (a Call on a receiver named `flight`, e.g.
+    `self.flight.record(...)` / `flight.record(...)`, whose first
+    argument must be a STRING LITERAL — a computed kind is itself a lint
+    error, because it could ship undocumented).
 
 Wired as a tier-1 test in tests/test_tools.py.  Exit 0 = in sync,
 1 = drift (all directions printed), 2 = doc/section missing.
@@ -23,6 +31,7 @@ Wired as a tier-1 test in tests/test_tools.py.  Exit 0 = in sync,
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 import sys
@@ -34,7 +43,8 @@ from paddle_tpu.obs.metrics import CATALOG  # noqa: E402
 
 DOC = os.path.join(REPO, "docs", "observability.md")
 SECTION = "## Metric reference"
-#: a metric row: a table line whose FIRST cell is a backticked name
+EVENT_SECTION = "## Flight event reference"
+#: a metric/event row: a table line whose FIRST cell is a backticked name
 _ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`")
 
 
@@ -61,6 +71,75 @@ def check(doc_path: str = DOC) -> tuple[set, set]:
     documented = doc_metric_names(doc_path)
     code = set(CATALOG)
     return code - documented, documented - code
+
+
+def doc_event_kinds(doc_path: str = DOC) -> set[str]:
+    """Event kinds documented in the doc's flight-event table."""
+    with open(doc_path) as f:
+        text = f.read()
+    if EVENT_SECTION not in text:
+        raise ValueError(f"{doc_path} has no '{EVENT_SECTION}' section — "
+                         f"the event lint anchors to it")
+    section = text.split(EVENT_SECTION, 1)[1]
+    section = re.split(r"\n## ", section, maxsplit=1)[0]
+    kinds = set()
+    for line in section.splitlines():
+        m = _ROW.match(line.strip())
+        if m:
+            kinds.add(m.group(1))
+    return kinds
+
+
+def emitted_event_kinds(root: str = None) -> tuple[set[str], list[str]]:
+    """(kinds, problems): every first-arg string literal of a
+    `*.flight.record(...)` / `flight.record(...)` call under `root`,
+    plus a problem line per call whose kind is NOT a literal (those
+    could ship undocumented, so they fail the lint)."""
+    root = root or os.path.join(REPO, "paddle_tpu")
+    kinds: set[str] = set()
+    problems: list[str] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "record"):
+                    continue
+                recv = node.func.value
+                is_flight = (isinstance(recv, ast.Name)
+                             and recv.id == "flight") or \
+                            (isinstance(recv, ast.Attribute)
+                             and recv.attr == "flight")
+                if not is_flight:
+                    continue          # e.g. CompileWatch.record(self, ...)
+                rel = os.path.relpath(path, REPO)
+                if not node.args:
+                    problems.append(f"{rel}:{node.lineno}: flight.record "
+                                    f"with no kind argument")
+                elif isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    kinds.add(node.args[0].value)
+                else:
+                    problems.append(
+                        f"{rel}:{node.lineno}: flight.record kind is not "
+                        f"a string literal — the event lint cannot see "
+                        f"it, so it could ship undocumented")
+    return kinds, problems
+
+
+def check_events(doc_path: str = DOC,
+                 root: str = None) -> tuple[set, set, list]:
+    """(undocumented, stale, problems) — all empty when in sync."""
+    documented = doc_event_kinds(doc_path)
+    emitted, problems = emitted_event_kinds(root)
+    return emitted - documented, documented - emitted, problems
 
 
 def _source_without_catalog(path: str) -> str:
@@ -109,7 +188,8 @@ def main(argv=None) -> int:
     try:
         undocumented, stale = check()
         dead = unreferenced_names()
-    except (OSError, ValueError) as e:
+        ev_undoc, ev_stale, ev_problems = check_events()
+    except (OSError, ValueError, SyntaxError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     ok = True
@@ -126,9 +206,22 @@ def main(argv=None) -> int:
         print(f"DEAD CATALOG ROW: {name!r} is in obs.metrics.CATALOG but "
               f"nothing under paddle_tpu/ references it — delete the row "
               f"or wire the metric")
+    for kind in sorted(ev_undoc):
+        ok = False
+        print(f"UNDOCUMENTED EVENT: flight kind {kind!r} is emitted under "
+              f"paddle_tpu/ but has no row in {DOC} '{EVENT_SECTION}'")
+    for kind in sorted(ev_stale):
+        ok = False
+        print(f"STALE EVENT DOC: {DOC} documents flight kind {kind!r} "
+              f"but nothing under paddle_tpu/ emits it")
+    for line in ev_problems:
+        ok = False
+        print(f"UNLINTABLE EVENT: {line}")
     if ok:
-        print(f"ok: {len(CATALOG)} metric names in sync with "
-              f"docs/observability.md and all referenced in code")
+        emitted, _ = emitted_event_kinds()
+        print(f"ok: {len(CATALOG)} metric names and {len(emitted)} flight "
+              f"event kinds in sync with docs/observability.md, all "
+              f"referenced in code")
     return 0 if ok else 1
 
 

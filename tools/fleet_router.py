@@ -44,6 +44,13 @@ def parse_addr(s: str) -> tuple[str, int]:
 async def amain(args) -> int:
     from paddle_tpu.fleet import FleetRouter
 
+    tracer = None
+    if args.trace_out:
+        from paddle_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        tracer.enabled = True
+
     rt = FleetRouter(host=args.host, port=args.port,
                      replicas=[parse_addr(s) for s in args.replica],
                      policy=args.policy,
@@ -52,20 +59,41 @@ async def amain(args) -> int:
                      wedge_age_s=args.wedge_age_s,
                      retry_limit=args.retry_limit,
                      postmortem_dir=args.postmortem_dir or None)
-    host, port = await rt.start()
-    print("FLEET_JSON:" + json.dumps(
-        {"host": host, "port": port, "pid": os.getpid()}), flush=True)
 
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, stop.set)
-    await stop.wait()
-    print("draining: refusing new requests, finishing routed ones...",
-          file=sys.stderr, flush=True)
-    await rt.drain()
-    print("drained; bye", file=sys.stderr, flush=True)
-    return 0
+    def flush_trace():
+        # EVERY exit path flushes (the serve.py discipline, PR 6): a
+        # crashed router must never leave an empty trace file — the
+        # placement/relay spans up to the failure are exactly what a
+        # postmortem wants.  The meta line stamps process identity so
+        # trace_dump --merge labels this file's track group.
+        if tracer is not None:
+            from paddle_tpu.obs import process_info
+
+            n = tracer.export_jsonl(
+                args.trace_out,
+                meta={"process": process_info("router", args.host,
+                                              rt.port)})
+            print(f"wrote {n} spans to {args.trace_out} "
+                  f"({tracer.dropped} dropped by ring wrap); convert "
+                  f"with tools/trace_dump.py", file=sys.stderr, flush=True)
+
+    try:
+        host, port = await rt.start()
+        print("FLEET_JSON:" + json.dumps(
+            {"host": host, "port": port, "pid": os.getpid()}), flush=True)
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        print("draining: refusing new requests, finishing routed ones...",
+              file=sys.stderr, flush=True)
+        await rt.drain()
+        print("drained; bye", file=sys.stderr, flush=True)
+        return 0
+    finally:
+        flush_trace()
 
 
 def main(argv=None) -> int:
@@ -97,6 +125,12 @@ def main(argv=None) -> int:
                     help="arm the flight recorder: total-fleet-unhealthy "
                          "or a client dump frame freezes an atomic "
                          "bundle here")
+    ap.add_argument("--trace-out", default="",
+                    help="enable router-side distributed tracing "
+                         "(ingress/place/relay/retry spans carrying "
+                         "trace ids); spans written as JSONL here on "
+                         "EVERY exit path — clean drain, crash, SIGTERM "
+                         "— ready for tools/trace_dump.py --merge")
     args = ap.parse_args(argv)
     return asyncio.run(amain(args))
 

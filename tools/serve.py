@@ -53,7 +53,7 @@ def run_client(args) -> int:
     host, _, port = args.client.rpartition(":")
     with ServingClient(host or "127.0.0.1", int(port)) as c:
         if args.metrics:
-            print(c.metrics(), end="")
+            print(c.metrics(aggregate=args.aggregate), end="")
             return 0
         if args.dump:
             print(json.dumps(c.dump(), indent=2))
@@ -136,12 +136,20 @@ async def amain(args) -> int:
         tracer = get_tracer()
         tracer.enabled = True
 
-    def flush_trace():
+    def flush_trace(srv=None):
         # EVERY exit path flushes — a crashed or wedged server must never
         # leave an empty trace file behind (the spans up to the failure
-        # are exactly the ones a postmortem wants)
+        # are exactly the ones a postmortem wants).  The leading meta
+        # line stamps process identity so trace_dump --merge can label
+        # this file's track group in a stitched fleet trace.
         if tracer is not None:
-            n = tracer.export_jsonl(args.trace_out)
+            from paddle_tpu.obs import process_info
+
+            n = tracer.export_jsonl(
+                args.trace_out,
+                meta={"process": process_info(
+                    "replica", args.host,
+                    srv.port if srv is not None else args.port)})
             print(f"wrote {n} spans to {args.trace_out} "
                   f"({tracer.dropped} dropped by ring wrap); convert with "
                   f"tools/trace_dump.py", file=sys.stderr, flush=True)
@@ -180,7 +188,7 @@ async def amain(args) -> int:
         print("drained; bye", file=sys.stderr, flush=True)
         return 0
     finally:
-        flush_trace()
+        flush_trace(srv)
 
 
 def main(argv=None) -> int:
@@ -249,6 +257,11 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", action="store_true",
                     help="with --client: print the Prometheus-style "
                          "metrics frame and exit")
+    ap.add_argument("--aggregate", action="store_true",
+                    help="with --client --metrics against a fleet "
+                         "router: the fleet-wide view — router fleet_* "
+                         "rows + every replica's families under a "
+                         "replica=\"rN\" label")
     ap.add_argument("--dump", action="store_true",
                     help="with --client: ask the server to freeze a "
                          "postmortem bundle and print its path (works "

@@ -1,4 +1,4 @@
-"""Convert a span-tracer JSONL dump to Chrome trace_event JSON.
+"""Convert span-tracer JSONL dumps to Chrome trace_event JSON.
 
 The span tracer (paddle_tpu/obs/trace.py) archives spans as JSON-lines —
 one span per line: {"seq", "name", "track", "ts", "dur", "attrs"?,
@@ -14,7 +14,20 @@ as bars, instants (preempt/done/cancelled/deadline) as markers.
   python tools/trace_dump.py spans.jsonl --summary      # per-name table,
                                   # per-lane counts, compile-lane breakdown
 
-Exit codes: 0 ok, 2 on unreadable/empty input.
+Distributed traces (docs/observability.md "Distributed tracing"): a
+fleet request crosses router and replica processes, each with its own
+span ring and its own perf_counter epoch.  `--merge` stitches several
+span FILES into ONE Chrome trace with a named process track group per
+file (a file's first line may be a `{"meta": {"process": ..., an
+"offset_s"}}` identity record — serve.py/fleet_router.py --trace-out
+write one); `--pull HOST:PORT` (repeatable) collects spans LIVE over the
+`trace` RPC instead, measuring each process's clock offset by
+ping-RTT midpointing so the tracks align:
+
+  python tools/trace_dump.py --pull 127.0.0.1:8440 \\
+      --pull 127.0.0.1:8431 --pull 127.0.0.1:8432 -o fleet.trace.json
+
+Exit codes: 0 ok, 2 on unreadable/empty input or an unreachable --pull.
 """
 
 from __future__ import annotations
@@ -26,11 +39,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from paddle_tpu.obs.trace import spans_to_chrome  # noqa: E402
+from paddle_tpu.obs.trace import merge_chrome, spans_to_chrome  # noqa: E402
 
 
-def load_spans(path: str) -> list[dict]:
-    """Read a JSONL span file; skips blank lines, raises on garbage."""
+def load_trace_file(path: str) -> tuple[dict, list[dict]]:
+    """Read a JSONL span file as (meta, spans).  `meta` is the optional
+    leading identity record ({"process": ..., "offset_s": ...}; {} when
+    the file has none — plain Tracer.export_jsonl output)."""
+    meta: dict = {}
     spans = []
     with open(path) as f:
         for i, line in enumerate(f, 1):
@@ -41,6 +57,10 @@ def load_spans(path: str) -> list[dict]:
                 rec = json.loads(line)
             except ValueError as e:
                 raise ValueError(f"{path}:{i}: not JSON: {e}") from e
+            if isinstance(rec, dict) and "meta" in rec and \
+                    "name" not in rec:
+                meta = rec["meta"] if isinstance(rec["meta"], dict) else {}
+                continue
             if not isinstance(rec, dict) or "name" not in rec \
                     or "ts" not in rec:
                 raise ValueError(f"{path}:{i}: not a span record "
@@ -49,7 +69,30 @@ def load_spans(path: str) -> list[dict]:
                 raise ValueError(f"{path}:{i}: complete span without a "
                                  f"dur field: {rec!r}")
             spans.append(rec)
-    return spans
+    return meta, spans
+
+
+def load_spans(path: str) -> list[dict]:
+    """Read a JSONL span file; skips blank lines (and a meta identity
+    line), raises on garbage."""
+    return load_trace_file(path)[1]
+
+
+def pull_source(addr: str, timeout: float = 60.0) -> dict:
+    """One live `trace` RPC pull -> a merge_chrome() source: spans +
+    process identity + the ping-RTT-measured clock offset mapping that
+    process's perf_counter timebase onto this tool's."""
+    from paddle_tpu.serving.client import ServingClient
+
+    host, _, port = addr.rpartition(":")
+    with ServingClient(host or "127.0.0.1", int(port),
+                       timeout=timeout) as c:
+        msg = c.trace()
+    return {"spans": msg.get("spans") or [],
+            "process": msg.get("process"),
+            "offset_s": msg.get("offset_s", 0.0),
+            "recorded": msg.get("recorded"),
+            "dropped": msg.get("dropped")}
 
 
 def summarize(spans: list[dict]) -> str:
@@ -124,8 +167,9 @@ def compile_breakdown(spans: list[dict]) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", help="span JSONL (tools/serve.py --trace-out, "
-                                  "or Tracer.export_jsonl)")
+    ap.add_argument("jsonl", nargs="*",
+                    help="span JSONL file(s) (tools/serve.py --trace-out, "
+                         "or Tracer.export_jsonl); several need --merge")
     ap.add_argument("-o", "--out", default="",
                     help="write Chrome trace_event JSON here "
                          "(default: <input>.trace.json)")
@@ -133,26 +177,67 @@ def main(argv=None) -> int:
                     help="print per-span-name and per-lane tables (plus a "
                          "compile-lane breakdown when present) instead of "
                          "writing")
+    ap.add_argument("--merge", action="store_true",
+                    help="stitch several span files (and any --pull "
+                         "sources) into ONE Chrome trace with a process "
+                         "track group per source, applying each file's "
+                         "meta offset_s")
+    ap.add_argument("--pull", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="collect spans live over the `trace` RPC from a "
+                         "replica server or fleet router (repeatable; "
+                         "clock offset measured per pull via ping RTT); "
+                         "implies --merge")
     args = ap.parse_args(argv)
 
+    if len(args.jsonl) > 1 and not (args.merge or args.pull):
+        print("error: several input files need --merge (one Chrome trace "
+              "with a process group per file)", file=sys.stderr)
+        return 2
+    if not args.jsonl and not args.pull:
+        ap.error("need a span JSONL file or --pull HOST:PORT")
+
+    sources = []
     try:
-        spans = load_spans(args.jsonl)
-    except (OSError, ValueError) as e:
+        for path in args.jsonl:
+            meta, spans = load_trace_file(path)
+            sources.append({"spans": spans,
+                            "process": meta.get("process"),
+                            "offset_s": float(meta.get("offset_s", 0.0)),
+                            "label": os.path.basename(path)
+                            if not meta.get("process") else None})
+        for addr in args.pull:
+            sources.append(pull_source(addr))
+    except (OSError, ValueError, ConnectionError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if not spans:
-        print(f"error: {args.jsonl} holds no spans (tracing never "
-              f"enabled, or the ring was cleared)", file=sys.stderr)
+    all_spans = [s for src in sources for s in src["spans"]]
+    if not all_spans:
+        print(f"error: {', '.join(args.jsonl + args.pull)} holds no spans "
+              f"(tracing never enabled, or the ring was cleared)",
+              file=sys.stderr)
         return 2
 
     if args.summary:
-        print(summarize(spans))
+        print(summarize(all_spans))
         return 0
 
-    out = args.out or args.jsonl + ".trace.json"
+    if args.merge or args.pull or len(sources) > 1:
+        out = args.out or ((args.jsonl[0] if args.jsonl
+                            else "fleet") + ".trace.json")
+        with open(out, "w") as f:
+            json.dump(merge_chrome(sources), f)
+        names = [(src.get("process") or {}).get("role") or
+                 src.get("label") or "?" for src in sources]
+        print(f"wrote {out}: {len(all_spans)} spans across "
+              f"{len(sources)} processes ({', '.join(names)}) — load in "
+              f"https://ui.perfetto.dev or chrome://tracing")
+        return 0
+
+    out = args.out or args.jsonl[0] + ".trace.json"
     with open(out, "w") as f:
-        json.dump(spans_to_chrome(spans), f)
-    print(f"wrote {out}: {len(spans)} spans — load in "
+        json.dump(spans_to_chrome(all_spans), f)
+    print(f"wrote {out}: {len(all_spans)} spans — load in "
           f"https://ui.perfetto.dev or chrome://tracing")
     return 0
 
