@@ -799,6 +799,109 @@ def bench_serving_spec(dtype: str) -> dict:
     }
 
 
+def bench_train_dist(dtype: str) -> dict:
+    """Parameter-server training record (paddle_tpu/pserver/,
+    docs/distributed_training.md): K sync trainer PROCESSES
+    (tools/train_dist.py) over one tools/pserver.py shard vs a 1-trainer
+    fleet through the IDENTICAL machinery — the scaling-efficiency A/B
+    of the distributed tier itself.  Headline = K-trainer aggregate
+    samples/sec; companions are the single-trainer arm, the efficiency
+    (agg / K*single — the sync-barrier + wire tax), and the server's
+    commit accounting.  Every process runs the CPU backend (K trainers
+    cannot share one chip, and the tier under test is the wire/barrier/
+    update machinery, not the matmul).  Bit-exactness vs grad_accum=K is
+    tests/test_train_dist.py's job."""
+    import signal
+    import subprocess
+    import time as _time
+
+    trainers = int(os.environ.get("BENCH_DIST_TRAINERS", "2"))
+    passes = int(os.environ.get("BENCH_DIST_PASSES", "2"))
+    samples = int(os.environ.get("BENCH_DIST_SAMPLES", "2048"))
+    batch = int(os.environ.get("BENCH_DIST_BATCH", "32"))
+    dim = int(os.environ.get("BENCH_DIST_DIM", "64"))
+    hidden = int(os.environ.get("BENCH_DIST_HIDDEN", "256"))
+    to_s = float(os.environ.get("BENCH_DIST_TIMEOUT_S", "600"))
+    cfg_args = (f"samples={samples},batch_size={batch},dim={dim},"
+                f"hidden={hidden}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run_fleet(k: int) -> dict:
+        ps = subprocess.Popen(
+            [sys.executable, "tools/pserver.py", "--port", "0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            import select
+
+            line = ""
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and ps.poll() is None:
+                # select-gate the read: a bound-but-silent pserver must
+                # trip THIS deadline, not block readline() until the
+                # queue's outer hard timeout kills the bench undiagnosed
+                r, _w, _x = select.select([ps.stdout], [], [], 1.0)
+                if not r:
+                    continue
+                line = ps.stdout.readline()
+                if line.startswith("PSERVER_JSON:"):
+                    break
+            if not line.startswith("PSERVER_JSON:"):
+                raise RuntimeError("pserver never printed its bind line "
+                                   "within 120s")
+            port = json.loads(line.split("PSERVER_JSON:", 1)[1])["port"]
+            procs = [subprocess.Popen(
+                [sys.executable, "tools/train_dist.py",
+                 "--config", "demo/distributed/mlp_dist.py",
+                 "--config-args", cfg_args,
+                 "--pserver", f"127.0.0.1:{port}",
+                 "--rank", str(r), "--trainers", str(k),
+                 "--passes", str(passes)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True) for r in range(k)]
+            stats = []
+            for p in procs:
+                out, _err = p.communicate(timeout=to_s)
+                if p.returncode != 0:
+                    raise RuntimeError(f"trainer rc={p.returncode}")
+                for ln in out.splitlines():
+                    if ln.startswith("TRAIN_JSON:"):
+                        stats.append(json.loads(
+                            ln.split("TRAIN_JSON:", 1)[1]))
+            assert len(stats) == k
+            total = sum(s["samples"] for s in stats)
+            wall = max(s["seconds"] for s in stats)
+            return {"samples": total, "wall_s": wall,
+                    "samples_per_sec": total / wall if wall else 0.0}
+        finally:
+            if ps.poll() is None:
+                ps.send_signal(signal.SIGTERM)
+                try:
+                    ps.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    ps.kill()
+
+    single = run_fleet(1)
+    fleet = run_fleet(trainers)
+    eff = (fleet["samples_per_sec"]
+           / (trainers * single["samples_per_sec"])
+           if single["samples_per_sec"] else 0.0)
+    return {
+        "metric": "train_dist_samples_per_sec",
+        "value": round(fleet["samples_per_sec"], 2),
+        "unit": "samples/sec (fleet aggregate)",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"trainers={trainers} passes={passes} "
+                  f"samples={samples} batch={batch} dim={dim} "
+                  f"hidden={hidden} (cpu trainers — the tier under test "
+                  f"is the wire/barrier/update machinery)",
+        "single_samples_per_sec": round(single["samples_per_sec"], 2),
+        "scaling_efficiency": round(eff, 4),
+        "trainers": trainers,
+        "fleet_wall_s": round(fleet["wall_s"], 3),
+    }
+
+
 BENCHES = {
     "vgg": bench_vgg,
     "seq2seq": bench_seq2seq,
@@ -809,6 +912,7 @@ BENCHES = {
     "serving_fleet": bench_serving_fleet,
     "serving_tp": bench_serving_tp,
     "serving_spec": bench_serving_spec,
+    "train_dist": bench_train_dist,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
     "recommendation": bench_recommendation,
@@ -934,6 +1038,7 @@ _METRIC_OF = {
     "serving_fleet": "lm_serving_fleet_tok_per_sec",
     "serving_tp": "lm_serving_tp_tok_per_sec",
     "serving_spec": "lm_serving_spec_tok_per_sec",
+    "train_dist": "train_dist_samples_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
     "recommendation": "movielens_recsys_train_samples_per_sec_per_chip",
@@ -1017,7 +1122,8 @@ def _assemble_lkg() -> dict | None:
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
-                "serving_fleet", "serving_tp", "serving_spec", "mnist",
+                "serving_fleet", "serving_tp", "serving_spec",
+                "train_dist", "mnist",
                 "sentiment", "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None

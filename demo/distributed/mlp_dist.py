@@ -1,0 +1,44 @@
+"""MLP config for the parameter-server training demo/bench
+(docs/distributed_training.md).  Deterministic synthetic data; shapes
+ride --config_args so the bench can scale the wire traffic:
+
+  python tools/train_dist.py --config demo/distributed/mlp_dist.py \
+      --config-args "dim=64,hidden=256,batch_size=32" \
+      --pserver 127.0.0.1:8571 --rank 0 --trainers 2
+"""
+
+from paddle_tpu.dsl import *  # noqa: F401,F403
+
+dim = get_config_arg("dim", int, 32)          # noqa: F821
+hidden = get_config_arg("hidden", int, 64)    # noqa: F821
+classes = get_config_arg("classes", int, 8)   # noqa: F821
+batch_size = get_config_arg("batch_size", int, 16)   # noqa: F821
+samples = get_config_arg("samples", int, 1024)       # noqa: F821
+compute_dtype = get_config_arg("compute_dtype", str, "")  # noqa: F821
+# the full update-rule surface the sync exactness contract covers:
+# L2 weight decay + model averaging ride config args so the oracle
+# tests (and curious operators) can flip them on
+l2 = get_config_arg("l2", float, 0.0)                # noqa: F821
+avg_window = get_config_arg("avg_window", float, 0.0)  # noqa: F821
+
+define_py_data_sources2(
+    train_list="none", test_list=None,
+    module="demo.distributed.synth_provider", obj="process",
+    args={"dim": dim, "classes": classes, "n": samples})
+
+settings(batch_size=batch_size, learning_rate=0.05,
+         learning_method=MomentumOptimizer(momentum=0.9),  # noqa: F405
+         regularization=(L2Regularization(l2)      # noqa: F405
+                         if l2 else None),
+         learning_rate_schedule="poly",
+         learning_rate_decay_a=0.001, learning_rate_decay_b=0.5,
+         average_window=avg_window, max_average_window=3,
+         compute_dtype=compute_dtype)
+
+x = data_layer(name="x", size=dim)            # noqa: F405
+h1 = fc_layer(input=x, size=hidden, act=TanhActivation())   # noqa: F405
+h2 = fc_layer(input=h1, size=hidden, act=TanhActivation())  # noqa: F405
+out = fc_layer(input=h2, size=classes,        # noqa: F405
+               act=SoftmaxActivation())       # noqa: F405
+classification_cost(input=out,                # noqa: F405
+                    label=data_layer(name="y", size=classes))  # noqa: F405

@@ -1,0 +1,31 @@
+"""Deterministic synthetic classification data for the distributed-
+training demo/bench (docs/distributed_training.md): every process that
+constructs this provider with the same args sees the IDENTICAL sample
+stream, which is what the pserver exactness oracle and the rank-strided
+data sharding of tools/train_dist.py assume."""
+
+import numpy as np
+
+from paddle_tpu.data.provider import (dense_vector, integer_value,
+                                      provider)
+
+
+def _init(settings, file_list, dim=32, classes=8, n=1024, seed=7, **_kw):
+    settings.dim = int(dim)
+    settings.classes = int(classes)
+    settings.n = int(n)
+    settings.seed = int(seed)
+    settings.slots = {"x": dense_vector(settings.dim),
+                      "y": integer_value(settings.classes)}
+
+
+@provider(init_hook=_init, should_shuffle=False)
+def process(settings, _file):
+    rng = np.random.default_rng(settings.seed)
+    w = rng.standard_normal((settings.dim, settings.classes))
+    for _ in range(settings.n):
+        x = rng.standard_normal(settings.dim).astype(np.float32)
+        # a learnable rule so the demo's cost actually falls
+        y = int(np.argmax(x @ w + 0.1 * rng.standard_normal(
+            settings.classes)))
+        yield [x, y]

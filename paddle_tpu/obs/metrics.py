@@ -127,6 +127,34 @@ CATALOG: dict[str, str] = {
         "prefix-affinity index entries (bounded LRU; first page-run -> "
         "replica)",
     "fleet_draining": "1 while the router refuses new work to drain",
+    # -- parameter server (paddle_tpu/pserver/) ----------------------------
+    "pserver_version": "optimizer updates committed (the parameter version)",
+    "pserver_pass_id": "training passes completed server-side",
+    "pserver_trainers_active": "trainers the sync barrier waits for",
+    "pserver_trainers_draining":
+        "trainers finishing a final batch before leaving (never stall "
+        "the barrier)",
+    "pserver_updates_total": "optimizer applies (sync windows + async "
+        "contributions) committed by the update thread",
+    "pserver_grads_received_total": "send_grad frames accepted",
+    "pserver_grads_discarded_total":
+        "in-flight contributions discarded (dead trainer mid-window, or "
+        "the drop-last convention at a pass barrier)",
+    "pserver_async_rejected_total":
+        "async gradients refused for exceeding max_staleness (the "
+        "trainer must re-pull)",
+    "pserver_async_staleness":
+        "versions behind at async apply — the honest divergence signal "
+        "of bounded-staleness training",
+    "pserver_barrier_wait_seconds":
+        "time a sync barrier waiter spent blocked until its window "
+        "committed (straggler skew shows here)",
+    "pserver_snapshots_total": "streaming checkpoints committed",
+    "pserver_snapshot_seconds":
+        "wall seconds per streaming checkpoint (capture is O(blocks) "
+        "pointer copies; the write overlaps live send_grad traffic)",
+    "pserver_blocks": "parameter/optimizer blocks held by this shard",
+    "pserver_block_bytes": "bytes held by this shard's parameter blocks",
     # -- pump-thread heartbeat watchdog -----------------------------------
     "pump_alive":
         "1 while the engine pump is running (0 the moment it has fatally "

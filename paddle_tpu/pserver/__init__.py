@@ -1,0 +1,43 @@
+"""Elastic parameter-server training tier.
+
+The TPU-native rebuild of the reference's layers 5-6 (ref:
+paddle/pserver/ParameterServer2.{h,cpp} addGradient/getParameter + block
+maps :120-145, ParameterClient2, trainer/RemoteParameterUpdater.{h,cpp}),
+re-expressed over the serving wire protocol (`serving/wire.py`) with the
+PS-vs-graph placement lesson of the TensorFlow paper (arXiv:1605.08695):
+the parameter + optimizer-state blocks live in a thin restartable server
+tier, the heavy forward/backward math stays on the trainers' devices, and
+the server's update math is the REPO'S OWN `optim/updater.py` applied at
+block granularity — which is what makes the sync mode bit-exact against
+a single-process `grad_accum=K` run.
+
+    pserver/blocks.py      deterministic block map + array wire codec
+    pserver/membership.py  elastic trainer membership state machine
+    pserver/server.py      ParameterServer (asyncio) + UpdateEngine
+    pserver/client.py      ParameterClient (blocking sockets, jax-free)
+
+The trainer-side half is `optim/remote_updater.py`
+(RemoteParameterUpdater — the third member of the reference's
+local/thread/remote updater family) behind the same interface as the
+local `ParameterUpdater`.  CLIs: `tools/pserver.py`, `tools/train_dist.py`.
+Design doc: docs/distributed_training.md.
+"""
+
+from paddle_tpu.pserver.blocks import BlockMap, decode_array, encode_array
+from paddle_tpu.pserver.membership import Membership, TrainerMember
+
+__all__ = ["BlockMap", "Membership", "TrainerMember", "decode_array",
+           "encode_array", "ParameterServer", "ParameterClient"]
+
+
+def __getattr__(name):
+    # ParameterServer pulls in jax (update math); ParameterClient is
+    # deliberately jax-free — lazy both so `import paddle_tpu.pserver`
+    # stays cheap for client-side tools
+    if name == "ParameterServer":
+        from paddle_tpu.pserver.server import ParameterServer
+        return ParameterServer
+    if name == "ParameterClient":
+        from paddle_tpu.pserver.client import ParameterClient
+        return ParameterClient
+    raise AttributeError(name)

@@ -1,0 +1,192 @@
+"""Deterministic block map + array wire codec for the parameter server.
+
+The reference partitions every parameter into fixed-size blocks and deals
+them across server shards (ref: ParameterServer2.h:120-145 BlockInfo /
+BlockIdMap, ParameterConfig blocks) so no shard needs a whole large
+parameter and update work load-balances.  This module is the TPU-native
+re-expression: each parameter's FLAT value is cut into `block_size`-element
+runs, and block `g` (a global counter over parameters in sorted-name
+order) lives on shard `g % n_shards`.  The map is a pure function of
+(sorted param specs, block_size, n_shards) — every trainer and every
+server shard derives the identical map from the `ps_init` config, nothing
+is negotiated.
+
+Because the optimizer family (optim/optimizers.py) is elementwise, a
+block-granular update is bit-identical to the whole-parameter update —
+the property the sync-mode exactness oracle rests on.
+
+Wire codec: arrays travel as {"dtype", "shape", "b64"} with the raw
+little-endian bytes base64'd inside the JSON frame — bit-exact by
+construction (no float/decimal round trip), debuggable with `nc` like the
+rest of the protocol.  numpy + stdlib only; no jax (the client side must
+stay importable on a box with no accelerator stack).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Iterable, Optional
+
+import numpy as np
+
+#: default elements per block — small enough that a shard map over a few
+#: MLP layers actually spreads, large enough that framing overhead stays
+#: trivial for real models
+DEFAULT_BLOCK_SIZE = 1 << 16
+
+
+def encode_array(arr: np.ndarray) -> dict:
+    """Array -> JSON-safe wire dict; bit-exact round trip."""
+    a = np.ascontiguousarray(arr)
+    return {"dtype": a.dtype.name, "shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def decode_array(d: dict) -> np.ndarray:
+    """Wire dict -> array (owns its buffer; writable)."""
+    raw = base64.b64decode(d["b64"])
+    a = np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
+    return a.reshape([int(x) for x in d["shape"]]).copy()
+
+
+class BlockRef:
+    """One block of one parameter: flat range [start, stop) on `shard`."""
+
+    __slots__ = ("name", "idx", "start", "stop", "shard")
+
+    def __init__(self, name: str, idx: int, start: int, stop: int,
+                 shard: int):
+        self.name, self.idx = name, idx
+        self.start, self.stop = start, stop
+        self.shard = shard
+
+    @property
+    def bid(self) -> str:
+        return f"{self.name}#{self.idx}"
+
+    @property
+    def size(self) -> int:
+        return self.stop - self.start
+
+    def __repr__(self):
+        return (f"BlockRef({self.bid}, [{self.start}:{self.stop}) "
+                f"-> s{self.shard})")
+
+
+class BlockMap:
+    """The deterministic (param specs, block_size, n_shards) -> shard map.
+
+    `specs` is {name: (shape tuple, dtype name)}; iteration is ALWAYS over
+    sorted names, so two processes building from the same specs hold the
+    same global block numbering and therefore the same shard assignment.
+    """
+
+    def __init__(self, specs: dict[str, tuple], n_shards: int = 1,
+                 block_size: int = DEFAULT_BLOCK_SIZE):
+        assert n_shards >= 1 and block_size >= 1
+        self.n_shards = int(n_shards)
+        self.block_size = int(block_size)
+        self.specs = {str(n): (tuple(int(d) for d in shape), str(dtype))
+                      for n, (shape, dtype) in specs.items()}
+        self.blocks: dict[str, list[BlockRef]] = {}
+        g = 0
+        for name in sorted(self.specs):
+            shape, _ = self.specs[name]
+            size = int(np.prod(shape)) if shape else 1
+            refs = []
+            for i, start in enumerate(range(0, max(size, 1),
+                                            self.block_size)):
+                stop = min(size, start + self.block_size)
+                refs.append(BlockRef(name, i, start, stop,
+                                     g % self.n_shards))
+                g += 1
+            self.blocks[name] = refs
+        self.n_blocks = g
+        self._by_bid = {r.bid: r for refs in self.blocks.values()
+                        for r in refs}
+
+    @classmethod
+    def from_arrays(cls, params: dict[str, np.ndarray], n_shards: int = 1,
+                    block_size: int = DEFAULT_BLOCK_SIZE) -> "BlockMap":
+        return cls({n: (np.shape(a), np.asarray(a).dtype.name)
+                    for n, a in params.items()},
+                   n_shards=n_shards, block_size=block_size)
+
+    # -- wire config (what ps_init carries) --------------------------------
+    def config(self) -> dict:
+        return {"block_size": self.block_size, "n_shards": self.n_shards,
+                "params": {n: [list(shape), dtype]
+                           for n, (shape, dtype) in self.specs.items()}}
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "BlockMap":
+        return cls({n: (tuple(shape), dtype)
+                    for n, (shape, dtype) in cfg["params"].items()},
+                   n_shards=int(cfg["n_shards"]),
+                   block_size=int(cfg["block_size"]))
+
+    # -- lookups -----------------------------------------------------------
+    def ref(self, bid: str) -> BlockRef:
+        return self._by_bid[bid]
+
+    def shard_blocks(self, shard: int) -> list[BlockRef]:
+        """This shard's blocks, in global (sorted-name, block-idx) order —
+        the canonical iteration order everywhere."""
+        return [r for name in sorted(self.blocks)
+                for r in self.blocks[name] if r.shard == shard]
+
+    def shard_of(self, bid: str) -> int:
+        return self._by_bid[bid].shard
+
+    # -- split / assemble --------------------------------------------------
+    def split(self, name: str, arr: np.ndarray,
+              shard: Optional[int] = None) -> dict[str, np.ndarray]:
+        """One parameter -> {bid: flat block} (optionally only `shard`'s)."""
+        flat = np.ascontiguousarray(arr).reshape(-1)
+        out = {}
+        for r in self.blocks[name]:
+            if shard is not None and r.shard != shard:
+                continue
+            out[r.bid] = flat[r.start:r.stop]
+        return out
+
+    def split_all(self, params: dict[str, np.ndarray],
+                  shard: Optional[int] = None) -> dict[str, np.ndarray]:
+        out = {}
+        for name in sorted(self.blocks):
+            out.update(self.split(name, params[name], shard=shard))
+        return out
+
+    def assemble(self, name: str,
+                 blocks: dict[str, np.ndarray]) -> np.ndarray:
+        """{bid: flat block} (superset ok) -> the full parameter."""
+        shape, dtype = self.specs[name]
+        refs = self.blocks[name]
+        parts = []
+        for r in refs:
+            if r.bid not in blocks:
+                raise KeyError(f"assemble({name!r}): missing block {r.bid} "
+                               f"— pulled from too few shards?")
+            part = np.asarray(blocks[r.bid]).reshape(-1)
+            if part.size != r.size:
+                raise ValueError(f"block {r.bid}: got {part.size} elements, "
+                                 f"map says {r.size}")
+            parts.append(part)
+        flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return flat.reshape(shape).astype(np.dtype(dtype), copy=False)
+
+    def assemble_all(self, blocks: dict[str, np.ndarray]
+                     ) -> dict[str, np.ndarray]:
+        return {name: self.assemble(name, blocks)
+                for name in sorted(self.specs)}
+
+    def names(self) -> Iterable[str]:
+        return sorted(self.specs)
+
+    def __eq__(self, other):
+        return (isinstance(other, BlockMap)
+                and self.config() == other.config())
+
+    def __repr__(self):
+        return (f"BlockMap({len(self.specs)} params, {self.n_blocks} "
+                f"blocks x <= {self.block_size}, {self.n_shards} shards)")

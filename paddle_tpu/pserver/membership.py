@@ -1,0 +1,175 @@
+"""Elastic trainer membership: the pserver's join/drain/leave state machine.
+
+The heartbeat + state-machine pattern of `fleet/replica.py`, ported to the
+OTHER side of the wire: there the router tracks serving replicas, here the
+parameter server tracks the trainers contributing gradients.  Like its
+sibling it is plain bookkeeping — no sockets, no clocks of its own (every
+method takes `now`) — so the tier-1 join/drain/leave tests drive it
+deterministically.
+
+State machine (one `TrainerMember` per joined trainer):
+
+    --ps_join--> ACTIVE --ps_drain--> DRAINING --ps_leave--> gone
+    ACTIVE/DRAINING --conn lost / heartbeat expiry--> gone (DEAD)
+
+The sync barrier only ever WAITS for ACTIVE members: a DRAINING trainer's
+contribution still counts if it arrives (its final in-flight batch is not
+lost), but its absence never stalls the fleet; a DEAD trainer's buffered
+in-flight contribution is discarded by the server and the barrier
+re-evaluates immediately — the pass continues with the surviving ranks.
+
+Ranks: each member carries a `rank`, the data-shard index that also fixes
+its position in the gradient reduction order (the exactness contract
+reduces contributions in rank order, so K trainers reproduce the
+single-process batch order).  Auto-assigned ranks reuse the smallest free
+slot, so a restarted trainer slides back into the shard it drained from.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+ACTIVE = "active"
+DRAINING = "draining"
+DEAD = "dead"        # terminal; the member is dropped from the table
+LEFT = "left"        # terminal; clean ps_leave
+
+#: states whose members may still contribute to a window
+CONTRIBUTING = (ACTIVE, DRAINING)
+
+
+class TrainerMember:
+    """One joined trainer, as the server sees it."""
+
+    __slots__ = ("tid", "rank", "state", "joined_t", "last_beat_t",
+                 "grads_sent", "windows_joined")
+
+    def __init__(self, tid: str, rank: int, now: float):
+        self.tid = tid
+        self.rank = int(rank)
+        self.state = ACTIVE
+        self.joined_t = now
+        self.last_beat_t = now
+        self.grads_sent = 0       # contributions received from this trainer
+        self.windows_joined = 0   # windows it was part of the commit set
+
+    def beat_age(self, now: float) -> float:
+        return now - self.last_beat_t
+
+    def summary(self) -> dict:
+        return {"tid": self.tid, "rank": self.rank, "state": self.state,
+                "grads_sent": self.grads_sent,
+                "windows_joined": self.windows_joined}
+
+
+class Membership:
+    """All live trainers, keyed by server-assigned id t0, t1, ..."""
+
+    def __init__(self):
+        self._seq = 0
+        self.members: dict[str, TrainerMember] = {}
+        self.ever_joined = 0      # total joins over the server's lifetime
+
+    # -- lifecycle ---------------------------------------------------------
+    def join(self, rank: Optional[int] = None,
+             now: Optional[float] = None) -> TrainerMember:
+        """Register one trainer; auto-rank = smallest unused (a restarted
+        trainer re-occupies its old data shard)."""
+        now = time.monotonic() if now is None else now
+        if rank is None:
+            used = {m.rank for m in self.members.values()}
+            rank = 0
+            while rank in used:
+                rank += 1
+        elif any(m.rank == int(rank) for m in self.members.values()):
+            raise ValueError(
+                f"rank {rank} is already held by a live trainer — two "
+                f"trainers on one data shard would double-count its "
+                f"gradients; pick a distinct --rank or let the server "
+                f"auto-assign")
+        m = TrainerMember(f"t{self._seq}", int(rank), now)
+        self._seq += 1
+        self.ever_joined += 1
+        self.members[m.tid] = m
+        return m
+
+    def get(self, tid: str) -> Optional[TrainerMember]:
+        return self.members.get(tid)
+
+    def beat(self, tid: str, now: Optional[float] = None) -> bool:
+        m = self.members.get(tid)
+        if m is None:
+            return False
+        m.last_beat_t = time.monotonic() if now is None else now
+        return True
+
+    def drain(self, tid: str) -> bool:
+        """ACTIVE -> DRAINING: stop waiting for this trainer at barriers;
+        contributions it still sends are honored."""
+        m = self.members.get(tid)
+        if m is None or m.state not in (ACTIVE, DRAINING):
+            return False
+        m.state = DRAINING
+        return True
+
+    def undrain(self, tid: str) -> bool:
+        m = self.members.get(tid)
+        if m is None or m.state != DRAINING:
+            return False
+        m.state = ACTIVE
+        return True
+
+    def leave(self, tid: str) -> Optional[TrainerMember]:
+        """Clean departure (ps_leave after the final batch)."""
+        m = self.members.pop(tid, None)
+        if m is not None:
+            m.state = LEFT
+        return m
+
+    def drop_dead(self, tid: str) -> Optional[TrainerMember]:
+        """Connection lost / heartbeat expired: the trainer is gone NOW;
+        the server discards its in-flight contribution."""
+        m = self.members.pop(tid, None)
+        if m is not None:
+            m.state = DEAD
+        return m
+
+    def expire(self, timeout_s: float,
+               now: Optional[float] = None) -> list[TrainerMember]:
+        """Drop every member whose heartbeat is older than `timeout_s`."""
+        now = time.monotonic() if now is None else now
+        stale = [m for m in self.members.values()
+                 if m.beat_age(now) > timeout_s]
+        for m in stale:
+            self.drop_dead(m.tid)
+        return stale
+
+    # -- barrier / commit queries ------------------------------------------
+    def required(self, arrived: set) -> set:
+        """Tids the sync barrier must still wait for: every ACTIVE member
+        not in `arrived`.  DRAINING members never stall the fleet."""
+        return {tid for tid, m in self.members.items()
+                if m.state == ACTIVE and tid not in arrived}
+
+    def in_rank_order(self, tids) -> list[str]:
+        """`tids` filtered to live members, sorted by rank — the gradient
+        reduction order of the exactness contract."""
+        live = [self.members[t] for t in tids if t in self.members]
+        return [m.tid for m in sorted(live, key=lambda m: m.rank)]
+
+    def counts(self) -> dict:
+        out = {ACTIVE: 0, DRAINING: 0}
+        for m in self.members.values():
+            out[m.state] = out.get(m.state, 0) + 1
+        return out
+
+    def __len__(self):
+        return len(self.members)
+
+    def __iter__(self):
+        return iter(list(self.members.values()))
+
+    def summary(self) -> list[dict]:
+        return [m.summary() for m in
+                sorted(self.members.values(), key=lambda m: m.rank)]

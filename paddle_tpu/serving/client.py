@@ -33,21 +33,40 @@ _RETRYABLE_CONNECT = (ConnectionRefusedError, ConnectionResetError,
                       ConnectionAbortedError)
 
 
+#: what each hello role is served by — used to make a wrong-port connect
+#: name BOTH ends instead of failing with a generic frame error
+_ROLE_TOOLS = {"replica": "a serving replica (tools/serve.py)",
+               "router": "a fleet router (tools/fleet_router.py)",
+               "pserver": "a parameter server (tools/pserver.py)"}
+
+
+def _role_desc(role) -> str:
+    return _ROLE_TOOLS.get(role, f"an unknown peer (role {role!r})")
+
+
 def connect_with_backoff(host: str, port: int, timeout: float,
                          attempts: int = 5, backoff_s: float = 0.05,
                          backoff_max_s: float = 2.0,
-                         jitter: Optional[random.Random] = None
-                         ) -> socket.socket:
+                         jitter: Optional[random.Random] = None,
+                         expect_role: Optional[str] = None):
     """create_connection with bounded jittered exponential backoff on
     ECONNREFUSED/reset — a replica mid-rolling-restart must not surface
     as an instant client failure.  `attempts` caps the total tries; the
     final failure re-raises the last connect error with an actionable
     message (same OSError family, so existing `except OSError` callers
-    keep working)."""
+    keep working).
+
+    `expect_role` additionally runs the `hello` handshake on the fresh
+    socket and verifies the peer's advertised role ("replica" / "router"
+    / "pserver") — a wrong-port connect (e.g. a trainer pointed at a
+    serving replica) then fails with an error NAMING both roles instead
+    of a generic frame error several RPCs later.  With `expect_role`
+    set, returns `(socket, hello_reply)`; without, the bare socket."""
     attempts = max(1, int(attempts))
     jitter = jitter or random.Random()
     t0 = time.monotonic()
     last: Optional[BaseException] = None
+    sock: Optional[socket.socket] = None
     for i in range(attempts):
         if i:
             # full jitter on an exponential base: concurrent clients
@@ -55,16 +74,45 @@ def connect_with_backoff(host: str, port: int, timeout: float,
             delay = min(backoff_max_s, backoff_s * (2.0 ** (i - 1)))
             time.sleep(delay * (0.5 + 0.5 * jitter.random()))
         try:
-            return socket.create_connection((host, port), timeout=timeout)
+            sock = socket.create_connection((host, port), timeout=timeout)
+            break
         except _RETRYABLE_CONNECT as e:
             last = e
-    waited = time.monotonic() - t0
-    raise type(last)(
-        f"connect to {host}:{port} failed after {attempts} attempts over "
-        f"{waited:.1f}s ({type(last).__name__}: {last}) — the server is "
-        f"down, still binding after a restart, or the address is wrong; "
-        f"raise ServingClient(connect_attempts=...) if its restart drain "
-        f"takes longer than the backoff window") from last
+    if sock is None:
+        waited = time.monotonic() - t0
+        raise type(last)(
+            f"connect to {host}:{port} failed after {attempts} attempts "
+            f"over {waited:.1f}s ({type(last).__name__}: {last}) — the "
+            f"server is down, still binding after a restart, or the "
+            f"address is wrong; raise ServingClient(connect_attempts=...) "
+            f"if its restart drain takes longer than the backoff window"
+        ) from last
+    if expect_role is None:
+        return sock
+    try:
+        wire.write_frame_sync(sock, {"type": "hello"})
+        reply = wire.read_frame_sync(sock)
+    except (wire.FrameError, OSError) as e:
+        sock.close()
+        raise ConnectionError(
+            f"connected to {host}:{port} expecting "
+            f"{_role_desc(expect_role)}, but the hello handshake failed "
+            f"({type(e).__name__}: {e}) — the far end does not speak the "
+            f"{wire.PROTO_DESC}") from e
+    if reply is None:
+        sock.close()
+        raise ConnectionError(
+            f"connected to {host}:{port} expecting "
+            f"{_role_desc(expect_role)}, but the peer closed the "
+            f"connection on the hello handshake")
+    role = reply.get("role")
+    if role != expect_role:
+        sock.close()
+        raise ConnectionError(
+            f"{host}:{port} is {_role_desc(role)}, not the expected "
+            f"{_role_desc(expect_role)} — check the address/port "
+            f"(hello reply: proto={reply.get('proto')}, role={role!r})")
+    return sock, reply
 
 
 class OverloadError(RuntimeError):

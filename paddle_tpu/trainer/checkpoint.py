@@ -106,14 +106,22 @@ def _path_str(p) -> str:
 
 
 def _unflatten_dicts(flat: dict[str, np.ndarray]) -> dict:
-    """Rebuild nested dicts from SEP-joined keys (trees here are nested dicts)."""
+    """Rebuild nested dicts from SEP-joined keys (trees here are nested
+    dicts).  Keys are inserted in SORTED order regardless of the writer's
+    npz ordering, so two checkpoints of the same state load into
+    identically-ordered trees no matter who wrote them — the trainer's
+    save(), or the pserver's streaming snapshotter assembling blocks —
+    and a loaded optimizer tree's slot iteration order is deterministic
+    (jax pytrees sort dict keys, but plain-dict consumers like
+    _merge_state and test assertions must not depend on writer
+    insertion order either)."""
     root: dict = {}
-    for key, arr in flat.items():
+    for key in sorted(flat):
         parts = key.split(SEP)
         node = root
         for p in parts[:-1]:
             node = node.setdefault(p, {})
-        node[parts[-1]] = arr
+        node[parts[-1]] = flat[key]
     return root
 
 

@@ -76,7 +76,14 @@ class Trainer:
         config: TrainerConfig,
         seed: int = 1,
         mesh: Optional[Any] = None,
+        updater: Optional[Any] = None,
     ):
+        """`updater` swaps the parameter-update strategy (ref: the
+        local/thread/remote ParameterUpdater family): None builds the
+        local fused-into-the-train-step ParameterUpdater; an
+        optim.remote_updater.RemoteParameterUpdater (is_remote=True)
+        makes the train step GRAD-ONLY and routes every batch through
+        the parameter-server tier (paddle_tpu/pserver/)."""
         assert config.model_config is not None and config.opt_config is not None
         self.config = config
         self.model = config.model_config
@@ -96,7 +103,9 @@ class Trainer:
         else:
             self.executor = GraphExecutor(self.model, mesh=mesh,
                                           compute_dtype=cdt)
-        self.updater = ParameterUpdater(self.model, self.opt)
+        self.updater = updater if updater is not None \
+            else ParameterUpdater(self.model, self.opt)
+        self._remote = bool(getattr(self.updater, "is_remote", False))
         self.evaluators = EvaluatorSet(self.model)
         # under pipeline parallelism stage-internal activations never
         # surface, so evaluators referencing them are skipped rather than
@@ -114,6 +123,16 @@ class Trainer:
         self.opt_state = self.updater.init_state(self.params)
         self.net_state: dict[str, Any] = {}
         self.pass_id = 0
+        if self._remote:
+            # join the pserver fleet and adopt the authoritative
+            # parameters (the first trainer seeds them from this very
+            # seed-deterministic init, so a cold fleet start is a no-op)
+            synced = self.updater.connect_and_sync(
+                {n: np.asarray(jax.device_get(v))
+                 for n, v in self.params.items()},
+                config_json=self.config.to_json())
+            self.params = {n: jnp.asarray(np.asarray(v))
+                           for n, v in synced.items()}
 
         if mesh is not None:
             from paddle_tpu.parallel.dp import (effective_zero_stage,
@@ -245,6 +264,7 @@ class Trainer:
 
     def _build_train_step_fn(self):
         executor, updater, evaluators = self.executor, self.updater, self.evaluators
+        remote = self._remote
         probe_names = self._probe_names
         grad_shardings = None
         if self.mesh is not None and self.zero_stage >= 2:
@@ -313,10 +333,22 @@ class Trainer:
                 # via sharding propagation; nothing to do here.
                 pass
             bsz = _batch_size(batch)
-            new_params, new_opt = updater.step(params, grads, opt_state, bsz)
+            if remote:
+                # parameter-server mode: the jitted step computes
+                # gradients only — the optimizer applies SERVER-side
+                # (ref: RemoteParameterUpdater — the update leaves the
+                # gradient machine), so params/opt_state pass through
+                # and the grads ride out for _dispatch_step to push
+                new_params, new_opt = params, opt_state
+            else:
+                new_params, new_opt = updater.step(params, grads,
+                                                   opt_state, bsz)
             partials = evaluators.batch_partials(outputs, batch)
             host_out = {n: outputs[n].flatten_image()
                         for n in evaluators.host_layer_names if n in outputs}
+            if remote:
+                return (new_params, new_opt, new_net, loss, partials,
+                        host_out, grads)
             return new_params, new_opt, new_net, loss, partials, host_out
 
         return train_step
@@ -458,14 +490,31 @@ class Trainer:
         seen = self._seen_sigs()
         if sig in seen:
             with self.barrier_stat.time_dispatch():
-                (self.params, self.opt_state, new_net, loss, partials, host_out) = \
-                    self._train_step(self.params, self.opt_state, self.net_state, batch, key)
+                out = self._train_step(self.params, self.opt_state,
+                                       self.net_state, batch, key)
         else:
             seen.add(sig)
-            (self.params, self.opt_state, new_net, loss, partials, host_out) = \
-                self._train_step(self.params, self.opt_state, self.net_state, batch, key)
+            out = self._train_step(self.params, self.opt_state,
+                                   self.net_state, batch, key)
+        (self.params, self.opt_state, new_net, loss, partials,
+         host_out) = out[:6]
         if new_net:
             self.net_state = new_net
+        if self._remote:
+            # parameter-server round trip (ref: RemoteParameterUpdater::
+            # finishBatch): fetch this batch's gradients to the host,
+            # contribute them to every shard, and adopt the post-window
+            # parameters (sync mode returns them every batch; async on
+            # the num_batches_per_get_parameter cadence)
+            grads = out[6]
+            with global_stat.time("remoteUpdate"):
+                grads_host = {n: np.asarray(jax.device_get(g))
+                              for n, g in grads.items()}
+                fresh = self.updater.remote_step(grads_host,
+                                                 _batch_size(batch))
+            if fresh is not None:
+                self.params = {n: jnp.asarray(np.asarray(v))
+                               for n, v in fresh.items()}
         return loss, partials, host_out
 
     def _dispatch_fused(self, staged, keys, sig: tuple):
@@ -609,6 +658,14 @@ class Trainer:
             batches = self.train_batches()
         k = int(FLAGS.steps_per_dispatch if steps_per_dispatch is None
                 else steps_per_dispatch)
+        if k > 1 and self._remote:
+            # the fused scan hosts the optimizer INSIDE the compiled
+            # dispatch; remote mode applies it server-side per batch —
+            # the two cannot compose, and the sync barrier is per batch
+            # anyway, so the scan would buy nothing
+            log.warning("remote updater forces steps_per_dispatch=1 "
+                        "(the pserver barrier is per batch)")
+            k = 1
         if k > 1 and FLAGS.detect_nan:
             # --detect_nan promises PER-BATCH halting + localisation with
             # the failing step's rng/params; a fused group would apply the
@@ -1136,6 +1193,13 @@ class Trainer:
                 break
         n_samples = sum(_batch_size(b) for b in batch_list[warmup:])
         if scan:
+            if self._remote:
+                raise ValueError(
+                    "benchmark(scan=True) hosts the optimizer inside one "
+                    "compiled dispatch — incompatible with the remote "
+                    "(parameter-server) updater; benchmark with "
+                    "scan=False or tools/train_dist.py / bench.py "
+                    "train_dist")
             return self._benchmark_scan(batch_list, warmup, n_samples)
         for b in batch_list[:warmup]:
             self._dispatch_step(b)

@@ -493,3 +493,34 @@ def test_assemble_lkg_skips_degraded_records_explicitly(tmp_path):
     # the degraded fallback's echoed serving part never became "measured"
     assert "serving" not in out
     assert out["sentiment"]["value"] == 9.0   # healthy parts still stitch
+
+
+def test_assemble_lkg_stitches_train_dist_record(tmp_path):
+    """ISSUE 14 wiring: the parameter-server training record
+    (train_dist_samples_per_sec + the 1-trainer arm and scaling
+    efficiency) rides the per-config queue shape — a top-level
+    BENCH_ONLY=train_dist record must stitch into the assembled fallback
+    under the `train_dist` key with the companions intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["train_dist"] == "train_dist_samples_per_sec"
+    assert "train_dist" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-03T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0,
+                    "vs_baseline": 2.0}},
+        {"ts": "2026-08-04T12:00:00+00:00",
+         "record": {"metric": M["train_dist"], "value": 5321.7,
+                    "trainers": 2,
+                    "single_samples_per_sec": 2900.4,
+                    "scaling_efficiency": 0.9174,
+                    "fleet_wall_s": 3.2,
+                    "measured_at": "2026-08-04T12:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["train_dist"]["value"] == 5321.7
+    assert out["train_dist"]["scaling_efficiency"] == 0.9174
+    assert out["train_dist"]["single_samples_per_sec"] == 2900.4

@@ -100,3 +100,42 @@ def test_keep_last_prunes_only_after_commit(tmp_path):
     ckpt.save_checkpoint(save_dir, 4, _params(), keep_last=2)
     assert not os.path.isdir(os.path.join(save_dir, "pass-00009.tmp"))
     assert sorted(os.listdir(save_dir)) == ["pass-00003", "pass-00004"]
+
+
+def test_load_canonicalizes_key_order(tmp_path):
+    """load_checkpoint must return identically-ORDERED trees no matter
+    what order the writer inserted npz entries in — the trainer's save()
+    flattens jax-pytree-sorted, but the pserver's streaming snapshotter
+    assembles blocks in its own iteration order, and optimizer-slot
+    iteration order must round-trip deterministically either way."""
+    d = tmp_path / "pass-00000"
+    d.mkdir(parents=True)
+    sep = ckpt.SEP
+    arrs = {
+        f"params{sep}w": np.arange(6, dtype=np.float32),
+        f"params{sep}b": np.ones(3, np.float32),
+        f"opt{sep}slots{sep}w{sep}momentum": np.zeros(6, np.float32),
+        f"opt{sep}slots{sep}b{sep}momentum": np.zeros(3, np.float32),
+        f"opt{sep}slots{sep}a{sep}momentum": np.zeros(2, np.float32),
+        f"opt{sep}num_updates": np.int32(4),
+    }
+    # adversarial writer: reverse-sorted insertion (npz preserves order)
+    with open(d / "model.npz", "wb") as f:
+        np.savez(f, **{k: arrs[k] for k in sorted(arrs, reverse=True)})
+    out = ckpt.load_checkpoint(str(d))
+    assert list(out["params"]) == ["b", "w"]
+    assert list(out["opt"]["slots"]) == ["a", "b", "w"]
+    # and a canonical writer produces the very same ordering
+    d2 = ckpt.save_checkpoint(
+        str(tmp_path / "ck2"), 0,
+        {"w": arrs[f"params{sep}w"], "b": arrs[f"params{sep}b"]},
+        opt_state={"slots": {"w": {"momentum": np.zeros(6, np.float32)},
+                             "b": {"momentum": np.zeros(3, np.float32)},
+                             "a": {"momentum": np.zeros(2, np.float32)}},
+                   "num_updates": np.int32(4)})
+    out2 = ckpt.load_checkpoint(d2)
+    assert list(out2["params"]) == list(out["params"])
+    assert list(out2["opt"]["slots"]) == list(out["opt"]["slots"])
+    for name in out["opt"]["slots"]:
+        assert list(out2["opt"]["slots"][name]) == \
+            list(out["opt"]["slots"][name])

@@ -1,0 +1,307 @@
+"""Distributed-training exactness oracles (ISSUE 14 acceptance).
+
+The headline contract: K=2 trainer PROCESSES (real `tools/train_dist.py`
+subprocesses over real TCP) against a pserver produce parameters
+BIT-IDENTICAL to a single-process run with `grad_accum=K` — including
+the poly LR schedule, L2 weight decay, and model averaging, all of which
+live server-side.  The slow churn soak kills a trainer with SIGKILL
+mid-training and proves the surviving fleet's final parameters replay
+EXACTLY from the server's commit log (zero lost updates, exact
+rank-ordered reduction under churn)."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = "demo/distributed/mlp_dist.py"
+# small but non-trivial: 8 batches/pass, full update-rule surface ON
+CONFIG_ARGS = ("samples=128,batch_size=16,dim=16,hidden=32,"
+               "l2=0.0001,avg_window=0.5")
+
+
+def _spawn_trainer(port, rank, trainers, passes, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "train_dist.py"),
+         "--config", CONFIG, "--config-args", CONFIG_ARGS,
+         "--pserver", f"127.0.0.1:{port}", "--rank", str(rank),
+         "--trainers", str(trainers), "--passes", str(passes), *extra],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _oracle_trainer(accum, updater=None):
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config(CONFIG, CONFIG_ARGS)
+    cfg.opt_config.num_batches_per_send_parameter = accum
+    return Trainer(cfg, seed=1, updater=updater)
+
+
+def _host(tree):
+    import jax
+
+    return {k: np.asarray(jax.device_get(v)) for k, v in tree.items()}
+
+
+def test_sync_k2_processes_bit_exact_vs_grad_accum2():
+    """THE acceptance oracle: two trainer processes, disjoint stride
+    shards, 2 passes == one process with grad_accum=2, bit for bit."""
+    from paddle_tpu.pserver.server import ParameterServer
+
+    srv = ParameterServer(port=0, beat_timeout_s=60.0)
+    host, port = srv.start_background()
+    try:
+        procs = [_spawn_trainer(port, r, 2, 2) for r in range(2)]
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"trainer failed:\n{err[-2000:]}"
+            assert "TRAIN_JSON" in out
+        assert srv.engine is not None
+        params, opt = srv.engine.assemble_full()
+        assert int(opt["pass_id"]) == 2
+
+        oracle = _oracle_trainer(accum=2)
+        for _ in range(2):
+            oracle.train_one_pass(batches=None)
+        o_params = _host(oracle.params)
+        o_avg = _host(oracle.updater.averaged_params(oracle.params,
+                                                     oracle.opt_state))
+        for n in o_params:
+            np.testing.assert_array_equal(
+                params[n], o_params[n],
+                err_msg=f"{n}: K=2 fleet != grad_accum=2 oracle")
+        # model averaging (eval-time params) must agree too
+        for n in o_avg:
+            np.testing.assert_array_equal(
+                opt["average"][n], o_avg[n],
+                err_msg=f"{n}: averaged params diverge")
+        # scheduler state agrees (LR schedule inputs)
+        assert int(opt["num_samples"]) == \
+            int(oracle.opt_state["num_samples"])
+        assert int(opt["num_updates"]) == \
+            int(oracle.opt_state["num_updates"])
+    finally:
+        srv.stop_background(drain=False)
+
+
+def test_async_mode_trains_with_bounded_staleness():
+    """Async mode: no barrier, bounded staleness, pass accounting still
+    synchronized — the trainer makes progress and the divergence metric
+    is populated honestly."""
+    from paddle_tpu.optim.remote_updater import RemoteParameterUpdater
+    from paddle_tpu.pserver.server import ParameterServer
+
+    srv = ParameterServer(port=0, mode="async", max_staleness=8,
+                          beat_timeout_s=60.0)
+    host, port = srv.start_background()
+    try:
+        from paddle_tpu.config.parser import parse_config
+        from paddle_tpu.trainer.trainer import Trainer
+
+        cfg = parse_config(CONFIG, CONFIG_ARGS)
+        upd = RemoteParameterUpdater(cfg.model_config, cfg.opt_config,
+                                     [(host, port)])
+        tr = Trainer(cfg, seed=1, updater=upd)
+        init = _host(tr.params)
+        stats = tr.train_one_pass(batches=None)
+        assert stats["batches"] == 8
+        assert srv.engine.version == 8
+        assert srv.engine.pass_id == 1
+        final = _host(tr.params)
+        assert any(not np.array_equal(init[n], final[n]) for n in init)
+        m = upd.client.metrics()
+        assert "pserver_async_staleness_count 8" in m
+        upd.drain_and_leave()
+    finally:
+        srv.stop_background(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# churn soak: SIGKILL a trainer mid-training, replay the commit log
+# ---------------------------------------------------------------------------
+
+
+class _GradTap:
+    """is_remote updater stub: runs the IDENTICAL grad-only jitted train
+    step the live trainers ran, but hands the gradients to the replay
+    loop instead of a socket."""
+
+    is_remote = True
+    accum_n = 1
+
+    def __init__(self, opt):
+        self.use_average = opt.average_window > 0
+        self.captured = None
+
+    def apply_init_hooks(self, params):
+        return params
+
+    def init_state(self, params):
+        return {"remote": True}
+
+    def connect_and_sync(self, params_host, config_json=None):
+        return params_host
+
+    def remote_step(self, grads_host, batch_size, tag=None):
+        self.captured = (grads_host, batch_size)
+        return None
+
+    def start_pass(self, state):
+        return state
+
+    def finish_pass(self, state):
+        return state
+
+    def averaged_params(self, params, state):
+        return params
+
+
+@pytest.mark.slow
+def test_churn_soak_killed_trainer_replays_exact(tmp_path):
+    """3 trainer processes; one is SIGKILLed mid-training.  Training
+    completes on the survivors, the fleet ends healthy, and replaying
+    the server's commit log (exactly the contributions that committed,
+    in rank order, pass boundaries included) through a fresh
+    UpdateEngine reproduces the live parameters BIT-EXACTLY — zero lost
+    updates, nothing double-counted, the dead trainer's in-flight
+    contribution provably discarded."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.pserver.blocks import BlockMap
+    from paddle_tpu.pserver.server import ParameterServer, UpdateEngine
+
+    srv = ParameterServer(port=0, beat_timeout_s=60.0,
+                          snapshot_dir=str(tmp_path / "ck"),
+                          snapshot_every=5)
+    host, port = srv.start_background()
+    try:
+        procs = [_spawn_trainer(port, r, 3, 3) for r in range(3)]
+        # let the fleet make progress, then kill rank 2 ABRUPTLY
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if srv.engine is not None and srv.engine.version >= 2:
+                break
+            time.sleep(0.05)
+        assert srv.engine is not None and srv.engine.version >= 2
+        procs[2].send_signal(signal.SIGKILL)
+        outs = []
+        for p in procs[:2]:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"survivor failed:\n{err[-2000:]}"
+            outs.append(out)
+        procs[2].communicate(timeout=30)
+        # fleet healthy: survivors drained cleanly, no stuck members
+        st = srv._stats_msg()
+        assert st["trainers_active"] == 0
+        assert st["pending_barriers"] == 0
+        live_params, live_opt = srv.engine.assemble_full()
+        log = list(srv.commit_log)
+        assert any("pass" in rec for rec in log)
+        # rank 2 appears in SOME committed window (it did real work
+        # before dying) but not all
+        r2_windows = [rec for rec in log if "members" in rec
+                      and any(m[1] == 2 for m in rec["members"])]
+        assert r2_windows, "kill landed before rank 2 ever contributed " \
+                           "— lower the kill threshold"
+
+        # ---- replay oracle ------------------------------------------------
+        tap_cfgless = _oracle_trainer(accum=1)   # only for the batch stream
+        stream = list(tap_cfgless.train_batches())
+        shards = {r: stream[r::3] for r in range(3)}
+
+        from paddle_tpu.config.parser import parse_config
+        from paddle_tpu.trainer.trainer import Trainer
+        cfg = parse_config(CONFIG, CONFIG_ARGS)
+        tap = _GradTap(cfg.opt_config)
+        tr = Trainer(cfg, seed=1, updater=tap)
+        init = _host_params = {k: np.asarray(v)
+                               for k, v in _host(tr.params).items()}
+        bm = BlockMap.from_arrays(init, n_shards=1,
+                                  block_size=srv.block_size)
+        pcfgs = {p.name: p for p in cfg.model_config.parameters}
+        engine = UpdateEngine(bm, 0, cfg.opt_config, pcfgs,
+                              bm.split_all(init))
+        for rec in log:
+            if "pass" in rec:
+                engine.finish_pass()
+                continue
+            current = engine.assemble_full()[0]
+            entries = []
+            for tid, rank, samples, tag in rec["members"]:
+                # tag "r{rank}b{i}": i-th batch this rank contributed
+                i = int(tag.split("b", 1)[1])
+                shard = shards[rank]
+                batch = shard[i % len(shard)]
+                tr.params = {n: jnp.asarray(v)
+                             for n, v in current.items()}
+                tr._dispatch_step(batch)
+                grads, bsz = tap.captured
+                assert bsz == samples
+                entries.append((rank, tid, samples,
+                                bm.split_all(grads)))
+            engine.commit(entries)
+        re_params, re_opt = engine.assemble_full()
+        for n in live_params:
+            np.testing.assert_array_equal(
+                re_params[n], live_params[n],
+                err_msg=f"{n}: replayed commit log != live fleet state")
+        assert int(re_opt["num_updates"]) == int(live_opt["num_updates"])
+        assert int(re_opt["num_samples"]) == int(live_opt["num_samples"])
+        # the streaming checkpoints kept up through the churn
+        assert srv.snapshots_written >= 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop_background(drain=False)
+
+
+@pytest.mark.slow
+def test_pserver_cli_sigterm_drain_writes_final_checkpoint(tmp_path):
+    """tools/pserver.py contract: SIGTERM → drain → final checkpoint →
+    exit 0; tools/train_dist.py drains on SIGTERM → exit 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ps = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "pserver.py"),
+         "--port", "0", "--snapshot-dir", str(tmp_path / "ck")],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        import json
+
+        line = ""
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            line = ps.stdout.readline()
+            if line.startswith("PSERVER_JSON:"):
+                break
+        info = json.loads(line.split("PSERVER_JSON:", 1)[1])
+        port = info["port"]
+        t = _spawn_trainer(port, 0, 1, 30)    # many passes: will be cut
+        time.sleep(8)
+        t.send_signal(signal.SIGTERM)
+        out, err = t.communicate(timeout=120)
+        assert t.returncode == 0, f"trainer SIGTERM drain failed:\n{err}"
+        assert '"drained": true' in out or '"passes": 30' in out
+        ps.send_signal(signal.SIGTERM)
+        _out, perr = ps.communicate(timeout=120)
+        assert ps.returncode == 0, f"pserver SIGTERM drain failed:\n{perr}"
+        from paddle_tpu.trainer.checkpoint import (latest_checkpoint,
+                                                   load_checkpoint)
+        final = latest_checkpoint(str(tmp_path / "ck"))
+        assert final is not None
+        data = load_checkpoint(final)
+        assert "momentum" in next(iter(data["opt"]["slots"].values()))
+    finally:
+        for p in (ps,):
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,147 @@
+"""Serve one parameter-server shard (paddle_tpu/pserver/server.py).
+
+The pserver holds the authoritative parameter + optimizer-state blocks
+for multi-process data-parallel training; trainers connect with
+`tools/train_dist.py`.  Foreground; SIGTERM or SIGINT drains — open
+barriers are failed honestly, one FINAL streaming checkpoint is written
+(with --snapshot-dir), exit 0.
+
+  python tools/pserver.py --port 8571 --snapshot-dir runs/dist \
+      --snapshot-every 50            # checkpoint every 50 commits, live
+
+Multi-shard fleet (blocks dealt round-robin by the deterministic map;
+shard 0 is the membership coordinator):
+
+  python tools/pserver.py --shard-index 0 --n-shards 2 --port 8571
+  python tools/pserver.py --shard-index 1 --n-shards 2 --port 8572
+
+On bind it prints one machine-readable line (the scripting contract):
+
+  PSERVER_JSON:{"host": "127.0.0.1", "port": 8571, "pid": 123, ...}
+
+One-shot client ops (stats / Prometheus metrics / commit log / dump):
+
+  python tools/pserver.py --client 127.0.0.1:8571 --stats
+  python tools/pserver.py --client 127.0.0.1:8571 --metrics
+
+The server is model-agnostic: the FIRST trainer's `ps_init` seeds the
+blocks and the optimizer configuration; later trainers must match its
+config hash.  Design doc: docs/distributed_training.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_client(args) -> int:
+    from paddle_tpu.serving import wire
+    from paddle_tpu.serving.client import connect_with_backoff
+
+    host, _, port = args.client.rpartition(":")
+    sock, hello = connect_with_backoff(host or "127.0.0.1", int(port),
+                                       timeout=30.0, expect_role="pserver")
+    try:
+        if args.metrics:
+            wire.write_frame_sync(sock, {"type": "metrics"})
+            print(wire.read_frame_sync(sock)["text"], end="")
+        elif args.log:
+            wire.write_frame_sync(sock, {"type": "ps_log"})
+            print(json.dumps(wire.read_frame_sync(sock), indent=2))
+        elif args.dump:
+            wire.write_frame_sync(sock, {"type": "dump", "id": "cli"})
+            reply = wire.read_frame_sync(sock)
+            if reply.get("type") == "error":
+                print(reply["error"], file=sys.stderr)
+                return 1
+            print(json.dumps(reply, indent=2))
+        else:
+            wire.write_frame_sync(sock, {"type": "stats"})
+            print(json.dumps(wire.read_frame_sync(sock), indent=2))
+    finally:
+        sock.close()
+    return 0
+
+
+async def amain(args) -> int:
+    from paddle_tpu.pserver.server import ParameterServer
+
+    srv = ParameterServer(
+        host=args.host, port=args.port, shard_index=args.shard_index,
+        n_shards=args.n_shards, mode=args.mode,
+        max_staleness=args.max_staleness,
+        beat_timeout_s=args.beat_timeout_s,
+        snapshot_dir=args.snapshot_dir or None,
+        snapshot_every=args.snapshot_every, keep_last=args.keep_last,
+        block_size=args.block_size)
+    srv.flight.enabled = True
+    host, port = await srv.start()
+    print("PSERVER_JSON:" + json.dumps(
+        {"host": host, "port": port, "pid": os.getpid(),
+         "shard": args.shard_index, "n_shards": args.n_shards,
+         "mode": args.mode}), flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("draining: failing open barriers, writing the final "
+          "checkpoint...", file=sys.stderr, flush=True)
+    await srv.drain()          # final snapshot with --snapshot-dir
+    if srv.last_snapshot_path:
+        print(f"final checkpoint: {srv.last_snapshot_path}",
+              file=sys.stderr, flush=True)
+    print("drained; bye", file=sys.stderr, flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (see the PSERVER_JSON line)")
+    ap.add_argument("--shard-index", type=int, default=0)
+    ap.add_argument("--n-shards", type=int, default=1)
+    ap.add_argument("--mode", choices=("sync", "async"), default="sync",
+                    help="sync: barrier per batch, bit-exact vs "
+                         "grad_accum=K; async: bounded staleness")
+    ap.add_argument("--max-staleness", type=int, default=4,
+                    help="async mode: versions behind past which a "
+                         "gradient is rejected (trainer must re-pull)")
+    ap.add_argument("--beat-timeout-s", type=float, default=10.0,
+                    help="heartbeat age past which a trainer is dropped "
+                         "and its in-flight contribution discarded")
+    ap.add_argument("--block-size", type=int, default=0,
+                    help="elements per parameter block (0 = default)")
+    ap.add_argument("--snapshot-dir", default="",
+                    help="streaming-checkpoint target (atomic pass-dir "
+                         "format; also the postmortem-bundle dir)")
+    ap.add_argument("--snapshot-every", type=int, default=0,
+                    help="checkpoint every N commits WITHOUT pausing "
+                         "send_grad traffic (0 = only the final one)")
+    ap.add_argument("--keep-last", type=int, default=2)
+    # client mode
+    ap.add_argument("--client", default="",
+                    help="HOST:PORT — run as a one-shot client instead")
+    ap.add_argument("--stats", action="store_true")
+    ap.add_argument("--metrics", action="store_true")
+    ap.add_argument("--log", action="store_true",
+                    help="with --client: print the commit log")
+    ap.add_argument("--dump", action="store_true",
+                    help="with --client: freeze a postmortem bundle")
+    args = ap.parse_args(argv)
+    if args.client:
+        return run_client(args)
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

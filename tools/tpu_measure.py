@@ -352,6 +352,12 @@ def main() -> int:
         # arm needs a virtual 2-device mesh (harmless on real TPU steps,
         # which never see this env)
         tp_env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+        # parameter-server training record: tiny shapes; the trainers run
+        # the CPU backend on hardware too (the tier under test is the
+        # wire/barrier/update machinery — see bench.py bench_train_dist)
+        dist_env = {"BENCH_DIST_SAMPLES": "128", "BENCH_DIST_BATCH": "16",
+                    "BENCH_DIST_DIM": "16", "BENCH_DIST_HIDDEN": "32",
+                    "BENCH_DIST_PASSES": "1"}
         rnn_args = ["--shapes", "8,16,64", "--iters", "1"]
         tune_args = ["--lens", "256", "--blocks", "128,256", "--batch", "1",
                      "--heads", "2", "--target-ms", "5", "--reps", "1"]
@@ -384,6 +390,7 @@ def main() -> int:
         # on the locally-repetitive workload (defaults)
         serving_spec_args = ["--spec-k", "4"]
         tp_env = {}
+        dist_env = {}
         rnn_args = []
         additive_args = []
         profile_args = []
@@ -451,6 +458,13 @@ def main() -> int:
         ("bench_serving_spec_record", [py, "bench.py"], 900,
          bench_env("serving_spec", 840),
          lambda: _metric_fresh(_METRIC_OF["serving_spec"], fh)),
+        # parameter-server training record (K-trainer aggregate samples/s
+        # + the 1-trainer arm + scaling efficiency): all subprocesses on
+        # the CPU backend, so it never contends for the chip and runs the
+        # same on rehearse and hardware windows
+        ("bench_train_dist_record", [py, "bench.py"], 900,
+         bench_env("train_dist", 840, dist_env),
+         lambda: _metric_fresh(_METRIC_OF["train_dist"], fh)),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},

@@ -1,0 +1,125 @@
+"""One distributed trainer over the parameter-server tier.
+
+Runs the standard Trainer with a RemoteParameterUpdater: the jitted step
+computes gradients on-device, the optimizer applies on the pserver fleet
+(tools/pserver.py), and with K sync trainers on disjoint stride shards
+the result is BIT-IDENTICAL to one process training with grad_accum=K
+(docs/distributed_training.md "Exactness contract").
+
+  # shard 0 of 2 trainers against a single-shard pserver:
+  python tools/train_dist.py --config demo/mnist/mlp_mnist.py \
+      --pserver 127.0.0.1:8571 --rank 0 --trainers 2 --passes 2
+
+Data sharding: each trainer takes every K-th batch of the config's data
+stream (`--rank`-strided — the disjoint-shard convention the exactness
+oracle assumes).  SIGTERM/SIGINT drains: the current batch finishes, the
+trainer announces ps_drain + ps_leave (the barrier re-sizes, the fleet
+continues), exit 0.  On completion prints one machine-readable line:
+
+  TRAIN_JSON:{"rank": 0, "passes": 2, "samples": 4096, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_addrs(spec: str) -> list:
+    out = []
+    for part in spec.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    if not out:
+        raise SystemExit("--pserver needs HOST:PORT[,HOST:PORT...] "
+                         "(shard-index order, shard 0 first)")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--config-args", default="")
+    ap.add_argument("--pserver", required=True,
+                    help="HOST:PORT[,HOST:PORT...] — every shard, shard "
+                         "0 (the membership coordinator) first")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="data-shard index = reduction rank (default: "
+                         "server-assigned smallest free)")
+    ap.add_argument("--trainers", type=int, default=1,
+                    help="fleet size K for the stride data shard (this "
+                         "trainer takes batches rank, rank+K, ...)")
+    ap.add_argument("--passes", type=int, default=1)
+    ap.add_argument("--log-period", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--timeout-s", type=float, default=300.0,
+                    help="pserver RPC timeout (a sync barrier waits at "
+                         "most this long for straggler trainers)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.config.parser import parse_config
+    from paddle_tpu.optim.remote_updater import RemoteParameterUpdater
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg = parse_config(args.config, args.config_args)
+    updater = RemoteParameterUpdater(
+        cfg.model_config, cfg.opt_config, parse_addrs(args.pserver),
+        rank=args.rank, timeout=args.timeout_s)
+    tr = Trainer(cfg, seed=args.seed, updater=updater)
+    rank = updater.rank
+    print(f"joined as rank {rank} (tid {updater.client.tid}), "
+          f"mode {updater.mode}", file=sys.stderr, flush=True)
+
+    draining = {"flag": False}
+
+    def on_term(_sig, _frm):
+        print("SIGTERM: draining after the current batch",
+              file=sys.stderr, flush=True)
+        draining["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    def shard(batches):
+        """rank-strided disjoint shard, halting cleanly on drain."""
+        for b in itertools.islice(batches, rank, None,
+                                  max(args.trainers, 1)):
+            if draining["flag"]:
+                return
+            yield b
+
+    t0 = time.time()
+    samples = passes = 0
+    stats: dict = {}
+    try:
+        for _ in range(args.passes):
+            if draining["flag"]:
+                break
+            stats = tr.train_one_pass(batches=shard(tr.train_batches()),
+                                      log_period=args.log_period)
+            samples += int(stats.get("samples", 0))
+            passes += 1
+    finally:
+        updater.drain_and_leave()
+    dt = time.time() - t0
+    print("TRAIN_JSON:" + json.dumps({
+        "rank": rank, "passes": passes, "samples": samples,
+        "seconds": round(dt, 3),
+        "samples_per_sec": round(samples / dt, 3) if dt > 0 else 0.0,
+        "cost": stats.get("cost"),
+        "drained": draining["flag"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
