@@ -810,9 +810,20 @@ def bench_train_dist(dtype: str) -> dict:
     commit accounting.  Every process runs the CPU backend (K trainers
     cannot share one chip, and the tier under test is the wire/barrier/
     update machinery, not the matmul).  Bit-exactness vs grad_accum=K is
-    tests/test_train_dist.py's job."""
+    tests/test_train_dist.py's job.
+
+    The record also carries `train_dist_trace_overhead_pct` — the
+    training-fleet sibling of the serving live-flip probes (<= 2%
+    budget): ONE warm pserver, tracing flipped LIVE over the `trace`
+    RPC (no restart) between alternating-order off/on fleet runs (the
+    on-arms' trainers run --trace-out too, so the probe pays the FULL
+    training tracing stack: window/push/barrier/pull spans + wire
+    context + shard-side recv/apply spans), median of per-cycle
+    pairwise deltas against the reported spread."""
     import signal
+    import statistics
     import subprocess
+    import tempfile
     import time as _time
 
     trainers = int(os.environ.get("BENCH_DIST_TRAINERS", "2"))
@@ -826,66 +837,139 @@ def bench_train_dist(dtype: str) -> dict:
                 f"hidden={hidden}")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
 
-    def run_fleet(k: int) -> dict:
+    def spawn_pserver():
         ps = subprocess.Popen(
             [sys.executable, "tools/pserver.py", "--port", "0"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True)
-        try:
-            import select
+        import select
 
-            line = ""
-            deadline = _time.monotonic() + 120
-            while _time.monotonic() < deadline and ps.poll() is None:
-                # select-gate the read: a bound-but-silent pserver must
-                # trip THIS deadline, not block readline() until the
-                # queue's outer hard timeout kills the bench undiagnosed
-                r, _w, _x = select.select([ps.stdout], [], [], 1.0)
-                if not r:
-                    continue
-                line = ps.stdout.readline()
-                if line.startswith("PSERVER_JSON:"):
-                    break
-            if not line.startswith("PSERVER_JSON:"):
-                raise RuntimeError("pserver never printed its bind line "
-                                   "within 120s")
-            port = json.loads(line.split("PSERVER_JSON:", 1)[1])["port"]
-            procs = [subprocess.Popen(
-                [sys.executable, "tools/train_dist.py",
-                 "--config", "demo/distributed/mlp_dist.py",
-                 "--config-args", cfg_args,
-                 "--pserver", f"127.0.0.1:{port}",
-                 "--rank", str(r), "--trainers", str(k),
-                 "--passes", str(passes)],
-                env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL, text=True) for r in range(k)]
-            stats = []
-            for p in procs:
-                out, _err = p.communicate(timeout=to_s)
-                if p.returncode != 0:
-                    raise RuntimeError(f"trainer rc={p.returncode}")
-                for ln in out.splitlines():
-                    if ln.startswith("TRAIN_JSON:"):
-                        stats.append(json.loads(
-                            ln.split("TRAIN_JSON:", 1)[1]))
-            assert len(stats) == k
-            total = sum(s["samples"] for s in stats)
-            wall = max(s["seconds"] for s in stats)
-            return {"samples": total, "wall_s": wall,
-                    "samples_per_sec": total / wall if wall else 0.0}
+        line = ""
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline and ps.poll() is None:
+            # select-gate the read: a bound-but-silent pserver must
+            # trip THIS deadline, not block readline() until the
+            # queue's outer hard timeout kills the bench undiagnosed
+            r, _w, _x = select.select([ps.stdout], [], [], 1.0)
+            if not r:
+                continue
+            line = ps.stdout.readline()
+            if line.startswith("PSERVER_JSON:"):
+                break
+        if not line.startswith("PSERVER_JSON:"):
+            stop_pserver(ps)
+            raise RuntimeError("pserver never printed its bind line "
+                               "within 120s")
+        return ps, json.loads(line.split("PSERVER_JSON:", 1)[1])["port"]
+
+    def stop_pserver(ps) -> None:
+        if ps.poll() is None:
+            ps.send_signal(signal.SIGTERM)
+            try:
+                ps.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                ps.kill()
+
+    def run_trainers(port: int, k: int, extra=()) -> dict:
+        procs = [subprocess.Popen(
+            [sys.executable, "tools/train_dist.py",
+             "--config", "demo/distributed/mlp_dist.py",
+             "--config-args", cfg_args,
+             "--pserver", f"127.0.0.1:{port}",
+             "--rank", str(r), "--trainers", str(k),
+             "--passes", str(passes),
+             *(a.format(rank=r) for a in extra)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True) for r in range(k)]
+        stats = []
+        for p in procs:
+            out, _err = p.communicate(timeout=to_s)
+            if p.returncode != 0:
+                raise RuntimeError(f"trainer rc={p.returncode}")
+            for ln in out.splitlines():
+                if ln.startswith("TRAIN_JSON:"):
+                    stats.append(json.loads(
+                        ln.split("TRAIN_JSON:", 1)[1]))
+        assert len(stats) == k
+        total = sum(s["samples"] for s in stats)
+        wall = max(s["seconds"] for s in stats)
+        return {"samples": total, "wall_s": wall,
+                "samples_per_sec": total / wall if wall else 0.0}
+
+    def run_fleet(k: int) -> dict:
+        ps, port = spawn_pserver()
+        try:
+            return run_trainers(port, k)
         finally:
-            if ps.poll() is None:
-                ps.send_signal(signal.SIGTERM)
-                try:
-                    ps.wait(timeout=60)
-                except subprocess.TimeoutExpired:
-                    ps.kill()
+            stop_pserver(ps)
 
     single = run_fleet(1)
     fleet = run_fleet(trainers)
     eff = (fleet["samples_per_sec"]
            / (trainers * single["samples_per_sec"])
            if single["samples_per_sec"] else 0.0)
+
+    overhead: dict = {}
+    if os.environ.get("BENCH_DIST_TRACE", "1") != "0":
+        # the live-flip probe: one pserver across every probe arm (fresh
+        # servers would read jit warm-up as tracing cost — the PR 13
+        # fleet-probe lesson), alternating off/on order so the machine's
+        # monotonic warming cancels out of the pairwise deltas.  One
+        # discarded fleet first: it pays the server-side compile so
+        # neither measured side inherits the transient.
+        from paddle_tpu.serving.client import ServingClient
+
+        cycles = max(1, int(os.environ.get("BENCH_DIST_TRACE_CYCLES",
+                                           "3")))
+        ps, port = spawn_pserver()
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                run_trainers(port, trainers)           # discarded warmup
+
+                def set_tracing(on: bool) -> None:
+                    with ServingClient("127.0.0.1", port,
+                                       timeout=30) as c:
+                        c.trace(pings=1, enable=on)
+
+                offs, ons, pcts = [], [], []
+                for cyc in range(cycles):
+                    order = (False, True) if cyc % 2 == 0 \
+                        else (True, False)
+                    pair = {}
+                    for on in order:
+                        set_tracing(on)
+                        extra = (("--trace-out",
+                                  os.path.join(td, f"c{cyc}-r{{rank}}"
+                                                   f".jsonl"),)
+                                 if on else ())
+                        r = run_trainers(port, trainers, extra=extra)
+                        pair[on] = r["samples_per_sec"]
+                        (ons if on else offs).append(r["samples_per_sec"])
+                    if pair.get(False):
+                        pcts.append(100.0 * (pair[False] - pair[True])
+                                    / pair[False])
+                overhead = {
+                    # training-fleet tracing cost through the full stack;
+                    # <= 2% budget, read against the spread (negative /
+                    # within spread = noise)
+                    "train_dist_trace_overhead_pct":
+                        round(statistics.median(pcts), 2) if pcts else 0.0,
+                    "trace_overhead_spread_pct":
+                        round(max(pcts) - min(pcts), 2) if pcts else 0.0,
+                    "trace_off_samples_per_sec":
+                        round(statistics.mean(offs), 2) if offs else 0.0,
+                    "trace_on_samples_per_sec":
+                        round(statistics.mean(ons), 2) if ons else 0.0,
+                }
+        except Exception as e:  # noqa: BLE001
+            # the probe is severable (BENCH_DIST_TRACE=0 is the knob):
+            # a transient trainer crash in a probe arm must not discard
+            # the already-measured headline record — the freshness
+            # gate's need_field check forces a re-probe next window
+            overhead = {"trace_probe_error": f"{type(e).__name__}: {e}"}
+        finally:
+            stop_pserver(ps)
+
     return {
         "metric": "train_dist_samples_per_sec",
         "value": round(fleet["samples_per_sec"], 2),
@@ -899,6 +983,7 @@ def bench_train_dist(dtype: str) -> dict:
         "scaling_efficiency": round(eff, 4),
         "trainers": trainers,
         "fleet_wall_s": round(fleet["wall_s"], 3),
+        **overhead,
     }
 
 

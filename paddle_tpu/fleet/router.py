@@ -71,7 +71,7 @@ from paddle_tpu.fleet.replica import Replica, ReplicaTable
 from paddle_tpu.obs import MetricsRegistry, tracer_collector
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
 from paddle_tpu.obs.trace import (get_tracer, new_span_id, new_trace_id,
-                                  process_info)
+                                  trace_reply)
 from paddle_tpu.serving import wire
 
 
@@ -960,17 +960,8 @@ class FleetRouter:
             # the router's own span ring, same shape as a replica's
             # trace reply — trace_dump --pull treats both alike, and
             # `enable` flips router-side tracing live (see server.py)
-            if isinstance(msg.get("enable"), bool):
-                self.tracer.enabled = msg["enable"]
-            conn.send({"type": "trace", "id": msg.get("id"),
-                       "process": process_info("router", self.host,
-                                               self.port),
-                       "clock": {"perf_counter": time.perf_counter(),
-                                 "unix": time.time()},
-                       "enabled": self.tracer.enabled,
-                       "recorded": self.tracer.recorded,
-                       "dropped": self.tracer.dropped,
-                       "spans": self.tracer.snapshot()})
+            conn.send(trace_reply(self.tracer, msg, "router",
+                                  self.host, self.port))
         elif t == "dump":
             self.flight.record("dump_rpc", router=True)
             if not self.postmortem_dir:

@@ -42,11 +42,13 @@ from paddle_tpu.obs.metrics import (CATALOG, Counter,  # noqa: F401
                                     Gauge, Histogram, MetricsRegistry,
                                     barrier_collector, statset_collector,
                                     tracer_collector)
-from paddle_tpu.obs.trace import (Tracer, get_tracer,  # noqa: F401
-                                  merge_chrome, new_span_id, new_trace_id,
-                                  process_info, spans_to_chrome)
+from paddle_tpu.obs.trace import (Tracer, flush_trace_file,  # noqa: F401
+                                  get_tracer, merge_chrome, new_span_id,
+                                  new_trace_id, process_info,
+                                  spans_to_chrome)
 
 __all__ = ["Tracer", "get_tracer", "spans_to_chrome", "merge_chrome",
+           "flush_trace_file",
            "new_trace_id", "new_span_id", "process_info", "MetricsRegistry",
            "Counter", "Gauge", "Histogram", "CATALOG", "statset_collector",
            "barrier_collector", "tracer_collector", "CompileWatch",

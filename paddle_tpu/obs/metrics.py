@@ -155,6 +155,17 @@ CATALOG: dict[str, str] = {
         "pointer copies; the write overlaps live send_grad traffic)",
     "pserver_blocks": "parameter/optimizer blocks held by this shard",
     "pserver_block_bytes": "bytes held by this shard's parameter blocks",
+    "pserver_window_skew_ms":
+        "per-window barrier-arrival skew (last arriver minus first, ms) "
+        "on the shard-0 coordinator — the straggler signal",
+    "pserver_apply_seconds":
+        "update-thread wall per window commit (accumulate + optimizer "
+        "apply, device-synced)",
+    "pserver_update_lag_s":
+        "seconds the update thread has been inside its current job — "
+        "0 when idle; growing = a wedged optimizer apply",
+    "pserver_update_alive":
+        "1 while the update thread is running and error-free",
     # -- pump-thread heartbeat watchdog -----------------------------------
     "pump_alive":
         "1 while the engine pump is running (0 the moment it has fatally "

@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import sys
 import time
 from typing import Optional
 
@@ -71,6 +72,48 @@ def process_info(role: str, host: Optional[str] = None,
     if host is not None:
         out["addr"] = f"{host}:{port}"
     return out
+
+
+def trace_reply(tracer: "Tracer", msg: dict, role: str,
+                host: Optional[str] = None, port: Optional[int] = None,
+                **ident) -> dict:
+    """The `trace` RPC reply shared by the serving replica, the fleet
+    router, and the pserver shard — trace_dump --pull depends on the
+    three agreeing.  Applies a live `enable` flip BEFORE the snapshot
+    (so enable:false returns the spans it just froze), stamps process
+    identity (extra keyword fields like shard= ride along) plus a
+    perf_counter/unix clock sample for ping-RTT alignment, and ships
+    the retained ring with its accounting."""
+    if isinstance(msg.get("enable"), bool):
+        tracer.enabled = msg["enable"]
+    proc = process_info(role, host, port)
+    proc.update(ident)
+    return {"type": "trace", "id": msg.get("id"),
+            "process": proc,
+            "clock": {"perf_counter": time.perf_counter(),
+                      "unix": time.time()},
+            "enabled": tracer.enabled,
+            "recorded": tracer.recorded,
+            "dropped": tracer.dropped,
+            "spans": tracer.snapshot()}
+
+
+def flush_trace_file(tracer: "Tracer", path: str, role: str,
+                     host: Optional[str] = None,
+                     port: Optional[int] = None, **ident) -> int:
+    """Write `tracer`'s retained ring to `path` as JSONL with the
+    leading `{"meta": {"process": ...}}` identity line, and note the
+    count on stderr — the flush-on-every-exit-path discipline shared by
+    serve.py, fleet_router.py, pserver.py, and train_dist.py.  Extra
+    keyword fields (rank=, shard=) ride in the identity record so
+    trace_dump --merge can name the track."""
+    proc = process_info(role, host, port)
+    proc.update(ident)
+    n = tracer.export_jsonl(path, meta={"process": proc})
+    print(f"wrote {n} spans to {path} ({tracer.dropped} dropped by "
+          f"ring wrap); stitch with tools/trace_dump.py --merge",
+          file=sys.stderr, flush=True)
+    return n
 
 
 class _NullSpan:

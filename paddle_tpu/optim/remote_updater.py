@@ -24,11 +24,19 @@ RemoteParameterUpdater.cpp:206 — the same knob family).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 import numpy as np
 
 from paddle_tpu.config.schema import ModelConfig, OptimizationConfig
+from paddle_tpu.obs.trace import get_tracer, new_span_id, new_trace_id
+
+#: the per-window timing parts that sum to the window wall (the closure
+#: contract tier-1 asserts); `other_ms` absorbs the sub-ms gaps between
+#: the contiguous segments, so the identity is exact by construction
+TIMING_PARTS = ("compute_ms", "push_ms", "barrier_wait_ms", "pull_ms",
+                "other_ms")
 
 
 class RemoteParameterUpdater:
@@ -70,6 +78,10 @@ class RemoteParameterUpdater:
         self.pull_every = max(int(opt.num_batches_per_get_parameter), 1)
         self._async_since_pull = 0
         self._batch_seq = 0
+        self.last_window_timing: dict = {}
+        self._pass_t: dict = {}        # per-pass sums, reset by pass_timing
+        self._pass_windows = 0
+        self._rejects_at_pass_start = 0
 
     # -- interface parity with ParameterUpdater -----------------------------
     @property
@@ -96,9 +108,11 @@ class RemoteParameterUpdater:
 
     def finish_pass(self, state):
         """Pass boundary = a fleet-wide barrier; the server bumps its
-        pass_id (LR pass schedules) exactly once."""
+        pass_id (LR pass schedules) exactly once.  The boundary frame
+        carries its own trace context like every window frame."""
         if self.client is not None:
-            self.client.pass_barrier()
+            self.client.pass_barrier(
+                trace={"trace_id": new_trace_id(), "parent": new_span_id()})
         return state
 
     def averaged_params(self, params, state):
@@ -139,23 +153,94 @@ class RemoteParameterUpdater:
             config_json=config_json)
 
     def remote_step(self, grads_host: dict[str, np.ndarray],
-                    batch_size: int, tag: Optional[str] = None
+                    batch_size: int, tag: Optional[str] = None,
+                    compute: Optional[tuple] = None
                     ) -> Optional[dict[str, np.ndarray]]:
         """One batch's contribution; returns fresh full parameters (sync:
         every batch; async: on the num_batches_per_get_parameter cadence,
-        else None = keep training on the current ones)."""
+        else None = keep training on the current ones).
+
+        `compute` is the grad fetch's (t0, dur) — the window's compute
+        phase, measured by the caller.  Mints ONE trace_id per window,
+        stamped on every wire frame of the round (send_grad/barrier/
+        get_params) so shard-side spans adopt it; records the window +
+        grad_compute spans on the `remote` lane; and assembles
+        `last_window_timing` — contiguous phase walls whose TIMING_PARTS
+        sum to `total_ms` exactly (closure by construction, asserted in
+        tier-1)."""
         assert self.client is not None, "connect_and_sync first"
         if tag is None:
             tag = f"r{self.rank}b{self._batch_seq}"
         self._batch_seq += 1
-        out = self.client.push_grads(grads_host, batch_size, tag=tag)
-        if self.mode == "sync":
-            return out
-        self._async_since_pull += 1
-        if self._async_since_pull >= self.pull_every:
-            self._async_since_pull = 0
-            return self.client.pull()
-        return None
+        t_start = compute[0] if compute else time.perf_counter()
+        compute_ms = (compute[1] * 1e3) if compute else 0.0
+        span_id = new_span_id()
+        tctx = {"trace_id": new_trace_id(), "parent": span_id}
+        tr = get_tracer()
+        if tr.enabled and compute:
+            tr.add("grad_compute", compute[0], compute[1], track="remote",
+                   attrs=dict(tctx))
+        out = self.client.push_grads(grads_host, batch_size, tag=tag,
+                                     trace=tctx)
+        async_pull_ms = 0.0
+        if self.mode != "sync":
+            self._async_since_pull += 1
+            if self._async_since_pull >= self.pull_every:
+                self._async_since_pull = 0
+                out = self.client.pull(trace=tctx)
+                # the cadence pull is THIS window's dominant phase when
+                # it fires — attribute it, don't let it hide in other_ms
+                async_pull_ms = self.client.last_pull_ms
+        t_end = time.perf_counter()
+        ct = dict(self.client.last_timing)
+        total_ms = (t_end - t_start) * 1e3
+        parts = {"compute_ms": round(compute_ms, 3),
+                 "push_ms": ct.get("push_ms", 0.0),
+                 "barrier_wait_ms": ct.get("barrier_wait_ms", 0.0),
+                 "pull_ms": ct.get("pull_ms",
+                                   round(async_pull_ms, 3))}
+        other = total_ms - sum(parts.values())
+        # each of the 4 parts is rounded to 1e-3 ms (+5e-4 worst case
+        # apiece), so a genuinely-closed window can read up to 2e-3 ms
+        # of phantom excess against the unrounded wall
+        assert other > -2.5e-3, "window timing parts exceed the wall"
+        parts["other_ms"] = round(max(other, 0.0), 3)
+        self.last_window_timing = {
+            "window": ct.get("window"), "total_ms": round(total_ms, 3),
+            **parts,
+            # server-side nesting (accumulate/apply happen INSIDE
+            # barrier_wait for sync — attribution, not closure parts)
+            "accum_ms": ct.get("accum_ms", 0.0),
+            "apply_ms": ct.get("apply_ms", 0.0),
+            "skew_ms": ct.get("skew_ms", 0.0),
+            **({"staleness": ct["staleness"]} if "staleness" in ct
+               else {}),
+        }
+        for k in (*TIMING_PARTS, "accum_ms", "apply_ms", "total_ms"):
+            self._pass_t[k] = self._pass_t.get(k, 0.0) + \
+                self.last_window_timing.get(k, 0.0)
+        self._pass_windows += 1
+        if tr.enabled:
+            tr.add("window", t_start, t_end - t_start, track="remote",
+                   attrs={"trace_id": tctx["trace_id"],
+                          "span_id": span_id,
+                          "window": ct.get("window")})
+        return out
+
+    def pass_timing(self, reset: bool = True) -> dict:
+        """Per-pass remote-updater attribution sums — the fields the
+        trainer folds into its pass stats (and so into metrics.jsonl and
+        TRAIN_JSON): push/barrier_wait/pull/compute/apply ms, windows,
+        and the async stale-reject count for the pass."""
+        rejects = getattr(self.client, "stale_rejects", 0)
+        out = {k: round(v, 3) for k, v in self._pass_t.items()}
+        out["remote_windows"] = self._pass_windows
+        out["async_stale_rejects"] = rejects - self._rejects_at_pass_start
+        if reset:
+            self._pass_t = {}
+            self._pass_windows = 0
+            self._rejects_at_pass_start = rejects
+        return out
 
     def drain_and_leave(self) -> None:
         if self.client is not None:

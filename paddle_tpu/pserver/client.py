@@ -29,10 +29,12 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.pserver.blocks import (BlockMap, decode_array,
                                        encode_array)
 from paddle_tpu.serving import wire
@@ -93,6 +95,18 @@ class ParameterClient:
         self._beat_thread: Optional[threading.Thread] = None
         self._beat_stop = threading.Event()
         self._beat_interval = float(beat_interval_s)
+        # per-window timing attribution (docs/distributed_training.md
+        # "Observability"): push_grads/pull stamp contiguous phase walls
+        # into `last_timing`; the RemoteParameterUpdater folds them into
+        # the trainer's per-pass metrics.jsonl rows.  The tracer (obs is
+        # stdlib-only, so the jax-free claim holds) records the same
+        # phases as push[shard]/barrier_wait/pull spans on the `remote`
+        # lane — all from the training thread, the single-writer rule.
+        self.tracer = get_tracer()
+        self.last_timing: dict = {}
+        self.last_pull_timings: dict = {}   # shard -> relay-apply timing
+        self.last_pull_ms = 0.0
+        self.stale_rejects = 0         # async: grads refused as stale
 
     # -- plumbing ------------------------------------------------------------
     def __enter__(self):
@@ -228,7 +242,8 @@ class ParameterClient:
 
     def pull(self, want: str = "params",
              apply_members: Optional[list] = None,
-             window: Optional[int] = None) -> dict[str, np.ndarray]:
+             window: Optional[int] = None,
+             trace: Optional[dict] = None) -> dict[str, np.ndarray]:
         """Fetch and assemble the full tree from every shard.  With
         `apply_members`, relays the coordinator's commit set so shards
         1..N-1 apply the window before answering.  A plain pull (the
@@ -236,9 +251,13 @@ class ParameterClient:
         a shard the commit-set relay has not reached yet answers only
         once it has caught up, so the assembled state always existed
         fleet-wide."""
+        t0 = time.perf_counter()
         blocks: dict[str, np.ndarray] = {}
+        self.last_pull_timings = {}    # shard -> its window-apply timing
         for s in range(len(self.addrs)):
             msg: dict = {"type": "get_params", "want": want}
+            if trace:
+                msg["trace"] = trace
             if apply_members is not None and s != 0:
                 msg["apply"] = {"window": window, "members": apply_members}
             elif s != 0:
@@ -247,21 +266,41 @@ class ParameterClient:
             if s == 0:
                 self.version = int(reply["version"])
                 self.pass_id = int(reply["pass_id"])
+            if reply.get("timing"):
+                # a commit-relay reply: this shard just applied the
+                # window before answering — its breakdown nests inside
+                # the caller's pull phase
+                self.last_pull_timings[s] = reply["timing"]
             for bid, d in reply["blocks"].items():
                 blocks[bid] = decode_array(d)
+        self.last_pull_ms = (time.perf_counter() - t0) * 1e3
+        if self.tracer.enabled:
+            self.tracer.add("pull", t0, time.perf_counter() - t0,
+                            track="remote",
+                            attrs={"want": want, **(trace or {})})
         return self.block_map.assemble_all(blocks)
 
     # -- the batch flow ------------------------------------------------------
     def push_grads(self, grads: dict[str, np.ndarray], samples: int,
-                   tag: Optional[str] = None):
+                   tag: Optional[str] = None,
+                   trace: Optional[dict] = None):
         """Sync: contribute one batch's gradients, barrier, return the
         post-window full parameters.  Async: contribute against the last
         pulled version; returns None (pair with pull() on the trainer's
         num_batches_per_get_parameter cadence) — a stale rejection also
         returns None after recording the fleet's version so the next
-        pull re-bases."""
+        pull re-bases.
+
+        `trace` ({"trace_id", "parent"}) stamps the window's wire trace
+        context on every frame; `last_timing` afterwards holds the
+        window's contiguous phase walls (push/barrier_wait/pull ms, plus
+        the server-reported apply/skew) — the parts the updater's
+        closure-checked per-window attribution is built from."""
         bm = self.block_map
         w = self.window
+        tr = self.tracer
+        async_t: dict = {}
+        t_push0 = time.perf_counter()
         for s in range(len(self.addrs)):
             shard_blocks: dict = {}
             for name in bm.names():
@@ -274,36 +313,99 @@ class ParameterClient:
                               for bid, a in shard_blocks.items()}}
             if tag is not None:
                 msg["tag"] = tag
+            if trace:
+                msg["trace"] = trace
             if self.mode == "async":
                 msg["base_version"] = self.version
+            t_s0 = time.perf_counter()
             ack = self._rpc(s, msg, ("grad_ack",))
+            if tr.enabled:
+                tr.add("push", t_s0, time.perf_counter() - t_s0,
+                       track="remote",
+                       attrs={"shard": s, "window": w, **(trace or {})})
             if self.mode == "async":
-                if ack.get("rejected"):
-                    self.version = int(ack["version"])
-                    return None
                 self.version = int(ack["version"])
+                if ack.get("rejected"):
+                    self.stale_rejects += 1
+                    self.last_timing = {
+                        "window": w, "rejected": True,
+                        "staleness": int(ack.get("staleness", 0)),
+                        "push_ms": round(
+                            (time.perf_counter() - t_push0) * 1e3, 3)}
+                    return None
+                async_t = {"staleness": int(ack.get("staleness", 0)),
+                           **(ack.get("timing") or {})}
+        t_push1 = time.perf_counter()
         if self.mode == "async":
+            self.last_timing = {
+                "window": w,
+                "push_ms": round((t_push1 - t_push0) * 1e3, 3),
+                "apply_ms": async_t.get("apply_ms", 0.0),
+                "staleness": async_t.get("staleness", 0)}
             return None
-        reply = self._rpc(0, {"type": "barrier", "tid": self.tid,
-                              "window": w}, ("barrier",))
+        bmsg = {"type": "barrier", "tid": self.tid, "window": w}
+        if trace:
+            bmsg["trace"] = trace
+        reply = self._rpc(0, bmsg, ("barrier",))
+        t_bar1 = time.perf_counter()
+        srv_t = reply.get("timing") or {}
+        if tr.enabled:
+            tr.add("barrier_wait", t_push1, t_bar1 - t_push1,
+                   track="remote",
+                   attrs={"window": w, "skew_ms": srv_t.get("skew_ms"),
+                          **(trace or {})})
         self.window = int(reply["window"]) + 1
         members = reply["members"]
-        out = self.pull(apply_members=members, window=w)
+        out = self.pull(apply_members=members, window=w, trace=trace)
+        t_end = time.perf_counter()
+        # contiguous segments over [t_push0, t_end]: the three parts sum
+        # to the client-side window wall EXACTLY (the updater adds the
+        # grad_compute segment in front and asserts the closure)
+        self.last_timing = {
+            "window": w,
+            "push_ms": round((t_push1 - t_push0) * 1e3, 3),
+            "barrier_wait_ms": round((t_bar1 - t_push1) * 1e3, 3),
+            "pull_ms": round((t_end - t_bar1) * 1e3, 3),
+            "apply_ms": srv_t.get("apply_ms", 0.0),
+            "accum_ms": srv_t.get("accum_ms", 0.0),
+            "skew_ms": srv_t.get("skew_ms", 0.0),
+            # shards 1..N-1 apply DURING the pull (the commit-set relay
+            # triggers them) — the slowest relay apply nests inside
+            # pull_ms the way shard 0's apply_ms nests in barrier_wait
+            "relay_apply_ms": max(
+                (t.get("apply_ms", 0.0)
+                 for t in self.last_pull_timings.values()), default=0.0),
+        }
         return out
 
-    def pass_barrier(self) -> int:
+    def pass_barrier(self, trace: Optional[dict] = None) -> int:
         """End-of-pass synchronization: the coordinator runs finish_pass
         once, then the boundary is RELAYED to every other shard (like
         window commit sets ride get_params) so pass-dependent LR
         schedules and snapshot pass labels never drift per shard.
         Returns the new pass_id."""
-        reply = self._rpc(0, {"type": "barrier", "tid": self.tid,
-                              "kind": "pass"}, ("barrier",))
+        t0 = time.perf_counter()
+        msg = {"type": "barrier", "tid": self.tid, "kind": "pass"}
+        if trace:
+            msg["trace"] = trace
+        reply = self._rpc(0, msg, ("barrier",))
         self.pass_id = int(reply["pass_id"])
         self.window = int(reply["window"])
         for s in range(1, len(self.addrs)):
-            self._rpc(s, {"type": "barrier", "kind": "pass",
-                          "pass_id": self.pass_id}, ("barrier",))
+            relay = {"type": "barrier", "kind": "pass",
+                     "pass_id": self.pass_id}
+            if trace:
+                relay["trace"] = trace
+            self._rpc(s, relay, ("barrier",))
+        if self.tracer.enabled:
+            # this span OWNS the boundary context's parent id: shard-side
+            # pass-commit spans list the trace_id in their trace_ids
+            self.tracer.add("pass_barrier", t0,
+                            time.perf_counter() - t0, track="remote",
+                            attrs={"pass": self.pass_id,
+                                   **({"trace_id": trace["trace_id"],
+                                       "span_id": trace["parent"]}
+                                      if trace else {})})
         return self.pass_id
 
     # -- ops -----------------------------------------------------------------

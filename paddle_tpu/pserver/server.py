@@ -46,9 +46,22 @@ distribution exported honestly as `pserver_async_staleness`.
 
 Observability rides the existing machinery: pserver_* rows in
 obs.metrics.CATALOG behind a strict registry (`metrics` frame), flight
-events (trainer_join/trainer_leave/trainer_drain/ps_commit/ps_snapshot)
-on the process-global recorder, and a `dump` frame freezing a postmortem
-bundle.  Design doc: docs/distributed_training.md.
+events (trainer_join/trainer_leave/trainer_drain/ps_commit/ps_snapshot/
+straggler/ps_wedge) on the process-global recorder, and a `dump` frame
+freezing a postmortem bundle.  Training-fleet tracing (docs/
+distributed_training.md "Observability"): `send_grad`/`barrier`/
+`get_params` frames carry the trainer-minted wire trace context, this
+shard records `recv_grad` (loop), `accumulate`/`apply`/`commit` (update
+thread) and `snapshot` spans adopting it, and a `trace` RPC (loop
+thread, stale-ok against a wedged update thread, live `enable` flip)
+feeds `tools/trace_dump.py --pull` — so a K-trainer × N-shard run
+stitches into ONE Perfetto trace.  The barrier reply carries the
+window's `timing` (accum/apply ms + arrival skew) for the trainer's
+per-window attribution; the shard-0 coordinator observes per-window
+barrier-arrival skew (`pserver_window_skew_ms`, `straggler` events
+naming the late rank), and a loop-side watchdog over the update
+thread's job lag freezes one postmortem bundle per wedge episode.
+Design doc: docs/distributed_training.md.
 """
 
 from __future__ import annotations
@@ -68,8 +81,9 @@ from typing import Optional
 
 import numpy as np
 
-from paddle_tpu.obs import MetricsRegistry
+from paddle_tpu.obs import MetricsRegistry, tracer_collector
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
+from paddle_tpu.obs.trace import get_tracer, trace_reply
 from paddle_tpu.pserver import membership as mem
 from paddle_tpu.pserver.blocks import BlockMap, decode_array, encode_array
 from paddle_tpu.pserver.membership import Membership
@@ -97,7 +111,12 @@ class UpdateEngine:
 
         from paddle_tpu.optim.updater import ParameterUpdater
 
+        self._jax = jax
         self._jnp = jnp
+        # spans (accumulate/apply/commit on the "update" lane) land on the
+        # owning server's ring; standalone engines (the replay oracle)
+        # default to the process-global tracer, off unless a test flips it
+        self.tracer = get_tracer()
         self.block_map = block_map
         self.shard_index = int(shard_index)
         self.refs = block_map.shard_blocks(self.shard_index)
@@ -171,13 +190,22 @@ class UpdateEngine:
                    for v in self.params.values())
 
     # -- the commit (update thread) -----------------------------------------
-    def commit(self, entries: list[tuple]) -> dict:
+    def commit(self, entries: list[tuple], window=None,
+               trace=None) -> dict:
         """Apply one window: `entries` = [(rank, tid, samples,
         {bid: flat grad})] ALREADY in rank order.  Accumulates sample-
         weighted in fp32 then applies the optimizer once on the mean —
-        identical math to the local updater's grad_accum window."""
+        identical math to the local updater's grad_accum window.
+
+        `window`/`trace` (the committed window id and its contributors'
+        trace_ids) only label the accumulate/apply spans and the timing
+        breakdown the barrier reply carries — the math never sees them.
+        The apply is device-synced before the pointer swap so `apply_ms`
+        is honest wall time (the trainers' pull would have paid the sync
+        anyway) and a snapshot capture sees concrete arrays."""
         jnp = self._jnp
         assert entries, "commit with no contributions"
+        t0 = time.perf_counter()
         acc = {bid: self._acc_zeros(self.params[bid])
                for bid in self._updatable}
         total = 0
@@ -187,24 +215,53 @@ class UpdateEngine:
             for bid, g in blocks.items():
                 if bid in acc:
                     acc[bid] = self._acc_add(acc[bid], jnp.asarray(g), bsz)
+        self._jax.block_until_ready(acc)
+        t1 = time.perf_counter()
         new_params, new_state = self._apply_window(
             self.params, acc, self.state,
             jnp.asarray(total, jnp.int32))
+        self._jax.block_until_ready(new_params)
         with self.lock:
             self.params = dict(new_params)
             self.state = new_state
             self.version += 1
+        t2 = time.perf_counter()
+        if self.tracer.enabled:
+            attrs = {"version": self.version, "n": len(entries)}
+            if window is not None:
+                attrs["window"] = window
+            if trace:
+                attrs["trace_ids"] = trace
+            self.tracer.add("accumulate", t0, t1 - t0, track="update",
+                            attrs=attrs)
+            self.tracer.add("apply", t1, t2 - t1, track="update",
+                            attrs=attrs)
+            self.tracer.add("commit", t0, t2 - t0, track="update",
+                            attrs=attrs)
         return {"version": self.version, "samples": total,
-                "n": len(entries)}
+                "n": len(entries),
+                "timing": {"accum_ms": round((t1 - t0) * 1e3, 3),
+                           "apply_ms": round((t2 - t1) * 1e3, 3),
+                           "total_ms": round((t2 - t0) * 1e3, 3)}}
 
     def async_apply(self, tid: str, samples: int,
-                    blocks: dict[str, np.ndarray]) -> dict:
+                    blocks: dict[str, np.ndarray],
+                    trace=None) -> dict:
         """One async contribution = its own window of one."""
-        return self.commit([(0, tid, int(samples), blocks)])
+        return self.commit([(0, tid, int(samples), blocks)], trace=trace)
 
-    def finish_pass(self) -> int:
+    def finish_pass(self, trace_ids=None) -> int:
+        """`trace_ids` = the pass-boundary frames' contributor contexts
+        (attribution only, like commit's `trace`)."""
+        t0 = time.perf_counter()
         with self.lock:
             self.state = self.updater.finish_pass(self.state)
+        if self.tracer.enabled:
+            attrs = {"kind": "pass", "pass": self.pass_id}
+            if trace_ids:
+                attrs["trace_ids"] = trace_ids
+            self.tracer.add("commit", t0, time.perf_counter() - t0,
+                            track="update", attrs=attrs)
         return self.pass_id
 
     # -- reads --------------------------------------------------------------
@@ -322,7 +379,9 @@ class ParameterServer:
                  beat_timeout_s: float = 10.0,
                  snapshot_dir: Optional[str] = None,
                  snapshot_every: int = 0, keep_last: int = 2,
-                 commit_log_cap: int = 4096, block_size: int = 0):
+                 commit_log_cap: int = 4096, block_size: int = 0,
+                 tracer=None, wedge_threshold_s: float = 30.0,
+                 straggler_ms: float = 250.0):
         from paddle_tpu.pserver.blocks import DEFAULT_BLOCK_SIZE
         assert mode in ("sync", "async"), mode
         if mode == "async" and int(n_shards) > 1:
@@ -358,6 +417,7 @@ class ParameterServer:
         self._contrib: dict[str, dict] = {}      # tid -> contribution
         self._barriers: dict[str, tuple] = {}    # tid -> (conn, t_arrived)
         self._pass_waiters: dict[str, tuple] = {}
+        self._pass_traces: dict[str, str] = {}   # tid -> boundary trace_id
         self._committing = False
         self._after_commit: list = []            # deferred loop callbacks
         # non-coordinator apply state
@@ -368,6 +428,7 @@ class ParameterServer:
         #                                  shard caught up to shard 0
         self._pass_relaying = False
         self._pass_relay_waiters: list = []
+        self._pass_relay_traces: list = []       # boundary trace_ids
         self._applying = False
         self.commit_log: deque = deque(maxlen=int(commit_log_cap))
         self._async_version: dict[str, int] = {}     # tid -> base at pull
@@ -380,11 +441,21 @@ class ParameterServer:
         self._draining = False
         self._started_t = time.monotonic()
 
-        # update thread
+        # update thread + its wedge watchdog: `_job_started` is stamped
+        # by the update thread around each job, so the loop-side watchdog
+        # (and the pserver_update_lag_s gauge) can see a single apply
+        # wedging without touching the jax state — the serving pump-beat
+        # pattern, job-shaped
         self._jobs: "queue.Queue" = queue.Queue()
         self._update_thread: Optional[threading.Thread] = None
         self._update_error: Optional[str] = None
         self._updates_done = 0
+        self._job_started: Optional[float] = None
+        self.wedge_threshold_s = float(wedge_threshold_s)
+        self._wedge_dumped = False    # one bundle per wedge episode
+        self._watch_task = None
+        self.straggler_ms = float(straggler_ms)
+        self.last_skew_ms = 0.0
 
         # snapshot thread
         self._snap_thread: Optional[threading.Thread] = None
@@ -401,6 +472,10 @@ class ParameterServer:
         self._snap_hook = None          # test seam: runs between capture
         #                                 and write, on the snapshot thread
 
+        # per-server tracer (default: the process-global ring) — in-process
+        # multi-shard tests hand each shard its own Tracer, the per-process
+        # shape the `trace` RPC snapshots in a real deployment
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.flight = get_flight_recorder()
         self._init_metrics()
 
@@ -419,7 +494,14 @@ class ParameterServer:
         self._m_barrier_wait = self.metrics.histogram(
             "pserver_barrier_wait_seconds")
         self._m_snap_s = self.metrics.histogram("pserver_snapshot_seconds")
+        self._m_skew = self.metrics.histogram(
+            "pserver_window_skew_ms",
+            buckets=(1.0, 5.0, 25.0, 100.0, 250.0, 1000.0, 5000.0))
+        self._m_apply_s = self.metrics.histogram("pserver_apply_seconds")
         g = self.metrics.gauge
+        g("pserver_update_lag_s").set_fn(self.update_lag)
+        g("pserver_update_alive").set_fn(
+            lambda: 1.0 if self.update_alive() else 0.0)
         g("pserver_version").set_fn(
             lambda: float(self.engine.version) if self.engine else 0.0)
         g("pserver_pass_id").set_fn(
@@ -433,6 +515,7 @@ class ParameterServer:
         g("pserver_block_bytes").set_fn(
             lambda: float(self.engine.block_bytes()) if self.engine else 0.0)
         self.metrics.register_collector(flight_collector(self.flight))
+        self.metrics.register_collector(tracer_collector(self.tracer))
 
     # -- lifecycle -----------------------------------------------------------
     async def start(self) -> tuple[str, int]:
@@ -450,6 +533,10 @@ class ParameterServer:
                 daemon=True)
             self._snap_thread.start()
         self._expire_task = self._loop.create_task(self._expire_loop())
+        # the wedge watchdog rides the LOOP thread (it must keep running
+        # exactly when the update thread cannot) — crossing the threshold
+        # records a ps_wedge event and freezes one postmortem bundle
+        self._watch_task = self._loop.create_task(self._wedge_watchdog())
         return self.host, self.port
 
     async def drain(self, final_snapshot: bool = True) -> None:
@@ -476,6 +563,9 @@ class ParameterServer:
         if self._expire_task is not None:
             self._expire_task.cancel()
             self._expire_task = None
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None
         self._jobs.put(("stop",))
         self._snap_stop = True
         self._snap_event.set()
@@ -519,36 +609,91 @@ class ParameterServer:
             job = self._jobs.get()
             if job[0] == "stop":
                 return
+            self._job_started = time.monotonic()
             try:
                 if job[0] == "commit":
-                    _, entries, cb = job
-                    out = self.engine.commit(entries)
+                    _, entries, cb, info = job
+                    out = self.engine.commit(
+                        entries, window=info.get("window"),
+                        trace=info.get("trace"))
+                    self._m_apply_s.observe(
+                        out["timing"]["total_ms"] / 1e3)
                     self._m_updates.inc()
                     self._updates_done += 1
                     if self.snapshot_every and self.snapshot_dir and \
                             self._updates_done % self.snapshot_every == 0:
                         self._snap_event.set()
                 elif job[0] == "async":
-                    _, tid, samples, blocks, cb = job
-                    out = self.engine.async_apply(tid, samples, blocks)
+                    _, tid, samples, blocks, cb, trace = job
+                    out = self.engine.async_apply(tid, samples, blocks,
+                                                  trace=trace)
+                    self._m_apply_s.observe(
+                        out["timing"]["total_ms"] / 1e3)
                     self._m_updates.inc()
                     self._updates_done += 1
                     if self.snapshot_every and self.snapshot_dir and \
                             self._updates_done % self.snapshot_every == 0:
                         self._snap_event.set()
                 elif job[0] == "pass":
-                    _, cb = job
-                    out = {"pass_id": self.engine.finish_pass()}
+                    # snapshot: the relay path hands the LIVE list so
+                    # late-arriving relays still attribute to this pass
+                    _, cb, traces = job
+                    out = {"pass_id": self.engine.finish_pass(
+                        trace_ids=list(traces) or None)}
                 else:                  # pragma: no cover — unknown job
+                    self._job_started = None
                     continue
             except Exception as e:     # noqa: BLE001 — surfaced to clients
                 self._update_error = f"{type(e).__name__}: {e}"
                 out = {"error": self._update_error}
+            self._job_started = None
             self._loop.call_soon_threadsafe(cb, out)
 
     def update_alive(self) -> bool:
         return self._update_error is None and \
             self._update_thread is not None and self._update_thread.is_alive()
+
+    def update_lag(self) -> float:
+        """Seconds the update thread has been inside its CURRENT job
+        (0.0 when idle) — the wedge signal.  A healthy apply is
+        milliseconds; a lag crossing `wedge_threshold_s` means a hung
+        compiled step / stuck host callback, exactly the state the
+        stale-ok stats/metrics/trace frames must stay readable through."""
+        t = self._job_started
+        return 0.0 if t is None else max(0.0, time.monotonic() - t)
+
+    async def _wedge_watchdog(self) -> None:
+        """Loop-side wedge detector (the serving pump watchdog, ported to
+        the update thread): when one job's lag crosses the threshold,
+        record a ps_wedge event and freeze exactly ONE postmortem bundle
+        for the episode; re-arm when the job completes, so a flapping
+        apply produces one bundle per episode, not one per poll."""
+        period = max(0.05, min(1.0, self.wedge_threshold_s / 4.0))
+        while True:
+            await asyncio.sleep(period)
+            lag = self.update_lag()
+            if lag > self.wedge_threshold_s and self.update_alive():
+                if not self._wedge_dumped:
+                    self._wedge_dumped = True
+                    self.flight.record("ps_wedge", lag_s=round(lag, 3),
+                                       window=self._next_window)
+                    if self.snapshot_dir:
+                        try:
+                            self.flight.dump(
+                                self.snapshot_dir, reason="update_wedge",
+                                spans=self.tracer.snapshot(),
+                                engine=self._stats_msg(),
+                                metrics=self.metrics.snapshot(),
+                                config=self._config_snapshot(),
+                                error=f"update thread wedged: current "
+                                      f"job running {lag:.1f}s "
+                                      f"(threshold "
+                                      f"{self.wedge_threshold_s:g}s)")
+                        except OSError as e:
+                            print(f"pserver: wedge dump failed: {e}",
+                                  file=sys.stderr, flush=True)
+            elif lag <= self.wedge_threshold_s:
+                self._wedge_dumped = False
 
     # -- snapshot thread -----------------------------------------------------
     def _snapshot_loop(self) -> None:
@@ -613,6 +758,10 @@ class ParameterServer:
             self.last_snapshot_seconds = dt
             self._m_snapshots.inc()
             self._m_snap_s.observe(dt)
+            if self.tracer.enabled:
+                self.tracer.add("snapshot", t0, dt, track="snapshot",
+                                attrs={"why": why,
+                                       "version": snap["version"]})
             self.flight.record("ps_snapshot", path=path, why=why,
                                version=snap["version"],
                                seconds=round(dt, 4))
@@ -635,6 +784,7 @@ class ParameterServer:
             self._m_discarded.inc()
         self._barriers.pop(tid, None)
         self._pass_waiters.pop(tid, None)
+        self._pass_traces.pop(tid, None)
         self._async_version.pop(tid, None)
         self.flight.record("trainer_leave", tid=tid, rank=m.rank, why=why)
         self._maybe_commit()
@@ -650,11 +800,38 @@ class ParameterServer:
                 not self.membership.required(set(self._pass_waiters)):
             self._commit_pass()
 
+    def _window_skew(self, waiters: dict) -> float:
+        """Per-rank barrier-arrival skew for the closing window: last
+        arriver minus first, in ms, observed into the histogram; past
+        `straggler_ms` a `straggler` flight event NAMES the late rank —
+        the 1605.08695 lesson that PS-architecture stragglers are the
+        scaling killer you must measure before you tune."""
+        arrivals = [(t_arr, tid) for tid, (_c, t_arr) in waiters.items()]
+        if not arrivals:
+            return 0.0
+        t_first = min(t for t, _ in arrivals)
+        t_last, tid_last = max(arrivals)
+        skew_ms = (t_last - t_first) * 1e3
+        self._m_skew.observe(skew_ms)
+        self.last_skew_ms = skew_ms
+        if len(arrivals) >= 2 and skew_ms > self.straggler_ms:
+            m = self.membership.get(tid_last)
+            rank = m.rank if m is not None else -1
+            self.flight.record("straggler", tid=tid_last, rank=rank,
+                               window=self._next_window,
+                               skew_ms=round(skew_ms, 3))
+            if self.tracer.enabled:
+                self.tracer.instant("straggler", track="pserver",
+                                    rank=rank, window=self._next_window,
+                                    skew_ms=round(skew_ms, 3))
+        return skew_ms
+
     def _commit_window(self) -> None:
         w = self._next_window
         order = self.membership.in_rank_order(list(self._barriers))
         entries = []
         members = []
+        traces = []
         for tid in order:
             c = self._contrib.get(tid)
             if c is None:
@@ -662,8 +839,11 @@ class ParameterServer:
             m = self.membership.get(tid)
             entries.append((m.rank, tid, c["samples"], c["blocks"]))
             members.append([tid, m.rank, c["samples"], c.get("tag")])
+            if c.get("trace"):
+                traces.append(c["trace"]["trace_id"])
             m.windows_joined += 1
         waiters = dict(self._barriers)
+        skew_ms = self._window_skew(waiters)
         self._barriers.clear()
         self._contrib.clear()
         self._committing = True
@@ -689,8 +869,13 @@ class ParameterServer:
             self.flight.record("ps_commit", window=w, version=version,
                                n=len(members))
             now = time.monotonic()
+            # the window's server-side timing breakdown rides the barrier
+            # reply: the trainer folds apply_ms into its per-window
+            # attribution (nested inside its own barrier_wait_ms)
+            timing = dict(out.get("timing") or {})
+            timing["skew_ms"] = round(skew_ms, 3)
             reply = {"type": "barrier", "window": w, "version": version,
-                     "members": members}
+                     "members": members, "timing": timing}
             for tid, (conn, t_arr) in waiters.items():
                 self._m_barrier_wait.observe(now - t_arr)
                 conn.send(dict(reply, tid=tid))
@@ -700,7 +885,8 @@ class ParameterServer:
             self._maybe_commit()
 
         if entries:
-            self._jobs.put(("commit", entries, done))
+            self._jobs.put(("commit", entries, done,
+                            {"window": w, "trace": traces or None}))
         else:
             # every barrierer arrived grad-less (possible but degenerate):
             # advance the window without an optimizer apply
@@ -714,6 +900,8 @@ class ParameterServer:
             self._contrib.clear()
         waiters = dict(self._pass_waiters)
         self._pass_waiters.clear()
+        traces = [self._pass_traces.pop(tid) for tid in waiters
+                  if tid in self._pass_traces]
         self._committing = True
 
         def done(out: dict) -> None:
@@ -742,7 +930,7 @@ class ParameterServer:
                 cb()
             self._maybe_commit()
 
-        self._jobs.put(("pass", done))
+        self._jobs.put(("pass", done, traces))
 
     # -- non-coordinator apply (loop thread) ---------------------------------
     def _maybe_apply_shard(self, w: int) -> None:
@@ -757,6 +945,8 @@ class ParameterServer:
             return                     # a member's send_grad is in flight
         entries = [(rank, tid, have[tid]["samples"], have[tid]["blocks"])
                    for tid, rank, _samples, *_tag in members]
+        traces = [have[tid]["trace"]["trace_id"]
+                  for tid, *_rest in members if have[tid].get("trace")]
         # a dead trainer's buffered contribution (it never made the
         # commit set) dies with the window bucket
         extra = len(have) - len(entries)
@@ -791,8 +981,9 @@ class ParameterServer:
                                     "members": members})
             self.flight.record("ps_commit", window=w,
                                version=self.engine.version, n=len(members))
+            timing = out.get("timing")
             for conn, msg in waiters:
-                self._reply_params(conn, msg)
+                self._reply_params(conn, msg, timing=timing)
             # joiner pulls parked on a minimum version: answer the ones
             # this apply satisfied
             still, ready = [], []
@@ -805,7 +996,8 @@ class ParameterServer:
             self._maybe_apply_shard(self._next_window)
 
         if entries:
-            self._jobs.put(("commit", entries, done))
+            self._jobs.put(("commit", entries, done,
+                            {"window": w, "trace": traces or None}))
         else:
             done({})
 
@@ -857,7 +1049,8 @@ class ParameterServer:
                 capabilities=sorted([
                     "hello", "ping", "ps_init", "ps_join", "ps_beat",
                     "ps_drain", "ps_leave", "send_grad", "barrier",
-                    "get_params", "stats", "metrics", "dump", "ps_log"])))
+                    "get_params", "stats", "metrics", "dump", "ps_log",
+                    "trace"])))
         elif t == "ps_init":
             self._handle_init(conn, msg)
         elif t == "ps_join":
@@ -879,6 +1072,7 @@ class ParameterServer:
                 self._contrib.pop(tid, None)
                 self._barriers.pop(tid, None)
                 self._pass_waiters.pop(tid, None)
+                self._pass_traces.pop(tid, None)
                 self.flight.record("trainer_leave", tid=tid, rank=m.rank,
                                    why="left")
             conn.send({"type": "ps_leave", "tid": tid,
@@ -901,7 +1095,19 @@ class ParameterServer:
                        "next_window": self._next_window})
         elif t == "dump":
             self._handle_dump(conn, msg)
-        elif t in ("generate", "cancel", "trace", "fleet"):
+        elif t == "trace":
+            # trace collection over the wire — loop thread, stale-ok like
+            # `metrics`/`stats`: snapshot() is safe concurrent with the
+            # update thread, so trace_dump --pull works against a wedged
+            # or dead optimizer apply (exactly when an operator pulls).
+            # `enable` flips tracing LIVE (no restart) — the train_dist
+            # overhead probe's same-fleet A/B switch; the flip applies
+            # before the snapshot, so enable:false returns the spans it
+            # just froze.
+            conn.send(trace_reply(self.tracer, msg, "pserver",
+                                  self.host, self.port,
+                                  shard=self.shard_index))
+        elif t in ("generate", "cancel", "fleet"):
             conn.send({"type": "error", "id": msg.get("id"),
                        "error": f"{t!r} belongs to a serving replica/"
                                 f"router — this is a parameter server "
@@ -953,6 +1159,7 @@ class ParameterServer:
         blocks = {bid: decode_array(d)
                   for bid, d in (msg.get("blocks") or {}).items()}
         self.engine = UpdateEngine(bm, self.shard_index, opt, pcfgs, blocks)
+        self.engine.tracer = self.tracer
         self._config_hash = h
         self._config_json = msg.get("config_json")
         conn.send({"type": "ps_init", "initialized": True, "version": 0})
@@ -993,13 +1200,25 @@ class ParameterServer:
             conn.send({"type": "error", "op": "send_grad",
                        "error": "server not initialized — ps_init first"})
             return
+        t0 = time.perf_counter()
         tid = str(msg.get("tid"))
         w = int(msg.get("window", -1))
         samples = int(msg.get("samples", 0))
         blocks = {bid: decode_array(d) for bid, d in msg["blocks"].items()}
+        # wire-level trace context: the trainer minted one trace_id for
+        # this window and stamped it on the frame; adopting it as span
+        # attrs is what joins this shard's recv/apply spans to the
+        # trainer's window span in a stitched trace
+        trace = wire.get_trace(msg)
         self._m_grads.inc()
+        if self.tracer.enabled:
+            self.tracer.add("recv_grad", t0, time.perf_counter() - t0,
+                            track="pserver",
+                            attrs={"tid": tid, "window": w,
+                                   **(trace or {})})
         if self.mode == "async":
-            self._handle_async_grad(conn, msg, tid, samples, blocks)
+            self._handle_async_grad(conn, msg, tid, samples, blocks,
+                                    trace)
             return
         if self.is_coordinator:
             m = self.membership.get(tid)
@@ -1020,14 +1239,15 @@ class ParameterServer:
                 return
             m.grads_sent += 1
             self._contrib[tid] = {"samples": samples, "blocks": blocks,
-                                  "tag": msg.get("tag")}
+                                  "tag": msg.get("tag"), "trace": trace}
         else:
             self._shard_contrib.setdefault(w, {})[tid] = {
-                "samples": samples, "blocks": blocks}
+                "samples": samples, "blocks": blocks, "trace": trace}
             self._maybe_apply_shard(w)
         conn.send({"type": "grad_ack", "tid": tid, "window": w})
 
-    def _handle_async_grad(self, conn, msg, tid, samples, blocks) -> None:
+    def _handle_async_grad(self, conn, msg, tid, samples, blocks,
+                           trace=None) -> None:
         base = int(msg.get("base_version", 0))
         staleness = self.engine.version - base
         if staleness > self.max_staleness:
@@ -1046,9 +1266,11 @@ class ParameterServer:
             else:
                 conn.send({"type": "grad_ack", "tid": tid,
                            "version": out["version"],
-                           "staleness": staleness})
+                           "staleness": staleness,
+                           "timing": out.get("timing")})
 
-        self._jobs.put(("async", tid, samples, blocks, done))
+        self._jobs.put(("async", tid, samples, blocks, done,
+                        [trace["trace_id"]] if trace else None))
 
     def _handle_barrier(self, conn: FrameConn, msg: dict) -> None:
         if not self.is_coordinator:
@@ -1075,6 +1297,9 @@ class ParameterServer:
             # both modes synchronize pass boundaries (the LR pass
             # schedule and finish_pass bookkeeping live server-side)
             self._pass_waiters[tid] = (conn, time.monotonic())
+            tr = wire.get_trace(msg)
+            if tr:
+                self._pass_traces[tid] = tr["trace_id"]
         elif self.mode == "async":
             conn.send({"type": "error", "op": "barrier",
                        "error": "async mode has no batch barrier — "
@@ -1112,14 +1337,22 @@ class ParameterServer:
                                 f"shard?)"})
             return
         self._pass_relay_waiters.append(conn)
+        tr = wire.get_trace(msg)
+        if tr:
+            self._pass_relay_traces.append(tr["trace_id"])
         if self._pass_relaying:
             return
         self._pass_relaying = True
 
         def done(out: dict) -> None:
             self._pass_relaying = False
+            # waiters AND traces swap together here (not at enqueue): a
+            # relay arriving while the job is in flight is answered by
+            # THIS done, so its boundary trace_id must ride this pass's
+            # commit span, not the next one's
             waiters, self._pass_relay_waiters = \
                 self._pass_relay_waiters, []
+            self._pass_relay_traces = []
             for c in waiters:
                 if "error" in out:
                     c.send({"type": "error", "op": "barrier",
@@ -1130,7 +1363,7 @@ class ParameterServer:
                             "pass_id": out["pass_id"],
                             "window": self._next_window})
 
-        self._jobs.put(("pass", done))
+        self._jobs.put(("pass", done, self._pass_relay_traces))
 
     def _handle_get_params(self, conn: FrameConn, msg: dict) -> None:
         if self.engine is None:
@@ -1167,13 +1400,19 @@ class ParameterServer:
             return
         self._reply_params(conn, msg)
 
-    def _reply_params(self, conn: FrameConn, msg: dict) -> None:
+    def _reply_params(self, conn: FrameConn, msg: dict,
+                      timing: Optional[dict] = None) -> None:
         want = msg.get("want", "params")
-        conn.send({"type": "params", "id": msg.get("id"), "want": want,
-                   "version": self.engine.version,
-                   "window": self._next_window,
-                   "pass_id": self.engine.pass_id,
-                   "blocks": self.engine.wire_blocks(want)})
+        reply = {"type": "params", "id": msg.get("id"), "want": want,
+                 "version": self.engine.version,
+                 "window": self._next_window,
+                 "pass_id": self.engine.pass_id,
+                 "blocks": self.engine.wire_blocks(want)}
+        if timing is not None:
+            # the window reply a commit-set relay triggered carries this
+            # shard's apply breakdown (accum/apply/total ms)
+            reply["timing"] = timing
+        conn.send(reply)
 
     # -- ops frames ----------------------------------------------------------
     def _stats_msg(self) -> dict:
@@ -1197,6 +1436,10 @@ class ParameterServer:
             "block_bytes": self.engine.block_bytes() if self.engine else 0,
             "update_alive": self.update_alive(),
             "update_error": self._update_error,
+            "update_lag_s": round(self.update_lag(), 3),
+            "wedge_threshold_s": self.wedge_threshold_s,
+            "straggler_ms": self.straggler_ms,
+            "last_skew_ms": round(self.last_skew_ms, 3),
             "draining": self._draining,
             "snapshot": {
                 "dir": self.snapshot_dir,
@@ -1221,14 +1464,20 @@ class ParameterServer:
         try:
             path = self.flight.dump(
                 self.snapshot_dir, reason="dump_rpc",
+                spans=self.tracer.snapshot(),
                 engine=self._stats_msg(),
                 metrics=self.metrics.snapshot(),
-                config={"shard": self.shard_index,
-                        "n_shards": self.n_shards, "mode": self.mode,
-                        "config_hash": self._config_hash})
+                config=self._config_snapshot())
         except OSError as e:
             conn.send({"type": "error", "id": msg.get("id"),
                        "error": f"dump failed: {e}"})
             return
         conn.send({"type": "dump", "id": msg.get("id"), "path": path,
-                   "events": self.flight.recorded})
+                   "events": self.flight.recorded,
+                   "spans": self.tracer.recorded})
+
+    def _config_snapshot(self) -> dict:
+        return {"shard": self.shard_index, "n_shards": self.n_shards,
+                "mode": self.mode, "config_hash": self._config_hash,
+                "wedge_threshold_s": self.wedge_threshold_s,
+                "straggler_ms": self.straggler_ms}

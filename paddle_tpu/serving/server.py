@@ -64,7 +64,7 @@ from paddle_tpu.obs import (MetricsRegistry, statset_collector,
 from paddle_tpu.obs.compile_watch import compile_collector, get_compile_watch
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
 from paddle_tpu.obs.hbm import hbm_collector, hbm_snapshot
-from paddle_tpu.obs.trace import process_info
+from paddle_tpu.obs.trace import trace_reply
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.engine import Request, ServingEngine
 from paddle_tpu.utils.stat import StatSet
@@ -835,17 +835,8 @@ class ServingServer:
             # misbehaving replica" move, and the bench overhead probe's
             # same-fleet A/B switch); the flip applies before the
             # snapshot, so enable:false returns the spans it just froze.
-            if isinstance(msg.get("enable"), bool):
-                self.tracer.enabled = msg["enable"]
-            conn.send({"type": "trace", "id": msg.get("id"),
-                       "process": process_info("replica", self.host,
-                                               self.port),
-                       "clock": {"perf_counter": time.perf_counter(),
-                                 "unix": time.time()},
-                       "enabled": self.tracer.enabled,
-                       "recorded": self.tracer.recorded,
-                       "dropped": self.tracer.dropped,
-                       "spans": self.tracer.snapshot()})
+            conn.send(trace_reply(self.tracer, msg, "replica",
+                                  self.host, self.port))
         elif t == "hello":
             # version/capabilities negotiation: answered on connect so a
             # peer (the fleet router, a ctl, a probing operator) can
@@ -938,14 +929,9 @@ class ServingServer:
         # distributed-trace context: a router (or a tracing client)
         # stamps {"trace": {"trace_id", "parent"?}} on the generate frame;
         # adopting it here is what joins the engine's lifecycle spans to
-        # the sender's trace.  Malformed contexts are dropped, not fatal —
-        # tracing must never fail a request.
-        trace = None
-        tc = msg.get("trace")
-        if isinstance(tc, dict) and isinstance(tc.get("trace_id"), str):
-            trace = {"trace_id": tc["trace_id"]}
-            if isinstance(tc.get("parent"), str):
-                trace["parent"] = tc["parent"]
+        # the sender's trace (wire.get_trace drops malformed contexts —
+        # shared with the pserver's send_grad/barrier adoption).
+        trace = wire.get_trace(msg)
         # engine req_ids are namespaced per connection so two clients
         # picking "0" can never collide inside the scheduler; the type tag
         # keeps JSON id 1 and id "1" distinct too (conn.rids already does)

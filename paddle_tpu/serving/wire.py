@@ -51,6 +51,25 @@ def hello_msg(role: str, **extra) -> dict:
     return {"type": "hello", "proto": PROTO, "role": role, **extra}
 
 
+def get_trace(msg: dict) -> Optional[dict]:
+    """Validated distributed-trace context off a wire frame, or None.
+
+    Frames that cross processes (serving `generate`, pserver
+    `send_grad`/`barrier`/`get_params`) may carry
+    `{"trace": {"trace_id": "<hex>", "parent": "<span id>"}}` —
+    docs/observability.md "Distributed tracing".  Malformed contexts are
+    dropped, not fatal: tracing must never fail a request, and the
+    serving replica server and the parameter server must agree on that
+    rule, which is why the validation lives HERE and not in either."""
+    tc = msg.get("trace")
+    if isinstance(tc, dict) and isinstance(tc.get("trace_id"), str):
+        out = {"trace_id": tc["trace_id"]}
+        if isinstance(tc.get("parent"), str):
+            out["parent"] = tc["parent"]
+        return out
+    return None
+
+
 class FrameError(ValueError):
     """Malformed frame: oversized length prefix or non-JSON body."""
 

@@ -508,10 +508,16 @@ class Trainer:
             # the num_batches_per_get_parameter cadence)
             grads = out[6]
             with global_stat.time("remoteUpdate"):
+                t_c0 = time.perf_counter()
                 grads_host = {n: np.asarray(jax.device_get(g))
                               for n, g in grads.items()}
-                fresh = self.updater.remote_step(grads_host,
-                                                 _batch_size(batch))
+                # the grad fetch blocks until the dispatched step's
+                # gradients exist — its wall IS the window's compute
+                # part; the updater folds it into the per-window
+                # attribution and the window span
+                fresh = self.updater.remote_step(
+                    grads_host, _batch_size(batch),
+                    compute=(t_c0, time.perf_counter() - t_c0))
             if fresh is not None:
                 self.params = {n: jnp.asarray(np.asarray(v))
                                for n, v in fresh.items()}
@@ -710,6 +716,14 @@ class Trainer:
         stats.update(cost=total_cost / max(n_batches, 1), batches=n_batches,
                      samples=n_samples, seconds=dt,
                      samples_per_sec=n_samples / dt if dt > 0 else 0.0)
+        if self._remote and hasattr(self.updater, "pass_timing"):
+            # remote-updater attribution riding the pass row: where this
+            # pass's wall went (push/barrier_wait/pull/apply ms + async
+            # staleness rejects) — metrics.jsonl and TRAIN_JSON inherit
+            # these next to the throughput gauges, so a distributed run's
+            # single-file pass history answers "where did my
+            # scaling_efficiency go" without a trace viewer
+            stats.update(self.updater.pass_timing())
         log.info("pass %d done: %s", self.pass_id, _fmt(stats))
         if self._tracer.enabled:
             self._tracer.add("train_pass", time.perf_counter() - dt, dt,

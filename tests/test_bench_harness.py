@@ -516,6 +516,10 @@ def test_assemble_lkg_stitches_train_dist_record(tmp_path):
                     "single_samples_per_sec": 2900.4,
                     "scaling_efficiency": 0.9174,
                     "fleet_wall_s": 3.2,
+                    "train_dist_trace_overhead_pct": 0.8,
+                    "trace_overhead_spread_pct": 2.1,
+                    "trace_off_samples_per_sec": 5400.0,
+                    "trace_on_samples_per_sec": 5356.8,
                     "measured_at": "2026-08-04T12:00:00+00:00"}},
     ]
     log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
@@ -524,3 +528,8 @@ def test_assemble_lkg_stitches_train_dist_record(tmp_path):
     assert out["train_dist"]["value"] == 5321.7
     assert out["train_dist"]["scaling_efficiency"] == 0.9174
     assert out["train_dist"]["single_samples_per_sec"] == 2900.4
+    # ISSUE 15 wiring: the live-flip trace-overhead probe's fields ride
+    # the same record through the fallback assembly
+    assert out["train_dist"]["train_dist_trace_overhead_pct"] == 0.8
+    assert out["train_dist"]["trace_overhead_spread_pct"] == 2.1
+    assert out["train_dist"]["trace_off_samples_per_sec"] == 5400.0

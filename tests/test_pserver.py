@@ -556,3 +556,163 @@ def test_engine_refuses_updater_hooks():
     with pytest.raises(NotImplementedError, match="hooks"):
         UpdateEngine(bm, 0, OPT, bad,
                      bm.split_all(_init_params()))
+
+
+# ---------------------------------------------------------------------------
+# straggler detection + the wedged-update-thread path (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_window_skew_histogram_and_straggler_event():
+    """The shard-0 coordinator measures per-window barrier-arrival skew;
+    past straggler_ms, a `straggler` flight event NAMES the late rank."""
+    from paddle_tpu.obs.flight import get_flight_recorder
+
+    fr = get_flight_recorder()
+    was_enabled = fr.enabled
+    fr.enabled = True
+    n0 = fr.recorded
+    srvs, addrs = _start(beat_timeout_s=60.0, straggler_ms=50.0)
+    try:
+        a = _client(addrs, rank=0)
+        b = _client(addrs, rank=1)
+        got = {}
+
+        def push_a():
+            got["a"] = a.push_grads(_grads(0), samples=4)
+
+        th = threading.Thread(target=push_a)
+        th.start()
+        time.sleep(0.3)                  # rank 1 is the straggler
+        got["b"] = b.push_grads(_grads(1), samples=4)
+        th.join(timeout=30)
+        assert "a" in got
+        events = [e for e in fr.snapshot()
+                  if e["kind"] == "straggler" and e["seq"] >= n0]
+        assert len(events) == 1
+        assert events[0]["data"]["rank"] == 1        # the LATE rank
+        assert events[0]["data"]["skew_ms"] >= 100.0
+        m = a.metrics()
+        assert "pserver_window_skew_ms_count 1" in m
+        st = a.stats()
+        assert st["last_skew_ms"] >= 100.0
+        assert st["straggler_ms"] == 50.0
+        # the barrier reply fed the skew into the client's attribution
+        assert a.last_timing["skew_ms"] >= 100.0
+        for cl in (a, b):
+            cl.leave()
+            cl.close()
+    finally:
+        fr.enabled = was_enabled
+        for s in srvs:
+            s.stop_background(drain=False)
+
+
+def test_wedged_update_thread_stale_ok_one_bundle_per_episode(tmp_path):
+    """ISSUE 15 satellite — the serving wedge e2e, ported to the
+    pserver: a deliberately wedged optimizer apply leaves stats/metrics/
+    trace RPCs answerable stale-ok on the loop thread, the watchdog's
+    lag gauge grows, EXACTLY one postmortem bundle freezes per episode
+    (role-aware in tools/postmortem.py), and releasing the wedge lets
+    the barrier commit and re-arms the watchdog for the next episode."""
+    import os
+
+    from paddle_tpu.obs import Tracer
+    from paddle_tpu.obs.flight import get_flight_recorder, load_bundle
+    from paddle_tpu.serving.client import ServingClient
+    from tools.postmortem import render
+
+    fr = get_flight_recorder()
+    was_enabled = fr.enabled
+    fr.enabled = True
+    tracer = Tracer()
+    tracer.enabled = True
+    srvs, addrs = _start(beat_timeout_s=60.0, wedge_threshold_s=0.5,
+                         snapshot_dir=str(tmp_path), tracer=tracer)
+    srv = srvs[0]
+
+    def bundles():
+        return sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("postmortem-"))
+
+    try:
+        a = _client(addrs, rank=0)
+        orig = srv.engine.commit
+        gate = {"wedged": threading.Event(), "release": threading.Event()}
+
+        def commit_wedged(entries, **kw):
+            gate["wedged"].set()
+            assert gate["release"].wait(60), "wedge never released"
+            return orig(entries, **kw)
+
+        srv.engine.commit = commit_wedged
+        got = {}
+        th = threading.Thread(
+            target=lambda: got.update(out=a.push_grads(_grads(0),
+                                                       samples=4)))
+        th.start()
+        assert gate["wedged"].wait(10), "update thread never picked up"
+        # stale-ok frames answer on the LOOP thread while the update
+        # thread is stuck, and the lag gauge grows between reads
+        with ServingClient(addrs[0][0], addrs[0][1], timeout=10) as c:
+            st1 = c.stats()
+            assert st1["update_alive"] is True
+            assert st1["update_lag_s"] >= 0.0
+            time.sleep(0.3)
+            st2 = c.stats()
+            assert st2["update_lag_s"] > st1["update_lag_s"]
+            mtext = c.metrics()
+            assert "pserver_update_lag_s" in mtext
+            assert "pserver_update_alive 1" in mtext
+            pull = c.trace()             # answers against the wedge
+            assert pull["process"]["role"] == "pserver"
+        # exactly ONE bundle at the threshold, not one per poll
+        deadline = time.monotonic() + 10
+        while not bundles() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(bundles()) == 1, "no bundle at the wedge threshold"
+        time.sleep(0.5)                  # > watchdog poll period
+        assert len(bundles()) == 1, \
+            "a sustained wedge must be one bundle, not one per poll"
+        b = load_bundle(str(tmp_path / bundles()[0]))
+        assert b["meta"]["reason"] == "update_wedge"
+        assert "update thread wedged" in b["meta"]["error"]
+        assert "ps_wedge" in [e["kind"] for e in b["events"]]
+        # the bundle renders ROLE-AWARE: membership table + update-
+        # thread state + window counters, not the serving slots layout
+        txt = render(b)
+        assert "pserver: shard 0/1" in txt
+        assert "update thread: WEDGED" in txt
+        assert "rank 0" in txt
+        assert "slots" not in txt.split("events:")[0]
+        # release: the parked barrier commits and the client advances
+        gate["release"].set()
+        th.join(timeout=30)
+        assert got.get("out") is not None
+        assert a.version == 1
+        # recovery re-arms the episode latch: a SECOND wedge freezes a
+        # second bundle
+        deadline = time.monotonic() + 5
+        while srv._wedge_dumped and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not srv._wedge_dumped, "watchdog never re-armed"
+        gate["wedged"] = threading.Event()
+        gate["release"] = threading.Event()
+        th2 = threading.Thread(
+            target=lambda: got.update(out2=a.push_grads(_grads(1),
+                                                        samples=4)))
+        th2.start()
+        assert gate["wedged"].wait(10)
+        deadline = time.monotonic() + 10
+        while len(bundles()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(bundles()) == 2, "second episode must dump again"
+        gate["release"].set()
+        th2.join(timeout=30)
+        assert got.get("out2") is not None
+        a.leave()
+        a.close()
+    finally:
+        fr.enabled = was_enabled
+        for s in srvs:
+            s.stop_background(drain=False)

@@ -52,19 +52,33 @@ def _host(tree):
     return {k: np.asarray(jax.device_get(v)) for k, v in tree.items()}
 
 
-def test_sync_k2_processes_bit_exact_vs_grad_accum2():
+def test_sync_k2_processes_bit_exact_vs_grad_accum2(tmp_path):
     """THE acceptance oracle: two trainer processes, disjoint stride
-    shards, 2 passes == one process with grad_accum=2, bit for bit."""
+    shards, 2 passes == one process with grad_accum=2, bit for bit —
+    run with the FULL tracing stack ON both sides (server ring enabled,
+    trainers --trace-out), so the observability tier provably never
+    perturbs the update math (ISSUE 15 acceptance)."""
+    from paddle_tpu.obs import Tracer
     from paddle_tpu.pserver.server import ParameterServer
 
-    srv = ParameterServer(port=0, beat_timeout_s=60.0)
+    tracer = Tracer()
+    tracer.enabled = True
+    srv = ParameterServer(port=0, beat_timeout_s=60.0, tracer=tracer)
     host, port = srv.start_background()
     try:
-        procs = [_spawn_trainer(port, r, 2, 2) for r in range(2)]
+        procs = [_spawn_trainer(
+            port, r, 2, 2,
+            extra=("--trace-out", str(tmp_path / f"r{r}.jsonl")))
+            for r in range(2)]
         for p in procs:
             out, err = p.communicate(timeout=300)
             assert p.returncode == 0, f"trainer failed:\n{err[-2000:]}"
             assert "TRAIN_JSON" in out
+        # tracing really ran: the server ring recorded shard-side spans
+        # and both trainers flushed stitchable files
+        assert tracer.recorded > 0
+        for r in range(2):
+            assert (tmp_path / f"r{r}.jsonl").stat().st_size > 0
         assert srv.engine is not None
         params, opt = srv.engine.assemble_full()
         assert int(opt["pass_id"]) == 2
@@ -151,7 +165,7 @@ class _GradTap:
     def connect_and_sync(self, params_host, config_json=None):
         return params_host
 
-    def remote_step(self, grads_host, batch_size, tag=None):
+    def remote_step(self, grads_host, batch_size, tag=None, compute=None):
         self.captured = (grads_host, batch_size)
         return None
 

@@ -67,15 +67,10 @@ async def amain(args) -> int:
         # postmortem wants.  The meta line stamps process identity so
         # trace_dump --merge labels this file's track group.
         if tracer is not None:
-            from paddle_tpu.obs import process_info
+            from paddle_tpu.obs import flush_trace_file
 
-            n = tracer.export_jsonl(
-                args.trace_out,
-                meta={"process": process_info("router", args.host,
-                                              rt.port)})
-            print(f"wrote {n} spans to {args.trace_out} "
-                  f"({tracer.dropped} dropped by ring wrap); convert "
-                  f"with tools/trace_dump.py", file=sys.stderr, flush=True)
+            flush_trace_file(tracer, args.trace_out, "router", args.host,
+                             rt.port)
 
     try:
         host, port = await rt.start()

@@ -2,7 +2,12 @@
 
 A bundle is the atomic directory the serving front end freezes on pump
 death, on the watchdog-wedge threshold, or on an operator `dump` frame
-(obs/flight.py; armed via `tools/serve.py --postmortem-dir`):
+(obs/flight.py; armed via `tools/serve.py --postmortem-dir`) — or the
+parameter server freezes on an update-thread wedge / `dump` frame
+(tools/pserver.py --snapshot-dir).  The renderer is role-aware: a
+pserver bundle (engine.json role "pserver") shows the membership table,
+update-thread state and window/commit counters instead of the serving
+slots/queue layout:
 
   python tools/postmortem.py runs/postmortems/postmortem-20260803-101500-123/
   python tools/postmortem.py ... --events 50      # more of the event tail
@@ -42,6 +47,49 @@ def _fmt_bytes(n) -> str:
     return f"{n:.1f}GiB"
 
 
+def _render_pserver(eng: dict) -> list:
+    """The pserver half of render(): membership table, update-thread
+    state, window/commit/snapshot counters — the engine.json a
+    parameter-server bundle carries is its stats frame."""
+    out = [f"pserver: shard {eng.get('shard')}/{eng.get('n_shards')} "
+           f"mode={eng.get('mode')} "
+           f"{'initialized' if eng.get('initialized') else 'UNINITIALIZED'}",
+           f"  window={eng.get('window')} version={eng.get('version')} "
+           f"pass={eng.get('pass_id')}  blocks={eng.get('blocks')} "
+           f"({_fmt_bytes(eng.get('block_bytes'))})"]
+    lag = eng.get("update_lag_s")
+    alive = eng.get("update_alive")
+    state = "alive" if alive else "DEAD"
+    if alive and isinstance(lag, (int, float)) and \
+            isinstance(eng.get("wedge_threshold_s"), (int, float)) and \
+            lag > eng["wedge_threshold_s"]:
+        state = "WEDGED"
+    out.append(f"  update thread: {state} lag={lag}s "
+               f"(wedge threshold {eng.get('wedge_threshold_s')}s)")
+    if eng.get("update_error"):
+        out.append(f"    error: {eng['update_error']}")
+    out.append(f"  pending: {eng.get('pending_grads')} grads, "
+               f"{eng.get('pending_barriers')} barriers, "
+               f"{eng.get('pending_pass_barriers')} pass barriers"
+               + ("  DRAINING" if eng.get("draining") else ""))
+    out.append(f"  last window skew: {eng.get('last_skew_ms')}ms "
+               f"(straggler threshold {eng.get('straggler_ms')}ms)")
+    trainers = eng.get("trainers") or []
+    out.append(f"  trainers: {eng.get('trainers_active')} active, "
+               f"{eng.get('trainers_draining')} draining")
+    for t in trainers:
+        out.append(f"    rank {t.get('rank')}  {t.get('tid'):<6} "
+                   f"{t.get('state'):<9} grads={t.get('grads_sent')} "
+                   f"windows={t.get('windows_joined')}")
+    snap = eng.get("snapshot") or {}
+    if snap.get("dir"):
+        out.append(f"  snapshots: {snap.get('written')} written "
+                   f"(every {snap.get('every')} commits) "
+                   f"last={snap.get('last_path')}"
+                   + ("  IN PROGRESS" if snap.get("in_progress") else ""))
+    return out
+
+
 def render(bundle: dict, n_events: int = 20) -> str:
     meta = bundle["meta"]
     out = [f"postmortem bundle: {bundle['path']}",
@@ -59,7 +107,9 @@ def render(bundle: dict, n_events: int = 20) -> str:
             out.append(f"            ... ({len(first) - 6} more lines)")
 
     eng = bundle.get("engine") or {}
-    if eng and "snapshot_error" not in eng:
+    if eng.get("role") == "pserver" and "snapshot_error" not in eng:
+        out.extend(_render_pserver(eng))
+    elif eng and "snapshot_error" not in eng:
         slots = eng.get("slots") or []
         live = [s for s in slots if isinstance(slots, list) and s]
         out.append("engine:")

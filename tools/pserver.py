@@ -24,6 +24,13 @@ One-shot client ops (stats / Prometheus metrics / commit log / dump):
   python tools/pserver.py --client 127.0.0.1:8571 --stats
   python tools/pserver.py --client 127.0.0.1:8571 --metrics
 
+Observability (docs/distributed_training.md "Observability"):
+`--trace-out` writes this shard's span ring on every exit path; the
+`trace` RPC (tools/trace_dump.py --pull HOST:PORT) pulls it live with a
+no-restart enable flip; `--straggler-ms` tunes the per-window
+barrier-skew event and `--wedge-threshold-s` the update-thread watchdog
+that freezes one postmortem bundle per wedge episode.
+
 The server is model-agnostic: the FIRST trainer's `ps_init` seeds the
 blocks and the optimizer configuration; later trainers must match its
 config hash.  Design doc: docs/distributed_training.md.
@@ -73,6 +80,13 @@ def run_client(args) -> int:
 async def amain(args) -> int:
     from paddle_tpu.pserver.server import ParameterServer
 
+    tracer = None
+    if args.trace_out:
+        from paddle_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        tracer.enabled = True
+
     srv = ParameterServer(
         host=args.host, port=args.port, shard_index=args.shard_index,
         n_shards=args.n_shards, mode=args.mode,
@@ -80,27 +94,43 @@ async def amain(args) -> int:
         beat_timeout_s=args.beat_timeout_s,
         snapshot_dir=args.snapshot_dir or None,
         snapshot_every=args.snapshot_every, keep_last=args.keep_last,
-        block_size=args.block_size)
+        block_size=args.block_size,
+        wedge_threshold_s=args.wedge_threshold_s,
+        straggler_ms=args.straggler_ms)
     srv.flight.enabled = True
-    host, port = await srv.start()
-    print("PSERVER_JSON:" + json.dumps(
-        {"host": host, "port": port, "pid": os.getpid(),
-         "shard": args.shard_index, "n_shards": args.n_shards,
-         "mode": args.mode}), flush=True)
 
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, stop.set)
-    await stop.wait()
-    print("draining: failing open barriers, writing the final "
-          "checkpoint...", file=sys.stderr, flush=True)
-    await srv.drain()          # final snapshot with --snapshot-dir
-    if srv.last_snapshot_path:
-        print(f"final checkpoint: {srv.last_snapshot_path}",
-              file=sys.stderr, flush=True)
-    print("drained; bye", file=sys.stderr, flush=True)
-    return 0
+    def flush_trace():
+        # EVERY exit path flushes (serve.py's finally discipline): the
+        # meta line stamps role/shard identity so trace_dump --merge
+        # labels this shard's track group
+        if tracer is not None:
+            from paddle_tpu.obs import flush_trace_file
+
+            flush_trace_file(tracer, args.trace_out, "pserver",
+                             args.host, srv.port, shard=args.shard_index)
+
+    try:
+        host, port = await srv.start()
+        print("PSERVER_JSON:" + json.dumps(
+            {"host": host, "port": port, "pid": os.getpid(),
+             "shard": args.shard_index, "n_shards": args.n_shards,
+             "mode": args.mode}), flush=True)
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        print("draining: failing open barriers, writing the final "
+              "checkpoint...", file=sys.stderr, flush=True)
+        await srv.drain()          # final snapshot with --snapshot-dir
+        if srv.last_snapshot_path:
+            print(f"final checkpoint: {srv.last_snapshot_path}",
+                  file=sys.stderr, flush=True)
+        print("drained; bye", file=sys.stderr, flush=True)
+        return 0
+    finally:
+        flush_trace()
 
 
 def main(argv=None) -> int:
@@ -128,6 +158,17 @@ def main(argv=None) -> int:
                     help="checkpoint every N commits WITHOUT pausing "
                          "send_grad traffic (0 = only the final one)")
     ap.add_argument("--keep-last", type=int, default=2)
+    ap.add_argument("--trace-out", default="",
+                    help="enable the span tracer and write this shard's "
+                         "spans as JSONL here on every exit path; the "
+                         "`trace` RPC (trace_dump --pull) also works "
+                         "without this, flipped live")
+    ap.add_argument("--wedge-threshold-s", type=float, default=30.0,
+                    help="update-thread job lag past which the watchdog "
+                         "freezes one postmortem bundle per episode")
+    ap.add_argument("--straggler-ms", type=float, default=250.0,
+                    help="per-window barrier-arrival skew past which a "
+                         "`straggler` flight event names the late rank")
     # client mode
     ap.add_argument("--client", default="",
                     help="HOST:PORT — run as a one-shot client instead")

@@ -143,16 +143,10 @@ async def amain(args) -> int:
         # line stamps process identity so trace_dump --merge can label
         # this file's track group in a stitched fleet trace.
         if tracer is not None:
-            from paddle_tpu.obs import process_info
+            from paddle_tpu.obs import flush_trace_file
 
-            n = tracer.export_jsonl(
-                args.trace_out,
-                meta={"process": process_info(
-                    "replica", args.host,
-                    srv.port if srv is not None else args.port)})
-            print(f"wrote {n} spans to {args.trace_out} "
-                  f"({tracer.dropped} dropped by ring wrap); convert with "
-                  f"tools/trace_dump.py", file=sys.stderr, flush=True)
+            flush_trace_file(tracer, args.trace_out, "replica", args.host,
+                             srv.port if srv is not None else args.port)
 
     engine = build_engine(args)
     srv = ServingServer(engine, host=args.host, port=args.port,

@@ -459,12 +459,18 @@ def main() -> int:
          bench_env("serving_spec", 840),
          lambda: _metric_fresh(_METRIC_OF["serving_spec"], fh)),
         # parameter-server training record (K-trainer aggregate samples/s
-        # + the 1-trainer arm + scaling efficiency): all subprocesses on
-        # the CPU backend, so it never contends for the chip and runs the
-        # same on rehearse and hardware windows
+        # + the 1-trainer arm + scaling efficiency + the live-flip
+        # trace-overhead probe): all subprocesses on the CPU backend, so
+        # it never contends for the chip and runs the same on rehearse
+        # and hardware windows; freshness requires the probe field so a
+        # pre-probe record never masks the measurement (the step pins
+        # BENCH_DIST_TRACE=1 — an operator-exported =0 would otherwise
+        # write records that can never satisfy the gate)
         ("bench_train_dist_record", [py, "bench.py"], 900,
-         bench_env("train_dist", 840, dist_env),
-         lambda: _metric_fresh(_METRIC_OF["train_dist"], fh)),
+         bench_env("train_dist", 840,
+                   {**dist_env, "BENCH_DIST_TRACE": "1"}),
+         lambda: _metric_fresh(_METRIC_OF["train_dist"], fh,
+                               "train_dist_trace_overhead_pct")),
         # (c) the VGG regression evidence: xplane profile banked on disk
         ("profile_vgg", [py, "tools/profile_vgg.py"] + profile_args,
          700, {},

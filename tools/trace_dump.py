@@ -15,17 +15,24 @@ as bars, instants (preempt/done/cancelled/deadline) as markers.
                                   # per-lane counts, compile-lane breakdown
 
 Distributed traces (docs/observability.md "Distributed tracing"): a
-fleet request crosses router and replica processes, each with its own
+fleet request crosses router and replica processes — and a training
+window crosses trainer and pserver-shard processes — each with its own
 span ring and its own perf_counter epoch.  `--merge` stitches several
 span FILES into ONE Chrome trace with a named process track group per
 file (a file's first line may be a `{"meta": {"process": ..., an
-"offset_s"}}` identity record — serve.py/fleet_router.py --trace-out
-write one); `--pull HOST:PORT` (repeatable) collects spans LIVE over the
-`trace` RPC instead, measuring each process's clock offset by
-ping-RTT midpointing so the tracks align:
+"offset_s"}}` identity record — serve.py/fleet_router.py/pserver.py/
+train_dist.py --trace-out all write one); `--pull HOST:PORT`
+(repeatable) collects spans LIVE over the `trace` RPC instead —
+replica, router, or pserver shard — measuring each process's clock
+offset by ping-RTT midpointing so the tracks align:
 
   python tools/trace_dump.py --pull 127.0.0.1:8440 \\
       --pull 127.0.0.1:8431 --pull 127.0.0.1:8432 -o fleet.trace.json
+
+  # training fleet: pull both pserver shards live, merge the trainers'
+  # --trace-out files — one Perfetto trace, role-named tracks
+  python tools/trace_dump.py --pull 127.0.0.1:8571 \\
+      --pull 127.0.0.1:8572 --merge t0.jsonl t1.jsonl -o dist.trace.json
 
 Exit codes: 0 ok, 2 on unreadable/empty input or an unreachable --pull.
 """

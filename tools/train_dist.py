@@ -14,9 +14,20 @@ Data sharding: each trainer takes every K-th batch of the config's data
 stream (`--rank`-strided — the disjoint-shard convention the exactness
 oracle assumes).  SIGTERM/SIGINT drains: the current batch finishes, the
 trainer announces ps_drain + ps_leave (the barrier re-sizes, the fleet
-continues), exit 0.  On completion prints one machine-readable line:
+continues), exit 0.  On completion prints one machine-readable line
+(sync runs include the last pass's per-window attribution sums —
+push/barrier_wait/pull ms):
 
   TRAIN_JSON:{"rank": 0, "passes": 2, "samples": 4096, ...}
+
+Observability (docs/distributed_training.md "Observability"):
+`--trace-out spans.jsonl` enables the span tracer for the run and writes
+the retained ring on EVERY exit path (clean, drained, or crashed — the
+spans up to a failure are exactly what a postmortem wants), led by a
+`{"meta": {"process"}}` identity line so `tools/trace_dump.py --merge`
+labels this trainer's track in a stitched fleet trace; `--save-dir`
+appends one metrics.jsonl row per pass (the remote-updater timing fields
+ride next to the throughput gauges).
 """
 
 from __future__ import annotations
@@ -65,11 +76,25 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout-s", type=float, default=300.0,
                     help="pserver RPC timeout (a sync barrier waits at "
                          "most this long for straggler trainers)")
+    ap.add_argument("--trace-out", default="",
+                    help="enable the span tracer and write this "
+                         "trainer's spans as JSONL here on every exit "
+                         "path (trace_dump --merge food)")
+    ap.add_argument("--save-dir", default="",
+                    help="append one metrics.jsonl row per pass here "
+                         "(remote-updater timing fields included)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.config.parser import parse_config
     from paddle_tpu.optim.remote_updater import RemoteParameterUpdater
     from paddle_tpu.trainer.trainer import Trainer
+
+    tracer = None
+    if args.trace_out:
+        from paddle_tpu.obs import get_tracer
+
+        tracer = get_tracer()
+        tracer.enabled = True
 
     cfg = parse_config(args.config, args.config_args)
     updater = RemoteParameterUpdater(
@@ -98,6 +123,15 @@ def main(argv=None) -> int:
                 return
             yield b
 
+    def flush_trace():
+        # EVERY exit path flushes (serve.py's finally discipline): a
+        # SIGTERM-drained or crashed trainer must still leave a
+        # stitchable trace file with its identity line
+        if tracer is not None:
+            from paddle_tpu.obs import flush_trace_file
+
+            flush_trace_file(tracer, args.trace_out, "trainer", rank=rank)
+
     t0 = time.time()
     samples = passes = 0
     stats: dict = {}
@@ -109,15 +143,24 @@ def main(argv=None) -> int:
                                       log_period=args.log_period)
             samples += int(stats.get("samples", 0))
             passes += 1
+            if args.save_dir:
+                tr.append_metrics(args.save_dir, extra=stats)
     finally:
-        updater.drain_and_leave()
+        try:
+            updater.drain_and_leave()
+        finally:
+            flush_trace()
     dt = time.time() - t0
+    timing = {k: stats[k] for k in
+              ("push_ms", "barrier_wait_ms", "pull_ms", "apply_ms",
+               "compute_ms", "remote_windows", "async_stale_rejects")
+              if k in stats}
     print("TRAIN_JSON:" + json.dumps({
         "rank": rank, "passes": passes, "samples": samples,
         "seconds": round(dt, 3),
         "samples_per_sec": round(samples / dt, 3) if dt > 0 else 0.0,
         "cost": stats.get("cost"),
-        "drained": draining["flag"]}), flush=True)
+        "drained": draining["flag"], **timing}), flush=True)
     return 0
 
 
