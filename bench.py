@@ -799,6 +799,61 @@ def bench_serving_spec(dtype: str) -> dict:
     }
 
 
+def bench_serving_scan(dtype: str) -> dict:
+    """Multi-step decode record (docs/serving.md "Multi-step decode"):
+    the mixed-length closed-loop workload through ONE engine at
+    decode_steps=1 (one dispatch per token — the baseline) then with
+    `BENCH_SERVE_DECODE_STEPS` scanned decode bodies per dispatch —
+    tools/bench_serving.py --decode-steps is the sweep tool, this is the
+    compact record for the driver's BENCH capture.  Headline = scan-arm
+    tokens/s; companions are the baseline arm, the flush/step counters
+    (`scan_steps == k * scan_flushes` — the ceil(n/k) dispatch
+    evidence), and `reconcile_ok`.  On CPU expect speedup <= 1 (PERF.md
+    "Reading the multi-step bench"); token exactness across k is
+    tests/test_multi_step.py's job."""
+    import argparse
+
+    from tools.bench_serving import build_engine, measure_scan
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        dtype=dtype)
+    max_new = int(os.environ.get("BENCH_SERVE_MAX_NEW", "64"))
+    k = int(os.environ.get("BENCH_SERVE_DECODE_STEPS", "4"))
+    wl = dict(
+        n=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prompt_lo=int(os.environ.get("BENCH_SERVE_PROMPT_LO", "32")),
+        prompt_hi=min(int(os.environ.get("BENCH_SERVE_PROMPT_HI", "256")),
+                      args.max_context - max_new - 1),
+        max_new=max_new,
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")))
+    reps = int(os.environ.get("BENCH_SERVE_REPS", "3"))
+
+    eng = build_engine(args)
+    m = measure_scan(eng, wl, reps, seed=0, k=k)
+    return {
+        "metric": "lm_serving_scan_tok_per_sec",
+        "value": round(m["scan_tok_per_sec"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"decode_steps={k} vocab={args.vocab} dim={args.dim} "
+                  f"L={args.layers} H={args.heads} slots={args.slots} "
+                  f"page={args.page_size} "
+                  f"prompts={wl['prompt_lo']}-{wl['prompt_hi']} "
+                  f"max_new={max_new}",
+        **{key: m[key] for key in (
+            "baseline_tok_per_sec", "speedup_vs_baseline", "scan_flushes",
+            "scan_steps", "tokens", "baseline_decode_steps",
+            "scan_decode_steps", "reconcile_ok", "sig_stable")},
+    }
+
+
 def bench_train_dist(dtype: str) -> dict:
     """Parameter-server training record (paddle_tpu/pserver/,
     docs/distributed_training.md): K sync trainer PROCESSES
@@ -997,6 +1052,7 @@ BENCHES = {
     "serving_fleet": bench_serving_fleet,
     "serving_tp": bench_serving_tp,
     "serving_spec": bench_serving_spec,
+    "serving_scan": bench_serving_scan,
     "train_dist": bench_train_dist,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
@@ -1123,6 +1179,7 @@ _METRIC_OF = {
     "serving_fleet": "lm_serving_fleet_tok_per_sec",
     "serving_tp": "lm_serving_tp_tok_per_sec",
     "serving_spec": "lm_serving_spec_tok_per_sec",
+    "serving_scan": "lm_serving_scan_tok_per_sec",
     "train_dist": "train_dist_samples_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
@@ -1208,7 +1265,7 @@ def _assemble_lkg() -> dict | None:
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
                 "serving_fleet", "serving_tp", "serving_spec",
-                "train_dist", "mnist",
+                "serving_scan", "train_dist", "mnist",
                 "sentiment", "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None

@@ -68,11 +68,13 @@ from typing import Optional
 from paddle_tpu.fleet import replica as rep
 from paddle_tpu.fleet.policy import PlacementPolicy
 from paddle_tpu.fleet.replica import Replica, ReplicaTable
-from paddle_tpu.obs import MetricsRegistry, tracer_collector
+from paddle_tpu.obs import (MetricsRegistry, statset_collector,
+                            tracer_collector)
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
 from paddle_tpu.obs.trace import (get_tracer, new_span_id, new_trace_id,
                                   trace_reply)
 from paddle_tpu.serving import wire
+from paddle_tpu.utils.stat import StatSet
 
 
 #: one client connection (the router's client face): the SAME slow-reader
@@ -87,7 +89,8 @@ class _RoutedReq:
 
     __slots__ = ("conn", "cid", "msg", "grid", "rid", "stream", "streamed",
                  "retries", "t_submit", "trace_id", "span_id",
-                 "client_parent", "t0")
+                 "client_parent", "t0", "t_last_tok", "burst_left",
+                 "burst_share")
 
     def __init__(self, conn, cid, msg, grid):
         self.conn = conn
@@ -99,6 +102,16 @@ class _RoutedReq:
         self.streamed = 0              # token frames the CLIENT has seen
         self.retries = 0
         self.t_submit = time.monotonic()
+        # burst-aware relay inter-token latency (multi-step decode): a
+        # replica running decode_steps=k relays ≤k token frames back to
+        # back, each stamped with `burst` = fresh tokens remaining in its
+        # burst including itself — the router divides the inter-burst
+        # arrival gap by the burst size so relay ITL percentiles stay
+        # comparable across decode_steps settings (one arrival is k
+        # tokens of progress, not one)
+        self.t_last_tok = 0.0          # last relayed-token arrival
+        self.burst_left = 0            # burst tokens still to charge
+        self.burst_share = 0.0         # per-token share of the burst gap
         # distributed-trace identity, stamped at ingress: one trace_id per
         # request (adopted from the client's frame when it sent one), and
         # the router's ingress span id — the `parent` every router-side
@@ -281,6 +294,10 @@ class FleetRouter:
         self._last_dump_error = "unknown"
         self.flight = get_flight_recorder()
         self.flight.enabled = True
+        # router-side latency stats (utils/stat.py): today one stat —
+        # relay_token_latency, the burst-honest inter-token gap clients
+        # actually observed at the router tier
+        self.stats = StatSet("fleet_router")
         self._routes: dict[str, _RoutedReq] = {}
         self._seq = 0
         self._draining = False
@@ -321,6 +338,9 @@ class FleetRouter:
             lambda: float(len(self.policy.index)))
         reg.gauge("fleet_draining").set_fn(
             lambda: 1.0 if self._draining else 0.0)
+        reg.register_collector(statset_collector(
+            self.stats, "fleet_relay_latency_seconds",
+            "fleet_relay_latency_count"))
         reg.register_collector(tracer_collector(self.tracer))
         reg.register_collector(flight_collector(self.flight))
 
@@ -705,6 +725,24 @@ class FleetRouter:
             # it forwards per-token — but only st.stream clients receive)
             if st.stream:
                 st.streamed += 1
+                # relay ITL, burst-honest: charge each token of a ≤k
+                # burst an equal share of the inter-burst gap.  Kept to
+                # arithmetic + one Stat.add (~100ns lock) — per-token
+                # loop-thread work beyond that measurably costs tok/s
+                # (see the tracer note below).
+                now = time.monotonic()
+                if st.streamed > 1:
+                    if st.burst_left > 0:
+                        st.burst_left -= 1
+                        self.stats.get("relay_token_latency").add(
+                            st.burst_share)
+                    else:
+                        b = max(1, int(msg.get("burst") or 1))
+                        st.burst_share = (now - st.t_last_tok) / b
+                        st.burst_left = b - 1
+                        self.stats.get("relay_token_latency").add(
+                            st.burst_share)
+                st.t_last_tok = now
                 if self.tracer.enabled and st.streamed == 1:
                     # FIRST-token relay only: the router-side TTFT stitch
                     # point.  A marker per token here would put python
@@ -1120,6 +1158,14 @@ class FleetRouter:
             "placements": placements,
             "retries": self._m_retries.value(),
             "sheds": self._m_sheds.value(),
+            # burst-honest relay inter-token latency (ms): one scanned
+            # k-token burst is k tokens of progress, each charged an
+            # equal share of the inter-burst gap — comparable across
+            # replicas running different decode_steps
+            "relay_itl_ms": {k: round(v * 1e3, 3) for k, v in
+                             self.stats.percentiles(
+                                 "relay_token_latency",
+                                 (50.0, 90.0, 99.0)).items()},
             "replicas": [r.summary() for r in self.table],
         }
 

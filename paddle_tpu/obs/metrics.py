@@ -85,6 +85,12 @@ CATALOG: dict[str, str] = {
         "prompt chunks scheduled into mixed prefill/decode steps",
     "serving_mixed_steps_total":
         "compiled steps that carried at least one prefill chunk row",
+    "serving_scan_steps_total":
+        "decode bodies run inside scanned multi-step dispatches "
+        "(decode_steps per flush; see serving_scan_flushes_total)",
+    "serving_scan_flushes_total":
+        "scanned multi-step dispatches (host boundaries) — steps/flushes "
+        "reads back the effective decode_steps",
     "serving_decode_gap_ms":
         "pump-step gap decoding slots saw (ms between consecutive steps "
         "advancing decode rows — HOL-blocking prefill shows here)",
@@ -104,6 +110,12 @@ CATALOG: dict[str, str] = {
     "serving_latency_count": "samples recorded per latency stat (label: stat)",
     # -- fleet router (paddle_tpu/fleet/router.py) -------------------------
     "fleet_requests_accepted_total": "generate requests the router placed",
+    "fleet_relay_latency_seconds":
+        "router-tier relay latency quantiles (labels: stat, quantile; "
+        "relay_token_latency = burst-honest inter-token gap — a scanned "
+        "k-token burst charges each token gap/k)",
+    "fleet_relay_latency_count":
+        "samples recorded per router relay stat (label: stat)",
     "fleet_placements_total":
         "placements by policy decision (label: policy = "
         "affinity/least_loaded/random)",

@@ -52,6 +52,16 @@ validates divisibility, and the grouped-query ratio H/h_kv is shard-
 invariant).  The kernel itself needs no collective and no change: page
 tables and lengths arrive replicated, every DMA stays on-chip, and the
 head padding below (`max(H, 8)`) applies to the LOCAL count.
+
+MULTI-STEP decode (the engine's `--decode-steps K` scanned dispatch):
+the kernel is scan-body safe — pure in its operands with no host
+callbacks, no side channels, and no per-call state, so `lax.scan`
+tracing it K times produces ONE kernel instance in the loop body (the
+body appears once in the HLO).  Positions/lengths arriving as scan
+carries instead of host-staged arrays change nothing here: each body's
+DMA addressing reads whatever `table`/`lengths` values the carry holds,
+and under shard_map the same holds per shard (hlo_shard_check lowers
+the scanned program and proves the collective set matches one body).
 """
 
 from __future__ import annotations

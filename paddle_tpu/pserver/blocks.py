@@ -18,8 +18,13 @@ the property the sync-mode exactness oracle rests on.
 Wire codec: arrays travel as {"dtype", "shape", "b64"} with the raw
 little-endian bytes base64'd inside the JSON frame — bit-exact by
 construction (no float/decimal round trip), debuggable with `nc` like the
-rest of the protocol.  numpy + stdlib only; no jax (the client side must
-stay importable on a box with no accelerator stack).
+rest of the protocol.  On the `send_grad`/`get_params` hot paths, peers
+that both advertise the "bin_blocks" hello capability switch to
+encode_blocks_bin/decode_blocks_bin: block bytes ride RAW behind a binary
+wire frame (serving/wire.py) — ~25% fewer bytes and no base64 encode on
+every training step, same bit-exact arrays.  numpy + stdlib only; no jax
+(the client side must stay importable on a box with no accelerator
+stack).
 """
 
 from __future__ import annotations
@@ -47,6 +52,41 @@ def decode_array(d: dict) -> np.ndarray:
     raw = base64.b64decode(d["b64"])
     a = np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
     return a.reshape([int(x) for x in d["shape"]]).copy()
+
+
+def encode_blocks_bin(blocks: dict) -> tuple[dict, bytes]:
+    """{bid: array} -> (JSON-safe meta, one concatenated raw payload) for
+    a binary wire frame (serving/wire.py encode_bin): meta records each
+    block's dtype/shape plus its [off, off+n) byte span in the payload —
+    no base64, no per-element JSON, bit-exact by construction.  Blocks
+    are laid out in sorted-bid order (determinism; the meta offsets are
+    authoritative either way)."""
+    meta = {}
+    parts = []
+    off = 0
+    for bid in sorted(blocks):
+        a = np.ascontiguousarray(blocks[bid])
+        raw = a.tobytes()
+        meta[bid] = {"dtype": a.dtype.name, "shape": list(a.shape),
+                     "off": off, "n": len(raw)}
+        parts.append(raw)
+        off += len(raw)
+    return meta, b"".join(parts)
+
+
+def decode_blocks_bin(meta: dict, payload: bytes) -> dict:
+    """(meta, payload) -> {bid: array} (each owns its buffer; writable)
+    — the inverse of encode_blocks_bin, same contract as decode_array."""
+    out = {}
+    view = memoryview(payload)
+    for bid, d in meta.items():
+        off, n = int(d["off"]), int(d["n"])
+        if off < 0 or off + n > len(payload):
+            raise ValueError(f"block {bid}: byte span [{off}, {off + n}) "
+                             f"overruns the {len(payload)}-byte payload")
+        a = np.frombuffer(view[off:off + n], dtype=np.dtype(d["dtype"]))
+        out[bid] = a.reshape([int(x) for x in d["shape"]]).copy()
+    return out
 
 
 class BlockRef:

@@ -36,7 +36,8 @@ import numpy as np
 
 from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.pserver.blocks import (BlockMap, decode_array,
-                                       encode_array)
+                                       decode_blocks_bin,
+                                       encode_array, encode_blocks_bin)
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.client import connect_with_backoff
 
@@ -80,6 +81,10 @@ class ParameterClient:
             self.socks.append(sock)
             self.hellos.append(hello)
         self.mode = self.hellos[0].get("mode", "sync")
+        # hot-path framing: binary block frames only if EVERY shard
+        # advertises the capability (an old shard keeps getting JSON)
+        self._bin = all("bin_blocks" in (h.get("capabilities") or ())
+                        for h in self.hellos)
         # dedicated control connection to the coordinator: membership +
         # heartbeats, so a beat never interleaves with a blocked barrier
         self._ctl, _ = connect_with_backoff(
@@ -123,9 +128,13 @@ class ParameterClient:
             except OSError:
                 pass
 
-    def _rpc(self, shard: int, msg: dict, reply_types: tuple) -> dict:
+    def _rpc(self, shard: int, msg: dict, reply_types: tuple,
+             payload: Optional[bytes] = None) -> dict:
         sock = self.socks[shard]
-        wire.write_frame_sync(sock, msg)
+        if payload is None:
+            wire.write_frame_sync(sock, msg)
+        else:
+            wire.write_frame_bin_sync(sock, msg, payload)
         while True:
             reply = wire.read_frame_sync(sock)
             if reply is None:
@@ -256,6 +265,8 @@ class ParameterClient:
         self.last_pull_timings = {}    # shard -> its window-apply timing
         for s in range(len(self.addrs)):
             msg: dict = {"type": "get_params", "want": want}
+            if self._bin:
+                msg["bin"] = True
             if trace:
                 msg["trace"] = trace
             if apply_members is not None and s != 0:
@@ -271,8 +282,12 @@ class ParameterClient:
                 # window before answering — its breakdown nests inside
                 # the caller's pull phase
                 self.last_pull_timings[s] = reply["timing"]
-            for bid, d in reply["blocks"].items():
-                blocks[bid] = decode_array(d)
+            if wire.PAYLOAD_KEY in reply:
+                blocks.update(decode_blocks_bin(reply["blocks"],
+                                                reply[wire.PAYLOAD_KEY]))
+            else:
+                for bid, d in reply["blocks"].items():
+                    blocks[bid] = decode_array(d)
         self.last_pull_ms = (time.perf_counter() - t0) * 1e3
         if self.tracer.enabled:
             self.tracer.add("pull", t0, time.perf_counter() - t0,
@@ -308,9 +323,13 @@ class ParameterClient:
                     shard_blocks.update(bm.split(name, grads[name],
                                                  shard=s))
             msg = {"type": "send_grad", "tid": self.tid, "window": w,
-                   "samples": int(samples),
-                   "blocks": {bid: encode_array(a)
-                              for bid, a in shard_blocks.items()}}
+                   "samples": int(samples)}
+            payload = None
+            if self._bin:
+                msg["blocks"], payload = encode_blocks_bin(shard_blocks)
+            else:
+                msg["blocks"] = {bid: encode_array(a)
+                                 for bid, a in shard_blocks.items()}
             if tag is not None:
                 msg["tag"] = tag
             if trace:
@@ -318,7 +337,7 @@ class ParameterClient:
             if self.mode == "async":
                 msg["base_version"] = self.version
             t_s0 = time.perf_counter()
-            ack = self._rpc(s, msg, ("grad_ack",))
+            ack = self._rpc(s, msg, ("grad_ack",), payload=payload)
             if tr.enabled:
                 tr.add("push", t_s0, time.perf_counter() - t_s0,
                        track="remote",

@@ -85,7 +85,9 @@ from paddle_tpu.obs import MetricsRegistry, tracer_collector
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
 from paddle_tpu.obs.trace import get_tracer, trace_reply
 from paddle_tpu.pserver import membership as mem
-from paddle_tpu.pserver.blocks import BlockMap, decode_array, encode_array
+from paddle_tpu.pserver.blocks import (BlockMap, decode_array,
+                                       decode_blocks_bin, encode_array,
+                                       encode_blocks_bin)
 from paddle_tpu.pserver.membership import Membership
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.wire import FrameConn
@@ -279,6 +281,23 @@ class UpdateEngine:
         with self.lock:
             src = dict(src)
         return {bid: encode_array(np.asarray(v)) for bid, v in src.items()}
+
+    def wire_blocks_bin(self, want: str = "params") -> tuple[dict, bytes]:
+        """wire_blocks, binary flavor: (meta, raw payload) for a binary
+        frame — the hot-path encoding peers negotiate via the
+        "bin_blocks" hello capability (no base64 on every pull)."""
+        if want == "average":
+            if not self.use_average:
+                raise ValueError("this configuration trains without model "
+                                 "averaging (settings average_window=0) — "
+                                 "pull want='params'")
+            src = self.state["average"]
+        else:
+            src = self.params
+        with self.lock:
+            src = dict(src)
+        return encode_blocks_bin({bid: np.asarray(v)
+                                  for bid, v in src.items()})
 
     def capture(self) -> dict:
         """Consistent snapshot by reference (copy-on-write: commits swap
@@ -1050,7 +1069,7 @@ class ParameterServer:
                     "hello", "ping", "ps_init", "ps_join", "ps_beat",
                     "ps_drain", "ps_leave", "send_grad", "barrier",
                     "get_params", "stats", "metrics", "dump", "ps_log",
-                    "trace"])))
+                    "trace", "bin_blocks"])))
         elif t == "ps_init":
             self._handle_init(conn, msg)
         elif t == "ps_join":
@@ -1204,7 +1223,14 @@ class ParameterServer:
         tid = str(msg.get("tid"))
         w = int(msg.get("window", -1))
         samples = int(msg.get("samples", 0))
-        blocks = {bid: decode_array(d) for bid, d in msg["blocks"].items()}
+        if wire.PAYLOAD_KEY in msg:
+            # binary frame (bin_blocks capability): block meta in the
+            # header, raw bytes behind it — no per-block base64 decode
+            blocks = decode_blocks_bin(msg["blocks"],
+                                       msg[wire.PAYLOAD_KEY])
+        else:
+            blocks = {bid: decode_array(d)
+                      for bid, d in msg["blocks"].items()}
         # wire-level trace context: the trainer minted one trace_id for
         # this window and stamped it on the frame; adopting it as span
         # attrs is what joins this shard's recv/apply spans to the
@@ -1403,16 +1429,26 @@ class ParameterServer:
     def _reply_params(self, conn: FrameConn, msg: dict,
                       timing: Optional[dict] = None) -> None:
         want = msg.get("want", "params")
+        binary = bool(msg.get("bin"))
         reply = {"type": "params", "id": msg.get("id"), "want": want,
                  "version": self.engine.version,
                  "window": self._next_window,
                  "pass_id": self.engine.pass_id,
-                 "blocks": self.engine.wire_blocks(want)}
+                 "bin": binary}
         if timing is not None:
             # the window reply a commit-set relay triggered carries this
             # shard's apply breakdown (accum/apply/total ms)
             reply["timing"] = timing
-        conn.send(reply)
+        if binary:
+            # the client asked for the raw-bytes reply (it saw the
+            # bin_blocks capability in our hello): block meta rides in
+            # the header, the concatenated bytes behind it
+            meta, payload = self.engine.wire_blocks_bin(want)
+            reply["blocks"] = meta
+            conn.send_bin(reply, payload)
+        else:
+            reply["blocks"] = self.engine.wire_blocks(want)
+            conn.send(reply)
 
     # -- ops frames ----------------------------------------------------------
     def _stats_msg(self) -> dict:

@@ -234,6 +234,7 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = -1,
                  max_step_tokens: Optional[int] = None,
                  spec_k: int = 0, drafter=None,
+                 decode_steps: int = 1,
                  mesh=None, tracer=None):
         self.executor = executor
         self.input_name, self.logits_name = _resolve_io_names(
@@ -366,6 +367,7 @@ class ServingEngine:
         self._d_run = None
         self._d_table = self._d_pos = self._d_toks = self._d_gen = None
         self._d_keys = self._d_temp = self._d_topk = self._d_topp = None
+        self._d_eos = self._d_maxnew = None
         # every engine jit reports to the compile watcher (obs/
         # compile_watch.py): the decode step must stay at ONE signature,
         # per-bucket prefill compiles feed the recompile-storm detector
@@ -417,6 +419,30 @@ class ServingEngine:
                                     # == accepted + chains unless an eos
                                     # truncated a chain (reconciliation)
         self.set_speculation(spec_k, drafter)
+        # MULTI-STEP DECODE (the scanned step): when every live slot is in
+        # pure-decode mode, step() runs ONE jitted lax.scan of
+        # `decode_steps` identical per-step bodies over the donated
+        # EngineState — pos/gen/toks/KV writes advance on device for up to
+        # k tokens per dispatch, eos/max_new enforced by an on-device run
+        # mask INSIDE the scan (a finished slot's later iterations become
+        # no-ops, mirroring lm_generate's early-exit chunks), and the host
+        # unpacks a [k, S] token block at the boundary where admission,
+        # streaming, cancel/deadline sweeps, and preemption still happen.
+        # Compiled signatures: ONE scanned program per (S, k) — k is a
+        # static argument of one lazily-built jit, alongside the k=1 step
+        # (which mixed/spec steps and page-starved windows fall back to).
+        # Tokens are bit-identical to k=1: the body IS _decode_impl and
+        # the device mask mirrors _bank_token's retirement rule exactly.
+        self._scan_step = None
+        self.decode_steps = 1
+        self.n_scan_steps = 0       # scan body iterations run (k per flush)
+        self.n_scan_flushes = 0     # scanned dispatches (boundaries seen)
+        # tokens banked for the slot currently being unpacked arrive in a
+        # burst of cur_burst (> 1 only inside a scan flush): on_token
+        # consumers divide inter-arrival gaps by it so inter-token latency
+        # stays honest across decode_steps settings (serving/server.py)
+        self.cur_burst = 1
+        self.set_decode_steps(decode_steps)
         # token-budget observability: per-step scheduled-token histogram
         # and the pump-step gap decoding slots actually saw (ms) — the
         # HOL-blocking number chunking exists to bound.  Standalone
@@ -613,6 +639,8 @@ class ServingEngine:
             temp = np.zeros(S, np.float32)
             topk = np.zeros(S, np.int32)
             topp = np.zeros(S, np.float32)
+            eos = np.full(S, -1, np.int32)
+            maxnew = np.zeros(S, np.int32)
             for s, sl in enumerate(self.slots):
                 if sl is None:
                     continue
@@ -621,6 +649,8 @@ class ServingEngine:
                 temp[s] = sl.req.temperature
                 topk[s] = sl.req.top_k
                 topp[s] = sl.req.top_p
+                eos[s] = sl.req.eos_id
+                maxnew[s] = sl.req.max_new
             self._d_pos = self._stage(pos)
             self._d_toks = self._stage(toks)
             self._d_gen = self._stage(gen)
@@ -628,6 +658,10 @@ class ServingEngine:
             self._d_temp = self._stage(temp)
             self._d_topk = self._stage(topk)
             self._d_topp = self._stage(topp)
+            # the scanned step's on-device retirement operands: eos id and
+            # max_new per slot — same lifecycle cadence as the knobs above
+            self._d_eos = self._stage(eos)
+            self._d_maxnew = self._stage(maxnew)
             self._slots_dirty = False
 
     def _sync_run_mask(self, runnable) -> None:
@@ -917,6 +951,16 @@ class ServingEngine:
         elif filling:
             return self._run_mixed_step(live, runnable, filling)
 
+        if self.decode_steps > 1 and self.spec_k == 0 \
+                and self._scan_window_ok(runnable, self.decode_steps):
+            # pure-decode steady state with multi-step on: ONE scanned
+            # dispatch advances every runnable slot up to k tokens.  Any
+            # slot that cannot secure pages for its whole window drops
+            # THIS dispatch back to the k=1 step below (progress without
+            # livelock); mixed/spec steps never scan — the engine returns
+            # to the scanned path once it is pure-decode again.
+            return self._run_scan_step(live, runnable, self.decode_steps)
+
         traced = self._tr_on()
         t_step = time.perf_counter() if traced else 0.0
         S = len(self.slots)
@@ -987,6 +1031,79 @@ class ServingEngine:
                 self.decode_gap_hist.observe(
                     (now - self._t_prev_decode) * 1e3)
             self._t_prev_decode = now
+
+    def _scan_window_ok(self, runnable, k: int) -> bool:
+        """Page precondition for ONE k-step scanned dispatch: every
+        runnable slot must hold pages for its whole window — min(k,
+        tokens it can still emit) positions from pos (a slot that hits
+        eos earlier simply stops writing; a retired slot's one frozen
+        recompute lands at most one position past its last token, still
+        inside the window).  Any shortfall reports False and the caller
+        falls back to the k=1 step for this dispatch — the +1 page every
+        runnable slot already secured guarantees progress, and the next
+        boundary retries after retires/eviction free pages."""
+        ok = True
+        for s in runnable:
+            sl = self.slots[s]
+            need = min(k, sl.req.max_new - sl.gen)
+            if not self.kv.try_grow(s, sl.pos + need):
+                ok = False
+        return ok
+
+    def _run_scan_step(self, live, runnable, k: int) -> bool:
+        """ONE scanned dispatch: k identical decode bodies advance every
+        runnable slot on device (pos/gen/toks/KV writes all inside the
+        scan), the host unpacking a [k, S] token block at the boundary.
+        Per-slot banking cuts each slot's column at its own eos/max_new —
+        the exact retirement the device run mask applied — so host
+        mirrors re-converge with device state without any readback."""
+        traced = self._tr_on()
+        t_step = time.perf_counter() if traced else 0.0
+        S = len(self.slots)
+        psize = self.kv.page_size
+        for s in runnable:
+            sl = self.slots[s]
+            # every page the window can touch must be private (the k=1
+            # tripwire, widened to the window span)
+            last = sl.pos + min(k, sl.req.max_new - sl.gen) - 1
+            for j in range(sl.pos // psize, last // psize + 1):
+                assert self.kv.page_writable(int(self.kv.table[s, j])), \
+                    f"slot {s} scan window would write a shared page"
+        self._sync_run_mask(runnable)
+        self._sync_device_state()
+        st, blk = self._scan_step_fn()(
+            k, self.params, self._build_state(), self._d_run,
+            self._d_eos, self._d_maxnew)
+        self._unpack_state(st)
+        self.n_decode_steps += 1
+        self.n_scan_flushes += 1
+        self.n_scan_steps += k
+        self.occupancy_sum += len(live) / S
+        blk = np.asarray(blk)                          # [k, S] host sync
+        self._note_step_metrics(len(runnable), decoded=True)
+        if traced:
+            self.tracer.add("scan_step", t_step,
+                            time.perf_counter() - t_step, track="engine",
+                            attrs={"live": len(live), "k": k,
+                                   "step": self.n_decode_steps})
+        # per-flush, never per-token: one boundary event each k tokens
+        self.flight.record("scan_flush", k=k, slots=len(runnable))
+        for s in runnable:
+            sl = self.slots[s]
+            burst = []
+            for i in range(k):
+                t = int(blk[i, s])
+                burst.append(t)
+                if t == sl.req.eos_id or sl.gen + len(burst) >= \
+                        sl.req.max_new:
+                    break                # device run mask froze here too
+            self.cur_burst = len(burst)
+            try:
+                for t in burst:
+                    self._bank_token(s, t)
+            finally:
+                self.cur_burst = 1
+        return True
 
     def _run_mixed_step(self, live, runnable, filling) -> bool:
         """ONE mixed prefill/decode dispatch: pack each runnable decode
@@ -1718,6 +1835,24 @@ class ServingEngine:
             from paddle_tpu.serving.drafter import NgramDrafter
             self.drafter = NgramDrafter()
 
+    def set_decode_steps(self, decode_steps: int) -> None:
+        """Configure multi-step decode (idle engine only — a live slot's
+        host mirrors must be at a scan boundary).  `decode_steps=1`
+        disables — the baseline side of bench_serving's --decode-steps
+        A/B; k > 1 runs up to k decode bodies per dispatch inside ONE
+        jitted lax.scan whenever the engine is pure-decode.  Emitted
+        tokens are IDENTICAL either way; only dispatches-per-token (and
+        the streaming burst size) change.  Each distinct k is ONE scanned
+        signature per slot count — hold it fixed in production."""
+        assert all(sl is None for sl in self.slots) and not self.queue, \
+            "set_decode_steps requires an idle engine"
+        decode_steps = int(decode_steps)
+        if decode_steps < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1 (1 = multi-step off), got "
+                f"{decode_steps}")
+        self.decode_steps = decode_steps
+
     @property
     def spec_accept_rate(self) -> float:
         """Accepted / drafted over the engine lifetime (0.0 before any
@@ -1758,7 +1893,15 @@ class ServingEngine:
         preemption order, free-list order and page placement all survive.
         Call between steps on the step()-driving thread (the pump), like
         every other scheduler access.  This is the checkpoint/restore +
-        live-replica-migration unit the EngineState refactor unlocks."""
+        live-replica-migration unit the EngineState refactor unlocks.
+
+        Multi-step decode needs no special handling: a scanned dispatch
+        is atomic INSIDE step(), so between steps the engine is always at
+        a scan boundary — host mirrors converged, no mid-window state
+        exists to freeze.  `decode_steps` is deliberately NOT part of the
+        config-match dict: it is an A/B dispatch knob, and a snapshot
+        taken under k restores bit-exactly onto an engine running any
+        other k (tests/test_multi_step.py proves it)."""
 
         def req_snap(r: Request) -> dict:
             return {"req_id": r.req_id, "prompt_ids": r.prompt_ids.copy(),
@@ -1814,7 +1957,8 @@ class ServingEngine:
                 "occupancy_sum", "n_prefix_hits", "n_prefix_misses",
                 "prefill_tokens_saved", "n_prefill_chunks",
                 "n_mixed_steps", "n_spec_steps", "n_spec_chains",
-                "n_spec_drafted", "n_spec_accepted", "n_spec_tokens")},
+                "n_spec_drafted", "n_spec_accepted", "n_spec_tokens",
+                "n_scan_steps", "n_scan_flushes")},
             "results": {k: np.asarray(v).copy()
                         for k, v in self.results.items()},
             "finish_reasons": dict(self.finish_reasons),
@@ -2026,6 +2170,44 @@ class ServingEngine:
                              gen=st.gen + runi, keys=st.keys, temp=st.temp,
                              topk=st.topk, topp=st.topp)
         return new_st, nxt
+
+    def _scan_impl(self, k: int, params, st: EngineState, run, eos,
+                   maxnew):
+        """THE scanned decode step — one signature per (S, k): k
+        applications of the EXACT k=1 body (_decode_impl) chained through
+        the donated EngineState by lax.scan, with per-slot retirement ON
+        DEVICE: after each body, a slot whose sampled token hit its eos
+        id or whose generation count reached max_new drops out of the run
+        mask, so its later iterations recompute with frozen pos/toks —
+        batch-independent garbage whose K/V write lands at the one
+        uncommitted position after its last token (never read, never
+        donated to the prefix index).  The [k, S] stacked samples are the
+        host boundary's token block; rows past a slot's retirement are
+        discarded by the host cut that mirrors this very mask."""
+        def body(carry, _):
+            st, run = carry
+            new_st, nxt = self._decode_impl(params, st, run)
+            run = run & (nxt != eos) & (new_st.gen < maxnew)
+            return (new_st, run), nxt
+        (new_st, _), toks = jax.lax.scan(body, (st, run), None, length=k)
+        return new_st, toks
+
+    def _scan_step_fn(self):
+        """The jitted scanned step (signature discipline: ONE scanned
+        program per (S, k)) — `k` rides as a STATIC leading argument so
+        one jit object holds every window length, its cache size counts
+        the programs directly, and the compile watcher's signature at
+        site `serving.scan_step` distinguishes k (static ints are part
+        of the call signature, where a partial-bound k would vanish
+        from the aval-only view) — the recompile-storm detector sees a
+        knob-churning deployment the same way it sees bucket churn."""
+        if self._scan_step is None:
+            scan_jit = jax.jit(self._scan_impl, static_argnums=(0,),
+                               donate_argnums=(2,),
+                               **self._step_sharding_kwargs(n_extra=3))
+            self._scan_step = get_compile_watch().wrap_jit(
+                "serving.scan_step", scan_jit)
+        return self._scan_step
 
     def _mixed_impl(self, params, st: EngineState, row_ids, row_slot,
                     row_pos, sample_row, adv, emit):

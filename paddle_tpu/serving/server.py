@@ -73,7 +73,8 @@ from paddle_tpu.utils.stat import StatSet
 class _ReqState:
     """Server-side lifecycle of one accepted request."""
 
-    __slots__ = ("conn", "cid", "stream", "t_submit", "t_last", "next_idx")
+    __slots__ = ("conn", "cid", "stream", "t_submit", "t_last", "next_idx",
+                 "burst_left", "burst_share")
 
     def __init__(self, conn, cid, stream):
         self.conn = conn
@@ -85,6 +86,13 @@ class _ReqState:
                                       # preempted request replays identical
                                       # tokens from 0; indexes below this
                                       # are dropped, not re-streamed
+        # burst-honest inter-token latency (multi-step decode): a scanned
+        # dispatch banks up to k tokens back-to-back, so the first token
+        # of a burst divides the whole inter-arrival gap by the burst size
+        # and the rest charge the SAME share — token_latency percentiles
+        # stay comparable across decode_steps settings
+        self.burst_left = 0           # burst tokens still to charge
+        self.burst_share = 0.0        # per-token share of the burst gap
 
 
 #: one client connection (asyncio side): the shared slow-reader-severing
@@ -227,6 +235,12 @@ class ServingServer:
                  float(eng.n_prefill_chunks)),
                 ("serving_mixed_steps_total", "counter", None,
                  float(eng.n_mixed_steps)),
+                # multi-step decode: scan body iterations vs boundary
+                # flushes — steps/flushes ≈ decode_steps in steady state
+                ("serving_scan_steps_total", "counter", None,
+                 float(eng.n_scan_steps)),
+                ("serving_scan_flushes_total", "counter", None,
+                 float(eng.n_scan_flushes)),
                 # speculative decoding: drafted/accepted counters + the
                 # lifetime accept rate (the throughput-multiplier dial)
                 ("serving_spec_drafted_total", "counter", None,
@@ -623,6 +637,7 @@ class ServingServer:
             "prefix_cache": self.engine.prefix is not None,
             "tp_shards": int(self.engine.tp),
             "spec_k": int(self.engine.spec_k),
+            "decode_steps": int(self.engine.decode_steps),
             "wedge_threshold_s": self.wedge_threshold_s,
             "postmortem_dir": self.postmortem_dir,
         }
@@ -657,11 +672,28 @@ class ServingServer:
         if st is None:
             return
         now = time.monotonic()
+        # burst bookkeeping counts EVERY banked token (replays included —
+        # within one burst replayed indexes precede fresh ones), so the
+        # position within the engine's current ≤k-token burst is exact
+        if st.burst_left > 0:
+            st.burst_left -= 1
+        else:                                  # first token of a new burst
+            st.burst_left = max(1, int(self.engine.cur_burst)) - 1
+            st.burst_share = -1.0
         if idx >= st.next_idx:                 # fresh, not a preempt replay
             if idx == 0:
                 self.stats.get("first_token_latency").add(now - st.t_submit)
             else:
-                self.stats.get("token_latency").add(now - st.t_last)
+                if st.burst_share < 0.0:
+                    # first FRESH token since t_last: the gap since then
+                    # covers this token and the burst_left still to come
+                    # (all fresh — replays sort first), so each owns an
+                    # equal share.  At decode_steps=1 the burst is one
+                    # token and this is the classic per-token charge;
+                    # at k>1 this keeps token_latency percentiles
+                    # comparable across decode_steps settings.
+                    st.burst_share = (now - st.t_last) / (st.burst_left + 1)
+                self.stats.get("token_latency").add(st.burst_share)
             # t_last advances on FRESH tokens only: replayed (deduped)
             # emissions reach no client, so the first post-replay fresh
             # token must charge the whole preempt+re-prefill+replay stall
@@ -672,7 +704,8 @@ class ServingServer:
             if st.stream:
                 self._loop.call_soon_threadsafe(
                     st.conn.send, {"type": "token", "id": st.cid,
-                                   "token": int(tok), "index": int(idx)})
+                                   "token": int(tok), "index": int(idx),
+                                   "burst": st.burst_left + 1})
 
     def _on_finish(self, rid: str, toks: np.ndarray, reason: str) -> None:
         # the server owns delivery — keep the engine's archive empty so a
@@ -994,6 +1027,11 @@ class ServingServer:
             "spec_drafted": eng.n_spec_drafted,
             "spec_accepted": eng.n_spec_accepted,
             "spec_accept_rate": round(eng.spec_accept_rate, 4),
+            # multi-step decode: the A/B-able knob + scan dispatch
+            # counters (flushes = boundaries, steps = body iterations)
+            "decode_steps_k": eng.decode_steps,
+            "scan_steps": eng.n_scan_steps,
+            "scan_flushes": eng.n_scan_flushes,
             # sharding: model-axis shard count + per-device pool bytes
             "tp_shards": eng.tp,
             "kv_pool_bytes_per_shard": int(eng.kv.pool_bytes_per_shard),

@@ -12,6 +12,20 @@ debuggable with `nc` + a human eye.  Message schemas live in
 docs/serving.md; the server (serving/server.py, asyncio) and the client
 (serving/client.py, blocking sockets) both speak through THIS module so
 the framing can never drift between them.
+
+One payload class breaks the tiny-JSON assumption: the parameter server's
+block arrays (`send_grad`/`get_params`), where base64-inside-JSON costs
+~33% extra bytes plus encode/decode time on the training hot path.  For
+those, a BINARY frame variant tags the length prefix's high bit (free:
+MAX_FRAME is far below 2^31) and carries
+
+    [>I : BIN_BIT | N][>I : H][H bytes UTF-8 JSON header][N-4-H raw bytes]
+
+— the header is an ordinary message dict, the raw payload rides behind it
+un-encoded and is attached to the decoded dict under `PAYLOAD_KEY`.  Both
+read paths (asyncio + blocking) understand it unconditionally; SENDING it
+is negotiated through hello `capabilities` ("bin_blocks") so an old peer
+keeps receiving pure JSON.
 """
 
 from __future__ import annotations
@@ -26,6 +40,15 @@ _LEN = struct.Struct(">I")
 #: refuse frames above this — a corrupt/hostile length prefix must not make
 #: the receiver allocate gigabytes (64 MiB >> any real request/response)
 MAX_FRAME = 64 * 1024 * 1024
+
+#: high bit of the length prefix tags a binary frame (header + raw
+#: payload); every JSON frame's length is <= MAX_FRAME << 2^31, so the bit
+#: can never be set by accident on a well-formed legacy stream
+BIN_BIT = 0x80000000
+
+#: decoded binary frames carry their raw payload under this key (bytes);
+#: leading underscore keeps it out of any JSON re-encode by convention
+PAYLOAD_KEY = "_payload"
 
 #: wire-protocol version, carried by the `hello` frame both the replica
 #: server and the fleet router answer on connect.  Bump on any change a
@@ -94,13 +117,47 @@ def _decode_body(body: bytes) -> dict:
     return msg
 
 
+def encode_bin(msg: dict, payload: bytes) -> bytes:
+    """One message + raw payload -> binary wire frame (module docstring
+    layout).  `msg` must not already carry PAYLOAD_KEY."""
+    header = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    n = _LEN.size + len(header) + len(payload)
+    if n > MAX_FRAME:
+        raise FrameError(f"binary frame of {n} bytes exceeds the "
+                         f"{MAX_FRAME}-byte cap")
+    return _LEN.pack(BIN_BIT | n) + _LEN.pack(len(header)) \
+        + header + payload
+
+
+def _decode_bin_body(body: bytes) -> dict:
+    """Binary frame body -> header dict with the raw payload attached
+    under PAYLOAD_KEY."""
+    if len(body) < _LEN.size:
+        raise FrameError("binary frame too short for its header prefix")
+    (h,) = _LEN.unpack(body[:_LEN.size])
+    if h > len(body) - _LEN.size:
+        raise FrameError(f"binary frame header length {h} overruns the "
+                         f"{len(body)}-byte body — corrupt stream?")
+    msg = _decode_body(body[_LEN.size:_LEN.size + h])
+    msg[PAYLOAD_KEY] = bytes(body[_LEN.size + h:])
+    return msg
+
+
 def check_length(raw: bytes) -> int:
-    """Validate a length prefix; returns the body length."""
+    """Validate a length prefix; returns the body length (binary-frame
+    tag bit stripped — use split_length to see it)."""
+    return split_length(raw)[0]
+
+
+def split_length(raw: bytes) -> tuple[int, bool]:
+    """Validate a length prefix; returns (body length, is_binary)."""
     (n,) = _LEN.unpack(raw)
+    binary = bool(n & BIN_BIT)
+    n &= ~BIN_BIT
     if n > MAX_FRAME:
         raise FrameError(f"frame length {n} exceeds the {MAX_FRAME}-byte "
                          f"cap — corrupt stream?")
-    return n
+    return n, binary
 
 
 async def read_frame(reader) -> Optional[dict]:
@@ -111,12 +168,12 @@ async def read_frame(reader) -> Optional[dict]:
         raw = await reader.readexactly(_LEN.size)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
-    n = check_length(raw)
+    n, binary = split_length(raw)
     try:
         body = await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionError) as e:
         raise FrameError(f"stream ended mid-frame ({e})") from e
-    return _decode_body(body)
+    return _decode_bin_body(body) if binary else _decode_body(body)
 
 
 class FrameConn:
@@ -143,6 +200,14 @@ class FrameConn:
         self.rids = {}             # client id -> owner's routing id
 
     def send(self, msg: dict) -> None:
+        self._write(encode(msg))
+
+    def send_bin(self, msg: dict, payload: bytes) -> None:
+        """Binary frame variant (header + raw payload) — negotiated via
+        hello capabilities; same slow-reader discipline as send()."""
+        self._write(encode_bin(msg, payload))
+
+    def _write(self, frame: bytes) -> None:
         if self.dead or self.writer.is_closing():
             return
         try:
@@ -151,7 +216,7 @@ class FrameConn:
                 self.dead = True   # slow reader: sever, don't buffer
                 self.writer.close()
                 return
-            self.writer.write(encode(msg))
+            self.writer.write(frame)
         except (ConnectionError, RuntimeError):
             self.dead = True
 
@@ -173,12 +238,17 @@ def read_frame_sync(sock: socket.socket) -> Optional[dict]:
         return None
     if len(raw) < _LEN.size:
         raise FrameError("stream ended inside a length prefix")
-    n = check_length(raw)
+    n, binary = split_length(raw)
     body = _recv_exact(sock, n)
     if body is None or len(body) < n:
         raise FrameError(f"stream ended mid-frame (wanted {n} bytes)")
-    return _decode_body(body)
+    return _decode_bin_body(body) if binary else _decode_body(body)
 
 
 def write_frame_sync(sock: socket.socket, msg: dict) -> None:
     sock.sendall(encode(msg))
+
+
+def write_frame_bin_sync(sock: socket.socket, msg: dict,
+                         payload: bytes) -> None:
+    sock.sendall(encode_bin(msg, payload))

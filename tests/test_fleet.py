@@ -513,6 +513,46 @@ def test_fleet_stats_metrics_dump_frames_and_unhealthy_bundle(
         _stop_all(rt, srvs)
 
 
+def test_router_relay_itl_burst_honest_through_multi_step_replicas(
+        tiny_tr):
+    """ISSUE 16 satellite: replicas running decode_steps=3 relay token
+    frames in bursts; the router divides the inter-burst arrival gap by
+    the frame's `burst` stamp so relay ITL counts every token (no
+    k-times undercount, no 0-gap flood), streams stay bit-exact, and the
+    percentiles surface in the stats frame + CATALOG metrics."""
+    rng = np.random.default_rng(3)
+    rt, host, port, srvs = _fleet(tiny_tr, 2, decode_steps=3)
+    try:
+        prompts = [rng.integers(2, 31, int(rng.integers(3, 10))).tolist()
+                   for _ in range(4)]
+        with ServingClient(host, port) as c:
+            ids = [c.submit(p, max_new=7) for p in prompts]
+            out = c.collect(ids)
+            for rid, p in zip(ids, prompts):
+                assert out[rid]["tokens"] == _oracle(tiny_tr, p, 7)
+                assert out[rid]["stream"] == out[rid]["tokens"][len(p):]
+            # the replicas really did scan (multi-step actually engaged)
+            assert sum(srv.engine.n_scan_flushes for srv in srvs) > 0
+            s = c.stats()
+            itl = s["relay_itl_ms"]
+            assert set(itl) == {"p50", "p90", "p99"}
+            assert 0.0 <= itl["p50"] <= itl["p99"]
+            text = c.metrics()
+            vals = {}
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    key, v = line.rsplit(" ", 1)
+                    vals[key] = float(v)
+        # every relayed token past each request's first charged exactly
+        # one relay_token_latency sample: 4 requests x (7 - 1) tokens
+        assert vals['fleet_relay_latency_count'
+                    '{stat="relay_token_latency"}'] == 24.0
+        assert vals['fleet_relay_latency_seconds'
+                    '{quantile="p99",stat="relay_token_latency"}'] >= 0.0
+    finally:
+        _stop_all(rt, srvs)
+
+
 def test_router_rejects_non_replica_peer_on_join(tiny_tr):
     """Joining an address that is not a serving replica (here: the
     router ITSELF — role 'router') must fail the hello classification,

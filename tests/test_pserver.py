@@ -81,6 +81,61 @@ def test_block_split_assemble_roundtrip():
         bm.assemble("w", one_shard)
 
 
+def test_bin_blocks_codec_bit_exact_and_bounds_checked():
+    from paddle_tpu.pserver.blocks import (decode_blocks_bin,
+                                           encode_blocks_bin)
+    rng = np.random.default_rng(7)
+    blocks = {"w#1": rng.standard_normal((5, 3)).astype(np.float32),
+              "w#0": np.array([np.nan, np.inf, -0.0, 1e-45], np.float32),
+              "b#0": rng.integers(0, 9, (4,)).astype(np.int32)}
+    meta, payload = encode_blocks_bin(blocks)
+    # layout is sorted-bid and gap-free
+    assert list(meta) == sorted(blocks)
+    assert sum(d["n"] for d in meta.values()) == len(payload)
+    out = decode_blocks_bin(meta, payload)
+    assert set(out) == set(blocks)
+    for bid, a in blocks.items():
+        b = out[bid]
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(a.view(np.uint8), b.view(np.uint8))
+        assert b.flags.writeable          # same contract as decode_array
+    # exactly what decode_array yields from the JSON codec — the two wire
+    # formats are interchangeable representations of the same arrays
+    for bid, a in blocks.items():
+        np.testing.assert_array_equal(
+            out[bid].view(np.uint8),
+            decode_array(encode_array(a)).view(np.uint8))
+    # a corrupt span must fail loudly, not read out of bounds
+    bad = {k: dict(v) for k, v in meta.items()}
+    bad["w#1"]["off"] = len(payload)
+    with pytest.raises(ValueError, match="overruns"):
+        decode_blocks_bin(bad, payload)
+
+
+def test_bin_wire_frame_roundtrip_and_json_interleave():
+    import socket as socket_mod
+
+    from paddle_tpu.serving import wire
+
+    a, b = socket_mod.socketpair()
+    try:
+        payload = bytes(range(256)) * 17
+        wire.write_frame_bin_sync(a, {"type": "send_grad", "window": 3},
+                                  payload)
+        wire.write_frame_sync(a, {"type": "barrier", "window": 3})
+        msg = wire.read_frame_sync(b)
+        assert msg["type"] == "send_grad" and msg["window"] == 3
+        assert msg[wire.PAYLOAD_KEY] == payload
+        # a plain JSON frame on the same stream is untouched by the
+        # binary variant (no payload key, same framing)
+        nxt = wire.read_frame_sync(b)
+        assert nxt == {"type": "barrier", "window": 3}
+        assert wire.PAYLOAD_KEY not in nxt
+    finally:
+        a.close()
+        b.close()
+
+
 # ---------------------------------------------------------------------------
 # membership state machine units (ISSUE 14 satellite: deterministic
 # join/drain/leave — no sockets, injected clocks)
@@ -231,6 +286,41 @@ def test_elastic_join_drain_leave_and_abrupt_death():
     finally:
         for s in srvs:
             s.stop_background(drain=False)
+
+
+def test_bin_blocks_negotiated_and_bit_identical_to_json():
+    """ISSUE 16 satellite: the binary block framing changes BYTES ON THE
+    WIRE only — a fleet driven through binary frames commits bit-identical
+    parameters to one driven by a legacy JSON-only client, and a client
+    that advertises nothing (old peer) keeps working against a new
+    server because sending binary is hello-negotiated."""
+    def run_windows(force_json):
+        srvs, addrs = _start(n_shards=2)
+        try:
+            c = ParameterClient(addrs, timeout=30.0)
+            # every new shard advertises the capability
+            assert c._bin is True
+            if force_json:
+                c._bin = False       # what a pre-capability client sends
+            c.join(rank=0)
+            c.init_or_fetch(_init_params(), OPT.to_dict(),
+                            {n: p.to_dict() for n, p in PCFGS.items()})
+            out = None
+            for w in range(3):
+                out = c.push_grads(_grads(w), samples=4)
+            c.leave()
+            c.close()
+            return out
+        finally:
+            for s in srvs:
+                s.stop_background(drain=False)
+
+    p_bin = run_windows(force_json=False)
+    p_json = run_windows(force_json=True)
+    assert set(p_bin) == set(p_json) == {"w", "b"}
+    for n in p_bin:
+        np.testing.assert_array_equal(p_bin[n].view(np.uint8),
+                                      p_json[n].view(np.uint8))
 
 
 def test_wrong_window_after_eviction_is_actionable():

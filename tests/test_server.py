@@ -247,6 +247,71 @@ def test_metrics_frame_and_consistent_stats_over_tcp(tiny_tr):
         srv.stop_background(drain=True)
 
 
+def test_multi_step_streams_burst_frames_and_honest_itl(tiny_tr):
+    """ISSUE 16: a decode_steps=4 engine behind the server streams token
+    frames in deterministic ≤k bursts — each frame stamped with `burst` =
+    fresh tokens remaining in its burst including itself — the outputs
+    stay oracle-exact, token_latency charges every post-first token an
+    equal SHARE of its burst gap (count == fresh tokens, no k-times
+    undercount), and the scan dispatch counters surface in metrics."""
+    from paddle_tpu.serving import wire
+
+    eng = _engine(tiny_tr, decode_steps=4)
+    srv = ServingServer(eng, max_queue=8)
+    host, port = srv.start_background()
+    try:
+        import socket
+
+        prompt = [3, 9, 4, 7, 2]
+        sock = socket.create_connection((host, port), timeout=30)
+        try:
+            wire.write_frame_sync(sock, wire.hello_msg("client"))
+            assert wire.read_frame_sync(sock)["role"] == "replica"
+            # max_new=9: token 0 from the prefill boundary, then exactly
+            # two full k=4 scanned flushes
+            wire.write_frame_sync(sock, {"type": "generate", "id": "r0",
+                                         "prompt": prompt, "max_new": 9,
+                                         "stream": True})
+            frames = []
+            while True:
+                msg = wire.read_frame_sync(sock)
+                frames.append(msg)
+                if msg["type"] == "done":
+                    break
+        finally:
+            sock.close()
+        toks = [f for f in frames if f["type"] == "token"]
+        done = frames[-1]
+        assert done["reason"] == "length"
+        assert done["tokens"] == _oracle(tiny_tr, prompt, 9)
+        assert [f["token"] for f in toks] == done["tokens"][len(prompt):]
+        # the burst countdown: first token rides its own 1-burst (the
+        # prefill boundary), then two scanned flushes of 4
+        assert [f["burst"] for f in toks] == [1, 4, 3, 2, 1, 4, 3, 2, 1]
+        assert eng.n_scan_flushes == 2 and eng.n_scan_steps == 8
+
+        with ServingClient(host, port) as c:
+            s = c.stats()
+            assert s["decode_steps_k"] == 4
+            assert s["scan_flushes"] == 2 and s["scan_steps"] == 8
+            text = c.metrics()
+            vals = {}
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    key, v = line.rsplit(" ", 1)
+                    vals[key] = float(v)
+        assert vals["serving_scan_steps_total"] == 8.0
+        assert vals["serving_scan_flushes_total"] == 2.0
+        # burst-honest accounting: EVERY fresh post-first token charged
+        # token_latency exactly once (8 = 9 generated - the first)
+        assert vals['serving_latency_count{stat="token_latency"}'] == 8.0
+        assert vals['serving_latency_count'
+                    '{stat="first_token_latency"}'] == 1.0
+        eng.kv.check_reclaimed()
+    finally:
+        srv.stop_background(drain=True)
+
+
 def test_stats_stale_ok_works_with_pump_off(tiny_tr):
     """The watchdog path must answer when the pump never started — and
     the DEFAULT path must fall back rather than hang forever."""

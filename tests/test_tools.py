@@ -60,10 +60,13 @@ def test_hlo_gather_detector_anchors_to_shapes():
 
 def test_hlo_shard_check_decode_has_no_pool_allgather():
     """tools/hlo_shard_check.py on the real engine over a 2-shard host
-    mesh: the tensor-parallel decode AND mixed steps must contain zero
-    all-gathers of the KV pools or attention projections, and exactly
-    the per-layer post-attention all-reduce — the acceptance evidence
-    for the sharded-decode HBM/FLOPs split (docs/serving.md)."""
+    mesh: the tensor-parallel decode, mixed, spec-verify AND multi-step
+    scan programs must contain zero all-gathers of the KV pools or
+    attention projections, and exactly the per-layer post-attention
+    all-reduce — for the scan that count covers ONE body (lax.scan
+    lowers to a while loop; the body appears once in the HLO), the
+    acceptance evidence for the sharded-decode HBM/FLOPs split
+    (docs/serving.md)."""
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import jax
@@ -76,7 +79,7 @@ def test_hlo_shard_check_decode_has_no_pool_allgather():
         pytest.skip("needs >= 2 devices (conftest provides 8 host devices)")
     out = run_check(model=2, save="")
     assert out["ok"], out["verdict"]
-    for step in ("decode", "mixed", "spec"):
+    for step in ("decode", "mixed", "spec", "scan"):
         rec = out["steps"][step]
         assert rec["table_all_gathers"] == [], (step, rec)
         assert rec["n_all_gathers"] == 0, \

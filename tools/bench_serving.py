@@ -202,6 +202,8 @@ def run_workload(engine, requests, arrivals=None) -> dict:
     itl_seconds: list = []
     last_t: dict = {}
     last_idx: dict = {}
+    bleft: dict = {}
+    bshare: dict = {}
 
     def _on_token(rid, tok, idx):
         now = time.perf_counter()
@@ -213,6 +215,19 @@ def run_workload(engine, requests, arrivals=None) -> dict:
         if idx == 0 and rid in t_add and rid not in seen_first:
             seen_first.add(rid)
             first_tok_seconds.append(now - t_add[rid])
+        # burst bookkeeping counts EVERY banked token (replays included —
+        # within one burst replayed indexes precede fresh ones), the same
+        # discipline the server's token_latency stat uses: at
+        # decode_steps=k one scan flush banks up to k tokens per slot in
+        # one on_token volley, so each fresh token in the burst owns an
+        # equal 1/burst share of the gap since the request's previous
+        # fresh token — without it the ITL percentiles of a k>1 run
+        # would read k-times bursty against a k=1 run
+        if bleft.get(rid, 0) > 0:
+            bleft[rid] -= 1
+        else:                                  # first token of a new burst
+            bleft[rid] = max(1, int(getattr(engine, "cur_burst", 1))) - 1
+            bshare[rid] = -1.0
         # inter-token latency as the CLIENT sees it: the gap between a
         # request's consecutive FRESH tokens — the p99 of this is what
         # chunked prefill bounds.  Replayed tokens (idx <= last seen) are
@@ -222,7 +237,12 @@ def run_workload(engine, requests, arrivals=None) -> dict:
         prev = last_idx.get(rid, -1)
         if idx > prev:
             if prev >= 0:
-                itl_seconds.append(now - last_t[rid])
+                if bshare[rid] < 0.0:
+                    # first FRESH token since last_t: the gap covers this
+                    # token plus the bleft still to come (all fresh —
+                    # replays sort first within a burst)
+                    bshare[rid] = (now - last_t[rid]) / (bleft[rid] + 1)
+                itl_seconds.append(bshare[rid])
             last_t[rid] = now
             last_idx[rid] = idx
         if prev_token is not None:
@@ -507,6 +527,74 @@ def measure_spec(eng, wl: dict, reps: int, seed: int, spec_k: int) -> dict:
         "baseline_decode_steps": int(base_steps),
         "spec_decode_steps": int(steps),
         "reconcile_ok": (spec_tokens == accepted + chains
+                         and toks == reps * wl["n"] * wl["max_new"]),
+    }
+
+
+def measure_scan(eng, wl: dict, reps: int, seed: int, k: int) -> dict:
+    """Multi-step decode A/B on ONE engine: the identical mixed-length
+    workload (fresh Request objects each pass, same seeds) at
+    decode_steps=1 (one dispatch per token) then decode_steps=k (ONE
+    jitted lax.scan of k decode bodies per dispatch whenever every live
+    slot is pure-decode) — emitted tokens are identical by construction
+    (tests/test_multi_step.py's oracle), so the only deltas are
+    dispatches-per-token and wall time.  Closed loop: the scan's win is
+    host-dispatch amortization, arrival jitter would only blur it.
+
+    set_decode_steps requires an idle engine — both flips happen between
+    run_workload calls, when every slot has drained.  sig_stable pins
+    the compiled-program story: the k=1 decode step stays at ONE
+    signature across both arms and the scan arm compiles exactly ONE
+    scanned program (the body appears ONCE in its HLO, as a while loop).
+    reconcile_ok is the ceil(n/k) dispatch evidence: greedy with eos off
+    means both arms emit exactly n * max_new tokens, and every scan
+    flush advances its slots k steps — `scan_steps == k * scan_flushes`
+    with `scan_flushes > 0` (steps where admission/prefill interleaves
+    fall back to k=1 and touch neither counter)."""
+    import numpy as np
+
+    def sets():
+        return [make_requests(seed=seed + 1 + r, **wl)
+                for r in range(reps)]
+
+    eng.set_decode_steps(1)
+    warm_workload(eng, [make_requests(seed=seed, **wl)] + sets())
+    base_vals, base_disp = [], 0
+    for reqs in sets():
+        rec = run_workload(eng, reqs)
+        base_vals.append(rec["tokens"] / rec["seconds"])
+        base_disp += rec["decode_steps"]
+
+    eng.set_decode_steps(k)
+    eng.run(make_requests(seed=seed, **wl))      # scan-signature warm
+    decode_sigs = eng._decode_step._cache_size()
+    scan_sigs = eng._scan_step._cache_size() if eng._scan_step else 0
+    f0, s0 = eng.n_scan_flushes, eng.n_scan_steps
+    vals, toks, disp = [], 0, 0
+    for reqs in sets():
+        rec = run_workload(eng, reqs)
+        vals.append(rec["tokens"] / rec["seconds"])
+        toks += rec["tokens"]
+        disp += rec["decode_steps"]
+    eng.kv.check()
+    flushes = eng.n_scan_flushes - f0
+    steps = eng.n_scan_steps - s0
+    base_med, scan_med = float(np.median(base_vals)), float(np.median(vals))
+    return {
+        "sig_stable": (eng._decode_step._cache_size() == decode_sigs
+                       and eng._scan_step is not None
+                       and eng._scan_step._cache_size() == scan_sigs
+                       and scan_sigs == 1),
+        "decode_steps": int(k),
+        "baseline_tok_per_sec": base_med,
+        "scan_tok_per_sec": scan_med,
+        "speedup_vs_baseline": scan_med / base_med if base_med else 0.0,
+        "scan_flushes": int(flushes),
+        "scan_steps": int(steps),
+        "tokens": int(toks),
+        "baseline_decode_steps": int(base_disp),
+        "scan_decode_steps": int(disp),
+        "reconcile_ok": (flushes > 0 and steps == k * flushes
                          and toks == reps * wl["n"] * wl["max_new"]),
     }
 
@@ -1012,6 +1100,15 @@ def main() -> int:
                          "off then on at K drafts/slot/step (reports "
                          "tok/s both arms, accept rate, drafted/"
                          "accepted counters reconciled to tokens)")
+    # multi-step decode A/B (docs/serving.md "Multi-step decode"):
+    # decode_steps=1 vs ONE scanned dispatch of K decode bodies
+    ap.add_argument("--decode-steps", type=int, default=0, metavar="K",
+                    help="run the multi-step decode A/B: the same "
+                         "closed-loop workload at decode_steps=1 then "
+                         "with K scanned decode bodies per dispatch "
+                         "(reports tok/s both arms, scan flush/step "
+                         "counters reconciled to tokens; on CPU expect "
+                         "<=1x — PERF.md 'Reading the multi-step bench')")
     args = ap.parse_args()
 
     import numpy as np
@@ -1082,6 +1179,28 @@ def main() -> int:
                 "speedup_vs_baseline", "drafted", "accepted", "chains",
                 "spec_tokens", "tokens", "baseline_decode_steps",
                 "spec_decode_steps", "reconcile_ok", "sig_stable")},
+        }), flush=True)
+        return 0 if m["sig_stable"] and m["reconcile_ok"] else 1
+
+    if args.decode_steps > 1:
+        eng = build_engine(args)
+        hi = min(args.prompt_hi, args.max_context - args.max_new - 1)
+        wl = dict(n=args.num_requests, prompt_lo=args.prompt_lo,
+                  prompt_hi=hi, max_new=args.max_new, vocab=args.vocab)
+        m = measure_scan(eng, wl, args.reps, args.seed, args.decode_steps)
+        print(json.dumps({
+            "bench": "serving_scan",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prompt_lens": [args.prompt_lo, hi], "max_new": args.max_new,
+            "dim": args.dim, "layers": args.layers, "dtype": args.dtype,
+            "reps": args.reps,
+            "lm_serving_scan_tok_per_sec": round(m["scan_tok_per_sec"], 1),
+            **{k: m[k] for k in (
+                "decode_steps", "baseline_tok_per_sec",
+                "speedup_vs_baseline", "scan_flushes", "scan_steps",
+                "tokens", "baseline_decode_steps", "scan_decode_steps",
+                "reconcile_ok", "sig_stable")},
         }), flush=True)
         return 0 if m["sig_stable"] and m["reconcile_ok"] else 1
 

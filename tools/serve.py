@@ -116,6 +116,10 @@ def build_engine(args):
         print(f"speculative decoding: up to {args.spec_k} drafts/slot/"
               f"step (prompt-lookup drafter; emitted tokens unchanged)",
               file=sys.stderr)
+    if args.decode_steps > 1:
+        print(f"multi-step decode: {args.decode_steps} scanned decode "
+              f"bodies per dispatch when pure-decode (emitted tokens "
+              f"unchanged; tokens stream in bursts)", file=sys.stderr)
     return ServingEngine(tr.executor, tr.params, num_slots=args.slots,
                          page_size=args.page_size,
                          max_context=args.max_context,
@@ -123,6 +127,7 @@ def build_engine(args):
                          prefill_chunk=chunk,
                          max_step_tokens=args.max_step_tokens or None,
                          spec_k=args.spec_k,
+                         decode_steps=args.decode_steps,
                          mesh=mesh)
 
 
@@ -218,6 +223,13 @@ def main(argv=None) -> int:
                          "in one ragged dispatch (0 = off; emitted "
                          "tokens are identical either way — "
                          "docs/serving.md 'Speculative decoding')")
+    ap.add_argument("--decode-steps", type=int, default=1,
+                    help="multi-step decode: run K decode bodies per "
+                         "dispatch in ONE jitted lax.scan whenever every "
+                         "live slot is pure-decode (1 = off; emitted "
+                         "tokens are identical either way, streaming "
+                         "arrives in <=K bursts — docs/serving.md "
+                         "'Multi-step decode')")
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")

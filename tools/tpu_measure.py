@@ -244,6 +244,7 @@ _REHEARSE_ENV = {
     "BENCH_SERVE_SUFFIX_LO": "3", "BENCH_SERVE_SUFFIX_HI": "8",
     "BENCH_SERVE_FLEET": "2", "BENCH_SERVE_FLEET_CONC": "2",
     "BENCH_SERVE_SPEC_K": "3",
+    "BENCH_SERVE_DECODE_STEPS": "3",
 }
 
 
@@ -348,6 +349,13 @@ def main() -> int:
                              "--vocab", "64", "--dim", "32",
                              "--layers", "1", "--heads", "2",
                              "--dtype", "float32", "--reps", "1"]
+        serving_scan_args = ["--decode-steps", "3", "--num-requests", "6",
+                             "--slots", "2", "--page-size", "8",
+                             "--max-context", "48", "--prompt-lo", "6",
+                             "--prompt-hi", "16", "--max-new", "8",
+                             "--vocab", "64", "--dim", "32",
+                             "--layers", "1", "--heads", "2",
+                             "--dtype", "float32", "--reps", "1"]
         # the CPU rehearse has one host device by default — the sharded
         # arm needs a virtual 2-device mesh (harmless on real TPU steps,
         # which never see this env)
@@ -389,6 +397,10 @@ def main() -> int:
         # speculative-decoding A/B at TPU size: spec-off vs spec-on k=4
         # on the locally-repetitive workload (defaults)
         serving_spec_args = ["--spec-k", "4"]
+        # multi-step decode A/B at TPU size: decode_steps 1 vs 4 on the
+        # mixed-length workload (this is where the dispatch-amortization
+        # win actually shows — PERF.md "Reading the multi-step bench")
+        serving_scan_args = ["--decode-steps", "4"]
         tp_env = {}
         dist_env = {}
         rnn_args = []
@@ -458,6 +470,12 @@ def main() -> int:
         ("bench_serving_spec_record", [py, "bench.py"], 900,
          bench_env("serving_spec", 840),
          lambda: _metric_fresh(_METRIC_OF["serving_spec"], fh)),
+        # multi-step decode record (scan-arm tokens/s + baseline arm +
+        # the scan_steps == k * scan_flushes dispatch reconciliation):
+        # another two-arm A/B on one engine, same budget
+        ("bench_serving_scan_record", [py, "bench.py"], 900,
+         bench_env("serving_scan", 840),
+         lambda: _metric_fresh(_METRIC_OF["serving_scan"], fh)),
         # parameter-server training record (K-trainer aggregate samples/s
         # + the 1-trainer arm + scaling efficiency + the live-flip
         # trace-overhead probe): all subprocesses on the CPU backend, so
@@ -520,6 +538,11 @@ def main() -> int:
         ("bench_serving_spec",
          [py, "tools/bench_serving.py"] + serving_spec_args, 1200, {},
          lambda: _out_fresh("bench_serving_spec", fh)),
+        # multi-step decode sweep: the full-size k=1 vs k A/B with the
+        # flush/step counters and dispatch reconciliation banked
+        ("bench_serving_scan",
+         [py, "tools/bench_serving.py"] + serving_scan_args, 1200, {},
+         lambda: _out_fresh("bench_serving_scan", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
