@@ -854,6 +854,68 @@ def bench_serving_scan(dtype: str) -> dict:
     }
 
 
+def bench_serving_spill(dtype: str) -> dict:
+    """Host KV spill tier record (docs/serving.md "KV spill tier"): the
+    Zipf prefix-skew workload through ONE engine whose page pool is sized
+    BELOW the working set (BENCH_SERVE_SPILL_PAGES), spill tier off then
+    on — tools/bench_serving.py --spill-budget is the sweep tool, this is
+    the compact record for the driver's BENCH capture.  Headline = the
+    spill-on hit rate (the off arm destroys cold prefixes under pressure
+    and re-pays their prefill; the on arm restores them from host RAM);
+    companions are both arms' hit rates / tokens saved / first-token p50,
+    the spill/restore page counters, and the reconcile + signature-
+    stability verdicts.  Exactness of restored tokens is
+    tests/test_kv_spill.py's job."""
+    import argparse
+
+    from tools.bench_serving import build_engine, measure_spill
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SPILL_SLOTS", "4")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        num_pages=int(os.environ.get("BENCH_SERVE_SPILL_PAGES", "96")),
+        spill_budget=int(os.environ.get("BENCH_SERVE_SPILL_BUDGET",
+                                        str(64 << 20))),
+        dtype=dtype)
+    wl = dict(
+        n=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prefix_pool=int(os.environ.get("BENCH_SERVE_PREFIX_POOL", "8")),
+        prefix_len=int(os.environ.get("BENCH_SERVE_PREFIX_LEN", "128")),
+        prefix_skew=float(os.environ.get("BENCH_SERVE_PREFIX_SKEW", "1.0")),
+        suffix_lo=int(os.environ.get("BENCH_SERVE_SUFFIX_LO", "16")),
+        suffix_hi=int(os.environ.get("BENCH_SERVE_SUFFIX_HI", "64")),
+        max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "64")),
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")))
+    reps = int(os.environ.get("BENCH_SERVE_REPS", "3"))
+
+    eng = build_engine(args)
+    m = measure_spill(eng, wl, reps, seed=0, budget=args.spill_budget)
+    return {
+        "metric": "lm_serving_spill_hit_rate",
+        "value": round(m["hit_rate"], 4),
+        "unit": "hit fraction",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"budget={args.spill_budget} pages={args.num_pages} "
+                  f"pool={wl['prefix_pool']} prefix={wl['prefix_len']} "
+                  f"skew={wl['prefix_skew']} "
+                  f"suffix={wl['suffix_lo']}-{wl['suffix_hi']} "
+                  f"slots={args.slots} page={args.page_size} "
+                  f"reqs={wl['n']} max_new={wl['max_new']}",
+        "lm_serving_spill_tok_per_sec": round(m["tok_per_sec"], 1),
+        **{key: m[key] for key in (
+            "off_hit_rate", "hit_rate_improved", "off_tok_per_sec",
+            "first_tok_ms_p50", "off_first_tok_ms_p50", "tokens_saved",
+            "off_tokens_saved", "spilled_pages", "restored_pages",
+            "restore_hits", "restore_tokens_saved", "page_nbytes",
+            "reconcile_ok", "sig_stable")},
+    }
+
+
 def bench_train_dist(dtype: str) -> dict:
     """Parameter-server training record (paddle_tpu/pserver/,
     docs/distributed_training.md): K sync trainer PROCESSES
@@ -1053,6 +1115,7 @@ BENCHES = {
     "serving_tp": bench_serving_tp,
     "serving_spec": bench_serving_spec,
     "serving_scan": bench_serving_scan,
+    "serving_spill": bench_serving_spill,
     "train_dist": bench_train_dist,
     "mnist": bench_mnist,
     "sentiment": bench_sentiment,
@@ -1180,6 +1243,7 @@ _METRIC_OF = {
     "serving_tp": "lm_serving_tp_tok_per_sec",
     "serving_spec": "lm_serving_spec_tok_per_sec",
     "serving_scan": "lm_serving_scan_tok_per_sec",
+    "serving_spill": "lm_serving_spill_hit_rate",
     "train_dist": "train_dist_samples_per_sec",
     "mnist": "mnist_vgg_train_samples_per_sec_per_chip",
     "sentiment": "imdb_sentiment_lstm_train_samples_per_sec_per_chip",
@@ -1265,7 +1329,7 @@ def _assemble_lkg() -> dict | None:
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
                 "serving_fleet", "serving_tp", "serving_spec",
-                "serving_scan", "train_dist", "mnist",
+                "serving_scan", "serving_spill", "train_dist", "mnist",
                 "sentiment", "recommendation", "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None

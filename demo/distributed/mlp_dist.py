@@ -20,6 +20,10 @@ compute_dtype = get_config_arg("compute_dtype", str, "")  # noqa: F821
 # tests (and curious operators) can flip them on
 l2 = get_config_arg("l2", float, 0.0)                # noqa: F821
 avg_window = get_config_arg("avg_window", float, 0.0)  # noqa: F821
+# trainer-side pre-accumulation: sum N batches locally, ONE send_grad
+# per window (N× less gradient wire traffic, bit-exact vs N=1 with
+# grad_accum — docs/distributed_training.md)
+batches_per_send = get_config_arg("batches_per_send", int, 1)  # noqa: F821
 
 define_py_data_sources2(
     train_list="none", test_list=None,
@@ -33,6 +37,7 @@ settings(batch_size=batch_size, learning_rate=0.05,
          learning_rate_schedule="poly",
          learning_rate_decay_a=0.001, learning_rate_decay_b=0.5,
          average_window=avg_window, max_average_window=3,
+         num_batches_per_send_parameter=batches_per_send,
          compute_dtype=compute_dtype)
 
 x = data_layer(name="x", size=dim)            # noqa: F405

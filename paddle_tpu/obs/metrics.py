@@ -59,6 +59,13 @@ CATALOG: dict[str, str] = {
         "prefix pages evicted by page-pool pressure (LRU, before pausing)",
     "serving_prefix_cow_total":
         "copy-on-write page copies (divergence inside a shared boundary page)",
+    # -- host KV spill tier (docs/serving.md "KV spill tier") -------------
+    "serving_spill_pages_total":
+        "cold cached pages spilled to host RAM instead of destroyed",
+    "serving_restore_pages_total":
+        "spilled pages restored to device on a prefix hit",
+    "serving_spill_bytes":
+        "host-RAM bytes currently held by the spill tier",
     "serving_decode_steps_total": "compiled decode steps executed",
     # -- tensor-parallel sharded decode (docs/serving.md "Sharded decode")
     "serving_tp_shards":

@@ -60,13 +60,40 @@ class RemoteParameterUpdater:
                     f"parameter magnitudes, which the block-sharded "
                     f"parameter server does not reproduce — use the "
                     f"local ParameterUpdater for this config")
-        if int(opt.num_batches_per_send_parameter) > 1:
-            raise NotImplementedError(
-                "num_batches_per_send_parameter > 1 with the remote "
-                "updater: the sync window IS the trainer fleet (K "
-                "trainers reproduce grad_accum=K exactly) — local "
-                "pre-accumulation before the send is not implemented; "
-                "scale the fleet or use the local updater")
+        # num_batches_per_send_parameter = N > 1: buffer N batches'
+        # gradients HOST-SIDE as one sample-weighted fp32 sum and push it
+        # once per window with the send_grad pre_accum flag (ref:
+        # RemoteParameterUpdater.cpp sendParallel's batch cadence) — the
+        # wire then carries 1/N of the gradient frames.  The local ladder
+        # is the SAME jitted accumulate op as the server's (and the local
+        # updater's grad_accum branch), so one trainer at N reproduces
+        # the grad_accum=N oracle bit for bit.
+        self.accum = max(int(opt.num_batches_per_send_parameter), 1)
+        self._acc_add = None
+        if self.accum > 1:
+            from functools import partial
+
+            import jax
+            import jax.numpy as jnp
+
+            @partial(jax.jit, static_argnums=(2,))
+            def _acc_add(acc, g, bsz):
+                return acc + bsz * g.astype(acc.dtype)
+
+            def _acc_zeros(g):
+                dt = jnp.promote_types(g.dtype, jnp.float32) if \
+                    jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) \
+                    else jnp.asarray(g).dtype
+                return jnp.zeros(np.shape(g), dt)
+
+            self._acc_add = _acc_add
+            self._acc_zeros = _acc_zeros
+        self._buf_acc: Optional[dict] = None   # name -> fp32 device sum
+        self._buf_n = 0                        # batches buffered
+        self._buf_samples = 0
+        self._buf_t0 = 0.0                     # first batch's compute t0
+        self._buf_compute_s = 0.0              # summed compute durations
+        self.dropped_partial_batches = 0       # finish_pass drop-last
         self.addrs = list(addrs)
         self.rank = rank
         self.timeout = float(timeout)
@@ -86,7 +113,7 @@ class RemoteParameterUpdater:
     # -- interface parity with ParameterUpdater -----------------------------
     @property
     def accum_n(self) -> int:
-        return 1
+        return self.accum
 
     def apply_init_hooks(self, params: dict) -> dict:
         return params                  # hooks refused in __init__
@@ -109,7 +136,16 @@ class RemoteParameterUpdater:
     def finish_pass(self, state):
         """Pass boundary = a fleet-wide barrier; the server bumps its
         pass_id (LR pass schedules) exactly once.  The boundary frame
-        carries its own trace context like every window frame."""
+        carries its own trace context like every window frame.  A
+        partial pre-accumulation buffer (pass length not divisible by N)
+        is DROPPED here — the same drop-last convention as the local
+        updater's partial grad_accum window, counted loudly."""
+        if self._buf_n:
+            self.dropped_partial_batches += self._buf_n
+            self._buf_acc = None
+            self._buf_n = 0
+            self._buf_samples = 0
+            self._buf_compute_s = 0.0
         if self.client is not None:
             self.client.pass_barrier(
                 trace={"trace_id": new_trace_id(), "parent": new_span_id()})
@@ -145,6 +181,13 @@ class RemoteParameterUpdater:
                 f"fleet runs {server_mode!r} — the mode is a server "
                 f"(tools/pserver.py --mode) decision")
         self.mode = server_mode
+        if self.accum > 1 and not self.client.pre_accum_capable:
+            raise RuntimeError(
+                f"num_batches_per_send_parameter="
+                f"{self.accum} needs the pre_accum send_grad capability "
+                f"on every shard — a shard in this fleet predates it; "
+                f"upgrade the servers or run with "
+                f"num_batches_per_send_parameter=1")
         self.client.join(rank=self.rank)
         self.rank = self.client.rank
         return self.client.init_or_fetch(
@@ -172,6 +215,39 @@ class RemoteParameterUpdater:
         if tag is None:
             tag = f"r{self.rank}b{self._batch_seq}"
         self._batch_seq += 1
+        pre = False
+        if self.accum > 1:
+            # trainer-side pre-accumulation: fold this batch into the
+            # fp32 sample-weighted sum; only every Nth batch reaches the
+            # wire.  The buffered window's compute part is the SUM of
+            # the N grad-fetch walls, anchored at the first batch's t0 —
+            # the inter-batch gaps land in other_ms like any other
+            # untracked host time.
+            if self._buf_n == 0:
+                self._buf_t0 = compute[0] if compute \
+                    else time.perf_counter()
+                self._buf_compute_s = 0.0
+                self._buf_acc = {}
+            if compute:
+                self._buf_compute_s += compute[1]
+            for name, g in grads_host.items():
+                a = self._buf_acc.get(name)
+                if a is None:
+                    a = self._acc_zeros(g)
+                self._buf_acc[name] = self._acc_add(a, g, int(batch_size))
+            self._buf_n += 1
+            self._buf_samples += int(batch_size)
+            if self._buf_n < self.accum:
+                return None            # window still open: keep training
+            grads_host = {name: np.asarray(a)
+                          for name, a in self._buf_acc.items()}
+            batch_size = self._buf_samples
+            compute = (self._buf_t0, self._buf_compute_s)
+            self._buf_acc = None
+            self._buf_n = 0
+            self._buf_samples = 0
+            self._buf_compute_s = 0.0
+            pre = True
         t_start = compute[0] if compute else time.perf_counter()
         compute_ms = (compute[1] * 1e3) if compute else 0.0
         span_id = new_span_id()
@@ -181,7 +257,7 @@ class RemoteParameterUpdater:
             tr.add("grad_compute", compute[0], compute[1], track="remote",
                    attrs=dict(tctx))
         out = self.client.push_grads(grads_host, batch_size, tag=tag,
-                                     trace=tctx)
+                                     trace=tctx, pre_accum=pre)
         async_pull_ms = 0.0
         if self.mode != "sync":
             self._async_since_pull += 1

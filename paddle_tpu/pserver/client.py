@@ -85,6 +85,13 @@ class ParameterClient:
         # advertises the capability (an old shard keeps getting JSON)
         self._bin = all("bin_blocks" in (h.get("capabilities") or ())
                         for h in self.hellos)
+        # trainer-side pre-accumulation (num_batches_per_send > 1): only
+        # usable when EVERY shard knows the send_grad pre_accum flag —
+        # an old shard would sample-weight the summed blocks a second
+        # time and silently break the grad_accum equivalence
+        self.pre_accum_capable = all(
+            "pre_accum" in (h.get("capabilities") or ())
+            for h in self.hellos)
         # dedicated control connection to the coordinator: membership +
         # heartbeats, so a beat never interleaves with a blocked barrier
         self._ctl, _ = connect_with_backoff(
@@ -112,6 +119,10 @@ class ParameterClient:
         self.last_pull_timings: dict = {}   # shard -> relay-apply timing
         self.last_pull_ms = 0.0
         self.stale_rejects = 0         # async: grads refused as stale
+        # wire accounting: every send_grad frame's full on-wire size
+        # (length prefix + header + payload) summed here — the counter
+        # the pre-accumulation N-fold reduction is proved against
+        self.grad_bytes_sent = 0
 
     # -- plumbing ------------------------------------------------------------
     def __enter__(self):
@@ -131,10 +142,11 @@ class ParameterClient:
     def _rpc(self, shard: int, msg: dict, reply_types: tuple,
              payload: Optional[bytes] = None) -> dict:
         sock = self.socks[shard]
-        if payload is None:
-            wire.write_frame_sync(sock, msg)
-        else:
-            wire.write_frame_bin_sync(sock, msg, payload)
+        frame = (wire.encode(msg) if payload is None
+                 else wire.encode_bin(msg, payload))
+        if msg.get("type") == "send_grad":
+            self.grad_bytes_sent += len(frame)
+        sock.sendall(frame)
         while True:
             reply = wire.read_frame_sync(sock)
             if reply is None:
@@ -298,13 +310,21 @@ class ParameterClient:
     # -- the batch flow ------------------------------------------------------
     def push_grads(self, grads: dict[str, np.ndarray], samples: int,
                    tag: Optional[str] = None,
-                   trace: Optional[dict] = None):
+                   trace: Optional[dict] = None,
+                   pre_accum: bool = False):
         """Sync: contribute one batch's gradients, barrier, return the
         post-window full parameters.  Async: contribute against the last
         pulled version; returns None (pair with pull() on the trainer's
         num_batches_per_get_parameter cadence) — a stale rejection also
         returns None after recording the fleet's version so the next
         pull re-bases.
+
+        `pre_accum=True` marks the blocks as a trainer-side sample-
+        weighted fp32 SUM over several batches (`samples` = the summed
+        batch sizes): the server adds them to the window accumulator
+        with weight 1 instead of re-weighting by `samples`.  Requires
+        every shard to advertise the `pre_accum` capability
+        (`pre_accum_capable`).
 
         `trace` ({"trace_id", "parent"}) stamps the window's wire trace
         context on every frame; `last_timing` afterwards holds the
@@ -334,6 +354,13 @@ class ParameterClient:
                 msg["tag"] = tag
             if trace:
                 msg["trace"] = trace
+            if pre_accum:
+                if not self.pre_accum_capable:
+                    raise PServerError(
+                        "pre_accum push but a shard lacks the pre_accum "
+                        "capability — upgrade the fleet or run "
+                        "num_batches_per_send_parameter=1")
+                msg["pre_accum"] = True
             if self.mode == "async":
                 msg["base_version"] = self.version
             t_s0 = time.perf_counter()

@@ -195,9 +195,14 @@ class UpdateEngine:
     def commit(self, entries: list[tuple], window=None,
                trace=None) -> dict:
         """Apply one window: `entries` = [(rank, tid, samples,
-        {bid: flat grad})] ALREADY in rank order.  Accumulates sample-
-        weighted in fp32 then applies the optimizer once on the mean —
-        identical math to the local updater's grad_accum window.
+        {bid: flat grad}, pre_accum)] ALREADY in rank order.  Accumulates
+        sample-weighted in fp32 then applies the optimizer once on the
+        mean — identical math to the local updater's grad_accum window.
+        A `pre_accum` entry's blocks are ALREADY a trainer-side sample-
+        weighted fp32 sum over `samples` batches' worth of gradients
+        (the client ran the same `_acc_add` ladder locally), so they
+        join the accumulator with weight 1 — the mean's denominator
+        still counts every underlying sample.
 
         `window`/`trace` (the committed window id and its contributors'
         trace_ids) only label the accumulate/apply spans and the timing
@@ -211,12 +216,13 @@ class UpdateEngine:
         acc = {bid: self._acc_zeros(self.params[bid])
                for bid in self._updatable}
         total = 0
-        for _rank, _tid, samples, blocks in entries:
+        for _rank, _tid, samples, blocks, pre in entries:
             bsz = int(samples)
             total += bsz
             for bid, g in blocks.items():
                 if bid in acc:
-                    acc[bid] = self._acc_add(acc[bid], jnp.asarray(g), bsz)
+                    acc[bid] = self._acc_add(acc[bid], jnp.asarray(g),
+                                             1 if pre else bsz)
         self._jax.block_until_ready(acc)
         t1 = time.perf_counter()
         new_params, new_state = self._apply_window(
@@ -250,7 +256,8 @@ class UpdateEngine:
                     blocks: dict[str, np.ndarray],
                     trace=None) -> dict:
         """One async contribution = its own window of one."""
-        return self.commit([(0, tid, int(samples), blocks)], trace=trace)
+        return self.commit([(0, tid, int(samples), blocks, False)],
+                           trace=trace)
 
     def finish_pass(self, trace_ids=None) -> int:
         """`trace_ids` = the pass-boundary frames' contributor contexts
@@ -856,7 +863,8 @@ class ParameterServer:
             if c is None:
                 continue               # barrier'd without grads: no-op rank
             m = self.membership.get(tid)
-            entries.append((m.rank, tid, c["samples"], c["blocks"]))
+            entries.append((m.rank, tid, c["samples"], c["blocks"],
+                            c.get("pre", False)))
             members.append([tid, m.rank, c["samples"], c.get("tag")])
             if c.get("trace"):
                 traces.append(c["trace"]["trace_id"])
@@ -962,7 +970,8 @@ class ParameterServer:
         have = self._shard_contrib.get(w, {})
         if any(tid not in have for tid, *_rest in members):
             return                     # a member's send_grad is in flight
-        entries = [(rank, tid, have[tid]["samples"], have[tid]["blocks"])
+        entries = [(rank, tid, have[tid]["samples"], have[tid]["blocks"],
+                    have[tid].get("pre", False))
                    for tid, rank, _samples, *_tag in members]
         traces = [have[tid]["trace"]["trace_id"]
                   for tid, *_rest in members if have[tid].get("trace")]
@@ -1069,7 +1078,7 @@ class ParameterServer:
                     "hello", "ping", "ps_init", "ps_join", "ps_beat",
                     "ps_drain", "ps_leave", "send_grad", "barrier",
                     "get_params", "stats", "metrics", "dump", "ps_log",
-                    "trace", "bin_blocks"])))
+                    "trace", "bin_blocks", "pre_accum"])))
         elif t == "ps_init":
             self._handle_init(conn, msg)
         elif t == "ps_join":
@@ -1265,10 +1274,12 @@ class ParameterServer:
                 return
             m.grads_sent += 1
             self._contrib[tid] = {"samples": samples, "blocks": blocks,
-                                  "tag": msg.get("tag"), "trace": trace}
+                                  "tag": msg.get("tag"), "trace": trace,
+                                  "pre": bool(msg.get("pre_accum"))}
         else:
             self._shard_contrib.setdefault(w, {})[tid] = {
-                "samples": samples, "blocks": blocks, "trace": trace}
+                "samples": samples, "blocks": blocks, "trace": trace,
+                "pre": bool(msg.get("pre_accum"))}
             self._maybe_apply_shard(w)
         conn.send({"type": "grad_ack", "tid": tid, "window": w})
 

@@ -231,6 +231,7 @@ class ServingEngine:
                  input_name: Optional[str] = None,
                  logits_name: Optional[str] = None,
                  prefix_cache: bool = True,
+                 spill_bytes_budget: int = 0,
                  prefill_chunk: Optional[int] = -1,
                  max_step_tokens: Optional[int] = None,
                  spec_k: int = 0, drafter=None,
@@ -271,7 +272,8 @@ class ServingEngine:
         pages_per_slot = -(-int(max_context) // int(page_size))
         self.kv = PagedKVCache(executor, num_slots, page_size,
                                pages_per_slot, num_pages,
-                               mesh=self.mesh if self.tp > 1 else None)
+                               mesh=self.mesh if self.tp > 1 else None,
+                               spill_bytes_budget=spill_bytes_budget)
         # the ONE canonical pool sharding, derived by the cache that owns
         # the pools — every jit that hands pools back pins to it
         self._pool_sharding = self.kv.pool_sharding
@@ -289,6 +291,14 @@ class ServingEngine:
         self.n_prefix_hits = 0
         self.n_prefix_misses = 0
         self.prefill_tokens_saved = 0
+        # KV spill tier admission accounting (the page-level counters —
+        # n_spilled/n_restored/host_bytes — live on the kv allocator):
+        # hits whose prefix needed a host->device restore, and the
+        # prefill tokens among `C` served from restored pages — the
+        # number kv.n_restored * page_size must bound (the bench's
+        # restored-vs-saved reconciliation)
+        self.n_restore_hits = 0
+        self.restore_tokens_saved = 0
         self.queue: deque[Request] = deque()
         self.slots: list[Optional[_Slot]] = [None] * num_slots
         # finished-but-uncollected outputs: run() POPS what completed on
@@ -1518,14 +1528,28 @@ class ServingEngine:
         rolls back and admission retries COLD: the just-unmapped prefix
         pages drop to refcount zero, so the cold attempt's page-pressure
         eviction can reclaim them — holding them mapped would starve the
-        very admission they were meant to speed up (livelock)."""
+        very admission they were meant to speed up (livelock).
+
+        KV SPILL TIER: when the matched path ends in spilled (HOST) runs,
+        _restore_spilled faults them back to device FIRST — fresh pages,
+        one batched host->device scatter, promote — and the hit then maps
+        exactly like an always-device one.  Every restore failure mode
+        (budget-starved allocation, a stale host generation, the matched
+        device path lost to the restore's own pressure eviction) rolls
+        back completely and falls through to cold admission, which the
+        exactness oracles prove produces identical tokens."""
         p = req.prompt_ids.size
         if self.prefix is not None:
-            full, partial = self.prefix.match(req.prompt_ids[:p - 1])
-            if full or partial is not None:
-                mapped = full + ([partial[0]] if partial is not None else [])
+            nodes, partial = self.prefix.match_nodes(req.prompt_ids[:p - 1])
+            path = list(nodes) + ([partial[0]] if partial is not None
+                                  else [])
+            host_tail = [nd for nd in path if nd.host_id is not None]
+            if host_tail and not self._restore_spilled(req, path, host_tail):
+                path, partial = [], None        # rolled back: admit cold
+            if path:
+                mapped = [nd.page for nd in path]
                 self.kv.map_shared(s, mapped)
-                C = len(full) * self.kv.page_size + \
+                C = len(nodes) * self.kv.page_size + \
                     (partial[1] if partial is not None else 0)
                 ok = self.kv.try_grow(s, p)
                 if ok and partial is not None:
@@ -1534,15 +1558,68 @@ class ServingEngine:
                     if cow:
                         self.flight.record("prefix_cow",
                                            req=str(req.req_id),
-                                           page=int(partial[0]),
+                                           page=int(mapped[-1]),
                                            matched_in_page=int(partial[1]))
                 if ok:
+                    if host_tail:
+                        self.n_restore_hits += 1
+                        # tokens of C served from restored pages: the
+                        # device-resident full runs cover the first
+                        # dev_full * page_size of the match, the rest
+                        # (full HOST runs + a HOST boundary's partial
+                        # tokens) came back from the host tier
+                        dev_full = sum(1 for nd in nodes
+                                       if nd not in host_tail)
+                        self.restore_tokens_saved += \
+                            C - dev_full * self.kv.page_size
                     return (C, len(mapped))
                 self.kv.release(s)
         if self.kv.try_grow(s, p):
             return (0, 0)
         self.kv.release(s)
         return None
+
+    def _restore_spilled(self, req: Request, path, host_tail) -> bool:
+        """Fault a matched path's spilled tail back to device: take fresh
+        pages (spill inhibited, so the host tier — and these very entries
+        — can't churn under the allocation's pressure evictions), one
+        batched scatter, re-mark cached, promote the nodes.  False = full
+        rollback happened and the caller admits cold.  Page counts here
+        ride a bucketed jit at the admission boundary — the decode/mixed/
+        spec/scan step signatures never move (the compile-watch oracle)."""
+        kv, tree = self.kv, self.prefix
+        if not all(kv.host_entry_live(nd.host_id) for nd in host_tail):
+            # a dead generation (kv.reset without tree.clear — the
+            # checkpoint/restore seam) must never resurrect: drop the
+            # zombie subtree from its topmost host node and admit cold
+            tree.drop_host_subtree(host_tail[0])
+            return False
+        dev_nodes = [nd for nd in path if nd.host_id is None]
+        tree._spill_inhibit = True
+        try:
+            pages = kv.take_pages(len(host_tail))
+        finally:
+            tree._spill_inhibit = False
+        if pages is None:
+            return False
+        # the allocation's own eviction ran over the tree: verify the
+        # matched DEVICE prefix survived (LRU makes just-touched nodes
+        # the last victims, so this only trips when the pool is so small
+        # the reservation is infeasible anyway) and the host entries too
+        # (destroying a device ancestor drops its host subtree)
+        if any(nd.page <= 0 or nd.host_id is not None
+               for nd in dev_nodes) or \
+                not all(kv.host_entry_live(nd.host_id)
+                        for nd in host_tail):
+            kv.untake_pages(pages)
+            return False
+        kv.restore_pages([nd.host_id for nd in host_tail], pages)
+        kv.adopt_restored(pages)
+        tree.promote(host_tail, pages)
+        self.flight.record("restore", req=str(req.req_id),
+                           pages=len(pages),
+                           host_pages=kv.host_page_count)
+        return True
 
     def _admit(self, s: int, req: Request, C: int = 0, n_pp: int = 0) -> None:
         """Prefill the prompt (or, on a prefix hit, ONLY its uncached
@@ -1878,9 +1955,32 @@ class ServingEngine:
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            self.kv.uncache_page(node.page)
+            if node.host_id is not None:
+                # spilled nodes drain the HOST tier, not the device
+                # allocator — leaving the entry would orphan host bytes
+                # against the budget forever (no node names them again)
+                self.kv.drop_host_page(node.host_id, reason="drain")
+                node.host_id = None
+            else:
+                self.kv.uncache_page(node.page)
         self.prefix = None
         self.kv.on_page_pressure = None
+
+    def set_spill_budget(self, spill_bytes_budget: int) -> None:
+        """A/B knob (bench_serving --spill-budget measures the same
+        engine spill-off, then on): sets the host tier's byte budget.
+        Shrinking below current residency drops LRU HOST leaves until
+        the tier fits (0 drains it entirely) — never device state, so
+        an idle-engine flip is allocator-exact either way."""
+        assert all(sl is None for sl in self.slots) and not self.queue, \
+            "set_spill_budget requires an idle engine"
+        self.kv.spill_bytes_budget = int(spill_bytes_budget or 0)
+        while self.prefix is not None and \
+                self.kv.host_bytes > self.kv.spill_bytes_budget:
+            leaves = self.prefix._host_leaves()
+            assert leaves, "host tier non-empty but no HOST leaf found"
+            self.prefix._drop_host_node(
+                min(leaves, key=lambda n: n.last_use))
 
     # -- serving-state checkpoint/restore (fleet-migration primitive) ------
     def checkpoint_state(self) -> dict:
@@ -1921,6 +2021,7 @@ class ServingEngine:
                 node, pidx = stack.pop()
                 idx = len(nodes)
                 nodes.append({"run": list(node.run), "page": node.page,
+                              "host_id": node.host_id,
                               "last_use": node.last_use, "parent": pidx})
                 stack.extend((ch, idx) for ch in node.children.values())
             prefix = {"nodes": nodes, "clock": self.prefix._clock,
@@ -1934,12 +2035,27 @@ class ServingEngine:
                        "max_step_tokens": self.max_step_tokens,
                        "spec_k": self.spec_k,
                        "prefix_cache": self.prefix is not None,
+                       "spill_bytes_budget": kv.spill_bytes_budget,
                        "layer_specs": dict(kv.layer_specs)},
             "pools": {name: {p: np.asarray(kv.pools[name][p]).copy()
                              for p in ("k", "v")} for name in kv.pools},
             "kv": {"table": kv.table.copy(), "free": list(kv._free),
                    "n_pages": kv._n_pages.copy(), "ref": kv._ref.copy(),
-                   "cached": kv._cached.copy(), "n_cow": kv.n_cow},
+                   "cached": kv._cached.copy(), "n_cow": kv.n_cow,
+                   # host spill tier SERIALIZES INTO the bundle (the
+                   # documented choice over re-faulting: a migrated
+                   # replica keeps its whole effective cache, and the
+                   # spilled runs' restore-on-hit stays bit-exact on the
+                   # target) — generations re-stamp on restore
+                   "host": {hid: {"nbytes": e["nbytes"],
+                                  "data": {name: (k.copy(), v.copy())
+                                           for name, (k, v)
+                                           in e["data"].items()}}
+                            for hid, e in kv._host.items()},
+                   "next_hid": kv._next_hid,
+                   "spill_counters": (kv.n_spilled, kv.n_restored,
+                                      kv.n_host_evicted,
+                                      kv._host_drained)},
             "slots": [None if sl is None else
                       {"req": req_snap(sl.req),
                        "keys": np.asarray(sl.keys).copy(),
@@ -1955,7 +2071,8 @@ class ServingEngine:
                 "_admit_seq", "n_decode_steps", "n_preemptions",
                 "n_cancelled", "n_expired", "tokens_generated",
                 "occupancy_sum", "n_prefix_hits", "n_prefix_misses",
-                "prefill_tokens_saved", "n_prefill_chunks",
+                "prefill_tokens_saved", "n_restore_hits",
+                "restore_tokens_saved", "n_prefill_chunks",
                 "n_mixed_steps", "n_spec_steps", "n_spec_chains",
                 "n_spec_drafted", "n_spec_accepted", "n_spec_tokens",
                 "n_scan_steps", "n_scan_flushes")},
@@ -1979,6 +2096,7 @@ class ServingEngine:
                 "max_step_tokens": self.max_step_tokens,
                 "spec_k": self.spec_k,
                 "prefix_cache": self.prefix is not None,
+                "spill_bytes_budget": self.kv.spill_bytes_budget,
                 "layer_specs": dict(self.kv.layer_specs)}
         if mine != cfg:
             diff = {k: (cfg[k], mine[k]) for k in cfg if cfg[k] != mine[k]}
@@ -2014,6 +2132,28 @@ class ServingEngine:
         kv._ref[:] = snap["kv"]["ref"]
         kv._cached[:] = snap["kv"]["cached"]
         kv.n_cow = snap["kv"]["n_cow"]
+        # host spill tier: adopt the bundle's entries under THIS engine's
+        # current generation (the donor's gen counter is process-local;
+        # every serialized entry was live by construction — its tree node
+        # rebuilds below and names it).  Drain any pre-restore tree FIRST
+        # — its nodes' hids would otherwise collide with the bundle's hid
+        # space when the post-rebuild clear() walks them
+        if self.prefix is not None:
+            self.prefix.clear()
+        kv._host_drained += len(kv._host)
+        kv._host = {int(hid): {"gen": kv._host_gen,
+                               "nbytes": int(e["nbytes"]),
+                               "data": {name: (np.asarray(k),
+                                               np.asarray(v))
+                                        for name, (k, v)
+                                        in e["data"].items()}}
+                    for hid, e in snap["kv"].get("host", {}).items()}
+        kv._host_bytes = sum(e["nbytes"] for e in kv._host.values())
+        kv._next_hid = int(snap["kv"].get("next_hid", kv._next_hid))
+        (kv.n_spilled, kv.n_restored, kv.n_host_evicted,
+         kv._host_drained) = snap["kv"].get(
+            "spill_counters", (kv.n_spilled, kv.n_restored,
+                               kv.n_host_evicted, kv._host_drained))
         kv.version += 1
         self.slots = [None if d is None else
                       _Slot.__new__(_Slot) for d in snap["slots"]]
@@ -2037,6 +2177,7 @@ class ServingEngine:
                     node = _Node(tuple(nd["run"]), nd["page"],
                                  None if nd["parent"] < 0
                                  else built[nd["parent"]])
+                    node.host_id = nd.get("host_id")
                     node.last_use = nd["last_use"]
                     if node.parent is not None:
                         node.parent.add_child(node)

@@ -35,6 +35,24 @@ prefix index, serving/prefix_tree.py, between requests).  The contract:
     cached refcount-zero pages — eviction before pausing slots, preemption
     stays last resort.
 
+HOST SPILL TIER (docs/serving.md "KV spill tier"): with a non-zero
+`spill_bytes_budget`, a cold refcount-zero cached page that the prefix
+index would otherwise destroy under page pressure is instead COPIED to a
+host-RAM buffer (one `[page_size, h_kv, dh]` ndarray per layer per page)
+and the device page freed — the effective prefix cache grows past HBM.
+The tier is bounded by the byte budget with LRU eviction INSIDE it (the
+prefix index drops its least-recently-used host-resident leaves to make
+room), and an admission that prefix-hits a spilled run restores the
+pages: `take_pages` allocates fresh device pages, `restore_pages`
+scatters the host copies back in ONE batched dispatch (page-count
+bucketed to powers of two, pad rows writing zeros to trash page 0, so
+signatures stay bounded), and `adopt_restored` re-marks them cached
+before the slot maps them read-only.  Restores are MOVES — the host copy
+is dropped, a later re-spill re-copies.  All of it is admission-boundary
+host/allocator work: the decode/mixed/spec/scan step signatures never
+see the tier.  `_host_gen` stamps every entry and bumps on reset(), so a
+stale spilled page can never restore tokens from a dead tree generation.
+
 TENSOR PARALLELISM (PR 11): constructed with a mesh whose `model` axis
 exceeds 1, the pools shard on their kv-head axis (`PartitionSpec(None,
 None, "model", None)`) — each device's HBM holds only its heads' slice of
@@ -66,7 +84,7 @@ class PagedKVCache:
 
     def __init__(self, executor, num_slots: int, page_size: int,
                  pages_per_slot: int, num_pages: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, spill_bytes_budget: int = 0):
         assert page_size > 0 and pages_per_slot > 0
         self.page_size = int(page_size)
         self.pages_per_slot = int(pages_per_slot)
@@ -133,6 +151,21 @@ class PagedKVCache:
         self.on_page_pressure: Optional[Callable[[int], int]] = None
         self.n_cow = 0                 # copy-on-write page copies performed
         self._copy_fn = None           # lazily-jitted device page copy
+        # -- host spill tier (module docstring "HOST SPILL TIER") ----------
+        # hid -> {"gen", "nbytes", "data": {layer: (k_np, v_np)}}; the
+        # prefix index owns the POLICY (who spills, who drops) — this is
+        # the mechanism + the byte accounting
+        self.spill_bytes_budget = int(spill_bytes_budget or 0)
+        self._host: dict[int, dict] = {}
+        self._next_hid = 1
+        self._host_bytes = 0
+        self._host_gen = 0             # bumped on reset(): the stale-spill
+                                       # generation guard
+        self.n_spilled = 0             # pages spilled device -> host (ever)
+        self.n_restored = 0            # pages restored host -> device (ever)
+        self.n_host_evicted = 0        # host-tier LRU drops (budget pressure)
+        self._host_drained = 0         # non-evict, non-restore drops
+        self._restore_fns: dict[int, object] = {}   # bucketed jitted scatter
 
     def _canonical_free(self) -> list:
         """The free list in its construction-time canonical order (pop()
@@ -350,6 +383,14 @@ class PagedKVCache:
         self._ref[:] = 0
         self._cached[:] = False
         self._free = self._canonical_free()
+        # drain the host tier and bump the generation: a spilled page
+        # surviving a cache reset would restore K/V from a dead tree
+        # generation — any hid a caller still holds now fails
+        # host_entry_live and the admission falls back to cold prefill
+        self._host_drained += len(self._host)
+        self._host.clear()
+        self._host_bytes = 0
+        self._host_gen += 1
         self.version += 1
 
     # -- prefix-index retention -------------------------------------------
@@ -370,6 +411,171 @@ class PagedKVCache:
         self._cached[page] = False
         if self._ref[page] == 0:
             self._free.append(page)
+
+    # -- host spill tier ---------------------------------------------------
+    @property
+    def page_nbytes(self) -> int:
+        """Host bytes one spilled page costs: k + v across every layer."""
+        itemsize = next(iter(self.pools.values()))["k"].dtype.itemsize
+        return sum(2 * self.page_size * h_kv * dh * itemsize
+                   for (h_kv, dh) in self.layer_specs.values())
+
+    @property
+    def host_page_count(self) -> int:
+        return len(self._host)
+
+    @property
+    def host_bytes(self) -> int:
+        return self._host_bytes
+
+    def host_entry_live(self, hid) -> bool:
+        """The generation guard: an entry from before the last reset()
+        (or one already dropped) must never restore."""
+        e = self._host.get(int(hid))
+        return e is not None and e["gen"] == self._host_gen
+
+    def spill_page(self, page: int) -> Optional[int]:
+        """Copy a cold cached page's K/V to the host tier and free the
+        device page — the evict-to-host half of two-level eviction.
+        Returns the host id the caller (the prefix index) stores on its
+        node, or None when the budget cannot hold one page (the caller
+        destroys instead).  The caller makes budget room FIRST by
+        dropping its own host-LRU victims via drop_host_page.  The
+        device->host copy forces a device sync, which is fine here: page
+        pressure fires at admission boundaries, never inside a step."""
+        page = int(page)
+        assert self._ref[page] == 0 and self._cached[page], \
+            f"page {page} is not a cold cached page — only refcount-zero " \
+            f"prefix-index pages spill"
+        nbytes = self.page_nbytes
+        if self._host_bytes + nbytes > self.spill_bytes_budget:
+            return None
+        data = {name: (np.asarray(self.pools[name]["k"][page]),
+                       np.asarray(self.pools[name]["v"][page]))
+                for name in self.pools}
+        hid = self._next_hid
+        self._next_hid += 1
+        self._host[hid] = {"gen": self._host_gen, "nbytes": nbytes,
+                           "data": data}
+        self._host_bytes += nbytes
+        self.n_spilled += 1
+        self._cached[page] = False          # uncache_page for ref==0, but
+        self._free.append(page)             # the contents live on as `hid`
+        self.version += 1
+        return hid
+
+    def drop_host_page(self, hid, reason: str = "evict") -> None:
+        """Forget one host entry.  `reason` keeps the conservation ledger
+        exact: "evict" = host-tier LRU budget pressure (n_host_evicted),
+        "drain" = cache clear / re-donation / stale-gen cleanup
+        (_host_drained), "restore" = the move to device (restore_pages
+        counts it as n_restored).  Tolerates an already-drained entry —
+        reset() empties the tier wholesale and the tree's clear() walk
+        follows it."""
+        e = self._host.pop(int(hid), None)
+        if e is None:
+            return
+        self._host_bytes -= e["nbytes"]
+        if reason == "evict":
+            self.n_host_evicted += 1
+        elif reason == "drain":
+            self._host_drained += 1
+
+    def take_pages(self, n: int) -> Optional[list]:
+        """Pop `n` free pages for a host-tier restore WITHOUT binding
+        them to a slot table (the engine scatters the host copies in,
+        then adopt_restored + the tree's promote re-establish prefix
+        retention).  One pressure call for the whole shortfall, like
+        try_grow.  Returns None — nothing taken — when the pool cannot
+        cover it; untake_pages rolls back a taken batch exactly."""
+        n = int(n)
+        shortfall = n - len(self._free)
+        if shortfall > 0 and self.on_page_pressure is not None:
+            if shortfall > self.cached_page_count:
+                return None
+            self.on_page_pressure(shortfall)
+        if len(self._free) < n:
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            assert self._ref[p] == 0 and not self._cached[p], \
+                f"free list held a referenced page {p}"
+        self.version += 1
+        return pages
+
+    def untake_pages(self, pages) -> None:
+        """Return a take_pages batch to the free list in the exact order
+        it came off — page placement stays reproducible on rollback."""
+        for p in reversed(pages):
+            self._free.append(int(p))
+        self.version += 1
+
+    def adopt_restored(self, pages) -> None:
+        """Mark freshly-restored pages as prefix-index retained.  Unlike
+        cache_page (donation: the donor slot still maps the page) a
+        restored page has no mapping yet — the restoring slot's
+        map_shared follows immediately."""
+        for p in pages:
+            p = int(p)
+            assert 0 < p < self.num_pages and self._ref[p] == 0 and \
+                not self._cached[p], f"page {p} is not a fresh taken page"
+            self._cached[p] = True
+
+    def restore_pages(self, hids, pages) -> None:
+        """Batched host->device restore: scatter each host entry's K/V
+        into its taken device page in ONE jitted dispatch per
+        reservation.  Page count buckets to the next power of two (pad
+        rows write zeros to trash page 0) so compiled signatures are
+        bounded by log2(num_pages), never by restore-batch diversity.
+        MOVE semantics: the host copies drop here — a later re-spill
+        re-copies."""
+        n = len(hids)
+        assert n == len(pages) and n > 0
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        idx = np.zeros(bucket, np.int32)            # pad -> trash page 0
+        idx[:n] = pages
+        ks: dict = {}
+        vs: dict = {}
+        for name in self.pools:
+            h_kv, dh = self.layer_specs[name]
+            dtype = np.dtype(self.pools[name]["k"].dtype)
+            k = np.zeros((bucket, self.page_size, h_kv, dh), dtype)
+            v = np.zeros_like(k)
+            for i, hid in enumerate(hids):
+                e = self._host[int(hid)]
+                k[i], v[i] = e["data"][name]
+            ks[name], vs[name] = k, v
+        self.pools = self._restore_fn(bucket)(
+            self.pools, jnp.asarray(idx), ks, vs)
+        for hid in hids:
+            self.drop_host_page(hid, reason="restore")
+        self.n_restored += n
+
+    def _restore_fn(self, bucket: int):
+        if bucket not in self._restore_fns:
+            def scatter(pools, pages, ks, vs):
+                # duplicate pad indices all write zeros to the trash
+                # page, so the scatter's write order is immaterial
+                return {name: {
+                    "k": pools[name]["k"].at[pages].set(ks[name]),
+                    "v": pools[name]["v"].at[pages].set(vs[name]),
+                } for name in pools}
+
+            from paddle_tpu.obs.compile_watch import get_compile_watch
+            kw = {}
+            if self.pool_sharding is not None:
+                # same canonical-pool-sharding pin as the COW copy — a
+                # drifted layout would reshard every pool next step
+                kw["out_shardings"] = {
+                    name: {"k": self.pool_sharding,
+                           "v": self.pool_sharding}
+                    for name in self.pools}
+            self._restore_fns[bucket] = get_compile_watch().wrap_jit(
+                "serving.spill_restore",
+                jax.jit(scatter, donate_argnums=(0,), **kw))
+        return self._restore_fns[bucket]
 
     # -- device page copy (COW) -------------------------------------------
     def _page_copy(self):
@@ -417,12 +623,29 @@ class PagedKVCache:
             f"free list {sorted(free)} != unreferenced pages {sorted(expect)}"
         assert not self._cached[0] and self._ref[0] == 0, \
             "trash page 0 must never be referenced or cached"
+        # host-tier accounting: bytes agree with the entries, every entry
+        # belongs to the CURRENT generation (reset drains wholesale, so a
+        # stale-gen entry means a drain was skipped), and the tier honors
+        # its budget (empty when spilling is off)
+        assert self._host_bytes == sum(
+            e["nbytes"] for e in self._host.values()), \
+            f"host-tier bytes {self._host_bytes} disagree with entries"
+        assert all(e["gen"] == self._host_gen
+                   for e in self._host.values()), \
+            "host tier holds entries from a dead generation"
+        assert self._host_bytes <= self.spill_bytes_budget, \
+            f"host tier {self._host_bytes}B exceeds the " \
+            f"{self.spill_bytes_budget}B spill budget"
 
     def check_reclaimed(self) -> None:
         """check() plus the end-of-workload invariant: no slot holds
         pages (private or shared), and everything off the free list is
         retained ONLY by the prefix index — evictable on demand, so the
-        pool is fully reclaimable even though retired pages stay cached."""
+        pool is fully reclaimable even though retired pages stay cached.
+        Two-tier conservation: device free + device cached account for
+        the whole pool (spilled pages freed their device page the moment
+        their contents moved to host), and the spill/restore/evict
+        counters reconcile against the host pages still resident."""
         self.check()
         assert self.private_pages_in_use == 0, \
             f"{self.private_pages_in_use} private pages still slot-mapped"
@@ -432,3 +655,9 @@ class PagedKVCache:
             self.num_pages - 1, \
             f"free {self.free_page_count} + cached " \
             f"{self.cached_page_count} != pool {self.num_pages - 1}"
+        assert self.host_page_count == \
+            self.n_spilled - self.n_restored - self.n_host_evicted - \
+            self._host_drained, \
+            f"host tier {self.host_page_count} pages != spilled " \
+            f"{self.n_spilled} - restored {self.n_restored} - evicted " \
+            f"{self.n_host_evicted} - drained {self._host_drained}"

@@ -29,6 +29,22 @@ children), and refcount-zero-first means eviction never steals a page out
 from under a live slot.  Eviction runs BEFORE the engine pauses slots;
 preemption stays last resort.
 
+TWO-LEVEL EVICTION (the KV spill tier, docs/serving.md): with a non-zero
+`kv.spill_bytes_budget`, a device-eviction victim is first offered to the
+host tier — the node keeps its tokens but trades `page` for `host_id`
+(spilled, resident HOST) instead of being destroyed.  Residency obeys ONE
+invariant: a HOST node's entire subtree is HOST (spill order is
+device-frontier-first), so "no DEVICE child" is exactly "no DEVICE
+descendant" and the device-eviction frontier stays cheap to find.  Budget
+room inside the host tier comes from dropping the least-recently-used
+HOST leaves (LRU *within* the tier); destroying a device node whose
+children already spilled drops that HOST subtree with it, keeping the
+invariant.  A prefix hit on a spilled run restores through the engine's
+admission path (`match_nodes` + `promote`), never here.  Node residency:
+DEVICE = `page > 0, host_id None`; HOST = `page == -1, host_id int`;
+detached/destroyed nodes zero both, so a stale reference can be told
+from a live one.
+
 Single-threaded by design: all calls happen on the engine's step()-driving
 thread (the pump), like the rest of the scheduler state.
 """
@@ -44,12 +60,13 @@ from paddle_tpu.obs.flight import get_flight_recorder
 
 class _Node:
     __slots__ = ("run", "page", "parent", "children", "by_first",
-                 "last_use")
+                 "last_use", "host_id")
 
     def __init__(self, run: tuple, page: int, parent: Optional["_Node"]):
         self.run = run                  # page_size token ids (() for root)
-        self.page = page                # physical page id (-1 for root)
-        self.parent = parent
+        self.page = page                # physical page id (-1 for root
+        self.host_id = None             # and HOST/spilled nodes, which
+        self.parent = parent            # carry a host-tier id instead)
         self.children: dict[tuple, _Node] = {}
         # first-token index over children: the partial-boundary probe
         # scans only runs sharing the probe's first token — donation adds
@@ -82,6 +99,11 @@ class PrefixTree:
         self.flight = get_flight_recorder()
         self.n_nodes = 0
         self.n_evictions = 0
+        # the engine's restore path sets this while it allocates fresh
+        # device pages: pressure eviction then destroys instead of
+        # spilling, so the host tier (and the hids mid-restore) stays
+        # stable under the restore's own allocation
+        self._spill_inhibit = False
 
     # -- LRU ---------------------------------------------------------------
     def _touch(self, node: _Node) -> None:
@@ -89,18 +111,20 @@ class PrefixTree:
         node.last_use = self._clock
 
     # -- lookup ------------------------------------------------------------
-    def match(self, tokens) -> tuple[list[int], Optional[tuple[int, int]]]:
-        """Longest cached prefix of `tokens`: returns
-        (full_page_ids, partial) where `full_page_ids` are the physical
-        pages of the matched whole-page runs, and `partial` is
-        (boundary_page_id, r) when a child's run additionally matches the
-        next r (1 <= r < page_size... or up to the tokens left) tokens —
-        the caller maps that page too and MUST copy-on-write it before its
-        first write.  Ties between partially-matching children break
-        deterministically (longest match, then smallest run).  Touches the
-        matched path for LRU."""
+    def match_nodes(self, tokens) -> \
+            tuple[list["_Node"], Optional[tuple["_Node", int]]]:
+        """Longest cached prefix of `tokens` as NODES, residency-blind:
+        the full-page path may end in HOST nodes (the residency invariant
+        guarantees device-prefix-then-host-suffix order along any path),
+        and `partial` is (boundary_node, r) when a child's run
+        additionally matches the next r (1 <= r < page_size, or up to the
+        tokens left) tokens.  The engine's admission restores any HOST
+        tail before mapping; `match` below is the device-only view.  Ties
+        between partially-matching children break deterministically
+        (longest match, then smallest run).  Touches the matched path for
+        LRU."""
         toks = np.asarray(tokens).reshape(-1)
-        node, pages = self.root, []
+        node, nodes = self.root, []
         i, n = 0, int(toks.size)
         while n - i >= self.ps:
             run = tuple(int(t) for t in toks[i:i + self.ps])
@@ -109,7 +133,7 @@ class PrefixTree:
                 break
             node = child
             self._touch(node)
-            pages.append(child.page)
+            nodes.append(child)
             i += self.ps
         partial = None
         rest = tuple(int(t) for t in toks[i:i + self.ps])
@@ -126,8 +150,26 @@ class PrefixTree:
                     best, best_r = child, r
             if best is not None:
                 self._touch(best)
-                partial = (best.page, best_r)
-        return pages, partial
+                partial = (best, best_r)
+        return nodes, partial
+
+    def match(self, tokens) -> tuple[list[int], Optional[tuple[int, int]]]:
+        """The DEVICE-resident view of match_nodes: physical page ids of
+        the matched whole-page runs up to the first spilled node, plus
+        (boundary_page_id, r) when the partial boundary is device-resident
+        and every full run before it was.  The caller maps the partial
+        page too and MUST copy-on-write it before its first write.
+        Spill-unaware callers (and a budget-zero engine) see exactly the
+        pre-spill behavior."""
+        nodes, partial = self.match_nodes(tokens)
+        pages = []
+        for nd in nodes:
+            if nd.host_id is not None:
+                return pages, None
+            pages.append(nd.page)
+        if partial is not None and partial[0].host_id is None:
+            return pages, (partial[0].page, partial[1])
+        return pages, None
 
     # -- insertion (donation at retire/preempt/abort) ----------------------
     def insert(self, tokens, pages) -> int:
@@ -149,32 +191,121 @@ class PrefixTree:
                 self.kv.cache_page(int(page))
                 self.n_nodes += 1
                 added += 1
+            elif child.host_id is not None:
+                # re-donation of a spilled run: the donor just committed
+                # a bit-identical device page (same token path, same
+                # deterministic prefill), so adopt it and drop the host
+                # copy — cheaper than ever restoring this one.  Insert
+                # walks top-down, so a promoted node's ancestors promoted
+                # in this same call: the residency invariant holds.
+                self.kv.drop_host_page(child.host_id, reason="drain")
+                child.host_id = None
+                child.page = int(page)
+                self.kv.cache_page(int(page))
             self._touch(child)
             node = child
         return added
 
     # -- eviction (the allocator's page-pressure hook) ----------------------
     def _evictable_leaves(self):
+        """The device-eviction frontier: DEVICE nodes whose page no slot
+        maps and with no DEVICE children.  By the residency invariant a
+        HOST child has a HOST subtree, so "no DEVICE child" is "no DEVICE
+        descendant" — spilling (or destroying, host subtree included) a
+        frontier node keeps parents outliving device children."""
+        out = []
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.host_id is not None:
+                continue                 # HOST subtree: nothing device below
+            dev = [c for c in node.children.values() if c.host_id is None]
+            if dev:
+                stack.extend(dev)
+            elif self.kv._ref[node.page] == 0:
+                out.append(node)
+        return out
+
+    def _host_leaves(self):
+        """Tree leaves resident HOST — the host tier's LRU victim set.
+        Non-empty whenever the tier is (every host entry is named by a
+        node, and a deepest HOST node is a leaf)."""
         out = []
         stack = list(self.root.children.values())
         while stack:
             node = stack.pop()
             if node.children:
                 stack.extend(node.children.values())
-            elif self.kv._ref[node.page] == 0:
+            elif node.host_id is not None:
                 out.append(node)
         return out
 
+    def _drop_host_node(self, node: "_Node", reason: str = "evict") -> None:
+        """Detach one HOST leaf and forget its host entry."""
+        node.parent.drop_child(node)
+        self.kv.drop_host_page(node.host_id, reason=reason)
+        node.host_id = None
+        self.n_nodes -= 1
+        if reason == "evict":
+            self.flight.record("prefix_evict", host=True,
+                               nodes_left=self.n_nodes)
+
+    def drop_host_subtree(self, top: "_Node") -> None:
+        """Detach `top` and its all-HOST subtree, draining the host
+        entries — stale-generation cleanup on the admission path (a node
+        whose entry predates a kv.reset must never restore)."""
+        top.parent.drop_child(top)
+        stack = [top]
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            if nd.host_id is not None:
+                self.kv.drop_host_page(nd.host_id, reason="drain")
+                nd.host_id = None
+            nd.page = -1
+            self.n_nodes -= 1
+
+    def _try_spill(self, victim: "_Node") -> bool:
+        """Offer a device-eviction victim to the host tier.  Makes budget
+        room first by dropping LRU HOST leaves (the walk per drop is fine:
+        one spill displaces at most a page's worth — typically one leaf —
+        and pressure paths are admission-boundary, not per-token)."""
+        kv = self.kv
+        if self._spill_inhibit or kv.spill_bytes_budget <= 0 or \
+                kv.page_nbytes > kv.spill_bytes_budget:
+            return False
+        while kv.host_bytes + kv.page_nbytes > kv.spill_bytes_budget:
+            leaves = self._host_leaves()
+            assert leaves, "host tier non-empty but no HOST leaf found"
+            self._drop_host_node(min(leaves, key=lambda n: n.last_use))
+        page = victim.page
+        hid = kv.spill_page(page)
+        if hid is None:
+            return False
+        victim.host_id = hid
+        victim.page = -1
+        self.flight.record("spill", page=int(page),
+                           host_pages=kv.host_page_count,
+                           host_bytes=kv.host_bytes)
+        return True
+
     def evict_for(self, n_pages: int) -> int:
-        """Reclaim up to `n_pages` pages by evicting LRU leaves whose page
-        no slot maps.  Returns pages actually freed.  Wired as
+        """Reclaim up to `n_pages` DEVICE pages by walking the LRU
+        eviction frontier.  Returns pages actually freed.  Wired as
         `kv.on_page_pressure`, so try_grow/COW call here before failing —
         eviction before pausing slots, preemption last resort.
 
-        One tree walk per CALL, not per freed page: the evictable leaves
-        go into a min-heap on last_use, and a victim's parent enters the
-        heap the moment it becomes a childless refcount-zero node — the
-        multi-page reclaim an overcommitted admission needs is
+        Two-level: each victim is offered to the host spill tier first
+        (_try_spill — the node survives, resident HOST); only when the
+        tier is off, inhibited, or can't make room does the node get
+        DESTROYED — and destroying takes any HOST subtree beneath it too
+        (an orphaned spilled run could never restore: the tree would no
+        longer spell its prefix).  Either way one device page frees.
+
+        One tree walk per CALL, not per freed page: the frontier goes
+        into a min-heap on last_use, and a victim's parent enters the
+        heap the moment it has no device children and no slot mapping —
+        the multi-page reclaim an overcommitted admission needs is
         O(nodes + freed·log nodes), not O(freed·nodes), precisely when
         the pool is under the pressure eviction exists to relieve.
         Single-threaded with the allocator, so no heap entry goes stale
@@ -191,21 +322,51 @@ class PrefixTree:
         while freed < int(n_pages) and heap:
             _, _, victim = heapq.heappop(heap)
             parent = victim.parent
-            parent.drop_child(victim)
-            self.kv.uncache_page(victim.page)
-            self.n_nodes -= 1
+            if not self._try_spill(victim):
+                for ch in list(victim.children.values()):
+                    self.drop_host_subtree(ch)
+                parent.drop_child(victim)
+                page, victim.page = victim.page, -1
+                self.kv.uncache_page(page)
+                self.n_nodes -= 1
+                self.flight.record("prefix_evict", page=int(page),
+                                   nodes_left=self.n_nodes)
             self.n_evictions += 1
             freed += 1
-            self.flight.record("prefix_evict", page=int(victim.page),
-                               nodes_left=self.n_nodes)
-            if parent is not self.root and not parent.children and \
+            if parent is not self.root and \
+                    not any(c.host_id is None
+                            for c in parent.children.values()) and \
                     self.kv._ref[parent.page] == 0:
                 heapq.heappush(heap, (parent.last_use, seq, parent))
                 seq += 1
         return freed
 
+    # -- restore (the engine's spilled-prefix-hit admission epilogue) -------
+    def promote(self, nodes, pages) -> None:
+        """Re-attach freshly-restored device pages to their HOST nodes
+        (kv.adopt_restored already re-marked the pages cached).  The
+        engine restores a contiguous HOST path tail top-down, so every
+        promoted node's ancestors are device by the end of the call —
+        the residency invariant holds."""
+        for nd, page in zip(nodes, pages):
+            assert nd.host_id is not None
+            nd.host_id = None
+            nd.page = int(page)
+            self._touch(nd)
+
     def clear(self) -> None:
-        """Forget everything WITHOUT touching allocator state — pair with
-        kv.reset(), which already drops the `_cached` marks."""
+        """Forget everything WITHOUT touching device-allocator state —
+        pair with kv.reset(), which already drops the `_cached` marks.
+        Host entries drain with the nodes that name them (a no-op after
+        kv.reset, which empties the tier wholesale; load-bearing for
+        set_prefix_cache(False), which must not leave orphaned host
+        bytes)."""
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.host_id is not None:
+                self.kv.drop_host_page(node.host_id, reason="drain")
+                node.host_id = None
         self.root = _Node((), -1, None)
         self.n_nodes = 0

@@ -227,6 +227,15 @@ class ServingServer:
                  float(eng.prefix.n_evictions if eng.prefix else 0)),
                 ("serving_prefix_cow_total", "counter", None,
                  float(eng.kv.n_cow)),
+                # KV spill tier: device->host spills, host->device
+                # restores, and the host-RAM bytes currently resident
+                # (bounded by spill_bytes_budget)
+                ("serving_spill_pages_total", "counter", None,
+                 float(eng.kv.n_spilled)),
+                ("serving_restore_pages_total", "counter", None,
+                 float(eng.kv.n_restored)),
+                ("serving_spill_bytes", "gauge", None,
+                 float(eng.kv.host_bytes)),
                 # chunked prefill: mixed-step/chunk counters plus the
                 # engine-owned token-budget histograms (step_tokens_hist /
                 # decode_gap_hist keep their own locks; their samples()
@@ -621,6 +630,16 @@ class ServingServer:
                 "tokens_saved": eng.prefill_tokens_saved,
                 "evictions": eng.prefix.n_evictions if eng.prefix else 0,
                 "cow": int(eng.kv.n_cow),
+                # KV spill tier (docs/serving.md): host-resident pages/
+                # bytes + the spill/restore lifecycle counters
+                "spill_bytes_budget": int(eng.kv.spill_bytes_budget),
+                "host_pages": int(eng.kv.host_page_count),
+                "spill_bytes": int(eng.kv.host_bytes),
+                "spilled_pages": int(eng.kv.n_spilled),
+                "restored_pages": int(eng.kv.n_restored),
+                "host_evicted_pages": int(eng.kv.n_host_evicted),
+                "restore_hits": eng.n_restore_hits,
+                "restore_tokens_saved": eng.restore_tokens_saved,
             }),
             "compile_watch": get_compile_watch().snapshot(),
             "hbm": hbm_snapshot(params=eng.params, kv=eng.kv),
@@ -635,6 +654,7 @@ class ServingServer:
             "num_pages": int(self.engine.kv.num_pages),
             "capacity_tokens": int(self.engine.kv.capacity_tokens),
             "prefix_cache": self.engine.prefix is not None,
+            "spill_bytes_budget": int(self.engine.kv.spill_bytes_budget),
             "tp_shards": int(self.engine.tp),
             "spec_k": int(self.engine.spec_k),
             "decode_steps": int(self.engine.decode_steps),
@@ -1017,6 +1037,13 @@ class ServingServer:
             "prefix_cached_pages": int(eng.kv.cached_page_count),
             "prefix_evictions": (eng.prefix.n_evictions
                                  if eng.prefix else 0),
+            # host spill tier: pages parked in host RAM + restore traffic
+            "spill_pages": int(eng.kv.host_page_count),
+            "spill_bytes": int(eng.kv.host_bytes),
+            "spilled_pages_total": int(eng.kv.n_spilled),
+            "restored_pages_total": int(eng.kv.n_restored),
+            "restore_hits": eng.n_restore_hits,
+            "restore_tokens_saved": eng.restore_tokens_saved,
             "prefill_chunk": eng.prefill_chunk,
             "max_step_tokens": eng.max_step_tokens,
             "prefill_chunks": eng.n_prefill_chunks,

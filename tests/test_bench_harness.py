@@ -272,6 +272,41 @@ def test_assemble_lkg_stitches_serving_spec_record(tmp_path):
     assert out["serving_spec"]["sig_stable"] is True
 
 
+def test_assemble_lkg_stitches_serving_spill_record(tmp_path):
+    """ISSUE 17 wiring: the host-spill record (lm_serving_spill_hit_rate
+    + the off-arm comparison and spill/restore page counters) rides the
+    same per-config queue shape — a top-level BENCH_ONLY=serving_spill
+    record must stitch into the assembled fallback under the
+    `serving_spill` key with the companions intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_spill"] == "lm_serving_spill_hit_rate"
+    assert "serving_spill" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-03T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-04T12:00:00+00:00",
+         "record": {"metric": M["serving_spill"], "value": 0.91,
+                    "lm_serving_spill_tok_per_sec": 5120.5,
+                    "off_hit_rate": 0.42, "hit_rate_improved": True,
+                    "spilled_pages": 480, "restored_pages": 455,
+                    "restore_hits": 120, "restore_tokens_saved": 6900,
+                    "reconcile_ok": True, "sig_stable": True,
+                    "measured_at": "2026-08-04T12:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_spill"]["value"] == 0.91
+    assert out["serving_spill"]["lm_serving_spill_tok_per_sec"] == 5120.5
+    assert out["serving_spill"]["off_hit_rate"] == 0.42
+    assert out["serving_spill"]["hit_rate_improved"] is True
+    assert out["serving_spill"]["restored_pages"] == 455
+    assert out["serving_spill"]["reconcile_ok"] is True
+    assert out["serving_spill"]["sig_stable"] is True
+
+
 def test_serving_latency_fields_ride_the_lkg_and_freshness_paths(tmp_path):
     """PR 4 wiring: the serving record's p99 per-token latency companion
     (lm_serving_p99_tok_latency_ms) must survive _assemble_lkg, and the

@@ -389,6 +389,105 @@ def measure_prefix_skew(eng, wl: dict, reps: int, seed: int) -> dict:
     }
 
 
+def measure_spill(eng, wl: dict, reps: int, seed: int,
+                  budget: int) -> dict:
+    """Host-spill A/B on ONE engine: the identical prefix-skew workload
+    (fresh Request objects each pass, same seeds) with the prefix cache ON
+    both arms and the spill tier OFF, then ON at `budget` bytes.  The
+    caller sizes the page pool BELOW the Zipf working set (--num-pages),
+    so the off arm destroys cold prefixes under pressure and re-pays
+    their prefill, while the on arm parks them in host RAM and restores
+    on the next hit — the hit-rate delta is the number the tier exists
+    for.  reset_prefix_cache between arms (drains the host tier too) so
+    the on arm starts from the same cold allocator state.
+
+    Warmup discipline matches measure_prefix_skew: warm_workload compiles
+    the prefill buckets, then each arm runs every rep set twice untimed —
+    the on arm's warming passes populate the host tier and compile the
+    per-bucket restore scatter before the timed region.  The decode and
+    mixed steps must hold their signatures across BOTH arms (spill work
+    is admission-boundary host code, never a new jit) — reported as
+    `sig_stable`, the bench's pass/fail verdict together with the
+    restored-pages-vs-tokens-saved reconciliation."""
+    import numpy as np
+
+    def sets():
+        return [make_prefix_requests(seed=seed + 1 + r, **wl)
+                for r in range(reps)]
+
+    pct = lambda xs: float(np.percentile(xs, 50)) * 1e3 if xs else 0.0
+
+    eng.set_spill_budget(0)
+    warm_workload(eng, [make_prefix_requests(seed=seed, **wl)] + sets())
+    sig0 = eng._decode_step._cache_size()
+    mixed0 = eng._mixed_step._cache_size()
+
+    arms = {}
+    for label, bytes_budget in (("off", 0), ("on", int(budget))):
+        eng.reset_prefix_cache()
+        eng.set_spill_budget(bytes_budget)
+        for _ in range(2):                     # untimed steady-state warmup
+            for reqs in sets():
+                eng.run(reqs)
+        spilled0 = eng.kv.n_spilled
+        restored0 = eng.kv.n_restored
+        rhit0 = eng.n_restore_hits
+        rsaved0 = eng.restore_tokens_saved
+        vals, ftok = [], []
+        hits = misses = saved = evs = 0
+        for reqs in sets():
+            rec = run_workload(eng, reqs)
+            vals.append(rec["tokens"] / rec["seconds"])
+            ftok += rec["first_tok_seconds"]
+            hits += rec["prefix_hits"]
+            misses += rec["prefix_misses"]
+            saved += rec["prefill_tokens_saved"]
+            evs += rec["prefix_evictions"]
+        eng.kv.check()
+        arms[label] = {
+            "tok_per_sec": float(np.median(vals)),
+            "first_tok_ms_p50": round(pct(ftok), 3),
+            "hits": hits, "misses": misses,
+            "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            "tokens_saved": saved, "evictions": evs,
+            "spilled_pages": eng.kv.n_spilled - spilled0,
+            "restored_pages": eng.kv.n_restored - restored0,
+            "restore_hits": eng.n_restore_hits - rhit0,
+            "restore_tokens_saved": eng.restore_tokens_saved - rsaved0,
+        }
+    off, on = arms["off"], arms["on"]
+    # every token a restore saved must be backed by a restored page (a
+    # restored hit can save at most page_size tokens per restored page)
+    reconcile_ok = (on["restored_pages"] > 0
+                    and 0 < on["restore_tokens_saved"]
+                    <= on["restored_pages"] * eng.kv.page_size)
+    return {
+        "spill_budget": int(budget),
+        "num_pages": int(eng.kv.num_pages),
+        "host_pages": int(eng.kv.host_page_count),
+        "host_bytes": int(eng.kv.host_bytes),
+        "page_nbytes": int(eng.kv.page_nbytes),
+        "tok_per_sec": on["tok_per_sec"],
+        "off_tok_per_sec": off["tok_per_sec"],
+        "first_tok_ms_p50": on["first_tok_ms_p50"],
+        "off_first_tok_ms_p50": off["first_tok_ms_p50"],
+        "hit_rate": on["hit_rate"], "off_hit_rate": off["hit_rate"],
+        "hit_rate_improved": on["hit_rate"] > off["hit_rate"],
+        "tokens_saved": on["tokens_saved"],
+        "off_tokens_saved": off["tokens_saved"],
+        "evictions": on["evictions"], "off_evictions": off["evictions"],
+        "spilled_pages": on["spilled_pages"],
+        "restored_pages": on["restored_pages"],
+        "restore_hits": on["restore_hits"],
+        "restore_tokens_saved": on["restore_tokens_saved"],
+        "off_spilled_pages": off["spilled_pages"],
+        "restore_fn_sigs": len(eng.kv._restore_fns),
+        "reconcile_ok": reconcile_ok,
+        "sig_stable": (eng._decode_step._cache_size() == sig0
+                       and eng._mixed_step._cache_size() == mixed0),
+    }
+
+
 def measure_chunked(eng, wl: dict, reps: int, seed: int,
                     prefill_chunk: int, max_step_tokens=None) -> dict:
     """Chunked-prefill A/B on ONE engine: the identical heavy-tail
@@ -948,6 +1047,8 @@ def build_engine(args, mesh=None):
     eng = ServingEngine(
         tr.executor, tr.params, num_slots=args.slots,
         page_size=args.page_size, max_context=args.max_context,
+        num_pages=(getattr(args, "num_pages", 0) or None),
+        spill_bytes_budget=(getattr(args, "spill_budget", 0) or 0),
         prefill_chunk=(getattr(args, "prefill_chunk", 0) or -1),
         max_step_tokens=(getattr(args, "max_step_tokens", 0) or None),
         mesh=mesh)
@@ -1058,6 +1159,19 @@ def main() -> int:
                     help="shared prefix length in tokens")
     ap.add_argument("--suffix-lo", type=int, default=16)
     ap.add_argument("--suffix-hi", type=int, default=64)
+    # host KV spill tier (docs/serving.md "KV spill tier"): A/B the same
+    # prefix-skew workload with the spill tier off then on — pair with
+    # --num-pages sized BELOW the Zipf working set so the off arm is
+    # forced to destroy cold prefixes under pool pressure
+    ap.add_argument("--spill-budget", type=int, default=0, metavar="BYTES",
+                    help="run the host-spill A/B: prefix cache on both "
+                         "arms, spill tier off then on at BYTES of host "
+                         "RAM (reports hit rate, restored pages, prefill "
+                         "tokens saved, first-token p50 both arms)")
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="KV page-pool size override incl. trash page "
+                         "(0 = engine default; the spill A/B wants this "
+                         "below the workload's working set)")
     # chunked prefill (docs/serving.md "Chunked prefill"): --prompt-dist
     # heavy-tail runs the A/B (legacy whole-prompt prefill vs budgeted
     # mixed steps) on a Pareto/lognormal prompt-length workload
@@ -1203,6 +1317,42 @@ def main() -> int:
                 "reconcile_ok", "sig_stable")},
         }), flush=True)
         return 0 if m["sig_stable"] and m["reconcile_ok"] else 1
+
+    if args.spill_budget > 0:
+        if args.prefix_skew is None:
+            args.prefix_skew = 1.0     # the spill A/B rides the prefix-
+                                       # skew workload; default the Zipf
+                                       # exponent when only --spill-budget
+                                       # is given
+        eng = build_engine(args)
+        wl = dict(n=args.num_requests, prefix_pool=args.prefix_pool,
+                  prefix_len=args.prefix_len, prefix_skew=args.prefix_skew,
+                  suffix_lo=args.suffix_lo, suffix_hi=args.suffix_hi,
+                  max_new=args.max_new, vocab=args.vocab)
+        m = measure_spill(eng, wl, args.reps, args.seed, args.spill_budget)
+        print(json.dumps({
+            "bench": "serving_spill",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prefix_pool": args.prefix_pool, "prefix_len": args.prefix_len,
+            "prefix_skew": args.prefix_skew,
+            "suffix_lens": [args.suffix_lo, args.suffix_hi],
+            "max_new": args.max_new, "dim": args.dim,
+            "layers": args.layers, "dtype": args.dtype, "reps": args.reps,
+            "lm_serving_spill_hit_rate": round(m["hit_rate"], 4),
+            "lm_serving_spill_tok_per_sec": round(m["tok_per_sec"], 1),
+            **{k: m[k] for k in (
+                "spill_budget", "num_pages", "host_pages", "host_bytes",
+                "page_nbytes", "off_tok_per_sec", "first_tok_ms_p50",
+                "off_first_tok_ms_p50", "off_hit_rate",
+                "hit_rate_improved", "tokens_saved", "off_tokens_saved",
+                "evictions", "off_evictions", "spilled_pages",
+                "restored_pages", "restore_hits", "restore_tokens_saved",
+                "off_spilled_pages", "restore_fn_sigs", "reconcile_ok",
+                "sig_stable")},
+        }), flush=True)
+        return 0 if (m["sig_stable"] and m["reconcile_ok"]
+                     and m["hit_rate_improved"]) else 1
 
     eng = build_engine(args)
     if args.prompt_dist == "heavy-tail":

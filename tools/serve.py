@@ -120,6 +120,10 @@ def build_engine(args):
         print(f"multi-step decode: {args.decode_steps} scanned decode "
               f"bodies per dispatch when pure-decode (emitted tokens "
               f"unchanged; tokens stream in bursts)", file=sys.stderr)
+    if args.spill_budget > 0:
+        print(f"KV spill tier: cold cached pages spill to host RAM "
+              f"(budget {args.spill_budget} bytes) and restore on "
+              f"prefix hits", file=sys.stderr)
     return ServingEngine(tr.executor, tr.params, num_slots=args.slots,
                          page_size=args.page_size,
                          max_context=args.max_context,
@@ -128,6 +132,7 @@ def build_engine(args):
                          max_step_tokens=args.max_step_tokens or None,
                          spec_k=args.spec_k,
                          decode_steps=args.decode_steps,
+                         spill_bytes_budget=args.spill_budget,
                          mesh=mesh)
 
 
@@ -205,6 +210,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-context", type=int, default=256)
     ap.add_argument("--num-pages", type=int, default=None,
                     help="overcommit the page pool (default: worst case)")
+    ap.add_argument("--spill-budget", type=int, default=0,
+                    help="host-RAM bytes for the KV spill tier (0 = off): "
+                         "cold cached pages spill instead of evicting and "
+                         "restore on prefix hits (docs/serving.md)")
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="chunked-prefill chunk size in tokens "
                          "(0 = engine default 4*page_size, negative = "

@@ -245,6 +245,8 @@ _REHEARSE_ENV = {
     "BENCH_SERVE_FLEET": "2", "BENCH_SERVE_FLEET_CONC": "2",
     "BENCH_SERVE_SPEC_K": "3",
     "BENCH_SERVE_DECODE_STEPS": "3",
+    "BENCH_SERVE_SPILL_SLOTS": "2", "BENCH_SERVE_SPILL_PAGES": "10",
+    "BENCH_SERVE_SPILL_BUDGET": "1000000",
 }
 
 
@@ -356,6 +358,17 @@ def main() -> int:
                              "--vocab", "64", "--dim", "32",
                              "--layers", "1", "--heads", "2",
                              "--dtype", "float32", "--reps", "1"]
+        # pool (14 pages) deliberately below the 6x3-page prefix working
+        # set so the off arm destroys cold prefixes and the on arm spills
+        serving_spill_args = ["--spill-budget", "1000000",
+                              "--num-pages", "14", "--num-requests", "8",
+                              "--slots", "2", "--page-size", "8",
+                              "--max-context", "64", "--prefix-pool", "6",
+                              "--prefix-len", "24", "--suffix-lo", "4",
+                              "--suffix-hi", "8", "--max-new", "8",
+                              "--vocab", "64", "--dim", "32",
+                              "--layers", "1", "--heads", "2",
+                              "--dtype", "float32", "--reps", "1"]
         # the CPU rehearse has one host device by default — the sharded
         # arm needs a virtual 2-device mesh (harmless on real TPU steps,
         # which never see this env)
@@ -401,6 +414,11 @@ def main() -> int:
         # mixed-length workload (this is where the dispatch-amortization
         # win actually shows — PERF.md "Reading the multi-step bench")
         serving_scan_args = ["--decode-steps", "4"]
+        # host-spill A/B at TPU size: 96-page pool (below the default
+        # 8x8-page prefix working set plus in-flight demand at 4 slots)
+        # with a 64 MiB host budget — PERF.md "Reading the spill bench"
+        serving_spill_args = ["--spill-budget", str(64 << 20),
+                              "--num-pages", "96", "--slots", "4"]
         tp_env = {}
         dist_env = {}
         rnn_args = []
@@ -476,6 +494,12 @@ def main() -> int:
         ("bench_serving_scan_record", [py, "bench.py"], 900,
          bench_env("serving_scan", 840),
          lambda: _metric_fresh(_METRIC_OF["serving_scan"], fh)),
+        # host-spill record (spill-on hit rate + both arms' tokens saved /
+        # first-token p50 + the restored-pages reconciliation): another
+        # two-arm A/B on one engine, same budget
+        ("bench_serving_spill_record", [py, "bench.py"], 900,
+         bench_env("serving_spill", 840),
+         lambda: _metric_fresh(_METRIC_OF["serving_spill"], fh)),
         # parameter-server training record (K-trainer aggregate samples/s
         # + the 1-trainer arm + scaling efficiency + the live-flip
         # trace-overhead probe): all subprocesses on the CPU backend, so
@@ -543,6 +567,11 @@ def main() -> int:
         ("bench_serving_scan",
          [py, "tools/bench_serving.py"] + serving_scan_args, 1200, {},
          lambda: _out_fresh("bench_serving_scan", fh)),
+        # host-spill sweep: the full-size off/on A/B with the spill/
+        # restore page counters and the hit-rate comparison banked
+        ("bench_serving_spill",
+         [py, "tools/bench_serving.py"] + serving_spill_args, 1200, {},
+         lambda: _out_fresh("bench_serving_spill", fh)),
         ("additive_bench", [py, "tools/bench_additive.py"] + additive_args,
          400, {},
          lambda: _out_fresh("additive_bench", fh)),
