@@ -753,10 +753,20 @@ def bench_serving_spec(dtype: str) -> dict:
     are the baseline arm, the accept rate, and the drafted/accepted/
     emitted reconciliation (`reconcile_ok` — the counters must account
     for every token).  Token exactness spec-on vs spec-off is
-    tests/test_spec_decode.py's job."""
+    tests/test_spec_decode.py's job.
+
+    The adaptive-speculation matrix (tools/bench_serving.py --drafter
+    model --spec-dynamic) rides the same record: ngram vs batched
+    draft-model (self-speculation) vs decode_mode=auto arms on the
+    repetitive AND heavy-tail workloads —
+    `lm_serving_spec_model_tok_per_sec`, the auto arm, the effective
+    per-slot k the dynamic policy converged to, and the model-vs-ngram
+    heavy-tail accept gate (`accept_model_gt_ngram` — the model drafter
+    must hold its accept rate exactly where prompt lookup collapses)."""
     import argparse
 
-    from tools.bench_serving import build_engine, measure_spec
+    from tools.bench_serving import (build_engine, measure_spec,
+                                     measure_spec_modes)
 
     args = argparse.Namespace(
         vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
@@ -780,6 +790,11 @@ def bench_serving_spec(dtype: str) -> dict:
 
     eng = build_engine(args)
     m = measure_spec(eng, wl, reps, seed=0, spec_k=spec_k)
+    # the adaptive matrix reuses the SAME engine (idle knob flips, fixed
+    # signature sets) — the heavy-tail workload shares the repetitive
+    # one's shape envelope so no new prefill/verify signatures appear
+    mm = measure_spec_modes(eng, wl, dict(wl), reps, seed=0,
+                            spec_k=spec_k)
     return {
         "metric": "lm_serving_spec_tok_per_sec",
         "value": round(m["spec_tok_per_sec"], 1),
@@ -796,6 +811,23 @@ def bench_serving_spec(dtype: str) -> dict:
             "accepted", "chains", "spec_tokens", "tokens",
             "baseline_decode_steps", "spec_decode_steps",
             "reconcile_ok", "sig_stable")},
+        "lm_serving_spec_model_tok_per_sec":
+            round(mm["model_rep_tok_per_sec"], 1),
+        "lm_serving_spec_auto_tok_per_sec":
+            round(mm["auto_rep_tok_per_sec"], 1),
+        "lm_serving_spec_effective_k":
+            round(mm["auto_rep_effective_k"], 3),
+        "lm_serving_spec_model_accept_rate_heavy":
+            mm["model_heavy_accept_rate"],
+        "lm_serving_spec_ngram_accept_rate_heavy":
+            mm["ngram_heavy_accept_rate"],
+        **{f"modes_{k}": mm[k] for k in (
+            "accept_model_gt_ngram", "auto_ok_rep", "auto_ok_heavy",
+            "auto_heavy_tok_per_sec", "static_rep_tok_per_sec",
+            "static_heavy_tok_per_sec", "scan_heavy_tok_per_sec",
+            "off_rep_tok_per_sec", "ngram_rep_tok_per_sec",
+            "ngram_heavy_tok_per_sec", "model_heavy_tok_per_sec",
+            "sig_stable", "reconcile_ok", "ok")},
     }
 
 

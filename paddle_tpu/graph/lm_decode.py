@@ -294,6 +294,58 @@ def _is_probs(model, logits_name: str) -> bool:
     return False
 
 
+def build_draft_roll(executor: GraphExecutor, *,
+                     input_name: Optional[str] = None,
+                     logits_name: Optional[str] = None):
+    """Build the GREEDY k-chain rollout a batched serving drafter jits:
+    `roll(params, buf, lens, k) -> [B, k]` proposals, where `buf` is a
+    [B, W + k] windowed-context buffer (each row's last valid token at
+    `lens[b] - 1`, k columns of slack on the right) and k is STATIC.
+
+    One `lax.scan` of k whole-window forwards: each body re-forwards the
+    padded buffer (the zero-support decode mode of `lm_generate` — no KV
+    cache to thread, so the rollout stays a pure params/ids -> tokens
+    function the serving engine can jit under ONE signature per (B, k)),
+    reads the last valid position's logits, takes the shared greedy pick
+    (serving/sampler.py:greedy_next — the drafter/sampler tie contract),
+    and appends.  Causal masking makes the right-side slack inert, so
+    garbage past `lens` can never leak into a proposal.
+
+    Cost: k forwards over W + k positions of whatever model `executor`
+    holds — a tiny draft transformer, or the TARGET itself over a
+    truncated window (self-speculation; the window cap is what makes it
+    cheaper than real decode at long contexts).  Proposals are guesses
+    by construction: the verify step re-scores every chain exactly, so
+    nothing here can change an emitted token."""
+    model = executor.model
+    input_name, logits_name = _resolve_io_names(model, input_name,
+                                                logits_name)
+    from paddle_tpu.serving.sampler import greedy_next
+
+    def roll(params, buf, lens, k: int):
+        B, W = buf.shape
+
+        def body(carry, _):
+            buf, lens = carry
+            feed = {input_name: Argument(ids=buf, lengths=lens)}
+            outputs, _, _ = executor.forward(params, feed, None, TEST,
+                                             None)
+            logits = outputs[logits_name].value        # [B, W, V]
+            last = jnp.take_along_axis(
+                logits, (jnp.clip(lens, 1, W) - 1)[:, None, None],
+                axis=1)[:, 0, :]
+            nxt = greedy_next(last)
+            buf = buf.at[jnp.arange(B),
+                         jnp.clip(lens, 0, W - 1)].set(nxt)
+            lens = jnp.minimum(lens + 1, W)
+            return (buf, lens), nxt
+
+        _, toks = jax.lax.scan(body, (buf, lens), None, length=k)
+        return toks.T                                  # [k, B] -> [B, k]
+
+    return roll
+
+
 def lm_beam_generate(
     executor: GraphExecutor,
     params: dict[str, Array],

@@ -83,6 +83,18 @@ CATALOG: dict[str, str] = {
     "serving_spec_accept_rate":
         "accepted / drafted over the engine lifetime (0 before any "
         "draft; PERF.md 'Reading the accept rate')",
+    "serving_draft_steps_total":
+        "drafter proposal passes that proposed at least one token "
+        "(a ModelDrafter pass is ONE batched device dispatch for all "
+        "decoding slots)",
+    "serving_draft_ms":
+        "wall ms per drafter proposal pass (host lookup or batched "
+        "draft-model dispatch) — must stay well under the verify step "
+        "it feeds for speculation to pay",
+    "serving_spec_k_effective":
+        "per-slot draft depth chosen each flush window (dynamic k: the "
+        "accept-EWMA policy's output, 0..spec_k; static: spec_k) — mass "
+        "near 0 means the workload does not sustain speculation",
     # -- chunked prefill / mixed-step token budget -------------------------
     "serving_step_tokens":
         "scheduled token rows per compiled step (decode rows + prefill "

@@ -1,4 +1,4 @@
-"""Host-side draft-token proposers for speculative decoding.
+"""Draft-token proposers for speculative decoding.
 
 The serving engine's speculative path (docs/serving.md "Speculative
 decoding") multiplies decode tokens/s by letting a cheap DRAFTER guess
@@ -11,29 +11,55 @@ change a single emitted token — only how many compiled steps it takes
 to emit them.  A useless drafter costs some wasted verify rows; a good
 one collapses k+1 sequential steps into one.
 
-The drafter interface is deliberately tiny so a small draft MODEL can
-slot in later:
+Two interfaces, both honored by the engine:
 
     class Drafter:
-        def propose(self, ctx: np.ndarray, k: int) -> np.ndarray:
+        def propose(self, ctx: np.ndarray, k: int,
+                    eos_id: int = -1) -> np.ndarray:
             '''Up to `k` int32 draft tokens continuing `ctx` (the slot's
             prompt + everything generated so far, newest last).  May
             return fewer (or zero) tokens; must be DETERMINISTIC in ctx
             — the engine consults it on the scheduling hot path, between
-            compiled steps, on the pump thread.'''
+            compiled steps, on the pump thread.  The CLAMP CONTRACT is
+            the drafter's, not the engine's: never more than k tokens,
+            and never a token past the first `eos_id` — the engine
+            asserts instead of silently truncating, so a drafter bug
+            shows up as a tripwire, not as skewed accept-rate stats.'''
 
-The default is prompt-lookup / n-gram drafting (the "no second model"
-scheme of arXiv-era LLMA/prompt-lookup decoding): the continuation of
-the most recent earlier occurrence of the slot's own trailing n-gram.
-Free to compute, surprisingly strong on the workloads serving actually
-sees (retrieval contexts, code, templated text, and the repetitive
-regimes of constrained decoding), and exactly zero-cost to correctness
-by construction.
+        def propose_batch(self, ctx: np.ndarray, lens: np.ndarray,
+                          k: int, eos_ids: np.ndarray) -> np.ndarray:
+            '''OPTIONAL batched form: [S, W] windowed contexts (row s
+            valid through lens[s], zero-padded right) -> [S, k] int32
+            proposals in ONE call, row s clamped at eos_ids[s] with -1
+            padding after the clamp.  When present the engine prefers
+            it: one device dispatch drafts for every decoding slot.'''
+
+`NgramDrafter` (the default) is host-side prompt lookup; `ModelDrafter`
+runs a real draft transformer — a separately-trained tiny model, an
+embedding-distilled one, or the TARGET over a truncated window
+(self-speculation) — batched across all slots in one jitted dispatch
+(compile-watch site `serving.draft_step`, ONE signature per (S, k)).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
+
+
+def clamp_proposal(d: np.ndarray, k: int, eos_id: int = -1) -> np.ndarray:
+    """The drafter-side clamp every propose() must apply: at most `k`
+    tokens, truncated just AFTER the first `eos_id` (a drafted eos can
+    be accepted and retire the slot; tokens past it could never be
+    banked, and scoring them would skew the accept rate the dynamic-k
+    policy steers by)."""
+    d = np.asarray(d, np.int32).reshape(-1)[:max(0, int(k))]
+    if eos_id >= 0 and d.size:
+        hit = np.flatnonzero(d == eos_id)
+        if hit.size:
+            d = d[:int(hit[0]) + 1]
+    return d
 
 
 class NgramDrafter:
@@ -52,6 +78,8 @@ class NgramDrafter:
     engine reads this attribute to hand over only the tail.
     """
 
+    kind = "ngram"
+
     def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
                  window: int = 256):
         assert 1 <= min_ngram <= max_ngram
@@ -59,7 +87,8 @@ class NgramDrafter:
         self.min_ngram = int(min_ngram)
         self.window = int(window)
 
-    def propose(self, ctx: np.ndarray, k: int) -> np.ndarray:
+    def propose(self, ctx: np.ndarray, k: int,
+                eos_id: int = -1) -> np.ndarray:
         ctx = np.asarray(ctx, np.int32).reshape(-1)[-self.window:]
         n_ctx = ctx.size
         if k <= 0 or n_ctx < 2:
@@ -73,5 +102,164 @@ class NgramDrafter:
             hits = np.flatnonzero((wins == pat[None, :]).all(axis=1))
             if hits.size:
                 start = int(hits[-1]) + n          # most recent match
-                return ctx[start:start + k].copy()
+                return clamp_proposal(ctx[start:start + k], k, eos_id)
         return np.zeros(0, np.int32)
+
+
+class ModelDrafter:
+    """Draft-model proposer: greedy k-chains for ALL decoding slots in
+    ONE jitted batched dispatch.
+
+    The engine hands over [S, W] windowed contexts (`window` caps W; the
+    engine reads the attribute, exactly as for NgramDrafter) plus valid
+    lengths, and gets back [S, k] greedy proposals from ONE compiled
+    program — compile-watch site `serving.draft_step`, ONE signature per
+    (S, k): S is the engine's fixed slot count and k is static, so a
+    steady spec deployment never grows the jit cache.  The rollout is
+    `graph/lm_decode.py:build_draft_roll` — k whole-window forwards of
+    whatever LM `executor`/`params` hold, under `lax.scan`.
+
+    Three ways to get one:
+      * `ModelDrafter(executor, params)` — a separately-trained tiny
+        draft LM (any config whose logits layer is [B, T, vocab]).
+      * `ModelDrafter.from_target(executor, params)` — SELF-SPECULATION:
+        the target model drafts for itself over a truncated window.
+        Zero extra weights; the window cap is the speedup (k drafts cost
+        k short-window forwards instead of k full paged-decode
+        dispatches), and greedy agreement with the target is high by
+        construction — the strongest drafter this repo can build without
+        a training run.
+      * `ModelDrafter.distilled_init(executor, params, dim=..)` — a
+        fresh tiny transformer whose token embedding (and tied LM head)
+        are sliced out of the TARGET's embedding: cheap geometric
+        alignment so an untrained drafter starts correlated with the
+        target's token space instead of fully random.
+
+    Replication contract for tensor-parallel serving: the drafter holds
+    its params as given (host/replicated), never the engine's sharded
+    copies — its program compiles with ZERO collectives under any mesh
+    (tools/hlo_shard_check.py lowers and proves it), so drafting can
+    never add cross-device traffic to the verify step it feeds.
+    """
+
+    kind = "model"
+
+    def __init__(self, executor, params, window: int = 64,
+                 input_name: Optional[str] = None,
+                 logits_name: Optional[str] = None):
+        import copy
+
+        import jax
+
+        from paddle_tpu.graph.lm_decode import build_draft_roll
+        from paddle_tpu.obs.compile_watch import get_compile_watch
+
+        # the replication contract, enforced: a tensor-parallel engine
+        # stamps its mesh onto the (shared) executor, whose forward then
+        # emits per-layer sharding constraints — tracing the draft
+        # rollout through it would compile Megatron all-reduces into the
+        # draft step.  An UNCONDITIONAL mesh-free shallow copy keeps the
+        # drafter's program single-device/replicated regardless of what
+        # the engine sharded — and regardless of whether the drafter was
+        # built before or after the engine stamped the mesh (the rollout
+        # reads executor.mesh at TRACE time, not here)
+        # (tools/hlo_shard_check.py lowers it and proves zero
+        # collectives).
+        if hasattr(executor, "mesh"):
+            executor = copy.copy(executor)
+            executor.mesh = None
+        self.executor = executor
+        self.params = params
+        self.window = int(window)
+        assert self.window >= 2, "draft window must hold an n-gram"
+        self._step = get_compile_watch().wrap_jit(
+            "serving.draft_step",
+            jax.jit(build_draft_roll(executor, input_name=input_name,
+                                     logits_name=logits_name),
+                    static_argnums=(3,)))
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_target(cls, executor, params, window: int = 64):
+        """Self-speculation: the target drafts for itself over a
+        truncated window.  Pass the HOST params (what you gave the
+        engine), not the engine's possibly-sharded copies."""
+        return cls(executor, params, window=window)
+
+    @classmethod
+    def distilled_init(cls, executor, params, dim: int = 32,
+                       layers: int = 1, heads: int = 2,
+                       window: int = 64, seed: int = 0,
+                       embedding_name: str = "_tok_embedding",
+                       head_name: Optional[str] = None):
+        """Build a tiny transformer drafter whose embedding (and tied LM
+        head) are distilled-initialized from the TARGET's token
+        embedding: the first `dim` embedding columns are copied in, and
+        the draft LM head is the copied embedding's transpose (weight
+        tying), so the untrained drafter's greedy picks already follow
+        the target's token geometry.  `executor`/`params` are the
+        TARGET's; vocab is read off its embedding table."""
+        import numpy as np
+
+        from paddle_tpu.config.parser import parse_config
+        from paddle_tpu.trainer.trainer import Trainer
+
+        emb = np.asarray(params[embedding_name], np.float32)
+        vocab, tdim = emb.shape
+        dim = min(int(dim), tdim)
+        cfg = parse_config(
+            "demo/model_zoo/transformer_lm.py",
+            f"vocab={vocab},dim={dim},layers={int(layers)},"
+            f"heads={int(heads)},batch_size=1")
+        tr = Trainer(cfg, seed=seed)
+        draft = dict(tr.params)
+        draft[embedding_name] = emb[:, :dim].copy()
+        if head_name is None:
+            head_name = next((n for n in draft
+                              if n.startswith("_lm_head")), None)
+        if head_name is not None and \
+                np.asarray(draft[head_name]).shape == (dim, vocab):
+            draft[head_name] = np.ascontiguousarray(emb[:, :dim].T)
+        return cls(tr.executor, draft, window=window)
+
+    # -- proposing ---------------------------------------------------------
+    def propose_batch(self, ctx: np.ndarray, lens: np.ndarray, k: int,
+                      eos_ids: Optional[np.ndarray] = None) -> np.ndarray:
+        """[S, W] windowed contexts + [S] valid lengths -> [S, k] greedy
+        proposals in ONE jitted dispatch.  Row s is clamped just after
+        its first eos_ids[s] and padded with -1 (the engine treats -1 as
+        end-of-proposal; -1 is never a vocab id)."""
+        import jax.numpy as jnp
+
+        S = int(ctx.shape[0])
+        k = int(k)
+        if k <= 0:
+            return np.zeros((S, 0), np.int32)
+        W = int(ctx.shape[1])
+        buf = np.zeros((S, W + k), np.int32)
+        buf[:, :W] = ctx
+        lens = np.clip(np.asarray(lens, np.int32), 1, W)
+        out = np.asarray(self._step(self.params, jnp.asarray(buf),
+                                    jnp.asarray(lens), k))
+        if eos_ids is not None:
+            eos = np.asarray(eos_ids, np.int32)[:, None]     # [S, 1]
+            past = np.zeros((S, k), bool)
+            hit = (out == eos) & (eos >= 0)
+            if k > 1:
+                past[:, 1:] = np.cumsum(hit[:, :-1], axis=1) > 0
+            out = np.where(past, -1, out)
+        return out.astype(np.int32)
+
+    def propose(self, ctx: np.ndarray, k: int,
+                eos_id: int = -1) -> np.ndarray:
+        """Single-context fallback (the generic engine path and the unit
+        tests): one row through the same batched program.  Note each
+        distinct (1, k) shape is its own draft-step signature — the
+        engine's batched path is the production one."""
+        ctx = np.asarray(ctx, np.int32).reshape(-1)[-self.window:]
+        if k <= 0 or ctx.size == 0:
+            return np.zeros(0, np.int32)
+        row = np.zeros((1, self.window), np.int32)
+        row[0, :ctx.size] = ctx
+        out = self.propose_batch(row, np.array([ctx.size]), int(k))
+        return clamp_proposal(out[0], k, eos_id)

@@ -114,6 +114,16 @@ from paddle_tpu.serving.paged_kv import PagedKVCache
 from paddle_tpu.serving.prefix_tree import PrefixTree
 from paddle_tpu.serving.sampler import pick_next_chain, pick_next_per_slot
 
+# Dynamic-speculation policy constants (see ServingEngine._dyn_k).
+# _EWMA_ALPHA weights the newest chain's accept rate into the slot's
+# running estimate — 0.25 adapts within ~4 chains without thrashing on a
+# single unlucky draft.  _PROBE_EVERY paces the k=1 re-probe of a slot
+# whose depth decayed to 0: often enough to notice a workload turning
+# repetitive, rare enough that a hostile workload pays ~1/16th of a
+# wasted verify row per window.
+_EWMA_ALPHA = 0.25
+_PROBE_EVERY = 16
+
 
 class EngineState(NamedTuple):
     """The decode/mixed steps' ENTIRE device state — one jittable pytree.
@@ -193,7 +203,7 @@ class _Slot:
     with `first_tok` set."""
 
     __slots__ = ("req", "keys", "pos", "gen", "last_tok", "generated",
-                 "admit_seq", "replay_until")
+                 "admit_seq", "replay_until", "accept_ewma", "probe_tick")
 
     def __init__(self, req: Request, keys: np.ndarray, pos: int,
                  first_tok: Optional[int], admit_seq: int):
@@ -215,6 +225,13 @@ class _Slot:
         # trace shows them as a `replay` span, flipping to `decode` at the
         # first genuinely fresh token.  0 = never preempted / caught up.
         self.replay_until = 0
+        # dynamic speculation (spec_dynamic=True): EWMA of this slot's
+        # per-chain accept fraction (None = cold, no chain verified yet)
+        # steers the per-slot draft depth k_s; probe_tick paces the k=1
+        # re-probes a decayed-to-0 slot still gets, so a workload that
+        # turns repetitive mid-request can climb back out of plain decode
+        self.accept_ewma: Optional[float] = None
+        self.probe_tick = 0
 
 
 class ServingEngine:
@@ -235,7 +252,9 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = -1,
                  max_step_tokens: Optional[int] = None,
                  spec_k: int = 0, drafter=None,
+                 spec_dynamic: bool = False,
                  decode_steps: int = 1,
+                 decode_mode: str = "auto",
                  mesh=None, tracer=None):
         self.executor = executor
         self.input_name, self.logits_name = _resolve_io_names(
@@ -421,6 +440,8 @@ class ServingEngine:
             "serving.spec_step", spec_jit)
         self.spec_k = 0
         self.drafter = None
+        self.spec_dynamic = False
+        self._drafter_takes_eos = False
         self.n_spec_steps = 0       # verify dispatches run
         self.n_spec_chains = 0      # (slot, step) chains that emitted
         self.n_spec_drafted = 0     # draft tokens scored by the target
@@ -428,7 +449,8 @@ class ServingEngine:
         self.n_spec_tokens = 0      # tokens banked through chains —
                                     # == accepted + chains unless an eos
                                     # truncated a chain (reconciliation)
-        self.set_speculation(spec_k, drafter)
+        self.n_draft_steps = 0      # draft passes that proposed anything
+        self.set_speculation(spec_k, drafter, dynamic=spec_dynamic)
         # MULTI-STEP DECODE (the scanned step): when every live slot is in
         # pure-decode mode, step() runs ONE jitted lax.scan of
         # `decode_steps` identical per-step bodies over the donated
@@ -453,6 +475,18 @@ class ServingEngine:
         # stays honest across decode_steps settings (serving/server.py)
         self.cur_burst = 1
         self.set_decode_steps(decode_steps)
+        # DISPATCH POLICY (`decode_mode`): "auto" (the default) picks the
+        # best dispatch PER FLUSH WINDOW among what is configured — the
+        # spec verify step when any slot drafted (or prefill chunks are
+        # in flight), the k-step scan when the window is pure-decode and
+        # draft-free, the mixed step otherwise — so speculation and
+        # multi-step decode COMPOSE instead of excluding each other
+        # (drafting happens at the scan boundary, chains verify inside
+        # the verify dispatch).  "static" keeps the legacy exclusivity:
+        # spec_k > 0 disables the scan entirely.  A dispatch knob like
+        # decode_steps: emitted tokens are bit-identical either way.
+        self.decode_mode = "auto"
+        self.set_decode_mode(decode_mode)
         # token-budget observability: per-step scheduled-token histogram
         # and the pump-step gap decoding slots actually saw (ms) — the
         # HOL-blocking number chunking exists to bound.  Standalone
@@ -467,6 +501,18 @@ class ServingEngine:
             "serving_decode_gap_ms", "", (), _threading.Lock(),
             buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
                      2500, 5000))
+        # speculation observability: wall ms per draft pass (host lookup
+        # or the batched serving.draft_step dispatch — the overhead the
+        # accept rate must out-earn), and the CHOSEN per-slot draft depth
+        # at every propose opportunity (the dynamic-k policy's output —
+        # mass at 0 means slots degraded to plain decode)
+        self.draft_ms_hist = _Hist(
+            "serving_draft_ms", "", (), _threading.Lock(),
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                     250))
+        self.spec_k_hist = _Hist(
+            "serving_spec_k_effective", "", (), _threading.Lock(),
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
         self._t_prev_decode: Optional[float] = None
 
     # -- tensor-parallel sharding trees ------------------------------------
@@ -949,26 +995,37 @@ class ServingEngine:
             if not live:
                 return True        # pages freed; next step() re-admits
         if self.spec_k > 0:
-            # speculative mode: the drafter proposes per decoding slot;
-            # any drafts (or chunk rows) route through the verify step —
-            # a zero-draft pure-decode step keeps the cheap [S, 1]
-            # signature, so an unhelpful drafter costs nothing steady-
-            # state beyond the host-side lookup
+            # speculative mode: the drafter proposes per decoding slot
+            # (dynamic k may choose 0 for cold/low-accept slots); any
+            # drafts (or chunk rows) route through the verify step — a
+            # zero-draft pure-decode step keeps the cheap [S, 1] or
+            # scanned signature, so an unhelpful drafter costs nothing
+            # steady-state beyond the draft pass itself
             drafts = self._propose_drafts(runnable)
             if drafts or filling:
                 return self._run_spec_step(live, runnable, filling,
                                            drafts)
         elif filling:
+            # mixed prefill/decode load drops to the mixed step PER
+            # FLUSH WINDOW — a mid-flight admission is never stalled
+            # behind a k-step scan (the scan gate below is only ever
+            # reached with no prefill in flight)
             return self._run_mixed_step(live, runnable, filling)
 
-        if self.decode_steps > 1 and self.spec_k == 0 \
+        if self.decode_steps > 1 \
+                and (self.spec_k == 0 or self.decode_mode == "auto") \
                 and self._scan_window_ok(runnable, self.decode_steps):
             # pure-decode steady state with multi-step on: ONE scanned
             # dispatch advances every runnable slot up to k tokens.  Any
             # slot that cannot secure pages for its whole window drops
             # THIS dispatch back to the k=1 step below (progress without
             # livelock); mixed/spec steps never scan — the engine returns
-            # to the scanned path once it is pure-decode again.
+            # to the scanned path once it is pure-decode again.  Under
+            # decode_mode="auto" this is how speculation and multi-step
+            # COMPOSE: the drafter already had its say at this boundary
+            # (above) and proposed nothing, so the window is draft-free
+            # and the scan is the best remaining dispatch; "static"
+            # keeps the legacy spec_k > 0 exclusion.
             return self._run_scan_step(live, runnable, self.decode_steps)
 
         traced = self._tr_on()
@@ -1256,39 +1313,138 @@ class ServingEngine:
                 self._emit_first(s, tok0_of(s))
 
     # -- speculative decoding (docs/serving.md "Speculative decoding") ----
+    def _dyn_k(self, sl) -> int:
+        """Per-slot draft depth for this flush window.  Static mode:
+        always spec_k.  Dynamic mode (`spec_dynamic=True`): the slot's
+        accept-rate EWMA picks k_s ∈ {0..spec_k} — a cold slot pays a
+        ONE-row probe (not k wasted verify rows), a low-accept slot
+        decays to plain decode (k=0) with a paced k=1 re-probe every
+        `_PROBE_EVERY` windows so a workload that turns repetitive can
+        climb back, and a high-accept slot rides the full depth.  The
+        choice is host-side data (chain packing is ragged by row count),
+        so dynamic k adds ZERO verify-step signatures."""
+        if not self.spec_dynamic:
+            return self.spec_k
+        if sl.accept_ewma is None:
+            return min(1, self.spec_k)           # cold: cheapest probe
+        k = int(round(sl.accept_ewma * self.spec_k))
+        if k <= 0:
+            sl.probe_tick += 1
+            if sl.probe_tick >= _PROBE_EVERY:
+                sl.probe_tick = 0
+                return 1
+            return 0
+        return min(k, self.spec_k)
+
+    def _draft_ctx(self, s: int, W: int) -> np.ndarray:
+        """Slot `s`'s drafting context: the most recent W tokens of
+        prompt + generated, newest last — the drafter's search window's
+        tail, so the host cost stays O(window) per slot, not O(context)
+        as generation grows."""
+        sl = self.slots[s]
+        gen_tail = sl.generated[-W:]
+        need = W - len(gen_tail)
+        if need > 0 and sl.req.prompt_ids.size:
+            return np.concatenate(
+                [sl.req.prompt_ids[-need:],
+                 np.asarray(gen_tail, np.int32)])
+        return np.asarray(gen_tail, np.int32)
+
     def _propose_drafts(self, runnable) -> dict:
-        """Ask the drafter for up to `spec_k` lookahead tokens per
-        decoding slot (host side, between steps).  The per-slot cap is
-        exact-by-construction: a chain emits at most k+1 tokens, so k
-        never exceeds the tokens the request may still emit
+        """Ask the drafter for lookahead tokens per decoding slot (host
+        side, between steps — the scan/flush boundary).  The per-slot
+        cap is exact-by-construction: a chain emits at most k+1 tokens,
+        so k never exceeds the tokens the request may still emit
         (max_new - gen - 1), and the deepest draft write (pos + k) never
         exceeds slot capacity — the same `p + max_new - 2` bound
         validate() already guarantees pages for.  Empty proposals drop
-        out entirely (their slot rides the plain decode row)."""
+        out entirely (their slot rides the plain decode row or the
+        scanned window).
+
+        Drafters exposing `propose_batch` (ModelDrafter) get ALL slots'
+        windowed contexts in ONE call — one jitted [S, W] -> [S, spec_k]
+        dispatch at site `serving.draft_step`, ALWAYS at depth spec_k so
+        dynamic per-slot k (applied by host-side slicing) never mints a
+        new signature.  Per-slot `propose` drafters own the clamp
+        contract (<= k tokens, nothing past eos) — the tripwire below
+        fails loudly instead of silently truncating, so a drafter bug
+        can no longer masquerade as a low accept rate."""
         out = {}
+        if not runnable or self.spec_k <= 0:
+            return out
         cap = self.kv.capacity_tokens
-        # hand the drafter only its search window's tail — this runs on
-        # the pump thread between compiled steps, so the host cost must
-        # stay O(window) per slot, not O(context) as generation grows
         W = int(getattr(self.drafter, "window", 0)) or cap
+        want = {}
         for s in runnable:
             sl = self.slots[s]
-            k = min(self.spec_k, sl.req.max_new - sl.gen - 1,
+            k = min(self._dyn_k(sl), sl.req.max_new - sl.gen - 1,
                     cap - 1 - sl.pos)
-            if k <= 0:
-                continue
-            gen_tail = sl.generated[-W:]
-            need = W - len(gen_tail)
-            if need > 0 and sl.req.prompt_ids.size:
-                ctx = np.concatenate(
-                    [sl.req.prompt_ids[-need:],
-                     np.asarray(gen_tail, np.int32)])
-            else:
-                ctx = np.asarray(gen_tail, np.int32)
-            d = np.asarray(self.drafter.propose(ctx, k),
-                           np.int32).reshape(-1)
-            if d.size:
-                out[s] = d[:k]
+            self.spec_k_hist.observe(float(max(k, 0)))
+            if k > 0:
+                want[s] = k
+        if not want:
+            return out
+        traced = self._tr_on()
+        t0 = time.perf_counter()
+        if hasattr(self.drafter, "propose_batch"):
+            out = self._propose_batched(want, W)
+        else:
+            for s, k in want.items():
+                sl = self.slots[s]
+                ctx = self._draft_ctx(s, W)
+                if self._drafter_takes_eos:
+                    d = self.drafter.propose(ctx, k,
+                                             eos_id=sl.req.eos_id)
+                else:
+                    d = self.drafter.propose(ctx, k)
+                d = np.asarray(d, np.int32).reshape(-1)
+                assert d.size <= k, \
+                    f"drafter returned {d.size} tokens for k={k} — the " \
+                    f"clamp contract is the drafter's (see " \
+                    f"serving/drafter.py); truncating here would skew " \
+                    f"accept-rate stats"
+                if d.size:
+                    out[s] = d
+        dt = time.perf_counter() - t0
+        self.draft_ms_hist.observe(dt * 1e3)
+        if out:
+            self.n_draft_steps += 1
+            self.flight.record("draft_step", slots=len(out),
+                               drafter=self.drafter_kind,
+                               ms=round(dt * 1e3, 3))
+            if traced:
+                self.tracer.add("draft_step", t0, dt, track="engine",
+                                attrs={"slots": len(out),
+                                       "k": self.spec_k,
+                                       "drafter": self.drafter_kind})
+        return out
+
+    def _propose_batched(self, want: dict, W: int) -> dict:
+        """ONE batched draft dispatch for every drafting slot: assemble
+        the [S, W] windowed-context matrix (idle rows ride as length-1
+        zero rows — S is the engine's slot count, fixed, so the
+        draft-step signature is stable), call `propose_batch` at depth
+        spec_k, then slice each slot's row to ITS dynamic k and cut at
+        the -1 padding the drafter's eos clamp left."""
+        S = len(self.slots)
+        ctx = np.zeros((S, W), np.int32)
+        lens = np.ones(S, np.int32)
+        eos = np.full(S, -1, np.int32)
+        for s in want:
+            c = self._draft_ctx(s, W)
+            ctx[s, :c.size] = c[-W:]
+            lens[s] = max(int(c.size), 1)
+            eos[s] = int(self.slots[s].req.eos_id)
+        props = np.asarray(self.drafter.propose_batch(
+            ctx, lens, self.spec_k, eos_ids=eos))
+        out = {}
+        for s, k in want.items():
+            row = np.asarray(props[s, :k], np.int32).reshape(-1)
+            stop = np.flatnonzero(row < 0)       # -1 = post-eos padding
+            if stop.size:
+                row = row[:int(stop[0])]
+            if row.size:
+                out[s] = row
         return out
 
     def _run_spec_step(self, live, runnable, filling, drafts) -> bool:
@@ -1434,6 +1590,15 @@ class ServingEngine:
             nd = int(n_draft[s])
             self.n_spec_accepted += a
             self.n_spec_chains += 1
+            if self.spec_dynamic and nd:
+                # feed the slot's accept EWMA BEFORE banking may retire
+                # it — the next flush window's _dyn_k steers by this.
+                # Draft-free rows (nd == 0) carry no signal: skipped, so
+                # a k=0 slot's estimate moves only on its paced probes.
+                rate = a / nd
+                sl.accept_ewma = rate if sl.accept_ewma is None else \
+                    (1.0 - _EWMA_ALPHA) * sl.accept_ewma \
+                    + _EWMA_ALPHA * rate
             if nd:
                 rid = str(sl.req.req_id)
                 self._bump_attr(sl.req.req_id, "spec_drafted", nd)
@@ -1880,17 +2045,22 @@ class ServingEngine:
         return prefill_chunk + len(self.slots) * (
             int(getattr(self, "spec_k", 0)) + 1)
 
-    def set_speculation(self, spec_k: int, drafter=None) -> None:
+    def set_speculation(self, spec_k: int, drafter=None,
+                        dynamic: Optional[bool] = None) -> None:
         """Configure speculative decoding (idle engine only — a live
         chain would straddle the toggle).  `spec_k=0` disables — the
         baseline side of bench_serving's --spec-k A/B; `spec_k > 0`
         drafts up to k lookahead tokens per decoding slot per step
         (serving/drafter.py's prompt-lookup NgramDrafter by default;
-        pass `drafter` for anything with a `.propose(ctx, k)` — a small
-        draft model slots in here).  Emitted tokens are IDENTICAL either
-        way; only steps-per-token changes.  Each distinct (token budget,
+        pass `drafter` for anything with a `.propose(ctx, k)` — a
+        ModelDrafter slots in here and additionally gets the batched
+        `propose_batch` path).  Emitted tokens are IDENTICAL either way;
+        only steps-per-token changes.  Each distinct (token budget,
         spec_k) pair is ONE verify-step signature — hold both fixed in
-        production."""
+        production.  `dynamic=True` turns on the per-slot EWMA depth
+        policy (see `_dyn_k`); it changes HOST-side slicing only, so it
+        adds zero signatures and — by the verify step's exactness — zero
+        token differences."""
         assert all(sl is None for sl in self.slots) and not self.queue, \
             "set_speculation requires an idle engine"
         spec_k = int(spec_k)
@@ -1898,6 +2068,8 @@ class ServingEngine:
             raise ValueError(
                 f"spec_k must be >= 0 (0 = speculation off), got {spec_k}")
         self.spec_k = spec_k
+        if dynamic is not None:
+            self.spec_dynamic = bool(dynamic)
         if self.prefill_chunk is not None and not self._mst_explicit:
             # a DEFAULTED budget follows the speculation depth (chunk +
             # S*(k+1)): otherwise `--spec-k` deployments would silently
@@ -1911,6 +2083,45 @@ class ServingEngine:
         elif self.drafter is None and spec_k > 0:
             from paddle_tpu.serving.drafter import NgramDrafter
             self.drafter = NgramDrafter()
+        # the eos clamp rides propose(ctx, k, eos_id=...) — but drafters
+        # predate that parameter (tests and deployments define 2-arg
+        # propose), so sniff the signature ONCE here, not per proposal
+        self._drafter_takes_eos = False
+        if self.drafter is not None and \
+                not hasattr(self.drafter, "propose_batch"):
+            import inspect
+            try:
+                self._drafter_takes_eos = "eos_id" in \
+                    inspect.signature(self.drafter.propose).parameters
+            except (TypeError, ValueError):
+                self._drafter_takes_eos = False
+
+    @property
+    def drafter_kind(self) -> Optional[str]:
+        """The configured drafter's self-declared kind ("ngram",
+        "model", ... — stats/hello frames report it), or None."""
+        return getattr(self.drafter, "kind", None) \
+            if self.drafter is not None else None
+
+    def set_decode_mode(self, mode: str) -> None:
+        """Configure the step() dispatch policy (idle engine only, like
+        every dispatch knob).  "auto" (the default) picks per flush
+        window between the spec verify step, the pure-decode k-step
+        scan, and the mixed step — speculation and multi-step COMPOSE: a
+        window where the drafter proposes runs the verify step, a
+        draft-free pure-decode window runs the scan, and filling slots
+        drop to the mixed step so admissions never stall.  "static"
+        keeps the legacy exclusivity (spec_k > 0 disables the scan) for
+        apples-to-apples A/B against pre-auto behavior.  Tokens are
+        bit-identical across modes — this chooses dispatch shapes, never
+        content — which is also why checkpoints deliberately do not pin
+        it (restore composes with either mode, like decode_steps)."""
+        assert all(sl is None for sl in self.slots) and not self.queue, \
+            "set_decode_mode requires an idle engine"
+        if mode not in ("auto", "static"):
+            raise ValueError(
+                f"decode_mode must be 'auto' or 'static', got {mode!r}")
+        self.decode_mode = mode
 
     def set_decode_steps(self, decode_steps: int) -> None:
         """Configure multi-step decode (idle engine only — a live slot's
@@ -2063,7 +2274,12 @@ class ServingEngine:
                        "last_tok": int(sl.last_tok),
                        "generated": list(sl.generated),
                        "admit_seq": int(sl.admit_seq),
-                       "replay_until": int(sl.replay_until)}
+                       "replay_until": int(sl.replay_until),
+                       # dynamic-speculation estimate rides the slot: a
+                       # migrated replica keeps its learned per-slot k
+                       # instead of re-probing from cold
+                       "accept_ewma": sl.accept_ewma,
+                       "probe_tick": int(sl.probe_tick)}
                       for sl in self.slots],
             "queue": [req_snap(r) for r in self.queue],
             "prefix": prefix,
@@ -2075,7 +2291,7 @@ class ServingEngine:
                 "restore_tokens_saved", "n_prefill_chunks",
                 "n_mixed_steps", "n_spec_steps", "n_spec_chains",
                 "n_spec_drafted", "n_spec_accepted", "n_spec_tokens",
-                "n_scan_steps", "n_scan_flushes")},
+                "n_scan_steps", "n_scan_flushes", "n_draft_steps")},
             "results": {k: np.asarray(v).copy()
                         for k, v in self.results.items()},
             "finish_reasons": dict(self.finish_reasons),
@@ -2167,6 +2383,8 @@ class ServingEngine:
             sl.generated = list(d["generated"])
             sl.admit_seq = d["admit_seq"]
             sl.replay_until = d["replay_until"]
+            sl.accept_ewma = d.get("accept_ewma")
+            sl.probe_tick = int(d.get("probe_tick", 0))
         self.queue = deque(req_restore(d) for d in snap["queue"])
         if self.prefix is not None:
             self.prefix.clear()

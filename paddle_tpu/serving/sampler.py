@@ -36,6 +36,20 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def greedy_next(last: Array, is_probs: bool = False) -> Array:
+    """[..., V] scores -> [...] int32 — THE greedy pick, shared by the
+    per-slot sampler below and the serving drafters' chain rollouts
+    (serving/drafter.py ModelDrafter).  One definition matters because
+    ties: `jnp.argmax` breaks ties lowest-index-first, and a drafter
+    whose rollout broke them differently would mispredict exactly the
+    tokens the verify step then rejects — a silent accept-rate tax, not
+    a correctness bug (verification is exact either way).  `is_probs`
+    is accepted for interface symmetry with pick_next; log is monotonic,
+    so the argmax is the same and no transform is spent."""
+    del is_probs
+    return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+
 def pick_next_chain(last: Array, keys: Array, temperature: Array,
                     top_k: Array, top_p: Array,
                     is_probs: bool = False) -> Array:
@@ -75,7 +89,7 @@ def pick_next_per_slot(last: Array, keys: Array, temperature: Array,
     S, V = last.shape
     last = jnp.log(jnp.maximum(last.astype(jnp.float32), 1e-30)) \
         if is_probs else last.astype(jnp.float32)
-    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    greedy = greedy_next(last)
 
     def _sampled(_):
         t_safe = jnp.where(temperature > 0.0, temperature, 1.0)

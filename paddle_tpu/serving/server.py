@@ -263,8 +263,16 @@ class ServingServer:
                 ("serving_tp_shards", "gauge", None, float(eng.tp)),
                 ("serving_kv_pool_bytes_per_shard", "gauge", None,
                  float(eng.kv.pool_bytes_per_shard)),
+                # speculative drafting: the drafter's host+device wall
+                # per proposal pass and the per-slot chosen depth (the
+                # dynamic-k policy's OUTPUT — an operator reads this
+                # histogram to see whether the workload sustains depth)
+                ("serving_draft_steps_total", "counter", None,
+                 float(eng.n_draft_steps)),
             ] + eng.step_tokens_hist.samples() \
-              + eng.decode_gap_hist.samples()
+              + eng.decode_gap_hist.samples() \
+              + eng.draft_ms_hist.samples() \
+              + eng.spec_k_hist.samples()
 
         reg.register_collector(engine_state)
         reg.register_collector(statset_collector(
@@ -612,12 +620,21 @@ class ServingServer:
             "n_expired": eng.n_expired,
             "speculation": _safe(lambda: {
                 "spec_k": eng.spec_k,
+                "drafter": eng.drafter_kind,
+                "dynamic": bool(eng.spec_dynamic),
+                "draft_steps": eng.n_draft_steps,
                 "steps": eng.n_spec_steps,
                 "chains": eng.n_spec_chains,
                 "drafted": eng.n_spec_drafted,
                 "accepted": eng.n_spec_accepted,
                 "tokens": eng.n_spec_tokens,
                 "accept_rate": round(eng.spec_accept_rate, 4),
+                # per-slot dynamic-k state: the learned accept EWMA each
+                # live slot steers its draft depth by (null = cold/idle)
+                "slot_accept_ewma": [
+                    None if sl is None or sl.accept_ewma is None
+                    else round(float(sl.accept_ewma), 4)
+                    for sl in eng.slots],
             }),
             "prefix_cache": _safe(lambda: {
                 "enabled": eng.prefix is not None,
@@ -657,7 +674,10 @@ class ServingServer:
             "spill_bytes_budget": int(self.engine.kv.spill_bytes_budget),
             "tp_shards": int(self.engine.tp),
             "spec_k": int(self.engine.spec_k),
+            "spec_dynamic": bool(self.engine.spec_dynamic),
+            "drafter": self.engine.drafter_kind,
             "decode_steps": int(self.engine.decode_steps),
+            "decode_mode": self.engine.decode_mode,
             "wedge_threshold_s": self.wedge_threshold_s,
             "postmortem_dir": self.postmortem_dir,
         }
@@ -908,6 +928,8 @@ class ServingServer:
                 prefix_cache=self.engine.prefix is not None,
                 tp_shards=int(self.engine.tp),
                 spec_k=int(self.engine.spec_k),
+                spec_dynamic=bool(self.engine.spec_dynamic),
+                drafter=self.engine.drafter_kind,
                 draining=self._draining))
         elif t == "ping":
             conn.send({"type": "pong"})
@@ -1048,15 +1070,24 @@ class ServingServer:
             "max_step_tokens": eng.max_step_tokens,
             "prefill_chunks": eng.n_prefill_chunks,
             "mixed_steps": eng.n_mixed_steps,
-            # speculative decoding: the A/B-able knob + the counters the
-            # accept rate reconciles from
+            # speculative decoding: the A/B-able knobs + the counters the
+            # accept rate reconciles from, plus the adaptive state
+            # (drafter kind, dynamic-k flag, per-slot learned EWMAs)
             "spec_k": eng.spec_k,
+            "spec_drafter": eng.drafter_kind,
+            "spec_dynamic": bool(eng.spec_dynamic),
+            "spec_draft_steps": eng.n_draft_steps,
             "spec_drafted": eng.n_spec_drafted,
             "spec_accepted": eng.n_spec_accepted,
             "spec_accept_rate": round(eng.spec_accept_rate, 4),
-            # multi-step decode: the A/B-able knob + scan dispatch
+            "spec_slot_accept_ewma": [
+                None if sl is None or sl.accept_ewma is None
+                else round(float(sl.accept_ewma), 4)
+                for sl in eng.slots],
+            # multi-step decode: the A/B-able knobs + scan dispatch
             # counters (flushes = boundaries, steps = body iterations)
             "decode_steps_k": eng.decode_steps,
+            "decode_mode": eng.decode_mode,
             "scan_steps": eng.n_scan_steps,
             "scan_flushes": eng.n_scan_flushes,
             # sharding: model-axis shard count + per-device pool bytes

@@ -409,3 +409,121 @@ def test_scan_matches_under_model_parallel():
     assert eng.n_scan_flushes > 0
     eng.kv.check_reclaimed()
     tr.executor.mesh = None
+
+
+# ---------------------------------------------------------------------------
+# decode_mode=auto (PR 18): speculation and the scan COMPOSE per window
+# ---------------------------------------------------------------------------
+
+
+def test_auto_mode_composes_spec_and_scan(tr):
+    """With spec_k > 0 AND decode_steps > 1 under decode_mode=auto, the
+    per-window policy routes drafted windows through the verify step and
+    draft-free pure-decode windows through the scan — BOTH counters
+    advance in one run, tokens stay bit-exact against the plain engine
+    and the oracle, and the composition mints no extra scan or verify
+    signatures (one of each)."""
+    prompt = _prompts((10,), 61, seed=11)[0]
+
+    def mk_req():
+        return Request("c", prompt.copy(), max_new=20)
+
+    full = _oracle(tr, mk_req())
+
+    class ParityReplay:
+        """Deterministic in ctx: replays the greedy continuation when the
+        context length is even, proposes nothing when odd — so the engine
+        alternates between verified chains and draft-free scan windows."""
+
+        def propose(self, ctx, k):
+            n = ctx.size
+            if n % 2 == 0 and n < full.size and \
+                    np.array_equal(full[:n], ctx):
+                return full[n:n + k].astype(np.int32)
+            return np.zeros(0, np.int32)
+
+    base = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                         max_context=64)
+    res_plain = base.run([mk_req()])
+    cw = get_compile_watch()
+    scan0 = cw.signature_count("serving.scan_step")
+    spec0 = cw.signature_count("serving.spec_step")
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64, spec_k=2, decode_steps=3,
+                        decode_mode="auto", drafter=ParityReplay())
+    res = eng.run([mk_req()])
+    _assert_equal_results(res_plain, res, "auto spec x scan vs plain")
+    np.testing.assert_array_equal(full, np.asarray(res["c"]))
+    assert eng.n_spec_steps > 0, "no window ever took the verify step"
+    assert eng.n_scan_flushes > 0, \
+        "no draft-free window ever scanned — spec_k > 0 must not " \
+        "disable multi-step under decode_mode=auto"
+    assert eng.n_spec_accepted > 0, "the replay chains never accepted"
+    # per-engine: ONE scan program and ONE verify program carried the
+    # whole composed run.  (The compile-watch site counts are global
+    # and dedup identical signatures across tests, so they bound the
+    # delta at <= 1 rather than == 1.)
+    assert eng._scan_step._cache_size() == 1
+    assert eng._spec_step._cache_size() == 1
+    assert cw.signature_count("serving.scan_step") <= scan0 + 1
+    assert cw.signature_count("serving.spec_step") <= spec0 + 1, \
+        "composition minted extra verify signatures"
+    eng.kv.check_reclaimed()
+
+
+def test_static_mode_keeps_legacy_exclusivity(tr):
+    """decode_mode=static restores the old behavior: spec_k > 0 disables
+    the scan entirely (the A/B control arm), with identical tokens."""
+    prompt = _prompts((8,), 61, seed=12)[0]
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=8,
+                        max_context=64, spec_k=2, decode_steps=3,
+                        decode_mode="static")
+    res = eng.run([Request("s", prompt.copy(), max_new=12)])
+    np.testing.assert_array_equal(
+        _oracle(tr, Request("s", prompt.copy(), max_new=12)),
+        np.asarray(res["s"]))
+    assert eng.n_scan_flushes == 0, \
+        "static mode must keep the spec-xor-scan exclusivity"
+    # the idle toggle flips the policy without rebuilding the engine
+    eng.set_decode_mode("auto")
+    assert eng.decode_mode == "auto"
+    with pytest.raises(ValueError, match="decode_mode"):
+        eng.set_decode_mode("sometimes")
+
+
+def test_admission_never_stalls_behind_scan(tr):
+    """The adaptive fallback regression (PR 18 satellite): a request
+    admitted MID-FLIGHT while the engine is in scanned steady state must
+    start chunk-prefilling on the very next dispatch — the window falls
+    back to mixed/verify scheduling instead of making the prompt wait
+    out k-step scan windows.  Checked with speculation on (auto mode)
+    AND off: no scan flush may occur while a prompt is mid-prefill."""
+    for spec_k in (0, 2):
+        eng = ServingEngine(tr.executor, tr.params, num_slots=2,
+                            page_size=8, max_context=64, prefill_chunk=8,
+                            decode_steps=4, decode_mode="auto",
+                            spec_k=spec_k)
+        short, long_ = _prompts((5, 30), 61, seed=13)
+        eng.add_request(Request("short", short, max_new=24))
+        # reach scanned steady state before the mid-flight admission
+        while eng.n_scan_flushes == 0:
+            assert eng.step(), "never reached the scan steady state"
+        eng.add_request(Request("long", long_, max_new=4))
+        chunks0, flushes0 = eng.n_prefill_chunks, eng.n_scan_flushes
+        eng.step()
+        assert eng.n_prefill_chunks > chunks0, \
+            f"spec_k={spec_k}: the admitted prompt's first chunk did " \
+            f"not ride the NEXT dispatch after admission"
+        while any(sl is not None and sl.gen == 0
+                  for sl in eng.slots if sl is not None):
+            assert eng.n_scan_flushes == flushes0, \
+                f"spec_k={spec_k}: a k-step scan ran while a prompt " \
+                f"was mid-prefill (admission stalled behind the scan)"
+            eng.step()
+        res = eng.run()
+        for r in (Request("short", short.copy(), max_new=24),
+                  Request("long", long_.copy(), max_new=4)):
+            np.testing.assert_array_equal(
+                _oracle(tr, r), np.asarray(res[r.req_id]),
+                err_msg=f"spec_k={spec_k}: {r.req_id} diverged")
+        eng.kv.check_reclaimed()

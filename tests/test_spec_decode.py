@@ -458,3 +458,278 @@ def test_uncommit_tail_releases_only_private_tail_pages(tr):
     assert kv.uncommit_tail(0, 6) == 0      # idempotent at the boundary
     kv.release(0)
     kv.check_reclaimed()
+
+
+# ---------------------------------------------------------------------------
+# adaptive speculation (PR 18): model drafter, dynamic k, the clamp contract
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.obs.compile_watch import get_compile_watch
+from paddle_tpu.serving.drafter import ModelDrafter, clamp_proposal
+
+
+def test_clamp_proposal_contract():
+    """The drafter-side clamp unit: at most k tokens, truncated just
+    AFTER the first eos (a drafted eos may retire the slot; tokens past
+    it could never be banked), eos_id=-1 disables the eos cut, and
+    degenerate inputs stay empty."""
+    d = np.asarray([4, 5, 6, 7, 8], np.int32)
+    np.testing.assert_array_equal(clamp_proposal(d, 3), [4, 5, 6])
+    # eos mid-proposal: keep the eos, drop everything after
+    np.testing.assert_array_equal(clamp_proposal(d, 5, eos_id=6), [4, 5, 6])
+    # eos beyond the k cut: the k clamp applies first
+    np.testing.assert_array_equal(clamp_proposal(d, 2, eos_id=6), [4, 5])
+    # no eos sentinel: untouched besides the k cap
+    np.testing.assert_array_equal(clamp_proposal(d, 9, eos_id=-1), d)
+    assert clamp_proposal(d, 0).size == 0
+    assert clamp_proposal(np.zeros(0, np.int32), 4, eos_id=2).size == 0
+
+
+def test_ngram_drafter_never_proposes_past_eos():
+    """The eos clamp reaches the default drafter: a looked-up
+    continuation containing eos truncates just after it — the bug class
+    the engine's tripwire exists for (proposals past eos / past k used
+    to be silently truncated, skewing accept-rate stats)."""
+    d = NgramDrafter(max_ngram=2, min_ngram=1)
+    # trailing [5, 6] last occurred early; its continuation is [9, 3, 8]
+    ctx = np.asarray([5, 6, 9, 3, 8, 2, 5, 6], np.int32)
+    np.testing.assert_array_equal(d.propose(ctx, 3), [9, 3, 8])
+    # same lookup with eos=3: the proposal cuts just AFTER the eos
+    np.testing.assert_array_equal(d.propose(ctx, 3, eos_id=3), [9, 3])
+    # eos as the first continuation token: a one-token proposal
+    np.testing.assert_array_equal(d.propose(ctx, 3, eos_id=9), [9])
+
+
+def test_engine_asserts_on_drafter_clamp_violation(tr):
+    """A drafter that violates the clamp contract (returns more than k
+    tokens) trips the engine's assert instead of being silently
+    truncated — a drafter bug must fail loudly, not masquerade as a low
+    accept rate."""
+    class Overlong:
+        def propose(self, ctx, k):
+            return np.zeros(k + 2, np.int32)
+
+    rng = np.random.default_rng(14)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, spec_k=2, drafter=Overlong())
+    with pytest.raises(AssertionError, match="clamp contract"):
+        eng.run([Request("r", _rep_prompt(rng, 23, 8), max_new=6)])
+
+
+def test_model_drafter_self_spec_exact_and_one_signature(tr):
+    """Self-speculation end to end: ModelDrafter.from_target drafting
+    for ALL slots in one batched dispatch, with dynamic k and
+    decode_mode=auto on — tokens bit-identical to the spec-off engine
+    and the lm_generate oracle across all four sampling modes, the
+    accept path genuinely exercised (greedy self-drafts agree with the
+    greedy target), and EXACTLY ONE serving.draft_step signature for
+    the whole workload (dynamic k rides as data)."""
+    rng = np.random.default_rng(0)
+    knobs = [dict(), dict(temperature=0.8, top_k=5),
+             dict(temperature=0.7, top_p=0.9), dict(temperature=1.1)]
+
+    def reqs():
+        return [Request(f"m{i}", _rep_prompt(np.random.default_rng(200 + i),
+                                             23, 9 + 2 * i),
+                        max_new=8, rng=jax.random.PRNGKey(60 + i), **kw)
+                for i, kw in enumerate(knobs)]
+
+    kw = dict(num_slots=2, page_size=4, max_context=32)
+    base = ServingEngine(tr.executor, tr.params, **kw).run(reqs())
+    cw = get_compile_watch()
+    sigs0 = cw.signature_count("serving.draft_step")
+    verify0 = cw.signature_count("serving.spec_step")
+    eng = ServingEngine(
+        tr.executor, tr.params, spec_k=3, spec_dynamic=True,
+        drafter=ModelDrafter.from_target(tr.executor, tr.params, window=16),
+        **kw)
+    spec = eng.run(reqs())
+    assert set(base) == set(spec)
+    for k in base:
+        np.testing.assert_array_equal(base[k], spec[k], err_msg=str(k))
+    _assert_exact(tr, reqs(), spec)
+    assert eng.drafter_kind == "model"
+    assert eng.n_draft_steps > 0 and eng.n_spec_accepted > 0, \
+        "self-speculation never accepted a draft — greedy agreement " \
+        "with the target should be near-certain"
+    assert cw.signature_count("serving.draft_step") == sigs0 + 1, \
+        "the batched draft dispatch must be ONE signature per (S, k)"
+    assert cw.signature_count("serving.spec_step") - verify0 <= 1, \
+        "dynamic k minted extra verify signatures — variable k must " \
+        "ride as data"
+    _assert_sigs(eng)
+    eng.kv.check_reclaimed()
+
+
+def test_model_drafter_law_across_ten_keys(tr):
+    """The distributional-law matrix with the MODEL drafter: across 10
+    rng keys (full-distribution and peaked alternating), the adaptive
+    engine (model drafts + dynamic k) emits EXACTLY what lm_generate
+    samples with the same key schedule — adaptivity never warps the
+    sampling law."""
+    rng = np.random.default_rng(15)
+    prompt = _rep_prompt(rng, 23, 10)
+    eng = ServingEngine(
+        tr.executor, tr.params, num_slots=2, page_size=4, max_context=32,
+        spec_k=3, spec_dynamic=True,
+        drafter=ModelDrafter.from_target(tr.executor, tr.params, window=16))
+    accepted_any = 0
+    for seed in range(10):
+        temp = 1.0 if seed % 2 else 0.05
+        r = Request(f"k{seed}", prompt.copy(), max_new=7,
+                    temperature=temp, rng=jax.random.PRNGKey(seed))
+        a0 = eng.n_spec_accepted
+        got = eng.run([r])[r.req_id]
+        accepted_any += eng.n_spec_accepted - a0
+        np.testing.assert_array_equal(
+            _oracle(tr, r), got,
+            err_msg=f"key {seed} (temp {temp}): adaptive speculation "
+                    f"diverged from lm_generate's sampling law")
+    assert accepted_any > 0
+
+
+def test_dynamic_k_rises_to_full_depth_under_oracle_drafter(tr):
+    """Dynamic-k convergence, favorable direction: an oracle drafter
+    (accept rate 1.0) starts at the cold one-row probe and the EWMA
+    drives k_s to the full spec_k — and the tokens stay exact."""
+    rng = np.random.default_rng(16)
+    prompt = _rep_prompt(rng, 23, 6)
+    probe = Request("probe", prompt.copy(), max_new=16)
+    full = _oracle(tr, probe)
+
+    class Replay:
+        def propose(self, ctx, k):
+            n = ctx.size
+            if n < full.size and np.array_equal(full[:n], ctx):
+                return full[n:n + k].astype(np.int32)
+            return np.zeros(0, np.int32)
+
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, spec_k=4, spec_dynamic=True,
+                        drafter=Replay())
+    eng.add_request(Request("o", prompt.copy(), max_new=16))
+    ks = []
+    while eng.step():
+        for sl in eng.slots:
+            if sl is not None and sl.accept_ewma is not None:
+                ks.append(eng._dyn_k(sl))
+    got = eng.results["o"]
+    np.testing.assert_array_equal(full, got)
+    assert eng.spec_accept_rate == 1.0
+    assert ks and max(ks) == eng.spec_k, \
+        f"EWMA never drove k to full depth (saw {sorted(set(ks))})"
+    assert ks[-1] == eng.spec_k, "k did not STAY at full depth"
+
+
+def test_dynamic_k_decays_to_plain_decode_under_adversarial_drafter(tr):
+    """Dynamic-k convergence, hostile direction: an always-wrong drafter
+    decays the slot to k=0 (plain decode — zero wasted verify rows)
+    after the cold probe rejects, leaving only the paced re-probe; the
+    engine must spend almost nothing on drafts while staying exact."""
+    rng = np.random.default_rng(17)
+
+    class Wrong:
+        def propose(self, ctx, k):
+            return np.zeros(k, np.int32)     # token 0 is never emitted
+
+    r = Request("w", _rep_prompt(rng, 23, 5), max_new=20)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, spec_k=3, spec_dynamic=True,
+                        drafter=Wrong())
+    eng.add_request(r)
+    saw_zero = False
+    while eng.step():
+        for sl in eng.slots:
+            if sl is not None and sl.accept_ewma is not None:
+                saw_zero |= (int(round(sl.accept_ewma * eng.spec_k)) == 0)
+    _assert_exact(tr, [r], dict(eng.results))
+    assert saw_zero, "the EWMA never decayed the slot to k=0"
+    assert eng.n_spec_accepted == 0
+    # cold probe (1 token) + at most one paced re-probe over 19 windows
+    # (_PROBE_EVERY = 16) + slack: nowhere near 19 * k = 57 static waste
+    assert eng.n_spec_drafted <= 4, \
+        f"dynamic k kept drafting against a 0.0 accept rate " \
+        f"({eng.n_spec_drafted} drafted)"
+    assert eng.n_spec_steps <= 4, "most windows should be PLAIN decode"
+
+
+def test_model_drafter_tp_model2_stays_exact():
+    """Adaptive speculation composes with tensor parallelism: a model=2
+    engine with the batched model drafter + dynamic k is
+    token-for-token the single-device spec-off engine.  The drafter's
+    replication contract holds regardless of construction order (it
+    snapshots a mesh-free executor), and the draft program stays ONE
+    signature."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest provides 8)")
+    from paddle_tpu.parallel.mesh import model_mesh
+
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=61,dim=32,layers=2,heads=4,batch_size=4")
+    tr2 = Trainer(cfg, seed=3)
+    rng = np.random.default_rng(18)
+    prompts = [_rep_prompt(rng, 61, n) for n in (8, 13, 6)]
+    knobs = [dict(), dict(temperature=0.8, top_k=5), dict(temperature=1.1)]
+    reqs = lambda: [Request(i, p.copy(), max_new=6,
+                            rng=jax.random.PRNGKey(80 + i), **kw)
+                    for i, (p, kw) in enumerate(zip(prompts, knobs))]
+    kw = dict(num_slots=2, page_size=8, max_context=64)
+    tr2.executor.mesh = None
+    base = ServingEngine(tr2.executor, tr2.params, **kw).run(reqs())
+    tr2.executor.mesh = None
+    # drafter built BEFORE the engine stamps the mesh — the ordering
+    # serve.py uses; the mesh-free snapshot must hold anyway
+    drafter = ModelDrafter.from_target(tr2.executor, tr2.params, window=16)
+    cw = get_compile_watch()
+    sigs0 = cw.signature_count("serving.draft_step")
+    eng = ServingEngine(tr2.executor, tr2.params, spec_k=3,
+                        spec_dynamic=True, drafter=drafter,
+                        mesh=model_mesh(2), **kw)
+    spec = eng.run(reqs())
+    for k in base:
+        np.testing.assert_array_equal(
+            base[k], spec[k],
+            err_msg=f"request {k!r} diverged between single-device "
+                    f"sequential and model=2 adaptive speculation")
+    assert eng.tp == 2 and eng.n_draft_steps > 0
+    assert cw.signature_count("serving.draft_step") == sigs0 + 1
+    _assert_sigs(eng)
+    tr2.executor.mesh = None
+
+
+def test_set_speculation_dynamic_toggle_and_state_roundtrip(tr):
+    """set_speculation(k, drafter, dynamic) is the idle A/B surface for
+    the whole adaptive matrix, and the per-slot EWMA state rides
+    checkpoint/restore (a restored engine resumes the learned depths
+    instead of re-probing cold)."""
+    rng = np.random.default_rng(19)
+    eng = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32)
+    assert not eng.spec_dynamic
+    eng.set_speculation(3, dynamic=True)
+    assert eng.spec_k == 3 and eng.spec_dynamic
+    eng.set_speculation(3, dynamic=False)
+    assert not eng.spec_dynamic
+    eng.set_speculation(
+        2, drafter=ModelDrafter.from_target(tr.executor, tr.params,
+                                            window=16), dynamic=True)
+    assert eng.drafter_kind == "model" and eng.spec_dynamic
+    # roundtrip: a mid-flight snapshot carries accept_ewma/probe_tick
+    eng.add_request(Request("r", _rep_prompt(rng, 23, 8), max_new=8))
+    for _ in range(3):
+        eng.step()
+    sl = next(s for s in eng.slots if s is not None)
+    sl.probe_tick = 5                      # make the value distinctive
+    snap = eng.checkpoint_state()
+    eng2 = ServingEngine(tr.executor, tr.params, num_slots=2, page_size=4,
+                        max_context=32, spec_k=2, spec_dynamic=True,
+                        drafter=ModelDrafter.from_target(
+                            tr.executor, tr.params, window=16))
+    eng2.restore_state(snap)
+    sl2 = next(s for s in eng2.slots if s is not None)
+    assert sl2.accept_ewma == sl.accept_ewma
+    assert sl2.probe_tick == 5
+    assert eng2.n_draft_steps == eng.n_draft_steps
+    got = eng2.run()["r"]
+    _assert_exact(tr, [Request("r", _rep_prompt(
+        np.random.default_rng(19), 23, 8), max_new=8)], {"r": got})

@@ -630,6 +630,150 @@ def measure_spec(eng, wl: dict, reps: int, seed: int, spec_k: int) -> dict:
     }
 
 
+def measure_spec_modes(eng, wl: dict, hwl: dict, reps: int, seed: int,
+                       spec_k: int, scan_k: int = 2,
+                       tol: float = 0.85) -> dict:
+    """Adaptive-speculation A/B on ONE engine: every drafter/depth/mode
+    configuration over the SAME two workloads (fresh Request objects per
+    pass, same seeds), all through idle-engine knob flips so the
+    signature sets stay fixed.  Emitted tokens are identical in every
+    arm by construction (greedy, exact verification), so the deltas are
+    accept rate, steps-per-token and wall time.
+
+    Workloads: `wl` is the locally-repetitive motif workload speculation
+    targets; `hwl` is the heavy-tail NON-repetitive workload where a
+    prompt-lookup drafter finds nothing — the separation the
+    model-vs-ngram accept A/B exists to show (a draft MODEL still agrees
+    with the target there; self-speculation maximally so).
+
+    Arms (median tok/s over `reps` passes each):
+      off_rep     spec 0, steps 1        — sequential baseline
+      ngram_rep   spec K, ngram, static  — the PR-12 configuration
+      model_rep   spec K, model, static  — batched draft-model dispatch
+      scan_heavy  spec 0, steps scan_k   — multi-step baseline
+      ngram_heavy / model_heavy          — the accept-rate A/B
+      auto_rep / auto_heavy              — spec K model + dynamic k +
+                                           decode_steps scan_k, mode auto
+
+    Gates: `accept_model_gt_ngram` (strict, heavy-tail — the drafter
+    upgrade's existence proof), `auto_ok_rep` / `auto_ok_heavy` (auto >=
+    `tol` x `decode_mode=static` with the SAME spec/scan knobs — the
+    pre-choice auto removes must never have been the better choice; tol
+    absorbs CPU-host timing noise — at small rehearse scales the
+    same-knob ratio sits near 0.9 with several-percent jitter, so the
+    default leaves real margin), `sig_stable` (ONE draft signature
+    across every model arm, verify/scan signatures unmoved by
+    dynamic/auto) and `reconcile_ok` (every arm emitted exactly
+    reps * n * max_new tokens).  The spec-OFF medians ride along
+    unguarded: on a CPU host the draft rollout costs as much as the
+    target step it saves, so spec-on wall time trails spec-off there —
+    the same dispatch-bound caveat as the multi-step bench (PERF.md
+    'Reading the multi-step bench'); the hardware queue carries the
+    real comparison."""
+    import numpy as np
+
+    from paddle_tpu.serving.drafter import ModelDrafter, NgramDrafter
+
+    def rep_sets():
+        return [make_repetitive_requests(seed=seed + 1 + r, **wl)
+                for r in range(reps)]
+
+    def heavy_sets():
+        return [make_heavytail_requests(seed=seed + 101 + r, **hwl)
+                for r in range(reps)]
+
+    S = len(eng.slots)
+    if eng.prefill_chunk is not None:
+        eng.set_chunking(eng.prefill_chunk,
+                         eng.prefill_chunk + S * (spec_k + 1))
+    # self-speculation from the ENGINE's own executor/params: the
+    # strongest drafter available without a training run, and exactly
+    # what `--drafter model` deploys
+    model = ModelDrafter.from_target(eng.executor, eng.params)
+    ngram = NgramDrafter()
+
+    def arm(sets_fn, k, drafter, dynamic, steps, mode):
+        eng.set_speculation(k, drafter=drafter, dynamic=dynamic)
+        eng.set_decode_steps(steps)
+        eng.set_decode_mode(mode)
+        warm_workload(eng, sets_fn()[:1])
+        d0, a0 = eng.n_spec_drafted, eng.n_spec_accepted
+        c0 = eng.n_spec_chains
+        vals, toks = [], 0
+        for reqs in sets_fn():
+            rec = run_workload(eng, reqs)
+            vals.append(rec["tokens"] / rec["seconds"])
+            toks += rec["tokens"]
+        drafted = eng.n_spec_drafted - d0
+        chains = eng.n_spec_chains - c0
+        return {
+            "tok_per_sec": float(np.median(vals)),
+            "accept_rate": ((eng.n_spec_accepted - a0) / drafted
+                            if drafted else 0.0),
+            # mean drafted per chain = the depth the policy actually
+            # ran at (k=0 windows draft nothing and open no chain)
+            "effective_k": drafted / chains if chains else 0.0,
+            "tokens": int(toks),
+        }
+
+    arms = {
+        "off_rep": arm(rep_sets, 0, None, False, 1, "static"),
+        "ngram_rep": arm(rep_sets, spec_k, ngram, False, 1, "static"),
+        "model_rep": arm(rep_sets, spec_k, model, False, 1, "static"),
+        "scan_heavy": arm(heavy_sets, 0, None, False, scan_k, "static"),
+        "ngram_heavy": arm(heavy_sets, spec_k, ngram, False, 1, "static"),
+        "model_heavy": arm(heavy_sets, spec_k, model, False, 1, "static"),
+        "static_rep": arm(rep_sets, spec_k, model, True, scan_k,
+                          "static"),
+        "static_heavy": arm(heavy_sets, spec_k, model, True, scan_k,
+                            "static"),
+        "auto_rep": arm(rep_sets, spec_k, model, True, scan_k, "auto"),
+        "auto_heavy": arm(heavy_sets, spec_k, model, True, scan_k,
+                          "auto"),
+    }
+    eng.kv.check()
+    from paddle_tpu.obs.compile_watch import get_compile_watch
+    draft_sigs = get_compile_watch().signature_count("serving.draft_step")
+    best_rep = max(arms[a]["tok_per_sec"]
+                   for a in ("off_rep", "ngram_rep", "model_rep"))
+    best_heavy = max(arms[a]["tok_per_sec"]
+                     for a in ("scan_heavy", "ngram_heavy",
+                               "model_heavy"))
+    out = {
+        "spec_k": int(spec_k), "scan_k": int(scan_k),
+        "max_step_tokens": int(eng.max_step_tokens),
+        "accept_model_gt_ngram": (arms["model_heavy"]["accept_rate"]
+                                  > arms["ngram_heavy"]["accept_rate"]),
+        "auto_ok_rep": (arms["auto_rep"]["tok_per_sec"]
+                        >= tol * arms["static_rep"]["tok_per_sec"]),
+        "auto_ok_heavy": (arms["auto_heavy"]["tok_per_sec"]
+                          >= tol * arms["static_heavy"]["tok_per_sec"]),
+        "best_static_rep_tok_per_sec": best_rep,
+        "best_static_heavy_tok_per_sec": best_heavy,
+        # ONE batched draft program serves every model arm — dynamic k
+        # and auto mode slice host-side, they never re-lower
+        "sig_stable": (draft_sigs == 1
+                       and eng._spec_step._cache_size() == 1
+                       and eng._decode_step._cache_size() == 1),
+        "reconcile_ok": all(
+            a["tokens"] == reps * w["n"] * w["max_new"]
+            for a, w in ((arms[n], wl) for n in
+                         ("off_rep", "ngram_rep", "model_rep",
+                          "auto_rep"))) and all(
+            arms[n]["tokens"] == reps * hwl["n"] * hwl["max_new"]
+            for n in ("scan_heavy", "ngram_heavy", "model_heavy",
+                      "auto_heavy")),
+    }
+    for name, a in arms.items():
+        out[f"{name}_tok_per_sec"] = a["tok_per_sec"]
+        out[f"{name}_accept_rate"] = round(a["accept_rate"], 4)
+        out[f"{name}_effective_k"] = round(a["effective_k"], 3)
+    out["ok"] = (out["accept_model_gt_ngram"] and out["auto_ok_rep"]
+                 and out["auto_ok_heavy"] and out["sig_stable"]
+                 and out["reconcile_ok"])
+    return out
+
+
 def measure_scan(eng, wl: dict, reps: int, seed: int, k: int) -> dict:
     """Multi-step decode A/B on ONE engine: the identical mixed-length
     workload (fresh Request objects each pass, same seeds) at
@@ -1214,6 +1358,18 @@ def main() -> int:
                          "off then on at K drafts/slot/step (reports "
                          "tok/s both arms, accept rate, drafted/"
                          "accepted counters reconciled to tokens)")
+    ap.add_argument("--drafter", choices=["ngram", "model"],
+                    default="ngram",
+                    help="with --spec-k: 'model' runs the adaptive-"
+                         "speculation matrix instead of the plain A/B — "
+                         "ngram vs batched draft-model (self-speculation)"
+                         " vs decode_mode=auto arms on repetitive AND "
+                         "heavy-tail workloads, with the model-vs-ngram "
+                         "accept-rate gate")
+    ap.add_argument("--spec-dynamic", action="store_true",
+                    help="with --spec-k: enable the per-slot dynamic-k "
+                         "policy in the auto arms (implies the adaptive "
+                         "matrix, like --drafter model)")
     # multi-step decode A/B (docs/serving.md "Multi-step decode"):
     # decode_steps=1 vs ONE scanned dispatch of K decode bodies
     ap.add_argument("--decode-steps", type=int, default=0, metavar="K",
@@ -1270,6 +1426,36 @@ def main() -> int:
                 "router_retries", "trace_off_tok_per_sec",
                 "trace_on_tok_per_sec", "trace_overhead_spread_pct",
                 "ok", "failures")},
+        }), flush=True)
+        return 0 if m["ok"] else 1
+
+    if args.spec_k > 0 and (args.drafter == "model" or args.spec_dynamic):
+        eng = build_engine(args)
+        hi = min(args.prompt_hi, args.max_context - args.max_new - 1)
+        wl = dict(n=args.num_requests, prompt_lo=args.prompt_lo,
+                  prompt_hi=hi, max_new=args.max_new, vocab=args.vocab)
+        hwl = dict(wl)
+        m = measure_spec_modes(eng, wl, hwl, args.reps, args.seed,
+                               args.spec_k)
+        print(json.dumps({
+            "bench": "serving_spec_modes",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prompt_lens": [args.prompt_lo, hi], "max_new": args.max_new,
+            "dim": args.dim, "layers": args.layers, "dtype": args.dtype,
+            "reps": args.reps, "drafter": "model",
+            "spec_dynamic": True,
+            "lm_serving_spec_model_tok_per_sec":
+                round(m["model_rep_tok_per_sec"], 1),
+            "lm_serving_spec_auto_tok_per_sec":
+                round(m["auto_rep_tok_per_sec"], 1),
+            "lm_serving_spec_effective_k":
+                round(m["auto_rep_effective_k"], 3),
+            "lm_serving_spec_model_accept_rate_heavy":
+                m["model_heavy_accept_rate"],
+            "lm_serving_spec_ngram_accept_rate_heavy":
+                m["ngram_heavy_accept_rate"],
+            **{k: m[k] for k in sorted(m)},
         }), flush=True)
         return 0 if m["ok"] else 1
 

@@ -10,10 +10,13 @@ head's pages on every chip) or an attention projection would silently
 forfeit both the HBM win (a model bigger than one chip) and the FLOPs win
 (decode faster than one chip) that sharding exists for.
 
-This tool compiles the REAL engine's decode, mixed, speculative-verify
-AND multi-step scan programs (the lax.scan of k decode bodies — its
-body appears ONCE in the HLO, as a while loop, so the all-reduce count
-must match a single body, not k of them) over an N-device mesh,
+This tool compiles the REAL engine's decode, mixed, speculative-verify,
+multi-step scan AND batched draft programs (the lax.scan of k decode
+bodies — its body appears ONCE in the HLO, as a while loop, so the
+all-reduce count must match a single body, not k of them; the
+ModelDrafter's draft step must lower with ZERO collectives — its params
+are replicated by contract, so any cross-device op means the
+replication boundary broke) over an N-device mesh,
 inventories every collective in the optimized HLO, flags
 any all-gather whose shape+gather-dim matches a KV pool (kv-head axis),
 an attention projection, a Megatron-split FFN weight, or the row-sharded
@@ -152,6 +155,20 @@ def run_check(model: int = 2, config_args: str = "vocab=61,dim=32,"
     hlo_scan = eng._scan_step_fn().lower(
         scan_k, eng.params, eng._build_state(), eng._d_run,
         eng._d_eos, eng._d_maxnew).compile().as_text()
+    # the batched DRAFT step (ModelDrafter): the drafter's replication
+    # contract says it holds host/replicated params and compiles with
+    # ZERO collectives under any mesh — drafting must never add
+    # cross-device traffic to the verify step it feeds.  Self-spec
+    # (from_target) is the strongest case: the TARGET's weights, which
+    # the engine DID shard — proving its draft program still lowers
+    # collective-free shows the replication boundary holds.
+    from paddle_tpu.serving.drafter import ModelDrafter
+    drafter = ModelDrafter.from_target(tr.executor, tr.params, window=16)
+    draft_k = 2
+    hlo_draft = drafter._step.lower(
+        drafter.params,
+        np.zeros((len(eng.slots), drafter.window + draft_k), np.int32),
+        np.ones(len(eng.slots), np.int32), draft_k).compile().as_text()
 
     # the ONLY acceptable collectives: one post-attention all-reduce per
     # attention layer (Megatron w_o row split), one per sharded FFN pair
@@ -164,19 +181,27 @@ def run_check(model: int = 2, config_args: str = "vocab=61,dim=32,"
            "sharded_params": params_sharded,
            "ffn_pairs_sharded": len(eng._tp_ffn_pairs),
            "lm_head_sharded": bool(eng._tp_lm_head),
-           "scan_decode_steps": scan_k, "steps": {}}
+           "scan_decode_steps": scan_k,
+           "draft": {"window": drafter.window, "k": draft_k,
+                     "kind": drafter.kind}, "steps": {}}
     bad = []
     for step, hlo in (("decode", hlo_decode), ("mixed", hlo_mixed),
-                      ("spec", hlo_spec), ("scan", hlo_scan)):
+                      ("spec", hlo_spec), ("scan", hlo_scan),
+                      ("draft", hlo_draft)):
         colls, gathers, reduces = _collectives(hlo)
         table_gathers = [ln[:200] for ln in gathers
                         if gather_spans_table(ln, tables)]
         bad += table_gathers
+        if step == "draft" and colls:
+            # the draft program's bar is stricter than shape-anchoring:
+            # ANY collective means the replicated-drafter contract broke
+            bad += [f"draft-step collective: {op} x{n}"
+                    for op, n in colls.items()]
         out["steps"][step] = {
             "collectives": colls,
             "n_all_gathers": len(gathers),
             "n_all_reduces": len(reduces),
-            "expected_all_reduces": n_expected,
+            "expected_all_reduces": 0 if step == "draft" else n_expected,
             "table_all_gathers": table_gathers,
         }
         if save:

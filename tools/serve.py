@@ -112,10 +112,18 @@ def build_engine(args):
             print(f"sharded decode: model={int(num)} "
                   f"(attention heads + KV pools partitioned)",
                   file=sys.stderr)
+    drafter = None
     if args.spec_k > 0:
+        if args.drafter == "model":
+            # self-speculation: the target drafts for itself over a
+            # truncated window, batched across all slots in one
+            # dispatch — zero extra weights to load or train
+            from paddle_tpu.serving.drafter import ModelDrafter
+            drafter = ModelDrafter.from_target(tr.executor, tr.params)
+        dyn = " (dynamic per-slot k)" if args.spec_dynamic else ""
         print(f"speculative decoding: up to {args.spec_k} drafts/slot/"
-              f"step (prompt-lookup drafter; emitted tokens unchanged)",
-              file=sys.stderr)
+              f"step ({args.drafter} drafter{dyn}; emitted tokens "
+              f"unchanged)", file=sys.stderr)
     if args.decode_steps > 1:
         print(f"multi-step decode: {args.decode_steps} scanned decode "
               f"bodies per dispatch when pure-decode (emitted tokens "
@@ -131,7 +139,10 @@ def build_engine(args):
                          prefill_chunk=chunk,
                          max_step_tokens=args.max_step_tokens or None,
                          spec_k=args.spec_k,
+                         drafter=drafter,
+                         spec_dynamic=args.spec_dynamic,
                          decode_steps=args.decode_steps,
+                         decode_mode=args.decode_mode,
                          spill_bytes_budget=args.spill_budget,
                          mesh=mesh)
 
@@ -232,6 +243,18 @@ def main(argv=None) -> int:
                          "in one ragged dispatch (0 = off; emitted "
                          "tokens are identical either way — "
                          "docs/serving.md 'Speculative decoding')")
+    ap.add_argument("--drafter", choices=["ngram", "model"],
+                    default="ngram",
+                    help="with --spec-k: the draft proposer — 'ngram' "
+                         "(host prompt lookup) or 'model' "
+                         "(self-speculation: the target drafts for "
+                         "itself over a truncated window, one batched "
+                         "dispatch for all slots)")
+    ap.add_argument("--spec-dynamic", action="store_true",
+                    help="with --spec-k: per-slot dynamic draft depth — "
+                         "an accept-rate EWMA picks k in 0..K per slot "
+                         "per flush window; low-accept slots degrade to "
+                         "plain decode (emitted tokens unchanged)")
     ap.add_argument("--decode-steps", type=int, default=1,
                     help="multi-step decode: run K decode bodies per "
                          "dispatch in ONE jitted lax.scan whenever every "
@@ -239,6 +262,13 @@ def main(argv=None) -> int:
                          "tokens are identical either way, streaming "
                          "arrives in <=K bursts — docs/serving.md "
                          "'Multi-step decode')")
+    ap.add_argument("--decode-mode", choices=["auto", "static"],
+                    default="auto",
+                    help="step dispatch policy: 'auto' composes "
+                         "speculation and multi-step per flush window "
+                         "(draft-free pure-decode windows ride the "
+                         "scan); 'static' keeps the legacy exclusivity "
+                         "(spec disables the scan)")
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")

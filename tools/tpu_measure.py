@@ -351,6 +351,12 @@ def main() -> int:
                              "--vocab", "64", "--dim", "32",
                              "--layers", "1", "--heads", "2",
                              "--dtype", "float32", "--reps", "1"]
+        # adaptive-speculation matrix (ngram vs batched draft model vs
+        # decode_mode=auto, repetitive + heavy-tail workloads) at the
+        # same tiny shapes — the accept-rate and auto-vs-static gates
+        # run end-to-end on the CPU rehearse
+        serving_spec_modes_args = serving_spec_args + [
+            "--drafter", "model", "--spec-dynamic"]
         serving_scan_args = ["--decode-steps", "3", "--num-requests", "6",
                              "--slots", "2", "--page-size", "8",
                              "--max-context", "48", "--prompt-lo", "6",
@@ -410,6 +416,11 @@ def main() -> int:
         # speculative-decoding A/B at TPU size: spec-off vs spec-on k=4
         # on the locally-repetitive workload (defaults)
         serving_spec_args = ["--spec-k", "4"]
+        # adaptive-speculation matrix at TPU size: the self-speculation
+        # drafter's batched dispatch vs ngram, dynamic k, and the auto
+        # dispatch policy — the hardware numbers the ROADMAP owes
+        serving_spec_modes_args = ["--spec-k", "4", "--drafter", "model",
+                                   "--spec-dynamic"]
         # multi-step decode A/B at TPU size: decode_steps 1 vs 4 on the
         # mixed-length workload (this is where the dispatch-amortization
         # win actually shows — PERF.md "Reading the multi-step bench")
@@ -562,6 +573,13 @@ def main() -> int:
         ("bench_serving_spec",
          [py, "tools/bench_serving.py"] + serving_spec_args, 1200, {},
          lambda: _out_fresh("bench_serving_spec", fh)),
+        # adaptive-speculation matrix sweep: drafter ngram-vs-model
+        # accept A/B + dynamic-k + decode_mode=auto arms on both
+        # workloads, with the auto-vs-static and accept gates banked
+        ("bench_serving_spec_modes",
+         [py, "tools/bench_serving.py"] + serving_spec_modes_args,
+         1800, {},
+         lambda: _out_fresh("bench_serving_spec_modes", fh)),
         # multi-step decode sweep: the full-size k=1 vs k A/B with the
         # flush/step counters and dispatch reconciliation banked
         ("bench_serving_scan",
