@@ -694,6 +694,59 @@ def bench_serving_fleet(dtype: str) -> dict:
     }
 
 
+def bench_serving_disagg(dtype: str) -> dict:
+    """Disaggregated prefill/decode record (docs/serving.md
+    "Disaggregated prefill/decode"): the same long-prompt prefix-skew
+    workload through a router + 2 colocated role=both replicas vs a
+    router + 1 prefill-role + 1 decode-role replica joined by the
+    kv_push page-transfer plane.  Headline = disagg-arm tokens/s;
+    companions are the colocated arm, first-token p50/p99 both arms,
+    and the transfer ledger (pushes, pages shipped, failures,
+    fallbacks — the reconcile gate requires pages genuinely shipped
+    with zero failures).  tools/bench_serving.py --disagg is the sweep
+    tool.  Cross-replica exactness is tests/test_fleet.py's job."""
+    import argparse
+
+    from tools.bench_serving import measure_disagg
+
+    args = argparse.Namespace(
+        vocab=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+        dim=int(os.environ.get("BENCH_LM_DIM", "512")),
+        layers=int(os.environ.get("BENCH_LM_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_LM_HEADS", "8")),
+        slots=int(os.environ.get("BENCH_SERVE_SLOTS", "16")),
+        page_size=int(os.environ.get("BENCH_SERVE_PAGE", "16")),
+        max_context=int(os.environ.get("BENCH_SERVE_CONTEXT", "768")),
+        num_requests=int(os.environ.get("BENCH_SERVE_REQS", "64")),
+        prefix_pool=int(os.environ.get("BENCH_SERVE_PREFIX_POOL", "8")),
+        prefix_len=int(os.environ.get("BENCH_SERVE_PREFIX_LEN", "128")),
+        prefix_skew=float(os.environ.get("BENCH_SERVE_PREFIX_SKEW", "1.0")),
+        suffix_lo=int(os.environ.get("BENCH_SERVE_SUFFIX_LO", "16")),
+        suffix_hi=int(os.environ.get("BENCH_SERVE_SUFFIX_HI", "64")),
+        max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "64")),
+        concurrency=int(os.environ.get("BENCH_SERVE_FLEET_CONC", "8")),
+        seed=0, dtype=dtype)
+    m = measure_disagg(args)
+    return {
+        "metric": "lm_serving_disagg_tok_per_sec",
+        "value": m["tok_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,       # beyond-reference family: no paddle analog
+        "config": f"conc={m['concurrency']} vocab={args.vocab} "
+                  f"dim={args.dim} L={args.layers} slots={args.slots} "
+                  f"page={args.page_size} pool={args.prefix_pool} "
+                  f"prefix={args.prefix_len} reqs={args.num_requests} "
+                  f"max_new={args.max_new}",
+        **{k: m[k] for k in (
+            "coloc_tok_per_sec", "speedup_vs_coloc",
+            "first_tok_ms_p50", "first_tok_ms_p99",
+            "coloc_first_tok_ms_p50", "coloc_first_tok_ms_p99",
+            "kv_pushes", "kv_push_failures", "kv_fallbacks",
+            "pages_shipped", "router_sheds", "router_retries",
+            "ok", "failures")},
+    }
+
+
 def bench_serving_tp(dtype: str) -> dict:
     """Tensor-parallel sharded-decode record (docs/serving.md "Sharded
     decode"): the same closed-loop workload on a single-device engine vs
@@ -1144,6 +1197,7 @@ BENCHES = {
     "serving_prefix": bench_serving_prefix,
     "serving_chunked": bench_serving_chunked,
     "serving_fleet": bench_serving_fleet,
+    "serving_disagg": bench_serving_disagg,
     "serving_tp": bench_serving_tp,
     "serving_spec": bench_serving_spec,
     "serving_scan": bench_serving_scan,
@@ -1272,6 +1326,7 @@ _METRIC_OF = {
     "serving_prefix": "lm_serving_prefix_hit_rate",
     "serving_chunked": "lm_serving_p99_itl_chunked_ms",
     "serving_fleet": "lm_serving_fleet_tok_per_sec",
+    "serving_disagg": "lm_serving_disagg_tok_per_sec",
     "serving_tp": "lm_serving_tp_tok_per_sec",
     "serving_spec": "lm_serving_spec_tok_per_sec",
     "serving_scan": "lm_serving_scan_tok_per_sec",
@@ -1360,9 +1415,10 @@ def _assemble_lkg() -> dict | None:
         "unit": "samples/sec/chip", "vs_baseline": 0.0}
     found_any = head is not None
     for key in ("lm", "serving", "serving_prefix", "serving_chunked",
-                "serving_fleet", "serving_tp", "serving_spec",
-                "serving_scan", "serving_spill", "train_dist", "mnist",
-                "sentiment", "recommendation", "seq2seq"):
+                "serving_fleet", "serving_disagg", "serving_tp",
+                "serving_spec", "serving_scan", "serving_spill",
+                "train_dist", "mnist", "sentiment", "recommendation",
+                "seq2seq"):
         # (a) newest nested occurrence under any headline...
         part = None
         for rec in recs:

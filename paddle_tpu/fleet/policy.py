@@ -34,6 +34,10 @@ from paddle_tpu.fleet.replica import Replica
 AFFINITY = "affinity"
 LEAST_LOADED = "least_loaded"
 RANDOM = "random"
+#: not a mode — the placement REASON stamped when the router routes the
+#: decode half of a disaggregated prefill/decode request at the replica
+#: its KV pages were just kv_push-mounted on (docs/serving.md)
+DISAGG = "disagg"
 
 
 class AffinityIndex:

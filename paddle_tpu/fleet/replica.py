@@ -87,6 +87,15 @@ class Replica:
     def page_size(self) -> int:
         return int(self.hello.get("page_size") or 0)
 
+    @property
+    def role(self) -> str:
+        """Advertised placement role (hello `role_mode`): "prefill",
+        "decode", or "both".  ADVISORY — any replica can serve any
+        request; the router's disaggregated placement tiers read it, and
+        normal placement merely prefers non-prefill replicas when any
+        exist (docs/serving.md "Disaggregated prefill/decode")."""
+        return str(self.hello.get("role_mode") or "both")
+
     def load(self) -> int:
         """Requests this replica is carrying as far as the router knows:
         its own outstanding placements (exact) plus the externally-placed
@@ -141,6 +150,7 @@ class Replica:
         s = self.stats
         return {
             "replica": self.rid, "addr": self.addr, "state": self.state,
+            "role": self.role,
             "draining": self.drain_requested,
             "pending": len(self.pending), "external": self.external,
             "max_inflight": self.max_inflight,
@@ -159,6 +169,12 @@ class Replica:
             "pump_last_step_age_s": s.get("pump_last_step_age_s"),
             "prefix_hits": s.get("prefix_hits"),
             "prefix_misses": s.get("prefix_misses"),
+            # cross-replica kv transfer, echoed from the polled stats so
+            # `ctl list` shows each replica's disagg traffic in place
+            "kv_pushes": s.get("kv_pushes"),
+            "kv_push_failures": s.get("kv_push_failures"),
+            "kv_pages_shipped": s.get("kv_pages_shipped"),
+            "kv_pages_received": s.get("kv_pages_received"),
         }
 
 

@@ -66,7 +66,7 @@ import time
 from typing import Optional
 
 from paddle_tpu.fleet import replica as rep
-from paddle_tpu.fleet.policy import PlacementPolicy
+from paddle_tpu.fleet.policy import DISAGG, PlacementPolicy
 from paddle_tpu.fleet.replica import Replica, ReplicaTable
 from paddle_tpu.obs import (MetricsRegistry, statset_collector,
                             tracer_collector)
@@ -90,7 +90,7 @@ class _RoutedReq:
     __slots__ = ("conn", "cid", "msg", "grid", "rid", "stream", "streamed",
                  "retries", "t_submit", "trace_id", "span_id",
                  "client_parent", "t0", "t_last_tok", "burst_left",
-                 "burst_share")
+                 "burst_share", "phase", "decode_rid", "disagg_pages")
 
     def __init__(self, conn, cid, msg, grid):
         self.conn = conn
@@ -101,6 +101,14 @@ class _RoutedReq:
         self.stream = bool(msg.get("stream", True))   # can never route)
         self.streamed = 0              # token frames the CLIENT has seen
         self.retries = 0
+        # disaggregated prefill/decode (docs/serving.md): "prefill" while
+        # the prefill_only leg is in flight at a prefill-tier replica;
+        # its done frame then routes the ORIGINAL msg to decode_rid (the
+        # replica the kv_push mounted the prompt's pages on) — or falls
+        # back to colocated placement when the push failed
+        self.phase = None              # None | "prefill"
+        self.decode_rid = None         # planned decode replica
+        self.disagg_pages = 0          # pages shipped for this request
         self.t_submit = time.monotonic()
         # burst-aware relay inter-token latency (multi-step decode): a
         # replica running decode_steps=k relays ≤k token frames back to
@@ -271,6 +279,7 @@ class FleetRouter:
                  heartbeat_misses: int = 10,
                  wedge_age_s: float = 30.0,
                  retry_limit: int = 2,
+                 disagg_min_prompt: int = 0,
                  postmortem_dir: Optional[str] = None,
                  tracer=None):
         self.host = host
@@ -290,6 +299,14 @@ class FleetRouter:
         self.heartbeat_misses = int(heartbeat_misses)
         self.wedge_age_s = float(wedge_age_s)
         self.retry_limit = int(retry_limit)
+        # disaggregated prefill/decode: prompts at least this long place
+        # on a prefill-tier replica first (kv_push to the chosen decode
+        # replica, then the real generate follows).  0 = auto (one
+        # affinity window, i.e. one KV page — the smallest prefix worth
+        # shipping); negative disables disagg placement entirely.  Only
+        # fires while BOTH a prefill-role and a decode-role replica are
+        # placeable; everything else places colocated as before.
+        self.disagg_min_prompt = int(disagg_min_prompt)
         self.postmortem_dir = postmortem_dir
         self._last_dump_error = "unknown"
         self.flight = get_flight_recorder()
@@ -322,8 +339,15 @@ class FleetRouter:
         self._m_sheds = reg.counter("fleet_sheds_total")
         self._m_joins = reg.counter("fleet_joins_total")
         self._m_leaves = reg.counter("fleet_leaves_total")
+        # disaggregated prefill/decode accounting (docs/serving.md)
+        self._m_kv_pushes = reg.counter("fleet_kv_pushes_total")
+        self._m_kv_push_fail = reg.counter("fleet_kv_push_failures_total")
+        self._m_kv_fallbacks = reg.counter("fleet_kv_fallbacks_total")
+        self._m_kv_pages = reg.counter("fleet_kv_pages_shipped_total")
         for m in (self._m_accepted, self._m_retries, self._m_sheds,
-                  self._m_joins, self._m_leaves):
+                  self._m_joins, self._m_leaves, self._m_kv_pushes,
+                  self._m_kv_push_fail, self._m_kv_fallbacks,
+                  self._m_kv_pages):
             m.inc(0.0)     # unlabeled counters render 0, not absent
         reg.gauge("fleet_inflight").set_fn(lambda: float(len(self._routes)))
         reg.gauge("fleet_replicas_registered").set_fn(
@@ -670,6 +694,7 @@ class FleetRouter:
             "heartbeat_misses": self.heartbeat_misses,
             "wedge_age_s": self.wedge_age_s,
             "retry_limit": self.retry_limit,
+            "disagg_min_prompt": self.disagg_min_prompt,
             "postmortem_dir": self.postmortem_dir,
         }
 
@@ -760,6 +785,9 @@ class FleetRouter:
                               "index": msg.get("index")})
         elif t == "done":
             r.pending.discard(grid)
+            if st.phase == "prefill":
+                self._on_prefill_done(st, msg)
+                return
             self._finish(st, {"type": "done", "id": st.cid,
                               "tokens": msg.get("tokens"),
                               "reason": msg.get("reason"),
@@ -792,6 +820,8 @@ class FleetRouter:
             "replica": st.rid,
             "total_ms": round((time.perf_counter() - st.t0) * 1e3, 3),
         }
+        if st.disagg_pages:
+            timing["router"]["disagg_pages"] = st.disagg_pages
         return timing
 
     def _finish(self, st: _RoutedReq, frame: dict) -> None:
@@ -830,14 +860,22 @@ class FleetRouter:
                     f"streamed; not retried (a retry would re-stream "
                     f"from the start) — resubmit the request")
             return
+        if st.phase == "prefill":
+            # the prefill leg died under us (replica left, circuit open,
+            # overload race) — a prefill_only request never streams, so
+            # the retry below IS the disagg fallback: re-place the
+            # ORIGINAL generate colocated and count the degradation
+            st.phase = None
+            st.decode_rid = None
+            self._m_kv_fallbacks.inc()
         if count_retry:
             st.retries += 1
             if st.retries > self.retry_limit:
                 self._finish_error(
                     st, f"{why}; retry limit {self.retry_limit} reached")
                 return
-        candidates = [c for c in self.table.placeable()
-                      if c.rid != st.rid]
+        candidates = self._decode_candidates(
+            [c for c in self.table.placeable() if c.rid != st.rid])
         if not candidates:
             if not count_retry:
                 # the replica REFUSED admission (overload race) and nobody
@@ -876,8 +914,15 @@ class FleetRouter:
                     trace_id=st.trace_id, parent=st.span_id)
         self._send_to(st, replica, policy)
 
+    def _decode_candidates(self, candidates: list) -> list:
+        """Placement preference for the DECODE/colocated path: keep
+        prefill-role replicas out of it while any other capacity exists
+        (their pool is sized for prompt churn, not long residencies) —
+        but roles are ADVISORY, so an all-prefill fleet still serves."""
+        return [c for c in candidates if c.role != "prefill"] or candidates
+
     def _send_to(self, st: _RoutedReq, replica: Replica,
-                 policy: str) -> None:
+                 policy: str, extra: Optional[dict] = None) -> None:
         # anything that can raise runs BEFORE the routing state mutates:
         # an exception after routes/rids/pending were touched would leak
         # a phantom in-flight request (inflated load, drain wedged)
@@ -889,6 +934,8 @@ class FleetRouter:
         # whole cross-process stitch
         fwd = dict(st.msg, id=None, stream=True,
                    trace={"trace_id": st.trace_id, "parent": st.span_id})
+        if extra:
+            fwd.update(extra)          # the prefill_only/push_to leg
         grid = f"g{self._seq}"
         self._seq += 1
         fwd["id"] = grid
@@ -1088,9 +1135,94 @@ class FleetRouter:
                            self.table.in_state(rep.HEALTHY))})
             return
         prompt = msg.get("prompt", [])
-        replica, policy = self.policy.place(prompt, candidates)
         st = _RoutedReq(conn, cid, msg, grid="")
         self._m_accepted.inc()
+        plan = self._disagg_plan(prompt, candidates)
+        if plan is not None:
+            prefill_r, decode_r = plan
+            st.phase = "prefill"
+            st.decode_rid = decode_r.rid
+            self._m_kv_pushes.inc()
+            self._send_to(st, prefill_r, DISAGG,
+                          extra={"prefill_only": True,
+                                 "push_to": {"host": decode_r.host,
+                                             "port": decode_r.port}})
+            return
+        replica, policy = self.policy.place(
+            prompt, self._decode_candidates(candidates))
+        self._send_to(st, replica, policy)
+
+    def _disagg_plan(self, prompt, candidates) -> Optional[tuple]:
+        """(prefill replica, decode replica) for a disaggregated
+        placement, or None to place colocated.  Fires only for prompts
+        past the threshold while BOTH role tiers have a placeable
+        member: the decode replica is chosen FIRST (affinity — its
+        prefix tree is where the pushed pages will live, so followers
+        sharing the prefix chase it there), the prefill replica
+        least-loaded within its tier."""
+        if self.disagg_min_prompt < 0:
+            return None
+        floor = self.disagg_min_prompt or self.policy.index.window
+        if floor <= 0 or len(prompt) < floor:
+            return None
+        prefill_tier = [c for c in candidates if c.role == "prefill"]
+        decode_tier = [c for c in candidates if c.role == "decode"]
+        if not prefill_tier or not decode_tier:
+            return None
+        decode_r, _ = self.policy.place(prompt, decode_tier)
+        prefill_r = min(prefill_tier, key=lambda r: r.score())
+        return prefill_r, decode_r
+
+    def _on_prefill_done(self, st: _RoutedReq, msg: dict) -> None:
+        """The prefill leg finished: on a successful kv_push route the
+        ORIGINAL generate to the decode replica holding the pages (its
+        admission is now a prefix hit); on any failure — push refused,
+        decode replica gone/unplaceable, prefill cancelled — degrade
+        honestly (fallback colocated, or forward the terminal frame)."""
+        self._routes.pop(st.grid, None)
+        st.conn.rids.pop(st.cid, None)
+        st.phase = None
+        reason = msg.get("reason")
+        if reason not in ("stop", "length"):
+            # the client cancelled (or the deadline fired) during the
+            # prefill leg — that terminates the REQUEST, not just the leg
+            self._finish(st, {"type": "done", "id": st.cid,
+                              "tokens": msg.get("tokens"),
+                              "reason": reason,
+                              "timing": self._merge_timing(st, msg)})
+            return
+        ok = bool(msg.get("push_ok"))
+        if ok:
+            st.disagg_pages = int(msg.get("pushed_pages") or 0)
+            self._m_kv_pages.inc(float(st.disagg_pages))
+        else:
+            self._m_kv_push_fail.inc()
+        decode_r = self.table.get(st.decode_rid)
+        st.decode_rid = None
+        if ok and decode_r is not None and decode_r.state == rep.HEALTHY \
+                and not decode_r.saturated():
+            self._send_to(st, decode_r, DISAGG)
+            return
+        # fallback: the push failed, or the decode replica died/filled
+        # while the prompt prefilled — place colocated like a both-mode
+        # fleet would have (zero client-visible failures: nothing
+        # streamed, so the re-place is transparent)
+        self._m_kv_fallbacks.inc()
+        st.disagg_pages = 0
+        candidates = self._decode_candidates(self.table.placeable())
+        if not candidates:
+            self._m_sheds.inc()
+            self.flight.record("shed", reason="disagg_fallback",
+                               inflight=len(self._routes))
+            self._finish(st, {"type": "overload", "id": st.cid,
+                              "reason": "fleet_saturated",
+                              "inflight": len(self._routes),
+                              "max_inflight": sum(
+                                  r.max_inflight for r in
+                                  self.table.in_state(rep.HEALTHY))})
+            return
+        replica, policy = self.policy.place(st.msg.get("prompt", []),
+                                            candidates)
         self._send_to(st, replica, policy)
 
     async def _handle_fleet_op(self, conn: _ClientConn, msg: dict) -> None:
@@ -1158,6 +1290,12 @@ class FleetRouter:
             "placements": placements,
             "retries": self._m_retries.value(),
             "sheds": self._m_sheds.value(),
+            # disaggregated prefill/decode traffic (docs/serving.md)
+            "disagg_min_prompt": self.disagg_min_prompt,
+            "kv_pushes": self._m_kv_pushes.value(),
+            "kv_push_failures": self._m_kv_push_fail.value(),
+            "kv_fallbacks": self._m_kv_fallbacks.value(),
+            "kv_pages_shipped": self._m_kv_pages.value(),
             # burst-honest relay inter-token latency (ms): one scanned
             # k-token burst is k tokens of progress, each charged an
             # equal share of the inter-burst gap — comparable across

@@ -67,6 +67,22 @@ CATALOG: dict[str, str] = {
     "serving_spill_bytes":
         "host-RAM bytes currently held by the spill tier",
     "serving_decode_steps_total": "compiled decode steps executed",
+    # -- cross-replica KV transfer (docs/serving.md "Disaggregated
+    # prefill/decode") ----------------------------------------------------
+    "serving_kv_xfer_pushes_total":
+        "outbound kv_push attempts (prefill_only completions that tried "
+        "to ship their committed prefix to a decode replica)",
+    "serving_kv_xfer_push_failures_total":
+        "outbound kv_push attempts that failed (connect refused, peer "
+        "error, timeout, nothing cached) — the router falls back to "
+        "colocated placement on each",
+    "serving_kv_xfer_pages_shipped_total":
+        "committed KV pages serialized to the wire by export_pages",
+    "serving_kv_xfer_pages_received_total":
+        "KV pages scattered into the pool from inbound kv_push blobs",
+    "serving_kv_xfer_mounts_total":
+        "inbound blobs mounted read-only into the prefix tree "
+        "(import_prefix calls that added at least zero runs)",
     # -- tensor-parallel sharded decode (docs/serving.md "Sharded decode")
     "serving_tp_shards":
         "tensor-parallel shards (mesh model-axis size; 1 = unsharded)",
@@ -137,7 +153,7 @@ CATALOG: dict[str, str] = {
         "samples recorded per router relay stat (label: stat)",
     "fleet_placements_total":
         "placements by policy decision (label: policy = "
-        "affinity/least_loaded/random)",
+        "affinity/least_loaded/random/disagg)",
     "fleet_retries_total":
         "requests transparently re-placed after replica death/circuit-open "
         "(only never-streamed requests retry)",
@@ -158,6 +174,19 @@ CATALOG: dict[str, str] = {
         "prefix-affinity index entries (bounded LRU; first page-run -> "
         "replica)",
     "fleet_draining": "1 while the router refuses new work to drain",
+    # -- disaggregated prefill/decode placement (docs/serving.md) ---------
+    "fleet_kv_pushes_total":
+        "disaggregated placements the router started (prefill_only sent "
+        "to a prefill-tier replica with a push_to target)",
+    "fleet_kv_push_failures_total":
+        "disaggregated placements whose kv_push failed (done frame came "
+        "back push_ok:false) — each falls back to colocated placement",
+    "fleet_kv_fallbacks_total":
+        "requests re-placed colocated after a disagg attempt failed "
+        "(push failure, prefill replica death, decode tier gone)",
+    "fleet_kv_pages_shipped_total":
+        "KV pages the router observed shipped on successful kv_pushes "
+        "(sum of pushed_pages off done frames)",
     # -- parameter server (paddle_tpu/pserver/) ----------------------------
     "pserver_version": "optimizer updates committed (the parameter version)",
     "pserver_pass_id": "training passes completed server-side",

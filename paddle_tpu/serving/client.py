@@ -188,18 +188,29 @@ class ServingClient:
                top_k: int = 0, top_p: float = 0.0, eos_id: int = -1,
                seed: Optional[int] = None, timeout_s: Optional[float] = None,
                stream: bool = True, req_id=None,
-               trace: Optional[dict] = None):
+               trace: Optional[dict] = None,
+               prefill_only: bool = False,
+               push_to: Optional[dict] = None):
         """Fire one generate; returns the request id (auto-assigned unless
         given).  Does NOT wait — pair with collect().  `trace`
         ({"trace_id": ..., "parent": ...?}) threads a client-originated
         distributed-trace context through the router/replica spans
-        (docs/observability.md "Distributed tracing")."""
+        (docs/observability.md "Distributed tracing").  `prefill_only`
+        (+ `push_to={"host", "port"}`) is the disaggregated-prefill
+        control frame the fleet router normally originates: prefill the
+        prompt, kv_push the committed pages to `push_to`, report the push
+        outcome on the done frame (docs/serving.md)."""
         if req_id is None:
             req_id = f"q{self._next_id}"
             self._next_id += 1
         msg = {"type": "generate", "id": req_id,
                "prompt": [int(t) for t in prompt],
                "max_new": int(max_new), "stream": bool(stream)}
+        if prefill_only:
+            msg["prefill_only"] = True
+            if push_to is not None:
+                msg["push_to"] = {"host": str(push_to["host"]),
+                                  "port": int(push_to["port"])}
         if trace is not None:
             msg["trace"] = dict(trace)
         if temperature:
@@ -248,6 +259,11 @@ class ServingClient:
                 # replay ms + preempt/spec counts; the router adds its
                 # hop/retry fields) — docs/serving.md "Message schemas"
                 out[rid]["timing"] = msg.get("timing")
+                # disaggregated prefill: a prefill_only done frame carries
+                # the kv_push outcome (push_ok / pushed_pages / push_error)
+                for k in ("push_ok", "pushed_pages", "push_error"):
+                    if k in msg:
+                        out[rid][k] = msg[k]
             elif t == "overload":
                 raise OverloadError(msg)
             else:

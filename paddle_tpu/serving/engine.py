@@ -318,6 +318,12 @@ class ServingEngine:
         # restored-vs-saved reconciliation)
         self.n_restore_hits = 0
         self.restore_tokens_saved = 0
+        # cross-replica kv transfer plane (docs/serving.md "Disaggregated
+        # prefill/decode"): mounts = import_prefix calls that attached at
+        # least one run; pages count what came over the wire (the
+        # byte-level n_exported/n_imported live on the kv allocator)
+        self.n_kv_mounts = 0
+        self.kv_pages_mounted = 0
         self.queue: deque[Request] = deque()
         self.slots: list[Optional[_Slot]] = [None] * num_slots
         # finished-but-uncollected outputs: run() POPS what completed on
@@ -1785,6 +1791,57 @@ class ServingEngine:
                            pages=len(pages),
                            host_pages=kv.host_page_count)
         return True
+
+    # -- cross-replica kv transfer (docs/serving.md "Disaggregated
+    # prefill/decode") -----------------------------------------------------
+    def export_prefix(self, tokens):
+        """Serialize the longest DEVICE-resident whole-page cached prefix
+        of `tokens` for a kv_push: returns (covered_tokens, meta, payload)
+        or None when nothing is cached.  Pump thread only (walks the
+        prefix tree and gathers from the pools between steps)."""
+        if self.prefix is None:
+            return None
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        pages, _ = self.prefix.match(toks)
+        if not pages:
+            return None
+        n_tok = len(pages) * self.kv.page_size
+        meta, payload = self.kv.export_pages(pages)
+        return toks[:n_tok], meta, payload
+
+    def import_prefix(self, tokens, meta: dict, payload: bytes) -> int:
+        """Mount a kv_push blob into the prefix tree: take fresh pages,
+        scatter the wire bytes in (one bucketed dispatch — the spill
+        tier's restore jit), adopt + insert so the NEXT admission of this
+        prompt is a prefix hit instead of a re-prefill.  Raises ValueError
+        — with the allocator rolled back exactly (`check()` green) — on
+        a malformed blob or page starvation; returns nodes newly added.
+        Pump thread only: kv.pools is authoritative between steps, so the
+        scatter is exactly as safe as an admission-time spill restore."""
+        if self.prefix is None:
+            raise ValueError("kv import: prefix cache is disabled")
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        n = int(meta.get("n_pages", 0))
+        ps = self.kv.page_size
+        if n <= 0 or toks.size != n * ps:
+            raise ValueError(
+                f"kv import: {toks.size} tokens do not cover "
+                f"{n} pages x {ps}")
+        pages = self.kv.take_pages(n)
+        if pages is None:
+            raise ValueError(
+                f"kv import: pool cannot cover {n} fresh pages")
+        try:
+            self.kv.import_pages(meta, payload, pages)
+        except ValueError:
+            self.kv.untake_pages(pages)
+            raise
+        self.kv.adopt_restored(pages)
+        added = self.prefix.insert(toks, pages, adopted=True)
+        self.n_kv_mounts += 1
+        self.kv_pages_mounted += n
+        self.flight.record("kv_recv", pages=n, mounted=added)
+        return added
 
     def _admit(self, s: int, req: Request, C: int = 0, n_pp: int = 0) -> None:
         """Prefill the prompt (or, on a prefix hit, ONLY its uncached

@@ -166,6 +166,10 @@ class PagedKVCache:
         self.n_host_evicted = 0        # host-tier LRU drops (budget pressure)
         self._host_drained = 0         # non-evict, non-restore drops
         self._restore_fns: dict[int, object] = {}   # bucketed jitted scatter
+        # -- cross-replica page transfer (docs/serving.md "Disaggregated
+        # prefill/decode"): committed pages serialized to/from host bytes
+        self.n_exported = 0            # pages exported to wire bytes (ever)
+        self.n_imported = 0            # pages imported from wire bytes (ever)
 
     def _canonical_free(self) -> list:
         """The free list in its construction-time canonical order (pop()
@@ -576,6 +580,109 @@ class PagedKVCache:
                 "serving.spill_restore",
                 jax.jit(scatter, donate_argnums=(0,), **kw))
         return self._restore_fns[bucket]
+
+    # -- cross-replica page transfer ---------------------------------------
+    def export_pages(self, pages) -> tuple[dict, bytes]:
+        """Serialize live committed pages to host bytes — the kv_push
+        transfer plane's sender half (docs/serving.md "Disaggregated
+        prefill/decode").  One batched device->host gather per layer part
+        in the spill tier's per-layer ndarray layout: the payload is the
+        concatenation, over layers in SORTED name order, of the k block
+        then the v block, each `[n, page_size, h_kv, dh]` row-major.
+        Returns `(meta, payload)` where meta names the shapes/dtypes the
+        importer must match exactly.  Pages must be live (slot-mapped or
+        prefix-cached) — exporting a free page would ship garbage."""
+        pages = [int(p) for p in pages]
+        assert pages, "export_pages needs at least one page"
+        for p in pages:
+            assert 0 < p < self.num_pages and (
+                self._ref[p] > 0 or self._cached[p]), \
+                f"page {p} is not a live committed page"
+        idx = np.asarray(pages, np.int32)
+        names = sorted(self.pools)
+        parts = []
+        layers = []
+        for name in names:
+            h_kv, dh = self.layer_specs[name]
+            k = np.ascontiguousarray(np.asarray(self.pools[name]["k"][idx]))
+            v = np.ascontiguousarray(np.asarray(self.pools[name]["v"][idx]))
+            parts.append(k.tobytes())
+            parts.append(v.tobytes())
+            layers.append({"name": name, "h_kv": h_kv, "dh": dh,
+                           "dtype": str(k.dtype)})
+        meta = {"n_pages": len(pages), "page_size": self.page_size,
+                "layers": layers}
+        self.n_exported += len(pages)
+        return meta, b"".join(parts)
+
+    def import_pages(self, meta: dict, payload: bytes, pages) -> None:
+        """Scatter an export_pages blob into freshly-taken device pages —
+        the kv_push receiver half.  Validates EVERYTHING (page count,
+        page size, layer set, per-layer shapes/dtypes, exact payload
+        length) before touching any device state and raises ValueError on
+        mismatch, so the caller's `untake_pages(pages)` rollback restores
+        the allocator exactly (`check()` stays green on partial failure).
+        The scatter reuses the spill tier's pow2-bucketed restore jit —
+        one dispatch, pad rows writing zeros to trash page 0."""
+        n = len(pages)
+        if int(meta.get("n_pages", -1)) != n:
+            raise ValueError(
+                f"kv import: blob holds {meta.get('n_pages')} pages, "
+                f"caller took {n}")
+        if int(meta.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"kv import: page_size {meta.get('page_size')} != "
+                f"pool page_size {self.page_size}")
+        layers = meta.get("layers") or []
+        if [l.get("name") for l in layers] != sorted(self.pools):
+            raise ValueError(
+                f"kv import: layer set {[l.get('name') for l in layers]} "
+                f"!= pool layers {sorted(self.pools)}")
+        total = 0
+        for l in layers:
+            h_kv, dh = self.layer_specs[l["name"]]
+            dtype = np.dtype(self.pools[l["name"]]["k"].dtype)
+            if int(l.get("h_kv", -1)) != h_kv or int(l.get("dh", -1)) != dh \
+                    or str(l.get("dtype")) != str(dtype):
+                raise ValueError(
+                    f"kv import: layer {l['name']!r} shape/dtype "
+                    f"({l.get('h_kv')},{l.get('dh')},{l.get('dtype')}) != "
+                    f"pool ({h_kv},{dh},{dtype})")
+            total += 2 * n * self.page_size * h_kv * dh * dtype.itemsize
+        if len(payload) != total:
+            raise ValueError(
+                f"kv import: payload is {len(payload)} bytes, "
+                f"meta declares {total}")
+        for p in pages:
+            p = int(p)
+            assert 0 < p < self.num_pages and self._ref[p] == 0 and \
+                not self._cached[p], f"page {p} is not a fresh taken page"
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        idx = np.zeros(bucket, np.int32)            # pad -> trash page 0
+        idx[:n] = [int(p) for p in pages]
+        ks: dict = {}
+        vs: dict = {}
+        off = 0
+        for l in layers:
+            name = l["name"]
+            h_kv, dh = self.layer_specs[name]
+            dtype = np.dtype(self.pools[name]["k"].dtype)
+            nb = n * self.page_size * h_kv * dh * dtype.itemsize
+            shape = (n, self.page_size, h_kv, dh)
+            k = np.zeros((bucket,) + shape[1:], dtype)
+            v = np.zeros_like(k)
+            k[:n] = np.frombuffer(payload, dtype, count=nb // dtype.itemsize,
+                                  offset=off).reshape(shape)
+            off += nb
+            v[:n] = np.frombuffer(payload, dtype, count=nb // dtype.itemsize,
+                                  offset=off).reshape(shape)
+            off += nb
+            ks[name], vs[name] = k, v
+        self.pools = self._restore_fn(bucket)(
+            self.pools, jnp.asarray(idx), ks, vs)
+        self.n_imported += n
 
     # -- device page copy (COW) -------------------------------------------
     def _page_copy(self):

@@ -172,13 +172,21 @@ class PrefixTree:
         return pages, None
 
     # -- insertion (donation at retire/preempt/abort) ----------------------
-    def insert(self, tokens, pages) -> int:
+    def insert(self, tokens, pages, adopted: bool = False) -> int:
         """Register `len(pages)` fully-committed pages: pages[j] holds the
         K/V of tokens[j*ps:(j+1)*ps].  A run already present keeps its
         existing physical page (the donated duplicate stays with the
         donor's normal release flow — it frees when the slot lets go);
         new runs retain their page via kv.cache_page.  Returns the number
-        of nodes added."""
+        of nodes added.
+
+        `adopted=True` is the cross-replica MOUNT path (a kv_push import,
+        docs/serving.md "Disaggregated prefill/decode"): the pages came
+        through kv.adopt_restored — already prefix-retained, mapped by no
+        slot — so new and promoted runs skip cache_page (which demands a
+        donor mapping), and a run already DEVICE-resident frees the
+        redundant imported page right here via uncache_page (there is no
+        donor slot whose release would reclaim it)."""
         toks = np.asarray(tokens).reshape(-1)
         assert toks.size >= len(pages) * self.ps
         node, added = self.root, 0
@@ -188,7 +196,8 @@ class PrefixTree:
             if child is None:
                 child = _Node(run, int(page), node)
                 node.add_child(child)
-                self.kv.cache_page(int(page))
+                if not adopted:
+                    self.kv.cache_page(int(page))
                 self.n_nodes += 1
                 added += 1
             elif child.host_id is not None:
@@ -201,7 +210,13 @@ class PrefixTree:
                 self.kv.drop_host_page(child.host_id, reason="drain")
                 child.host_id = None
                 child.page = int(page)
-                self.kv.cache_page(int(page))
+                if not adopted:
+                    self.kv.cache_page(int(page))
+            elif adopted:
+                # the run is already DEVICE-resident: the imported copy is
+                # bit-identical (same token path, deterministic prefill),
+                # keep the incumbent and free the duplicate now
+                self.kv.uncache_page(int(page))
             self._touch(child)
             node = child
         return added

@@ -74,12 +74,18 @@ class _ReqState:
     """Server-side lifecycle of one accepted request."""
 
     __slots__ = ("conn", "cid", "stream", "t_submit", "t_last", "next_idx",
-                 "burst_left", "burst_share")
+                 "burst_left", "burst_share", "push_to", "prompt")
 
     def __init__(self, conn, cid, stream):
         self.conn = conn
         self.cid = cid                # the client's id (frame field)
         self.stream = bool(stream)
+        # disaggregated prefill (docs/serving.md): a prefill_only request
+        # carries the decode replica to kv_push the committed pages to —
+        # the done frame is then DELAYED until the push resolves, so the
+        # router learns push_ok before it sends the real generate
+        self.push_to = None           # {"host", "port"} or None
+        self.prompt = None            # np.int32 prompt (prefill_only only)
         self.t_submit = time.monotonic()
         self.t_last = self.t_submit   # last token emission (TTFT base)
         self.next_idx = 0             # next UNSEEN token index — a
@@ -118,11 +124,25 @@ class ServingServer:
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, max_queue: int = 32,
                  postmortem_dir: Optional[str] = None,
-                 wedge_threshold_s: float = 30.0):
+                 wedge_threshold_s: float = 30.0, role: str = "both",
+                 kv_push_timeout_s: float = 10.0):
+        assert role in ("prefill", "decode", "both"), role
         self.engine = engine
         self.host = host
         self.port = port
         self.max_inflight = len(engine.slots) + int(max_queue)
+        # disaggregated prefill/decode (docs/serving.md): the replica's
+        # advertised placement role — ADVISORY, any replica can serve any
+        # request; the router's placement tiers read it off hello
+        self.role = role
+        self.kv_push_timeout_s = float(kv_push_timeout_s)
+        # kv_push accounting (loop thread): outbound pushes attempted /
+        # failed; page counts live on the engine/kv counters
+        self._kv_pushes = 0
+        self._kv_push_failures = 0
+        # in-progress inbound multi-part kv_push blobs, keyed by
+        # (conn.seq, client id) — dropped wholesale when the conn closes
+        self._kv_parts: dict = {}
         # the server exports/dumps the ENGINE's tracer (the process-global
         # one unless the embedder gave the engine its own ring), so the
         # `trace` RPC snapshot, the metrics accounting, and the
@@ -175,6 +195,11 @@ class ServingServer:
         reg = self.metrics = MetricsRegistry(strict=True)
         self._m_accepted = reg.counter("serving_requests_accepted_total")
         self._m_overload = reg.counter("serving_overload_total")
+        # disaggregated prefill/decode: outbound kv_push attempts/failures
+        # (loop thread increments, mirrored in self._kv_pushes for stats)
+        self._m_kv_pushes = reg.counter("serving_kv_xfer_pushes_total")
+        self._m_kv_push_fail = \
+            reg.counter("serving_kv_xfer_push_failures_total")
         reg.gauge("serving_inflight").set_fn(lambda: float(self._inflight))
         reg.gauge("serving_max_inflight").set(float(self.max_inflight))
         reg.gauge("serving_draining").set_fn(
@@ -269,6 +294,14 @@ class ServingServer:
                 # histogram to see whether the workload sustains depth)
                 ("serving_draft_steps_total", "counter", None,
                  float(eng.n_draft_steps)),
+                # cross-replica kv transfer: pages serialized to / scattered
+                # from the wire, and blob mounts into the prefix tree
+                ("serving_kv_xfer_pages_shipped_total", "counter", None,
+                 float(eng.kv.n_exported)),
+                ("serving_kv_xfer_pages_received_total", "counter", None,
+                 float(eng.kv.n_imported)),
+                ("serving_kv_xfer_mounts_total", "counter", None,
+                 float(eng.n_kv_mounts)),
             ] + eng.step_tokens_hist.samples() \
               + eng.decode_gap_hist.samples() \
               + eng.draft_ms_hist.samples() \
@@ -396,6 +429,9 @@ class ServingServer:
                 cmd = self._cmds.get_nowait()
                 if cmd[0] == "stats":
                     self._stats_on_loop(cmd[1], None)
+                elif cmd[0] == "kv_import":
+                    cmd[2].send({"type": "kv_push", "id": cmd[1]["cid"],
+                                 "ok": False, "error": "replica stopping"})
         except queue.Empty:
             pass
         if self._server is not None:
@@ -485,6 +521,13 @@ class ServingServer:
                                         self._loop.call_soon_threadsafe(
                                             self._stats_on_loop, cmd[1],
                                             self._engine_stats())
+                                    elif cmd[0] == "kv_import":
+                                        self._loop.call_soon_threadsafe(
+                                            cmd[2].send,
+                                            {"type": "kv_push",
+                                             "id": cmd[1]["cid"],
+                                             "ok": False,
+                                             "error": "replica stopping"})
                             except queue.Empty:
                                 pass
                             return
@@ -500,6 +543,28 @@ class ServingServer:
                                     self._fail_on_loop, req.req_id, str(e))
                         elif cmd[0] == "cancel":
                             self.engine.cancel(cmd[1])
+                        elif cmd[0] == "kv_import":
+                            # between steps kv.pools is authoritative (the
+                            # engine rebuilds its state pytree from it at
+                            # every dispatch), so the mount's scatter is
+                            # exactly as safe as an admission-time restore
+                            push, conn = cmd[1], cmd[2]
+                            try:
+                                added = self.engine.import_prefix(
+                                    push["tokens"], push["meta"],
+                                    b"".join(push["parts"]))
+                                reply = {"type": "kv_push",
+                                         "id": push["cid"], "ok": True,
+                                         "pages": int(push["meta"]
+                                                      ["n_pages"]),
+                                         "mounted": int(added)}
+                            except (ValueError, AssertionError) as e:
+                                reply = {"type": "kv_push",
+                                         "id": push["cid"], "ok": False,
+                                         "error": f"{type(e).__name__}: "
+                                                  f"{e}"}
+                            self._loop.call_soon_threadsafe(
+                                conn.send, reply)
                         elif cmd[0] == "stats":
                             # between-steps = the consistent view: no
                             # slot/page/queue mutation can interleave
@@ -544,6 +609,9 @@ class ServingServer:
                 cmd = self._cmds.get_nowait()     # nobody else reads now
                 if cmd[0] == "stats":
                     self._stats_on_loop(cmd[1], None)
+                elif cmd[0] == "kv_import":
+                    cmd[2].send({"type": "kv_push", "id": cmd[1]["cid"],
+                                 "ok": False, "error": "engine pump died"})
         except queue.Empty:
             pass
         for rid in list(self._routes):
@@ -678,6 +746,7 @@ class ServingServer:
             "drafter": self.engine.drafter_kind,
             "decode_steps": int(self.engine.decode_steps),
             "decode_mode": self.engine.decode_mode,
+            "role": self.role,
             "wedge_threshold_s": self.wedge_threshold_s,
             "postmortem_dir": self.postmortem_dir,
         }
@@ -765,13 +834,28 @@ class ServingServer:
             # request_ms and the CLIENT's wall time is the wire + front
             # tier — per-hop attribution with no trace viewer needed
             timing["request_ms"] = round(wall * 1e3, 3)
+        if st.push_to is not None and reason in ("stop", "length"):
+            # disaggregated prefill: the prompt's pages were just donated
+            # (_retire runs _donate before _finish), so the committed
+            # prefix is exportable RIGHT HERE on the pump thread; the
+            # loop side then ships it and delays the done frame until the
+            # push resolves, so the router learns push_ok from `done`.
+            # A cancelled/expired prefill finishes NORMALLY — shipping
+            # pages nobody will decode would only burn wire and counters.
+            export = self.engine.export_prefix(st.prompt)
+            self._loop.call_soon_threadsafe(
+                self._push_then_finish_on_loop, rid,
+                np.asarray(toks).astype(int).tolist(), reason, timing,
+                export)
+            return
         self._loop.call_soon_threadsafe(
             self._finish_on_loop, rid,
             np.asarray(toks).astype(int).tolist(), reason, timing)
 
     # -- loop-side completion/error delivery -------------------------------
     def _finish_on_loop(self, rid: str, tokens: list, reason: str,
-                        timing: Optional[dict] = None) -> None:
+                        timing: Optional[dict] = None,
+                        extra: Optional[dict] = None) -> None:
         st = self._routes.pop(rid, None)
         if st is None:
             return
@@ -785,7 +869,91 @@ class ServingServer:
                "reason": reason}
         if timing is not None:
             out["timing"] = timing
+        if extra:
+            out.update(extra)
         st.conn.send(out)
+
+    # -- the kv_push sender (prefill side, loop thread) --------------------
+    def _push_then_finish_on_loop(self, rid: str, tokens: list, reason: str,
+                                  timing: Optional[dict],
+                                  export) -> None:
+        """Ship a finished prefill_only request's committed prefix to its
+        decode replica, then deliver the (delayed) done frame carrying
+        the push outcome.  Every failure mode — nothing cached, connect
+        refused, peer error, timeout — degrades to push_ok:false on the
+        done frame; the ROUTER owns the fallback placement."""
+        st = self._routes.get(rid)
+        if st is None:
+            return
+
+        async def _run():
+            ok, err, pages, nbytes = False, "nothing cached to ship", 0, 0
+            if export is not None:
+                xtoks, meta, payload = export
+                pages, nbytes = int(meta["n_pages"]), len(payload)
+                try:
+                    ok, err = await asyncio.wait_for(
+                        self._kv_push(st.push_to, st.cid, xtoks, meta,
+                                      payload),
+                        timeout=self.kv_push_timeout_s)
+                except asyncio.TimeoutError:
+                    ok, err = False, f"kv_push timed out after " \
+                                     f"{self.kv_push_timeout_s:g}s"
+                except OSError as e:
+                    ok, err = False, f"kv_push failed: {e}"
+            self._kv_pushes += 1
+            self._m_kv_pushes.inc()
+            if ok:
+                self.flight.record(
+                    "kv_ship", pages=pages, bytes=nbytes,
+                    dest=f"{st.push_to.get('host')}:"
+                         f"{st.push_to.get('port')}")
+            else:
+                self._kv_push_failures += 1
+                self._m_kv_push_fail.inc()
+            extra = {"push_ok": ok, "pushed_pages": pages if ok else 0}
+            if not ok:
+                extra["push_error"] = err
+            self._finish_on_loop(rid, tokens, reason, timing, extra=extra)
+
+        self._loop.create_task(_run())
+
+    async def _kv_push(self, push_to: dict, cid, toks, meta: dict,
+                       payload: bytes) -> tuple[bool, str]:
+        """One outbound kv_push: connect to the decode replica, stream
+        the blob as BIN frames chunked under the serving binary-frame cap
+        (part 0 carries tokens + meta; the receiver mounts on `last`),
+        await the single kv_push reply.  The caller bounds the whole
+        exchange with kv_push_timeout_s."""
+        reader, writer = await asyncio.open_connection(
+            str(push_to.get("host")), int(push_to.get("port")))
+        try:
+            chunk = wire.MAX_BIN_PAYLOAD - 65536    # header headroom
+            parts = [payload[i:i + chunk]
+                     for i in range(0, len(payload), chunk)] or [b""]
+            for i, part in enumerate(parts):
+                hdr = {"type": "kv_push", "id": cid, "seq": i,
+                       "last": i == len(parts) - 1}
+                if i == 0:
+                    hdr["tokens"] = [int(t) for t in toks]
+                    hdr["meta"] = meta
+                writer.write(wire.encode_bin(hdr, part))
+                await writer.drain()
+            while True:
+                reply = await wire.read_frame(
+                    reader, bin_cap=wire.MAX_BIN_PAYLOAD)
+                if reply is None:
+                    return False, "peer closed during kv_push"
+                if reply.get("type") == "kv_push":
+                    return (bool(reply.get("ok")),
+                            str(reply.get("error", "")))
+                if reply.get("type") == "error":
+                    return False, str(reply.get("error"))
+        finally:
+            try:
+                writer.close()
+            except ConnectionError:
+                pass
 
     def _fail_on_loop(self, rid: str, message: str) -> None:
         st = self._routes.pop(rid, None)
@@ -808,7 +976,8 @@ class ServingServer:
         try:
             while True:
                 try:
-                    msg = await wire.read_frame(reader)
+                    msg = await wire.read_frame(
+                        reader, bin_cap=wire.MAX_BIN_PAYLOAD)
                 except wire.FrameError as e:
                     # a malformed FIRST frame is usually a peer speaking the
                     # wrong protocol entirely (an HTTP probe, a bare JSON
@@ -847,6 +1016,10 @@ class ServingServer:
             # pinned to a dead socket
             for rid in list(conn.rids.values()):
                 self._cmds.put(("cancel", rid))
+            # half-shipped kv_push blobs die with their connection — the
+            # buffered parts must not outlive the peer that was sending
+            for key in [k for k in self._kv_parts if k[0] == conn.seq]:
+                del self._kv_parts[key]
             self._wake.set()
             try:
                 writer.close()
@@ -857,6 +1030,8 @@ class ServingServer:
         t = msg.get("type")
         if t == "generate":
             self._handle_generate(conn, msg)
+        elif t == "kv_push":
+            self._handle_kv_push(conn, msg)
         elif t == "cancel":
             cid = msg.get("id")
             rid = conn.rids.get(cid) if isinstance(cid, (str, int)) else None
@@ -921,7 +1096,9 @@ class ServingServer:
                 "replica",
                 server="paddle_tpu-serving",
                 capabilities=sorted(["hello", "generate", "cancel", "stats",
-                                     "metrics", "dump", "ping", "trace"]),
+                                     "metrics", "dump", "ping", "trace",
+                                     "kv_xfer"]),
+                role_mode=self.role,
                 num_slots=len(self.engine.slots),
                 max_inflight=self.max_inflight,
                 page_size=int(self.engine.kv.page_size),
@@ -936,6 +1113,63 @@ class ServingServer:
         else:
             conn.send({"type": "error", "id": msg.get("id"),
                        "error": f"unknown message type {t!r}"})
+
+    def _handle_kv_push(self, conn: _Conn, msg: dict) -> None:
+        """Inbound cross-replica KV blob (decode side).  Multi-part BIN
+        frames accumulate per (connection, id) — part 0 carries tokens +
+        meta and declares the page count, later parts append payload
+        bytes, `last` hands the whole blob to the pump for an
+        import_prefix mount between steps.  The buffer is bounded by the
+        DECLARED blob (itself bounded by the receiver's own pool size):
+        a sender that overruns its declaration, or skips part 0, is
+        refused immediately — never buffered unboundedly."""
+        cid = msg.get("id")
+        if not isinstance(cid, (str, int)):
+            conn.send({"type": "error", "id": None,
+                       "error": "kv_push needs a string or int 'id'"})
+            return
+        key = (conn.seq, cid)
+
+        def refuse(err: str) -> None:
+            self._kv_parts.pop(key, None)
+            conn.send({"type": "kv_push", "id": cid, "ok": False,
+                       "error": err})
+
+        if self.engine.prefix is None:
+            refuse("prefix cache disabled on this replica")
+            return
+        if self._draining:
+            refuse("replica is draining")
+            return
+        if not self.pump_alive():
+            refuse("engine pump is not running")
+            return
+        payload = msg.get(wire.PAYLOAD_KEY) or b""
+        if int(msg.get("seq", 0)) == 0:
+            meta = msg.get("meta") or {}
+            n = int(meta.get("n_pages", 0))
+            if n <= 0 or n >= self.engine.kv.num_pages:
+                refuse(f"blob declares {n} pages; this replica's pool "
+                       f"holds {self.engine.kv.num_pages}")
+                return
+            self._kv_parts[key] = {
+                "cid": cid, "tokens": msg.get("tokens") or [],
+                "meta": meta, "parts": [], "bytes": 0,
+                "expect": n * self.engine.kv.page_nbytes}
+        st = self._kv_parts.get(key)
+        if st is None:
+            refuse("kv_push part arrived with no part 0")
+            return
+        st["parts"].append(payload)
+        st["bytes"] += len(payload)
+        if st["bytes"] > st["expect"]:
+            refuse(f"kv_push accumulated {st['bytes']} bytes, over the "
+                   f"{st['expect']}-byte declared blob")
+            return
+        if msg.get("last"):
+            self._kv_parts.pop(key, None)
+            self._cmds.put(("kv_import", st, conn))
+            self._wake.set()
 
     def _handle_generate(self, conn: _Conn, msg: dict) -> None:
         cid = msg.get("id")
@@ -973,14 +1207,24 @@ class ServingServer:
                        "inflight": self._inflight,
                        "max_inflight": self.max_inflight})
             return
+        if msg.get("prefill_only"):
+            # disaggregated prefill: run the prompt through admission
+            # (prefill + donation) but generate nothing beyond the one
+            # token sampling requires — the router discards it; streaming
+            # is forced off so the decode replica's run owns every token
+            msg = dict(msg, max_new=1, stream=False)
         try:
             req = self._build_request(conn, cid, msg)
             self.engine.validate(req)
         except (ValueError, AssertionError, TypeError) as e:
             conn.send({"type": "error", "id": cid, "error": str(e)})
             return
-        self._routes[req.req_id] = _ReqState(conn, cid,
-                                             msg.get("stream", True))
+        st = _ReqState(conn, cid, msg.get("stream", True))
+        if msg.get("prefill_only"):
+            push_to = msg.get("push_to")
+            st.push_to = push_to if isinstance(push_to, dict) else None
+            st.prompt = req.prompt_ids
+        self._routes[req.req_id] = st
         conn.rids[cid] = req.req_id
         self._inflight += 1
         self._m_accepted.inc()
@@ -1066,6 +1310,10 @@ class ServingServer:
             "restored_pages_total": int(eng.kv.n_restored),
             "restore_hits": eng.n_restore_hits,
             "restore_tokens_saved": eng.restore_tokens_saved,
+            # cross-replica kv transfer (the disagg plane's operator view)
+            "kv_pages_shipped": int(eng.kv.n_exported),
+            "kv_pages_received": int(eng.kv.n_imported),
+            "kv_mounts": eng.n_kv_mounts,
             "prefill_chunk": eng.prefill_chunk,
             "max_step_tokens": eng.max_step_tokens,
             "prefill_chunks": eng.n_prefill_chunks,
@@ -1110,6 +1358,9 @@ class ServingServer:
             "inflight": self._inflight,
             "max_inflight": self.max_inflight,
             "draining": self._draining,
+            "role": self.role,
+            "kv_pushes": self._kv_pushes,
+            "kv_push_failures": self._kv_push_failures,
             "pump_alive": self.pump_alive(),
             "pump_last_step_age_s": round(self.pump_last_step_age(), 3),
             "latency_ms": lat,

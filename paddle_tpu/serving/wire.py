@@ -46,6 +46,15 @@ MAX_FRAME = 64 * 1024 * 1024
 #: can never be set by accident on a well-formed legacy stream
 BIN_BIT = 0x80000000
 
+#: the SERVING tier's binary-frame budget: the replica server and the
+#: fleet router read with `bin_cap=MAX_BIN_PAYLOAD`, so a hostile/corrupt
+#: BIN length prefix on a front-end socket can never make them buffer
+#: tens of megabytes (kv_push senders chunk their page payloads under
+#: this).  The cap is OPT-IN per read path — the parameter-server wire
+#: legitimately ships whole-shard block frames far above it and keeps the
+#: plain MAX_FRAME bound.
+MAX_BIN_PAYLOAD = 8 * 1024 * 1024
+
 #: decoded binary frames carry their raw payload under this key (bytes);
 #: leading underscore keeps it out of any JSON re-encode by convention
 PAYLOAD_KEY = "_payload"
@@ -149,18 +158,26 @@ def check_length(raw: bytes) -> int:
     return split_length(raw)[0]
 
 
-def split_length(raw: bytes) -> tuple[int, bool]:
-    """Validate a length prefix; returns (body length, is_binary)."""
+def split_length(raw: bytes,
+                 bin_cap: Optional[int] = None) -> tuple[int, bool]:
+    """Validate a length prefix; returns (body length, is_binary).
+    `bin_cap` additionally bounds a BINARY frame's declared body — the
+    serving front ends pass MAX_BIN_PAYLOAD so a corrupt/hostile prefix
+    is refused BEFORE any buffering, not after 64 MiB of it."""
     (n,) = _LEN.unpack(raw)
     binary = bool(n & BIN_BIT)
     n &= ~BIN_BIT
     if n > MAX_FRAME:
         raise FrameError(f"frame length {n} exceeds the {MAX_FRAME}-byte "
                          f"cap — corrupt stream?")
+    if binary and bin_cap is not None and n > bin_cap:
+        raise FrameError(f"binary frame length {n} exceeds this "
+                         f"endpoint's {bin_cap}-byte binary-frame cap")
     return n, binary
 
 
-async def read_frame(reader) -> Optional[dict]:
+async def read_frame(reader, bin_cap: Optional[int] = None) \
+        -> Optional[dict]:
     """One frame from an asyncio StreamReader; None on clean EOF."""
     import asyncio
 
@@ -168,7 +185,7 @@ async def read_frame(reader) -> Optional[dict]:
         raw = await reader.readexactly(_LEN.size)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
-    n, binary = split_length(raw)
+    n, binary = split_length(raw, bin_cap=bin_cap)
     try:
         body = await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionError) as e:
@@ -231,14 +248,15 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def read_frame_sync(sock: socket.socket) -> Optional[dict]:
+def read_frame_sync(sock: socket.socket,
+                    bin_cap: Optional[int] = None) -> Optional[dict]:
     """One frame from a blocking socket; None on clean EOF."""
     raw = _recv_exact(sock, _LEN.size)
     if raw is None:
         return None
     if len(raw) < _LEN.size:
         raise FrameError("stream ended inside a length prefix")
-    n, binary = split_length(raw)
+    n, binary = split_length(raw, bin_cap=bin_cap)
     body = _recv_exact(sock, n)
     if body is None or len(body) < n:
         raise FrameError(f"stream ended mid-frame (wanted {n} bytes)")

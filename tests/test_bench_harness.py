@@ -202,6 +202,51 @@ def test_assemble_lkg_stitches_serving_fleet_record(tmp_path):
     assert out["serving_fleet"]["trace_on_tok_per_sec"] == 5084.6
 
 
+def test_assemble_lkg_stitches_serving_disagg_record(tmp_path):
+    """ISSUE 19 wiring: the disaggregated prefill/decode record
+    (role-split tok/s vs the colocated arm + the kv_push transfer
+    ledger) rides the same per-config queue shape — a top-level
+    BENCH_ONLY=serving_disagg record must stitch into the assembled
+    fallback under the `serving_disagg` key with the companions
+    intact."""
+    bench = _load_bench()
+    M = bench._METRIC_OF
+    assert M["serving_disagg"] == "lm_serving_disagg_tok_per_sec"
+    assert "serving_disagg" in bench.BENCHES
+    log = tmp_path / "PERF_LOG.jsonl"
+    rows = [
+        {"ts": "2026-08-03T09:00:00+00:00",
+         "record": {"metric": M["vgg"], "value": 100.0, "vs_baseline": 2.0}},
+        {"ts": "2026-08-05T10:00:00+00:00",
+         "record": {"metric": M["serving_disagg"], "value": 4980.2,
+                    "coloc_tok_per_sec": 4410.7,
+                    "speedup_vs_coloc": 1.129,
+                    "first_tok_ms_p50": 21.4,
+                    "first_tok_ms_p99": 48.9,
+                    "coloc_first_tok_ms_p50": 35.6,
+                    "coloc_first_tok_ms_p99": 92.3,
+                    "kv_pushes": 64.0,
+                    "kv_push_failures": 0.0,
+                    "kv_fallbacks": 0.0,
+                    "pages_shipped": 512.0,
+                    "ok": True,
+                    "measured_at": "2026-08-05T10:00:00+00:00"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bench._PERF_LOG = str(log)
+    out = bench._assemble_lkg()
+    assert out["serving_disagg"]["value"] == 4980.2
+    assert out["serving_disagg"]["coloc_tok_per_sec"] == 4410.7
+    assert out["serving_disagg"]["speedup_vs_coloc"] == 1.129
+    # the transfer-plane reconcile ledger (pages genuinely shipped,
+    # zero push failures or fallbacks) survives the per-part stitch
+    assert out["serving_disagg"]["kv_pushes"] == 64.0
+    assert out["serving_disagg"]["kv_push_failures"] == 0.0
+    assert out["serving_disagg"]["kv_fallbacks"] == 0.0
+    assert out["serving_disagg"]["pages_shipped"] == 512.0
+    assert out["serving_disagg"]["ok"] is True
+
+
 def test_assemble_lkg_stitches_serving_tp_record(tmp_path):
     """ISSUE 11 wiring: the tensor-parallel sharded-decode record
     (lm_serving_tp_tok_per_sec + the 1-vs-N-shard A/B companions incl.

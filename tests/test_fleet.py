@@ -44,8 +44,9 @@ def _replica(tr, **kw):
     kw.setdefault("page_size", PAGE)
     kw.setdefault("max_context", 64)
     max_queue = kw.pop("max_queue", 16)
+    role = kw.pop("role", "both")
     eng = ServingEngine(tr.executor, tr.params, **kw)
-    srv = ServingServer(eng, max_queue=max_queue)
+    srv = ServingServer(eng, max_queue=max_queue, role=role)
     host, port = srv.start_background()
     return srv, host, port
 
@@ -566,6 +567,201 @@ def test_router_rejects_non_replica_peer_on_join(tiny_tr):
             assert len(ctl.list()) == 1           # table unchanged
     finally:
         _stop_all(rt, srvs)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: disaggregated prefill/decode through the router
+# ---------------------------------------------------------------------------
+
+def _disagg_fleet(tr, router_kw=None, prefill_kw=None, decode_kw=None):
+    """1 prefill-role + 1 decode-role replica behind a router — the
+    minimal disaggregated fleet.  Long prompts place on the prefill
+    replica, kv_push their committed pages to the decode replica, and
+    the generate frame follows the pages."""
+    sp, hp, pp = _replica(tr, role="prefill", **(prefill_kw or {}))
+    sd, hd, pd = _replica(tr, role="decode", **(decode_kw or {}))
+    rkw = dict(poll_interval_s=0.1, heartbeat_misses=100)
+    rkw.update(router_kw or {})
+    rt = FleetRouter(port=0, replicas=[(hp, pp), (hd, pd)], **rkw)
+    host, port = rt.start_background()
+    return rt, host, port, [sp, sd]
+
+
+def test_disagg_cross_replica_exactness_and_role_surfaces(tiny_tr):
+    """ISSUE 19 acceptance: a request prefilled on replica A and decoded
+    on replica B streams token-for-token what a single replica (itself
+    oracle-checked) produces — greedy AND seeded-sampled — while the
+    router's kv_xfer counters, the placement ledger, and ctl's role
+    column all tell the disaggregation story.  Short prompts bypass the
+    split and stay exact."""
+    rng = np.random.default_rng(5)
+    rt, host, port, srvs = _disagg_fleet(tiny_tr)
+    sp, sd = srvs
+    try:
+        prompts = [rng.integers(2, 31, int(rng.integers(2 * PAGE + 1,
+                                                        3 * PAGE))).tolist()
+                   for _ in range(3)]
+        with ServingClient(host, port) as c:
+            ids = [c.submit(p, max_new=5) for p in prompts]
+            sampled = c.submit(prompts[0], max_new=5, temperature=0.9,
+                               top_k=4, seed=13)
+            out = c.collect(ids + [sampled])
+        dsrv, dh, dp = _replica(tiny_tr)          # single-replica control
+        try:
+            with ServingClient(dh, dp) as d:
+                for rid, p in zip(ids, prompts):
+                    toks, reason = d.generate(p, max_new=5)
+                    assert out[rid]["tokens"] == toks == _oracle(
+                        tiny_tr, p, 5), "disagg decode diverged"
+                    assert out[rid]["reason"] == reason == "length"
+                    assert out[rid]["stream"] == \
+                        out[rid]["tokens"][len(p):]
+                stoks, _ = d.generate(prompts[0], max_new=5,
+                                      temperature=0.9, top_k=4, seed=13)
+                assert out[sampled]["tokens"] == stoks == _oracle(
+                    tiny_tr, prompts[0], 5, temperature=0.9, top_k=4,
+                    seed=13), "seeded sampling must survive the split"
+        finally:
+            dsrv.stop_background(drain=True)
+        # every long prompt actually split: prefill leg + decode leg
+        with ServingClient(host, port) as c:
+            s = c.stats()
+            assert s["kv_pushes"] == 4 and s["kv_push_failures"] == 0
+            assert s["kv_fallbacks"] == 0
+            assert s["kv_pages_shipped"] == 8     # 4 x two committed pages
+            assert s["placements"]["disagg"] == 8.0
+            roles = {r["replica"]: r["role"] for r in s["replicas"]}
+            assert sorted(roles.values()) == ["decode", "prefill"]
+            # the pages really moved: shipped == received, and the decode
+            # side's admissions were prefix hits on mounted runs
+            by_role = {r["role"]: r for r in s["replicas"]}
+            assert by_role["prefill"]["kv_pushes"] == 4
+            assert by_role["prefill"]["kv_pages_shipped"] == 8
+            assert by_role["decode"]["kv_pages_received"] == 8
+            # the ctl's fleet view carries the same columns
+            with FleetCtl(host, port) as ctl:
+                rows = ctl.list()
+            assert sorted(r["role"] for r in rows) == ["decode", "prefill"]
+        assert sd.engine.n_kv_mounts >= 3 and sd.engine.n_prefix_hits >= 4
+        # a prompt under the floor (one KV page) never splits
+        short = [3, 4, 5, 6, 7]
+        with ServingClient(host, port) as c:
+            toks, reason = c.generate(short, max_new=4)
+            assert reason == "length"
+            assert toks == _oracle(tiny_tr, short, 4)
+            assert c.stats()["kv_pushes"] == 4    # unchanged
+    finally:
+        _stop_all(rt, srvs)
+
+
+def test_disagg_cow_divergence_on_shipped_pages_stays_exact(tiny_tr):
+    """Two requests sharing the shipped two-page run then DIVERGING
+    afterward: both reference the same mounted pages on the decode
+    replica concurrently, each appends into its own pages past the
+    shared run, and both stay bit-exact (a write-through into a shared
+    mounted page would corrupt the sibling)."""
+    rng = np.random.default_rng(6)
+    rt, host, port, srvs = _disagg_fleet(tiny_tr)
+    sp, sd = srvs
+    try:
+        shared = rng.integers(2, 31, 2 * PAGE).tolist()
+        p_a = shared + [9, 3, 11]
+        p_b = shared + [4, 17]
+        with ServingClient(host, port) as c:
+            ra = c.submit(p_a, max_new=6)
+            rb = c.submit(p_b, max_new=6)
+            out = c.collect([ra, rb])
+        assert out[ra]["tokens"] == _oracle(tiny_tr, p_a, 6)
+        assert out[rb]["tokens"] == _oracle(tiny_tr, p_b, 6), \
+            "divergent sibling corrupted by a shared shipped page?"
+        assert sd.engine.n_kv_mounts >= 1
+        assert sd.engine.n_prefix_hits >= 2      # both legs hit the run
+        for srv in srvs:
+            srv.engine.kv.check_reclaimed()
+    finally:
+        _stop_all(rt, srvs)
+
+
+def test_disagg_decode_preemption_replay_stays_exact(tiny_tr):
+    """An OVERCOMMITTED decode-side pool under disaggregated load:
+    mounted pages are shared by concurrent slots, growth wedges the
+    pool, victims are preempted and replayed — and every completed
+    request still matches its oracle exactly."""
+    rng = np.random.default_rng(8)
+    rt, host, port, srvs = _disagg_fleet(tiny_tr,
+                                         decode_kw=dict(num_pages=5))
+    sp, sd = srvs
+    try:
+        shared = rng.integers(2, 31, 2 * PAGE).tolist()
+        jobs = []
+        with ServingClient(host, port) as c:
+            for i in range(4):
+                # 2 shared pages + 1 distinct token, then 14 new tokens:
+                # two concurrent slots want 6 of the 5 real pages
+                p = shared + [2 + i]
+                jobs.append((c.submit(p, max_new=14, stream=False), p))
+            out = c.collect([rid for rid, _ in jobs])
+        for rid, p in jobs:
+            assert out[rid]["tokens"] == _oracle(tiny_tr, p, 14), \
+                "preemption/replay changed a disagg request's tokens"
+            assert out[rid]["reason"] == "length"
+        assert sd.engine.n_preemptions > 0, \
+            "decode pool was never overcommitted"
+        sd.engine.kv.check_reclaimed()
+    finally:
+        _stop_all(rt, srvs)
+
+
+def test_disagg_prefill_tier_death_degrades_to_both_mode(tiny_tr):
+    """Killing the prefill tier mid-workload: requests in their prefill
+    phase (never streamed, by construction) retry transparently, the
+    router stops planning splits the moment the tier is gone, and the
+    workload completes with ZERO failed requests — all oracle-exact on
+    the surviving decode replica."""
+    rt, host, port, srvs = _disagg_fleet(tiny_tr)
+    sp, sd = srvs
+    results: list = []
+    errors: list = []
+
+    def load_worker(wid):
+        try:
+            with ServingClient(host, port) as c:
+                w_rng = np.random.default_rng(300 + wid)
+                for _ in range(8):
+                    p = w_rng.integers(
+                        2, 31, 2 * PAGE + int(w_rng.integers(1, 6))
+                    ).tolist()
+                    rid = c.submit(p, max_new=4, stream=False)
+                    res = c.collect([rid])[rid]
+                    results.append((p, res["tokens"], res["reason"]))
+        except Exception as e:                     # noqa: BLE001
+            errors.append(e)
+
+    workers = [threading.Thread(target=load_worker, args=(w,))
+               for w in range(2)]
+    try:
+        for t in workers:
+            t.start()
+        time.sleep(0.3)                           # splits provably flowing
+        victim = next(r for r in rt.table if r.role == "prefill")
+        _loop_call(rt, victim.backend.abort)      # the tier "dies"
+        sp.stop_background(drain=False)
+        for t in workers:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in workers), "load wedged"
+        assert errors == [], \
+            f"prefill-tier death failed requests: {errors}"
+        assert len(results) == 16
+        for p, toks, reason in results:
+            assert reason == "length"
+            assert toks == _oracle(tiny_tr, p, 4)
+        with ServingClient(host, port) as c:
+            s = c.stats()
+        assert s["replicas_registered"] == 1
+        assert s["replicas"][0]["role"] == "decode"
+        assert s["kv_pushes"] >= 1, "no split ever ran before the kill"
+    finally:
+        _stop_all(rt, [sd])
 
 
 @pytest.mark.slow

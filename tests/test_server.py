@@ -634,6 +634,10 @@ def test_hello_frame_reports_proto_and_capabilities(tiny_tr):
             assert "dump" in h["capabilities"]
             assert h["page_size"] == 8 and h["num_slots"] == 2
             assert h["max_inflight"] == 6 and h["draining"] is False
+            # the KV transfer plane (ISSUE 19): the capability the router
+            # keys disaggregated placement on, plus the replica's role tier
+            assert "kv_xfer" in h["capabilities"]
+            assert h["role_mode"] == "both"
             # negotiation is just another frame: real work still flows
             toks, reason = c.generate([3, 4, 5], max_new=3)
             assert reason == "length" and len(toks) == 6
@@ -937,3 +941,211 @@ def test_dump_rpc_freezes_bundle_on_demand(tiny_tr, tmp_path):
                 c.dump()
     finally:
         srv2.stop_background(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: binary-frame robustness + the kv_push page-transfer plane
+# ---------------------------------------------------------------------------
+
+def test_bin_frame_over_cap_answers_error_then_severs(tiny_tr):
+    """A peer declaring a binary frame bigger than the endpoint's 8 MiB
+    cap gets an error frame NAMING the cap, then a clean close — the
+    declared length is refused from the 4-byte prefix alone, before a
+    single payload byte is buffered."""
+    import socket
+    import struct
+
+    from paddle_tpu.serving import wire
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        s = socket.create_connection((host, port), timeout=10)
+        s.settimeout(10)
+        try:
+            wire.write_frame_sync(s, {"type": "ping"})
+            assert wire.read_frame_sync(s)["type"] == "pong"
+            s.sendall(struct.pack(
+                ">I", wire.BIN_BIT | (wire.MAX_BIN_PAYLOAD + 1)))
+            msg = wire.read_frame_sync(s)
+            assert msg["type"] == "error"
+            assert "binary-frame cap" in msg["error"]
+            assert str(wire.MAX_BIN_PAYLOAD) in msg["error"]
+            assert wire.read_frame_sync(s) is None     # severed cleanly
+        finally:
+            s.close()
+        # the listener survived the hostile peer: real work still flows
+        with ServingClient(host, port) as c:
+            toks, reason = c.generate([3, 4, 5], max_new=3)
+            assert reason == "length" and len(toks) == 6
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_bin_frame_truncated_mid_payload_severs_cleanly(tiny_tr):
+    """A binary frame whose sender dies mid-payload must not wedge the
+    reader or leak half-buffered kv_push state — the connection dies,
+    the buffered parts die with it, the server keeps serving."""
+    import socket
+    import struct
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        from paddle_tpu.serving import wire
+
+        s = socket.create_connection((host, port), timeout=10)
+        try:
+            # declare a 4096-byte binary body, deliver 10 bytes, vanish
+            s.sendall(struct.pack(">I", wire.BIN_BIT | 4096) + b"x" * 10)
+        finally:
+            s.close()
+        deadline = time.time() + 20
+        while srv._conns and time.time() < deadline:
+            time.sleep(0.01)
+        assert not srv._conns, "truncated peer's connection never reaped"
+        assert srv._kv_parts == {}
+        with ServingClient(host, port) as c:
+            toks, reason = c.generate([3, 4, 5], max_new=3)
+            assert reason == "length" and len(toks) == 6
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_kv_push_malformed_frames_refused_not_fatal(tiny_tr):
+    """Hostile/buggy kv_push senders — no part 0, page counts outside
+    the pool, payload overrunning the declared blob, garbage meta — each
+    answer a `kv_push ok:false` (or error) frame and leave the
+    connection serving; nothing is buffered past the refusal."""
+    import socket
+
+    from paddle_tpu.serving import wire
+
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        s = socket.create_connection((host, port), timeout=30)
+        s.settimeout(30)
+        try:
+            # unusable id: error frame, not a dead socket
+            s.sendall(wire.encode_bin({"type": "kv_push", "id": [1],
+                                       "seq": 0, "last": True}, b""))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["type"] == "error" and "id" in msg["error"]
+            # part 1 with no part 0 before it
+            s.sendall(wire.encode_bin({"type": "kv_push", "id": "a",
+                                       "seq": 1, "last": True}, b"zz"))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["type"] == "kv_push" and msg["ok"] is False
+            assert "no part 0" in msg["error"]
+            # page counts the pool cannot hold (zero / the whole pool)
+            for n in (0, eng.kv.num_pages):
+                s.sendall(wire.encode_bin(
+                    {"type": "kv_push", "id": "b", "seq": 0, "last": True,
+                     "tokens": [3] * 8, "meta": {"n_pages": n}}, b""))
+                msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+                assert msg["ok"] is False and "pool" in msg["error"]
+            # payload overruns the declared 1-page blob
+            s.sendall(wire.encode_bin(
+                {"type": "kv_push", "id": "c", "seq": 0, "last": True,
+                 "tokens": [3] * 8, "meta": {"n_pages": 1}},
+                b"\0" * (eng.kv.page_nbytes + 1)))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["ok"] is False and "declared blob" in msg["error"]
+            # structurally valid framing, garbage meta: the import itself
+            # refuses on the pump thread and answers ok:false
+            s.sendall(wire.encode_bin(
+                {"type": "kv_push", "id": "d", "seq": 0, "last": True,
+                 "tokens": [3] * 8,
+                 "meta": {"n_pages": 1, "page_size": 8, "layers": []}},
+                b"\0" * eng.kv.page_nbytes))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["type"] == "kv_push" and msg["ok"] is False
+            assert srv._kv_parts == {}, "a refusal left buffered parts"
+            # the connection survived every refusal — real work flows
+            wire.write_frame_sync(s, {"type": "generate", "id": "ok",
+                                      "prompt": [3, 4, 5], "max_new": 2,
+                                      "stream": False})
+            while True:
+                msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+                if msg["type"] == "done":
+                    break
+            assert msg["reason"] == "length" and len(msg["tokens"]) == 5
+        finally:
+            s.close()
+        eng.kv.check_reclaimed()
+    finally:
+        srv.stop_background(drain=True)
+
+
+def test_kv_push_ships_pages_and_decode_side_admission_hits(tiny_tr):
+    """The transfer plane end to end between two servers: a prefill_only
+    request on replica A pushes its committed prompt pages to replica B;
+    B mounts them through its prefix tree, so the SAME prompt admitted
+    at B is a prefix hit and decodes token-for-token with the oracle.
+    A push aimed at a dead port degrades to push_ok:false on the done
+    frame (counted), never an error."""
+    rng = np.random.default_rng(9)
+    eng_a = _engine(tiny_tr)
+    srv_a = ServingServer(eng_a, max_queue=8, role="prefill")
+    ha, pa = srv_a.start_background()
+    eng_b = _engine(tiny_tr)
+    srv_b = ServingServer(eng_b, max_queue=8, role="decode")
+    hb, pb = srv_b.start_background()
+    try:
+        prompt = rng.integers(2, 31, 19).tolist()   # 2 committed pages
+        with ServingClient(ha, pa) as ca:
+            rid = ca.submit(prompt, max_new=8, prefill_only=True,
+                            push_to={"host": hb, "port": pb})
+            out = ca.collect([rid])
+            assert out[rid]["push_ok"] is True
+            assert out[rid]["pushed_pages"] == 2
+            # prefill_only clamps generation to the 1-token boundary
+            assert len(out[rid]["tokens"]) == len(prompt) + 1
+            sa = ca.stats()
+            assert sa["role"] == "prefill"
+            assert sa["kv_pushes"] == 1 and sa["kv_push_failures"] == 0
+            assert sa["kv_pages_shipped"] == 2
+        with ServingClient(hb, pb) as cb:
+            sb = cb.stats()
+            assert sb["role"] == "decode"
+            assert sb["kv_pages_received"] == 2 and sb["kv_mounts"] == 1
+            toks, reason = cb.generate(prompt, max_new=6)
+            assert reason == "length"
+            assert toks == _oracle(tiny_tr, prompt, 6)
+            assert cb.stats()["prefix_hits"] == 1, \
+                "shipped pages must make the decode-side admission a hit"
+        # same request single-replica: identical tokens (the exactness bar)
+        eng_c = _engine(tiny_tr)
+        srv_c = ServingServer(eng_c, max_queue=8)
+        hc, pc = srv_c.start_background()
+        try:
+            with ServingClient(hc, pc) as cc:
+                ctoks, _ = cc.generate(prompt, max_new=6)
+            assert toks == ctoks
+        finally:
+            srv_c.stop_background(drain=True)
+        # a push to a dead port: honest push_ok:false, request still done
+        import socket
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        with ServingClient(ha, pa) as ca:
+            rid = ca.submit(rng.integers(2, 31, 10).tolist(), max_new=4,
+                            prefill_only=True,
+                            push_to={"host": "127.0.0.1",
+                                     "port": dead_port})
+            out = ca.collect([rid])
+            assert out[rid]["push_ok"] is False
+            assert "kv_push" in out[rid]["push_error"]
+            assert ca.stats()["kv_push_failures"] == 1
+        eng_a.kv.check_reclaimed()
+        eng_b.kv.check_reclaimed()
+    finally:
+        srv_a.stop_background(drain=True)
+        srv_b.stop_background(drain=True)

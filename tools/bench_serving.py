@@ -847,7 +847,7 @@ def measure_scan(eng, wl: dict, reps: int, seed: int, k: int) -> dict:
 # replica, on the prefix-skew workload, affinity vs random placement
 # ---------------------------------------------------------------------------
 
-def _spawn_replica(args, seed: int = 1):
+def _spawn_replica(args, seed: int = 1, role: str = None):
     """One tools/serve.py subprocess built from the SAME model recipe as
     build_engine (identical params across replicas: same config, same
     seed); returns (proc, host, port) once its SERVE_JSON line prints."""
@@ -863,6 +863,8 @@ def _spawn_replica(args, seed: int = 1):
             "--slots", str(args.slots), "--page-size", str(args.page_size),
             "--max-context", str(args.max_context),
             "--max-queue", "64", "--seed", str(seed), "--port", "0"]
+    if role:
+        argv += ["--role", role]
     env = dict(os.environ, PYTHONPATH=repo)
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True, cwd=repo,
@@ -963,6 +965,9 @@ def run_client_workload(host: str, port: int, prompts, max_new: int,
             "tok_per_sec": sum(tokens) / dt if dt else 0.0,
             "first_tok_ms_p50": round(float(
                 np.percentile(first_tok, 50)) * 1e3, 3) if first_tok
+            else 0.0,
+            "first_tok_ms_p99": round(float(
+                np.percentile(first_tok, 99)) * 1e3, 3) if first_tok
             else 0.0,
             "failures": failures}
 
@@ -1177,6 +1182,93 @@ def measure_fleet(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode bench: router + 2 colocated role=both
+# replicas vs router + 1 prefill-role + 1 decode-role replica, the SAME
+# long-prompt workload (docs/serving.md "Disaggregated prefill/decode")
+# ---------------------------------------------------------------------------
+
+def measure_disagg(args) -> dict:
+    """The disaggregation A/B (ISSUE 19): the SAME prefix-skew workload
+    (same seeds, same request budget) through
+      (a) colocated — a router over 2 role=both replicas (each request
+          prefills AND decodes where it lands);
+      (b) disagg — a router over 1 prefill-role + 1 decode-role replica:
+          long prompts prefill on one, kv_push their committed pages,
+          and decode on the other.
+    Every arm gets FRESH replica subprocesses and an untimed warmup over
+    a different prefix pool.  Reported: tokens/s + first-token p50/p99
+    per arm, and the transfer ledger polled from the disagg router.
+    Reconcile gate: zero failed requests in either arm, and the disagg
+    arm genuinely shipped pages with zero push failures (a fallback-only
+    run would silently measure colocated serving twice)."""
+    wl = dict(n=args.num_requests, prefix_pool=args.prefix_pool,
+              prefix_len=args.prefix_len, prefix_skew=args.prefix_skew,
+              suffix_lo=args.suffix_lo, suffix_hi=args.suffix_hi,
+              vocab=args.vocab)
+    timed_prompts = make_prefix_prompts(pool_seed=args.seed,
+                                        seed=args.seed + 1, **wl)
+    warm_prompts = make_prefix_prompts(pool_seed=args.seed + 1000,
+                                       seed=args.seed + 1001, **wl)
+
+    def one_arm(roles):
+        from paddle_tpu.fleet import FleetRouter
+        from paddle_tpu.serving.client import ServingClient
+
+        procs, addrs = [], []
+        rt = None
+        try:
+            for role in roles:
+                proc, host, port = _spawn_replica(args, role=role)
+                procs.append(proc)
+                addrs.append((host, port))
+            rt = FleetRouter(port=0, replicas=addrs, policy="affinity")
+            host, port = rt.start_background()
+            warm = run_client_workload(host, port, warm_prompts,
+                                       args.max_new, args.concurrency)
+            if warm["failures"]:
+                raise RuntimeError(f"warmup failed: {warm['failures'][:3]}")
+            rec = run_client_workload(host, port, timed_prompts,
+                                      args.max_new, args.concurrency)
+            with ServingClient(host, port, timeout=60) as c:
+                s = c.stats()
+            for k in ("kv_pushes", "kv_push_failures", "kv_fallbacks",
+                      "kv_pages_shipped", "sheds", "retries"):
+                rec[k] = s[k]
+            return rec
+        finally:
+            if rt is not None:
+                rt.stop_background(drain=True)
+            _stop_procs(procs)
+
+    coloc = one_arm(["both", "both"])
+    disagg = one_arm(["prefill", "decode"])
+    ok = (not coloc["failures"] and not disagg["failures"]
+          and disagg["kv_pages_shipped"] > 0
+          and disagg["kv_push_failures"] == 0
+          and disagg["kv_fallbacks"] == 0)
+    return {
+        "concurrency": args.concurrency,
+        "ok": ok,
+        "failures": (coloc["failures"] + disagg["failures"])[:5],
+        "tok_per_sec": round(disagg["tok_per_sec"], 1),
+        "coloc_tok_per_sec": round(coloc["tok_per_sec"], 1),
+        "speedup_vs_coloc": round(
+            disagg["tok_per_sec"] / coloc["tok_per_sec"], 3)
+        if coloc["tok_per_sec"] else 0.0,
+        "first_tok_ms_p50": disagg["first_tok_ms_p50"],
+        "first_tok_ms_p99": disagg["first_tok_ms_p99"],
+        "coloc_first_tok_ms_p50": coloc["first_tok_ms_p50"],
+        "coloc_first_tok_ms_p99": coloc["first_tok_ms_p99"],
+        "kv_pushes": disagg["kv_pushes"],
+        "kv_push_failures": disagg["kv_push_failures"],
+        "kv_fallbacks": disagg["kv_fallbacks"],
+        "pages_shipped": disagg["kv_pages_shipped"],
+        "router_sheds": disagg["sheds"],
+        "router_retries": disagg["retries"],
+    }
+
+
 def build_engine(args, mesh=None):
     from paddle_tpu.config.parser import parse_config
     from paddle_tpu.serving import ServingEngine
@@ -1328,6 +1420,15 @@ def main() -> int:
                          "random prefix hit rates)")
     ap.add_argument("--concurrency", type=int, default=8,
                     help="client threads driving the fleet workload")
+    # disaggregated prefill/decode (docs/serving.md "Disaggregated
+    # prefill/decode"): --disagg runs the role-split A/B — router + 2
+    # colocated role=both replicas vs router + 1 prefill + 1 decode
+    # replica with the kv_push page-transfer plane, same seeds/budget
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode A/B "
+                         "(reports tok/s + first-token p50/p99 per arm "
+                         "and the kv_xfer ledger: pushes, pages shipped, "
+                         "failures, fallbacks)")
     ap.add_argument("--no-trace-overhead", dest="trace_overhead",
                     action="store_false", default=True,
                     help="skip the fleet trace-overhead arm (a fourth "
@@ -1400,6 +1501,32 @@ def main() -> int:
                 "pool_shrink_vs_single", "sig_stable")},
         }), flush=True)
         return 0 if m["sig_stable"] else 1
+
+    if args.disagg:
+        if args.prefix_skew is None:
+            args.prefix_skew = 1.0     # the disagg A/B rides the prefix-
+                                       # skew workload too (long shared
+                                       # prompts are what disagg splits)
+        m = measure_disagg(args)
+        print(json.dumps({
+            "bench": "serving_disagg",
+            "num_requests": args.num_requests, "slots": args.slots,
+            "page_size": args.page_size, "max_context": args.max_context,
+            "prefix_pool": args.prefix_pool, "prefix_len": args.prefix_len,
+            "prefix_skew": args.prefix_skew,
+            "suffix_lens": [args.suffix_lo, args.suffix_hi],
+            "max_new": args.max_new, "dim": args.dim,
+            "layers": args.layers, "dtype": args.dtype,
+            "lm_serving_disagg_tok_per_sec": m["tok_per_sec"],
+            **{k: m[k] for k in (
+                "concurrency", "coloc_tok_per_sec", "speedup_vs_coloc",
+                "first_tok_ms_p50", "first_tok_ms_p99",
+                "coloc_first_tok_ms_p50", "coloc_first_tok_ms_p99",
+                "kv_pushes", "kv_push_failures", "kv_fallbacks",
+                "pages_shipped", "router_sheds", "router_retries",
+                "ok", "failures")},
+        }), flush=True)
+        return 0 if m["ok"] else 1
 
     if args.fleet > 0:
         if args.prefix_skew is None:

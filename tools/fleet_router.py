@@ -58,6 +58,7 @@ async def amain(args) -> int:
                      heartbeat_misses=args.heartbeat_misses,
                      wedge_age_s=args.wedge_age_s,
                      retry_limit=args.retry_limit,
+                     disagg_min_prompt=args.disagg_min_prompt,
                      postmortem_dir=args.postmortem_dir or None)
 
     def flush_trace():
@@ -116,6 +117,12 @@ def main(argv=None) -> int:
     ap.add_argument("--retry-limit", type=int, default=2,
                     help="max transparent re-placements of a "
                          "never-streamed request after replica failures")
+    ap.add_argument("--disagg-min-prompt", type=int, default=0,
+                    help="disaggregated prefill/decode: prompts at least "
+                         "this long place on a prefill-role replica and "
+                         "kv_push to a decode-role one (0 = auto: one KV "
+                         "page; negative = never; only fires while both "
+                         "role tiers are placeable — docs/serving.md)")
     ap.add_argument("--postmortem-dir", default="",
                     help="arm the flight recorder: total-fleet-unhealthy "
                          "or a client dump frame freezes an atomic "

@@ -173,7 +173,8 @@ async def amain(args) -> int:
     srv = ServingServer(engine, host=args.host, port=args.port,
                         max_queue=args.max_queue,
                         postmortem_dir=args.postmortem_dir or None,
-                        wedge_threshold_s=args.wedge_threshold_s)
+                        wedge_threshold_s=args.wedge_threshold_s,
+                        role=args.role)
     try:
         host, port = await srv.start()
         print("SERVE_JSON:" + json.dumps(
@@ -269,6 +270,16 @@ def main(argv=None) -> int:
                          "(draft-free pure-decode windows ride the "
                          "scan); 'static' keeps the legacy exclusivity "
                          "(spec disables the scan)")
+    ap.add_argument("--role", choices=["prefill", "decode", "both"],
+                    default="both",
+                    help="disaggregated prefill/decode placement role, "
+                         "advertised to the fleet router via hello: "
+                         "'prefill' replicas run long prompts and "
+                         "kv_push the committed pages to 'decode' "
+                         "replicas, which own the token streams; 'both' "
+                         "(default) serves everything colocated "
+                         "(docs/serving.md 'Disaggregated "
+                         "prefill/decode')")
     ap.add_argument("--max-queue", type=int, default=32,
                     help="admission bound beyond the slots; one more "
                          "request gets an overload response")

@@ -337,6 +337,17 @@ def main() -> int:
                               "--max-new", "4", "--vocab", "64",
                               "--dim", "32", "--layers", "1",
                               "--heads", "2", "--dtype", "float32"]
+        # disaggregated prefill/decode A/B at tiny shapes: 24-token
+        # prefixes (three 8-token pages) clear the disagg floor, so the
+        # role-split arm genuinely ships pages on the CPU rehearse
+        serving_disagg_args = ["--disagg", "--concurrency", "2",
+                               "--num-requests", "8", "--slots", "2",
+                               "--page-size", "8", "--max-context", "96",
+                               "--prefix-pool", "2", "--prefix-len", "24",
+                               "--suffix-lo", "4", "--suffix-hi", "8",
+                               "--max-new", "8", "--vocab", "64",
+                               "--dim", "16", "--layers", "1",
+                               "--heads", "2", "--dtype", "float32"]
         serving_tp_args = ["--mesh-model", "2", "--num-requests", "6",
                            "--slots", "2", "--page-size", "8",
                            "--max-context", "48", "--prompt-lo", "3",
@@ -410,6 +421,11 @@ def main() -> int:
         # one replica, on the prefix-skew defaults (each arm spawns fresh
         # replicas, so this is the longest serving step)
         serving_fleet_args = ["--fleet", "2"]
+        # disaggregated prefill/decode A/B at TPU size: router + 2
+        # colocated replicas vs 1 prefill + 1 decode over kv_push, on
+        # the prefix-skew defaults (two fresh-replica arms — another
+        # long serving step)
+        serving_disagg_args = ["--disagg"]
         # tensor-parallel A/B: needs >= 2 real chips; a 1-chip tunnel
         # records the actionable device-count error instead of wedging
         serving_tp_args = ["--mesh-model", "2"]
@@ -487,6 +503,13 @@ def main() -> int:
         ("bench_serving_fleet_record", [py, "bench.py"], 1500,
          bench_env("serving_fleet", 1440),
          lambda: _metric_fresh(_METRIC_OF["serving_fleet"], fh)),
+        # disaggregated prefill/decode record (role-split tok/s vs the
+        # 2x colocated-replica arm, first-token p50/p99 both arms, and
+        # the kv_push/pages-shipped reconciliation): two fresh-replica
+        # arms behind routers, same budget as the fleet record
+        ("bench_serving_disagg_record", [py, "bench.py"], 1500,
+         bench_env("serving_disagg", 1440),
+         lambda: _metric_fresh(_METRIC_OF["serving_disagg"], fh)),
         # tensor-parallel sharded-decode record (tokens/s 1 vs 2 shards +
         # KV pool bytes per shard): another two-engine A/B, same budget;
         # the rehearse env injects the 2-virtual-device XLA flag
@@ -563,6 +586,11 @@ def main() -> int:
         ("bench_serving_fleet",
          [py, "tools/bench_serving.py"] + serving_fleet_args, 1800, {},
          lambda: _out_fresh("bench_serving_fleet", fh)),
+        # disagg sweep: the full colocated-vs-role-split A/B banked to
+        # OUT (tok/s + first-token latency both arms, kv_push counters)
+        ("bench_serving_disagg",
+         [py, "tools/bench_serving.py"] + serving_disagg_args, 1800, {},
+         lambda: _out_fresh("bench_serving_disagg", fh)),
         # tensor-parallel sweep: the full-size 1-vs-N-shard A/B banked to
         # OUT (tok/s both arms, per-shard pool bytes, sig stability)
         ("bench_serving_tp",
