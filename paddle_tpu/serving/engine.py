@@ -1833,7 +1833,10 @@ class ServingEngine:
                 f"kv import: pool cannot cover {n} fresh pages")
         try:
             self.kv.import_pages(meta, payload, pages)
-        except ValueError:
+        except (ValueError, AssertionError):
+            # import_pages' freshness preconditions are asserts; the
+            # server's pump handler treats both as a clean refusal, so
+            # both must roll the taken pages back or they leak
             self.kv.untake_pages(pages)
             raise
         self.kv.adopt_restored(pages)

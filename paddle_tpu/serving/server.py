@@ -51,6 +51,7 @@ serving/client.py.
 from __future__ import annotations
 
 import asyncio
+import json
 import queue
 import sys
 import threading
@@ -106,6 +107,45 @@ class _ReqState:
 #: face can never drift from this server's (conn.rids maps client id ->
 #: engine req_id here)
 _Conn = wire.FrameConn
+
+
+def _kv_push_frames(cid, toks, meta: dict, payload: bytes) -> list[bytes]:
+    """Split one kv_push blob into encoded BIN frames, each under the
+    receiver's MAX_BIN_PAYLOAD bin_cap.  The cap bounds the WHOLE
+    declared body (header-length word + JSON header + chunk), and part
+    0's header carries the full token list + per-layer meta — for long
+    prompts that header alone runs to hundreds of KiB, so the part-0
+    chunk is sized from the ENCODED header rather than a fixed headroom
+    (a fixed 64 KiB reserve silently busts the cap past ~9k tokens —
+    exactly the prompts --disagg-min-prompt selects for).  Raises
+    wire.FrameError when even an empty-chunk part 0 would exceed the cap
+    (the caller degrades to push_ok:false)."""
+    tokens = [int(t) for t in toks]
+    probe = {"type": "kv_push", "id": cid, "seq": 0, "last": False,
+             "tokens": tokens, "meta": meta}
+    h0 = len(json.dumps(probe, separators=(",", ":")).encode("utf-8"))
+    # 64 bytes absorb the real header's drift from this probe (the
+    # length word, last:true vs false)
+    room0 = wire.MAX_BIN_PAYLOAD - h0 - 64
+    if room0 < 0:
+        raise wire.FrameError(
+            f"kv_push part-0 header is {h0} bytes, over the "
+            f"{wire.MAX_BIN_PAYLOAD}-byte binary-frame cap")
+    # later parts carry a tiny header; 4096 bytes of slack covers it at
+    # any seq digit count
+    chunk = wire.MAX_BIN_PAYLOAD - 4096
+    parts = [payload[:room0]]
+    parts += [payload[i:i + chunk]
+              for i in range(len(parts[0]), len(payload), chunk)]
+    frames = []
+    for i, part in enumerate(parts):
+        hdr = {"type": "kv_push", "id": cid, "seq": i,
+               "last": i == len(parts) - 1}
+        if i == 0:
+            hdr["tokens"] = tokens
+            hdr["meta"] = meta
+        frames.append(wire.encode_bin(hdr, part))
+    return frames
 
 
 class ServingServer:
@@ -899,8 +939,19 @@ class ServingServer:
                 except asyncio.TimeoutError:
                     ok, err = False, f"kv_push timed out after " \
                                      f"{self.kv_push_timeout_s:g}s"
-                except OSError as e:
+                except (OSError, wire.FrameError) as e:
+                    # FrameError: the peer closed mid-frame or replied
+                    # malformed/over-cap — same degradation as a socket
+                    # error, NOT a task-killing exception
                     ok, err = False, f"kv_push failed: {e}"
+                except Exception as e:       # noqa: BLE001 — this task is
+                    # fire-and-forget: an exception escaping here would
+                    # swallow the done frame (the router's prefill leg
+                    # hangs with no retry), leak the route, and pin an
+                    # inflight slot forever; ANY failure must degrade to
+                    # push_ok:false so _finish_on_loop always runs
+                    ok, err = False, f"kv_push failed: " \
+                                     f"{type(e).__name__}: {e}"
             self._kv_pushes += 1
             self._m_kv_pushes.inc()
             if ok:
@@ -925,19 +976,12 @@ class ServingServer:
         (part 0 carries tokens + meta; the receiver mounts on `last`),
         await the single kv_push reply.  The caller bounds the whole
         exchange with kv_push_timeout_s."""
+        frames = _kv_push_frames(cid, toks, meta, payload)
         reader, writer = await asyncio.open_connection(
             str(push_to.get("host")), int(push_to.get("port")))
         try:
-            chunk = wire.MAX_BIN_PAYLOAD - 65536    # header headroom
-            parts = [payload[i:i + chunk]
-                     for i in range(0, len(payload), chunk)] or [b""]
-            for i, part in enumerate(parts):
-                hdr = {"type": "kv_push", "id": cid, "seq": i,
-                       "last": i == len(parts) - 1}
-                if i == 0:
-                    hdr["tokens"] = [int(t) for t in toks]
-                    hdr["meta"] = meta
-                writer.write(wire.encode_bin(hdr, part))
+            for frame in frames:
+                writer.write(frame)
                 await writer.drain()
             while True:
                 reply = await wire.read_frame(
@@ -1119,10 +1163,14 @@ class ServingServer:
         frames accumulate per (connection, id) — part 0 carries tokens +
         meta and declares the page count, later parts append payload
         bytes, `last` hands the whole blob to the pump for an
-        import_prefix mount between steps.  The buffer is bounded by the
-        DECLARED blob (itself bounded by the receiver's own pool size):
-        a sender that overruns its declaration, or skips part 0, is
-        refused immediately — never buffered unboundedly."""
+        import_prefix mount between steps.  Buffering is bounded twice:
+        each accumulation by its DECLARED blob (itself bounded by the
+        receiver's own pool size), and the SUM of declared blobs across
+        all live accumulations by one pool's worth of bytes — so a peer
+        opening many connections (or interleaving many ids) cannot
+        buffer multiples of the pool in host RAM.  A sender that
+        overruns its declaration, skips part 0, or repeats part 0 for a
+        live id is refused immediately — never buffered unboundedly."""
         cid = msg.get("id")
         if not isinstance(cid, (str, int)):
             conn.send({"type": "error", "id": None,
@@ -1146,16 +1194,35 @@ class ServingServer:
             return
         payload = msg.get(wire.PAYLOAD_KEY) or b""
         if int(msg.get("seq", 0)) == 0:
+            if key in self._kv_parts:
+                # a repeated part 0 means the sender's stream is confused
+                # — refuse (dropping the half-built blob) rather than
+                # silently restarting the accumulation mid-flight
+                refuse(f"kv_push part 0 repeated for id {cid!r} while "
+                       f"its blob is still accumulating")
+                return
             meta = msg.get("meta") or {}
             n = int(meta.get("n_pages", 0))
             if n <= 0 or n >= self.engine.kv.num_pages:
                 refuse(f"blob declares {n} pages; this replica's pool "
                        f"holds {self.engine.kv.num_pages}")
                 return
+            expect = n * self.engine.kv.page_nbytes
+            # server-wide budget: total DECLARED bytes across every live
+            # accumulation stays under one pool's worth — any single
+            # blob fits (it declares < num_pages), so only concurrent
+            # pushes that could never all mount anyway are refused
+            pending = sum(s["expect"] for s in self._kv_parts.values())
+            budget = self.engine.kv.num_pages * self.engine.kv.page_nbytes
+            if pending + expect > budget:
+                refuse(f"kv_push buffer budget exhausted: {pending} "
+                       f"bytes already accumulating, blob declares "
+                       f"{expect} more, budget is {budget}")
+                return
             self._kv_parts[key] = {
                 "cid": cid, "tokens": msg.get("tokens") or [],
                 "meta": meta, "parts": [], "bytes": 0,
-                "expect": n * self.engine.kv.page_nbytes}
+                "expect": expect}
         st = self._kv_parts.get(key)
         if st is None:
             refuse("kv_push part arrived with no part 0")

@@ -1065,6 +1065,36 @@ def test_kv_push_malformed_frames_refused_not_fatal(tiny_tr):
             msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
             assert msg["type"] == "kv_push" and msg["ok"] is False
             assert srv._kv_parts == {}, "a refusal left buffered parts"
+            # a repeated part 0 while the id's blob is still accumulating
+            # is refused (the half-built blob dropped), never a silent
+            # restart of the accumulation
+            for _ in range(2):
+                s.sendall(wire.encode_bin(
+                    {"type": "kv_push", "id": "e", "seq": 0, "last": False,
+                     "tokens": [3] * 8, "meta": {"n_pages": 1}}, b""))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["ok"] is False and "repeated" in msg["error"]
+            # server-wide buffer budget: two blobs that together declare
+            # more than one pool's worth of bytes — the second is refused
+            # up front instead of buffering multiples of the pool
+            s.sendall(wire.encode_bin(
+                {"type": "kv_push", "id": "f", "seq": 0, "last": False,
+                 "tokens": [3] * 8,
+                 "meta": {"n_pages": eng.kv.num_pages - 1}}, b""))
+            s.sendall(wire.encode_bin(
+                {"type": "kv_push", "id": "g", "seq": 0, "last": True,
+                 "tokens": [3] * 8,
+                 "meta": {"n_pages": eng.kv.num_pages - 1}}, b""))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["ok"] is False and "budget" in msg["error"]
+            # finish the live blob: the pump's import refuses the
+            # token/page mismatch cleanly and nothing stays buffered
+            s.sendall(wire.encode_bin(
+                {"type": "kv_push", "id": "f", "seq": 1, "last": True},
+                b""))
+            msg = wire.read_frame_sync(s, bin_cap=wire.MAX_BIN_PAYLOAD)
+            assert msg["type"] == "kv_push" and msg["ok"] is False
+            assert srv._kv_parts == {}, "a refusal left buffered parts"
             # the connection survived every refusal — real work flows
             wire.write_frame_sync(s, {"type": "generate", "id": "ok",
                                       "prompt": [3, 4, 5], "max_new": 2,
@@ -1149,3 +1179,87 @@ def test_kv_push_ships_pages_and_decode_side_admission_hits(tiny_tr):
     finally:
         srv_a.stop_background(drain=True)
         srv_b.stop_background(drain=True)
+
+
+def test_kv_push_part0_chunk_sized_from_encoded_header():
+    """Long-prompt regression: part 0's JSON header carries the FULL
+    token list, so a fixed 64 KiB headroom busts the 8 MiB bin cap past
+    ~9k tokens — exactly the prompts --disagg-min-prompt selects for.
+    Every frame must stay under the receiver's bin_cap with the part-0
+    chunk sized from the encoded header, and the parts must reassemble
+    the exact payload.  Pure framing — no engine in the loop."""
+    from paddle_tpu.serving import wire
+    from paddle_tpu.serving.server import _kv_push_frames
+
+    toks = list(range(20_000))               # header alone ~ 130 KiB
+    meta = {"n_pages": 4, "page_size": 8, "layers": [
+        {"name": "l0.attn", "h_kv": 2, "dh": 8, "dtype": "float32"}]}
+    payload = bytes(range(256)) * 66_000     # ~16 MiB -> several parts
+    frames = _kv_push_frames("rid", toks, meta, payload)
+    assert len(frames) >= 3
+    got = b""
+    for i, fr in enumerate(frames):
+        # the receiver's first act: bound the DECLARED body by bin_cap —
+        # an over-cap part 0 would be refused and the connection severed
+        n, binary = wire.split_length(fr[:4], bin_cap=wire.MAX_BIN_PAYLOAD)
+        assert binary and n == len(fr) - 4
+        msg = wire._decode_bin_body(fr[4:])
+        assert msg["seq"] == i and msg["last"] == (i == len(frames) - 1)
+        if i == 0:
+            assert msg["tokens"] == toks and msg["meta"] == meta
+        got += msg[wire.PAYLOAD_KEY]
+    assert got == payload
+    # a token list that cannot fit even an empty-chunk part 0 raises
+    # FrameError — the sender degrades to push_ok:false, never ships a
+    # frame the peer is guaranteed to refuse
+    with pytest.raises(wire.FrameError, match="binary-frame cap"):
+        _kv_push_frames("rid", list(range(1_500_000)), meta, b"")
+
+
+def test_kv_push_malformed_reply_degrades_to_push_ok_false(tiny_tr):
+    """A decode peer that answers the push with a MALFORMED frame raises
+    wire.FrameError (a ValueError, not an OSError) in the sender's
+    reply read — the fire-and-forget push task must still resolve the
+    prefill leg: done arrives with push_ok:false, the route does not
+    leak, and the inflight slot is released.  (An uncaught exception
+    here hangs the router's prefill leg forever — the replica stays
+    healthy so no retry fires — and pins an inflight slot per hit.)"""
+    import socket
+    import struct
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    port = lst.getsockname()[1]
+    peers = []
+
+    def peer():
+        # accept the push, then answer a valid-length non-JSON body —
+        # FrameError on the sender, with the socket held OPEN so no
+        # OSError path can mask the bug
+        c, _ = lst.accept()
+        peers.append(c)
+        c.recv(1 << 20)
+        c.sendall(struct.pack(">I", 5) + b"notjs")
+
+    threading.Thread(target=peer, daemon=True).start()
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4, role="prefill")
+    host, sport = srv.start_background()
+    try:
+        with ServingClient(host, sport) as c:
+            rid = c.submit([3, 4, 5, 6, 7, 8, 9, 10], max_new=4,
+                           prefill_only=True,
+                           push_to={"host": "127.0.0.1", "port": port})
+            out = c.collect([rid])
+            assert out[rid]["push_ok"] is False
+            assert "kv_push failed" in out[rid]["push_error"]
+            assert c.stats()["kv_push_failures"] == 1
+        assert srv._routes == {} and srv._inflight == 0, \
+            "a failed push leaked its route/inflight slot"
+        eng.kv.check_reclaimed()
+    finally:
+        srv.stop_background(drain=True)
+        lst.close()
+        for p in peers:
+            p.close()
