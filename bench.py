@@ -485,6 +485,59 @@ def bench_serving(dtype: str) -> dict:
         flight.enabled = False
     off_med, on_med = float(np.median(vals)), float(np.median(on_vals))
     overhead_pct = 100.0 * (off_med - on_med) / off_med if off_med else 0.0
+    # health-plane sampler-overhead probe (the fleet trace probe's
+    # interleaved-cycle discipline): the SAME workload with
+    # obs/timeseries.py's HistorySampler ticking at an AGGRESSIVE 50ms
+    # period (production runs 5s) against a registry of engine-state
+    # collectors, flipped LIVE between passes.  The engine keeps warming
+    # monotonically across passes, so a fixed order reads the warming
+    # trend as sampler cost — cycles alternate (off,on / on,off) and the
+    # MEDIAN of the per-cycle pairwise pcts cancels a linear drift.
+    # Budget <= 2% (negative = noise); the scalar rides _assemble_lkg.
+    from paddle_tpu.obs.metrics import MetricsRegistry
+    from paddle_tpu.obs.timeseries import HistorySampler, MetricHistory
+
+    reg = MetricsRegistry()
+    reg.register_collector(lambda: [
+        ("serving_tokens_generated_total", "counter", None,
+         float(eng.tokens_generated)),
+        ("serving_prefix_hits_total", "counter", None,
+         float(eng.n_prefix_hits)),
+        ("serving_prefix_misses_total", "counter", None,
+         float(eng.n_prefix_misses)),
+        ("serving_spec_drafted_total", "counter", None,
+         float(eng.n_spec_drafted)),
+        ("serving_spec_accepted_total", "counter", None,
+         float(eng.n_spec_accepted)),
+        ("serving_num_slots", "gauge", None, float(len(eng.slots))),
+    ])
+    sampler = HistorySampler(
+        MetricHistory(reg, resolution_s=0.05, retention_s=60.0),
+        period_s=0.05)
+    sampler.enabled = False
+    sampler.start()
+    cycle_pcts = []
+    try:
+        # one DISCARDED pass first: the trace probe just perturbed the
+        # engine's rhythm, and the first probe pass re-settles it — its
+        # transient must not land on whichever side runs first
+        run_workload(eng, make_requests(seed=1, **base))
+        cycles = int(os.environ.get("BENCH_SERVE_HISTORY_CYCLES", "3"))
+        for cyc in range(cycles):
+            order = (False, True) if cyc % 2 == 0 else (True, False)
+            pair = {}
+            for on in order:
+                sampler.enabled = on
+                rec = run_workload(
+                    eng, make_requests(seed=1 + (cyc % reps), **base))
+                pair[on] = rec["tokens"] / rec["seconds"]
+            if pair[False]:
+                cycle_pcts.append(
+                    100.0 * (pair[False] - pair[True]) / pair[False])
+    finally:
+        sampler.stop()
+    history_overhead_pct = float(np.median(cycle_pcts)) if cycle_pcts \
+        else 0.0
     tok_p50, tok_p99 = (np.percentile(step_s, [50, 99]) * 1e3
                         if step_s else (0.0, 0.0))
     return {
@@ -506,6 +559,10 @@ def bench_serving(dtype: str) -> dict:
         # tok/s cost of lifecycle tracing (negative = noise): tracked so a
         # tracer hot-path regression shows in the perf trajectory
         "lm_serving_trace_overhead_pct": round(overhead_pct, 2),
+        # tok/s cost of the health-plane sampler at 100x production rate
+        # (negative = noise): a registry-walk hot-path regression shows
+        # here before it shows on a fleet
+        "lm_serving_history_overhead_pct": round(history_overhead_pct, 2),
         "decode_signatures": eng._decode_step._cache_size(),
     }
 

@@ -71,6 +71,10 @@ from paddle_tpu.fleet.replica import Replica, ReplicaTable
 from paddle_tpu.obs import (MetricsRegistry, statset_collector,
                             tracer_collector)
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
+from paddle_tpu.obs.slo import SloEvaluator, default_router_slos
+from paddle_tpu.obs.timeseries import (HistorySampler, MetricHistory,
+                                       history_collector, history_reply,
+                                       merge_history)
 from paddle_tpu.obs.trace import (get_tracer, new_span_id, new_trace_id,
                                   trace_reply)
 from paddle_tpu.serving import wire
@@ -281,7 +285,10 @@ class FleetRouter:
                  retry_limit: int = 2,
                  disagg_min_prompt: int = 0,
                  postmortem_dir: Optional[str] = None,
-                 tracer=None):
+                 tracer=None,
+                 history_resolution_s: float = 5.0,
+                 history_retention_s: float = 1800.0,
+                 slo_specs=None):
         self.host = host
         self.port = port
         # router-side distributed tracing: every router action for a
@@ -328,6 +335,23 @@ class FleetRouter:
         self._closed: Optional[asyncio.Event] = None
         self._bg_thread: Optional[threading.Thread] = None
         self._init_metrics()
+        # the health plane (obs/timeseries.py + obs/slo.py): the router
+        # records its OWN fleet_* series only — per-replica series come
+        # in over the aggregate `history` fanout, never sampled here —
+        # and its SLOs (shed ratio, zero-healthy) burn over them.  The
+        # sampler thread reads lock-guarded registry state, so it rides
+        # alongside the asyncio loop without touching it.
+        self.history = MetricHistory(self.metrics,
+                                     resolution_s=history_resolution_s,
+                                     retention_s=history_retention_s)
+        self.metrics.register_collector(history_collector(self.history))
+        self.slo = SloEvaluator(
+            self.history,
+            default_router_slos() if slo_specs is None else slo_specs,
+            flight=self.flight, registry=self.metrics,
+            dump_fn=self._slo_dump)
+        self.history_sampler = HistorySampler(self.history,
+                                              on_sample=self.slo.evaluate)
 
     # -- metrics -----------------------------------------------------------
     def _init_metrics(self) -> None:
@@ -385,6 +409,7 @@ class FleetRouter:
                 print(f"fleet: replica {h}:{p} not reachable yet ({e}); "
                       f"will keep trying", file=sys.stderr, flush=True)
         self._poll_task = self._loop.create_task(self._poll_loop())
+        self.history_sampler.start()
         return self.host, self.port
 
     async def drain(self) -> None:
@@ -414,6 +439,7 @@ class FleetRouter:
         await self._shutdown()
 
     async def _shutdown(self) -> None:
+        self.history_sampler.stop()
         if self._poll_task is not None:
             self._poll_task.cancel()
             self._poll_task = None
@@ -671,7 +697,36 @@ class FleetRouter:
                 parts.append((r.rid, msg["text"]))
         return _merge_prometheus(parts), answered
 
+    async def _aggregate_history(self, msg: dict) -> dict:
+        """The fleet history view: the router's own series plus every
+        answering replica's, each labeled `replica="rN"` — the history
+        analog of _aggregate_metrics, over the same per-reply-type rpc
+        lane (so a slow fanout never holds up the stats heartbeat)."""
+        fwd = {"type": "history"}
+        for k in ("last_s", "names"):
+            if msg.get(k) is not None:
+                fwd[k] = msg[k]
+        targets = [r for r in self.table
+                   if r.backend is not None and not r.backend.dead]
+        replies = await asyncio.gather(
+            *[r.backend.rpc(dict(fwd), "history", 5.0)
+              for r in targets]) if targets else []
+        parts = [(None, self.history.snapshot(
+            last_s=msg.get("last_s"), names=msg.get("names")))]
+        for r, reply in zip(targets, replies):
+            if isinstance(reply, dict) and reply.get("type") == "history":
+                parts.append((r.rid, reply))
+        return merge_history(parts)
+
     # -- postmortem --------------------------------------------------------
+    def _slo_dump(self, fired: list) -> None:
+        """One proactive bundle per SLO episode (obs/slo.py calls this on
+        the sampler thread at the no-SLOs -> some-SLOs transition).  Same
+        contract as the replica server's: the bundle freezes BEFORE the
+        operator asks, with the offending series in history.json."""
+        names = ",".join(sorted({str(f.get("slo", "?")) for f in fired}))
+        self._write_bundle(f"slo:{names}", error=f"slo firing: {names}")
+
     def _router_snapshot(self) -> dict:
         return {
             "router": True,
@@ -716,6 +771,7 @@ class FleetRouter:
                 engine=engine,
                 metrics=self.metrics.snapshot(),
                 config=self._config_snapshot(),
+                history=self.history.snapshot(),
                 error=error)
             print(f"fleet postmortem bundle ({reason}): {path}",
                   file=sys.stderr, flush=True)
@@ -730,7 +786,7 @@ class FleetRouter:
     def _on_backend_frame(self, r: Replica, backend: _Backend,
                           msg: dict) -> None:
         t = msg.get("type")
-        if t in ("stats", "metrics", "trace"):
+        if t in ("stats", "metrics", "trace", "history"):
             fut = backend._rpc_futs.get(t)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -1047,6 +1103,21 @@ class FleetRouter:
             # `enable` flips router-side tracing live (see server.py)
             conn.send(trace_reply(self.tracer, msg, "router",
                                   self.host, self.port))
+        elif t == "history":
+            # the health plane's ring (loop-thread, stale-ok — see
+            # obs/timeseries.py); `aggregate` fans out to every live
+            # replica and merges their series under `replica` labels
+            if msg.get("aggregate"):
+                body = await self._aggregate_history(msg)
+                reply = history_reply(self.history,
+                                      {"id": msg.get("id")}, "router",
+                                      self.host, self.port)
+                reply.update(body)
+                reply["aggregate"] = True
+                conn.send(reply)
+            else:
+                conn.send(history_reply(self.history, msg, "router",
+                                        self.host, self.port))
         elif t == "dump":
             self.flight.record("dump_rpc", router=True)
             if not self.postmortem_dir:
@@ -1072,7 +1143,7 @@ class FleetRouter:
                 server="paddle_tpu-fleet-router",
                 capabilities=sorted(["hello", "generate", "cancel", "stats",
                                      "metrics", "dump", "ping", "fleet",
-                                     "trace"]),
+                                     "trace", "history"]),
                 replicas=len(self.table),
                 policy=self.policy.mode,
                 page_size=self.policy.index.window,

@@ -26,6 +26,15 @@ dependency-light serving client path without pulling in jax):
     that dumps atomic postmortem bundles on pump death / watchdog wedge /
     an operator `dump` RPC (`get_flight_recorder()`;
     `tools/postmortem.py` pretty-prints a bundle).
+  * `obs.timeseries` — the health plane's storage: a bounded in-memory
+    ring of downsampled samples per catalogued metric (counters as
+    deltas, gauges as last-value), fed by a background `HistorySampler`
+    and served over the `history` RPC (`tools/obs_top.py` renders it
+    live).
+  * `obs.slo` — declarative SLO specs + multi-window burn-rate alerting
+    over the time-series; firing transitions emit `slo_fire`/`slo_clear`
+    flight events, flip `obs_slo_firing`, and freeze one proactive
+    postmortem bundle per episode.
 
 See docs/observability.md for the span model, metric reference, the
 trace_dump workflow, and the postmortem-bundle format.
@@ -42,6 +51,13 @@ from paddle_tpu.obs.metrics import (CATALOG, Counter,  # noqa: F401
                                     Gauge, Histogram, MetricsRegistry,
                                     barrier_collector, statset_collector,
                                     tracer_collector)
+from paddle_tpu.obs.slo import (SloEvaluator, SloSpec,  # noqa: F401
+                                default_pserver_slos, default_router_slos,
+                                default_serving_slos)
+from paddle_tpu.obs.timeseries import (HistorySampler,  # noqa: F401
+                                       MetricHistory, history_collector,
+                                       history_reply, merge_history,
+                                       relabel_series_key)
 from paddle_tpu.obs.trace import (Tracer, flush_trace_file,  # noqa: F401
                                   get_tracer, merge_chrome, new_span_id,
                                   new_trace_id, process_info,
@@ -54,4 +70,8 @@ __all__ = ["Tracer", "get_tracer", "spans_to_chrome", "merge_chrome",
            "barrier_collector", "tracer_collector", "CompileWatch",
            "get_compile_watch", "compile_collector", "FlightRecorder",
            "get_flight_recorder", "flight_collector", "load_bundle",
-           "hbm_collector", "hbm_snapshot"]
+           "hbm_collector", "hbm_snapshot", "MetricHistory",
+           "HistorySampler", "history_collector", "history_reply",
+           "merge_history", "relabel_series_key", "SloSpec",
+           "SloEvaluator", "default_serving_slos", "default_router_slos",
+           "default_pserver_slos"]

@@ -107,7 +107,8 @@ class FlightRecorder:
 
     # -- the postmortem bundle --------------------------------------------
     def dump(self, out_dir: str, reason: str, *, spans=None, engine=None,
-             metrics=None, config=None, error: Optional[str] = None) -> str:
+             metrics=None, config=None, history=None,
+             error: Optional[str] = None) -> str:
         """Write one atomic postmortem bundle under `out_dir`; returns the
         committed bundle path.  Never raises into a dying caller's frame
         for snapshot problems — a part that fails to serialize is replaced
@@ -121,10 +122,11 @@ class FlightRecorder:
         with self._dump_lock:
             return self._dump_locked(out_dir, reason, spans=spans,
                                      engine=engine, metrics=metrics,
-                                     config=config, error=error)
+                                     config=config, history=history,
+                                     error=error)
 
     def _dump_locked(self, out_dir: str, reason: str, *, spans=None,
-                     engine=None, metrics=None, config=None,
+                     engine=None, metrics=None, config=None, history=None,
                      error: Optional[str] = None) -> str:
         ts = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
         base = os.path.join(out_dir, f"{BUNDLE_PREFIX}{ts}-{os.getpid()}")
@@ -175,6 +177,10 @@ class FlightRecorder:
         _write_json("engine.json", _safe(lambda: engine or {}, {}))
         _write_json("metrics.json", _safe(lambda: metrics or {}, {}))
         _write_json("config.json", _safe(lambda: config or {}, {}))
+        # the health plane's trailing window (PR 20) — written by every
+        # new dump but deliberately NOT in BUNDLE_FILES, so bundles from
+        # before the health plane stay loadable
+        _write_json("history.json", _safe(lambda: history or {}, {}))
         os.replace(tmp, final)             # commit: rename is the txn
         self.bundles_written += 1
         self.last_bundle_path = final
@@ -222,6 +228,12 @@ def load_bundle(path: str) -> dict:
                 if line:
                     recs.append(json.loads(line))
         out[name] = recs
+    # optional part: the history ring snapshot (absent in pre-PR-20
+    # bundles — readers branch on the key, never fail the load)
+    hpath = os.path.join(path, "history.json")
+    if os.path.exists(hpath):
+        with open(hpath) as f:
+            out["history"] = json.load(f)
     return out
 
 

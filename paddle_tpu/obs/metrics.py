@@ -277,6 +277,20 @@ CATALOG: dict[str, str] = {
     "flight_events_dropped_total":
         "flight-recorder events overwritten by ring wrap-around",
     "postmortem_bundles_total": "postmortem bundles written by this process",
+    # -- health plane (obs/timeseries.py + obs/slo.py) ---------------------
+    "obs_history_series":
+        "distinct metric series tracked by the in-memory history ring",
+    "obs_history_samples_total":
+        "sampling passes the history sampler has taken over the registry",
+    "obs_history_sample_age_s":
+        "seconds since the history sampler last walked the registry "
+        "(-1 before the first pass) — a stuck sampler shows here",
+    "obs_history_dropped_series_total":
+        "series refused by the history ring's cardinality cap",
+    "obs_slo_firing":
+        "1 while the named SLO is firing (label: slo; burn-rate "
+        "semantics in docs/observability.md 'Health plane')",
+    "obs_slo_fired_total": "firing transitions per SLO (label: slo)",
 }
 
 
@@ -491,6 +505,14 @@ class MetricsRegistry:
                 if name.endswith(suf):
                     return name[: -len(suf)]
         return name
+
+    def samples(self) -> list[tuple]:
+        """Public kinded view: [(name, kind, labels|None, value)] — the
+        raw feed `render()`/`snapshot()` are built from.  The history
+        sampler (obs/timeseries.py) reads this rather than `snapshot()`
+        because downsampling needs `kind` (counters store as deltas),
+        which the flat dict loses."""
+        return self._all_samples()
 
     def render(self) -> str:
         """Prometheus text exposition (text/plain; version 0.0.4)."""

@@ -83,6 +83,9 @@ import numpy as np
 
 from paddle_tpu.obs import MetricsRegistry, tracer_collector
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
+from paddle_tpu.obs.slo import SloEvaluator, default_pserver_slos
+from paddle_tpu.obs.timeseries import (HistorySampler, MetricHistory,
+                                       history_collector, history_reply)
 from paddle_tpu.obs.trace import get_tracer, trace_reply
 from paddle_tpu.pserver import membership as mem
 from paddle_tpu.pserver.blocks import (BlockMap, decode_array,
@@ -407,7 +410,10 @@ class ParameterServer:
                  snapshot_every: int = 0, keep_last: int = 2,
                  commit_log_cap: int = 4096, block_size: int = 0,
                  tracer=None, wedge_threshold_s: float = 30.0,
-                 straggler_ms: float = 250.0):
+                 straggler_ms: float = 250.0,
+                 history_resolution_s: float = 5.0,
+                 history_retention_s: float = 1800.0,
+                 slo_specs=None):
         from paddle_tpu.pserver.blocks import DEFAULT_BLOCK_SIZE
         assert mode in ("sync", "async"), mode
         if mode == "async" and int(n_shards) > 1:
@@ -504,6 +510,22 @@ class ParameterServer:
         self.tracer = tracer if tracer is not None else get_tracer()
         self.flight = get_flight_recorder()
         self._init_metrics()
+        # the health plane (obs/timeseries.py + obs/slo.py): pserver_*
+        # series history behind the `history` RPC, with the window-skew
+        # SLO burning over the skew histogram's per-window mean.  The
+        # sampler thread reads only lock-guarded registry state — it
+        # never touches the update thread's jax state.
+        self.history = MetricHistory(self.metrics,
+                                     resolution_s=history_resolution_s,
+                                     retention_s=history_retention_s)
+        self.metrics.register_collector(history_collector(self.history))
+        self.slo = SloEvaluator(
+            self.history,
+            default_pserver_slos() if slo_specs is None else slo_specs,
+            flight=self.flight, registry=self.metrics,
+            dump_fn=self._slo_dump)
+        self.history_sampler = HistorySampler(self.history,
+                                              on_sample=self.slo.evaluate)
 
     # -- metrics -------------------------------------------------------------
     def _init_metrics(self) -> None:
@@ -563,6 +585,7 @@ class ParameterServer:
         # exactly when the update thread cannot) — crossing the threshold
         # records a ps_wedge event and freezes one postmortem bundle
         self._watch_task = self._loop.create_task(self._wedge_watchdog())
+        self.history_sampler.start()
         return self.host, self.port
 
     async def drain(self, final_snapshot: bool = True) -> None:
@@ -586,6 +609,7 @@ class ParameterServer:
         await self.drain(final_snapshot=False)
 
     async def _shutdown(self) -> None:
+        self.history_sampler.stop()
         if self._expire_task is not None:
             self._expire_task.cancel()
             self._expire_task = None
@@ -711,6 +735,7 @@ class ParameterServer:
                                 engine=self._stats_msg(),
                                 metrics=self.metrics.snapshot(),
                                 config=self._config_snapshot(),
+                                history=self.history.snapshot(),
                                 error=f"update thread wedged: current "
                                       f"job running {lag:.1f}s "
                                       f"(threshold "
@@ -1078,7 +1103,7 @@ class ParameterServer:
                     "hello", "ping", "ps_init", "ps_join", "ps_beat",
                     "ps_drain", "ps_leave", "send_grad", "barrier",
                     "get_params", "stats", "metrics", "dump", "ps_log",
-                    "trace", "bin_blocks", "pre_accum"])))
+                    "trace", "bin_blocks", "pre_accum", "history"])))
         elif t == "ps_init":
             self._handle_init(conn, msg)
         elif t == "ps_join":
@@ -1135,6 +1160,13 @@ class ParameterServer:
             conn.send(trace_reply(self.tracer, msg, "pserver",
                                   self.host, self.port,
                                   shard=self.shard_index))
+        elif t == "history":
+            # the health plane's ring — loop thread, stale-ok like
+            # `trace`: reads only lock-guarded ring state, so it answers
+            # against a wedged update thread (obs/timeseries.py)
+            conn.send(history_reply(self.history, msg, "pserver",
+                                    self.host, self.port,
+                                    shard=self.shard_index))
         elif t in ("generate", "cancel", "fleet"):
             conn.send({"type": "error", "id": msg.get("id"),
                        "error": f"{t!r} belongs to a serving replica/"
@@ -1499,6 +1531,26 @@ class ParameterServer:
             "uptime_s": round(time.monotonic() - self._started_t, 3),
         }
 
+    def _slo_dump(self, fired: list) -> None:
+        """One proactive bundle per SLO episode (obs/slo.py calls this
+        on the sampler thread at the firing transition) — gated on the
+        snapshot dir like every other pserver dump."""
+        if not self.snapshot_dir:
+            return
+        names = ",".join(sorted({str(f.get("slo", "?")) for f in fired}))
+        try:
+            self.flight.dump(
+                self.snapshot_dir, reason=f"slo:{names}",
+                spans=self.tracer.snapshot(),
+                engine=self._stats_msg(),
+                metrics=self.metrics.snapshot(),
+                config=self._config_snapshot(),
+                history=self.history.snapshot(),
+                error=f"slo firing: {names}")
+        except OSError as e:
+            print(f"pserver: slo dump failed: {e}",
+                  file=sys.stderr, flush=True)
+
     def _handle_dump(self, conn: FrameConn, msg: dict) -> None:
         self.flight.record("dump_rpc", id=str(msg.get("id")))
         if not self.snapshot_dir:
@@ -1514,7 +1566,8 @@ class ParameterServer:
                 spans=self.tracer.snapshot(),
                 engine=self._stats_msg(),
                 metrics=self.metrics.snapshot(),
-                config=self._config_snapshot())
+                config=self._config_snapshot(),
+                history=self.history.snapshot())
         except OSError as e:
             conn.send({"type": "error", "id": msg.get("id"),
                        "error": f"dump failed: {e}"})

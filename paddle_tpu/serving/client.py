@@ -343,6 +343,33 @@ class ServingClient:
                            if remote is not None else 0.0)
         return msg
 
+    def history(self, last_s: Optional[float] = None,
+                names=None, aggregate: bool = False) -> dict:
+        """Pull the server's metric time-series ring (the `history` RPC —
+        loop thread, stale-ok: answers against a wedged pump, exactly
+        when the trailing window matters).  `last_s` trims each series
+        to the trailing window, `names` filters series keys by prefix.
+        Against a fleet router, `aggregate=True` asks for the FLEET
+        view: the router's own series plus every reachable replica's
+        relabeled `replica="rN"` — what tools/obs_top.py renders.
+        Returns the reply frame: ring accounting + {"series": {key:
+        {"kind", "points": [[unix_ts, value], ...]}}}."""
+        rid = f"hist{self._next_id}"
+        self._next_id += 1
+        msg = {"type": "history", "id": rid}
+        if last_s is not None:
+            msg["last_s"] = float(last_s)
+        if names is not None:
+            msg["names"] = [str(n) for n in names]
+        if aggregate:
+            msg["aggregate"] = True
+        self.send(msg)
+        msg = self._route(lambda m: m.get("type") in ("history", "error")
+                          and m.get("id") == rid)
+        if msg["type"] == "error":
+            raise ServerError(msg.get("error", "history pull failed"))
+        return msg
+
     def dump(self) -> dict:
         """Ask the server to freeze a postmortem bundle NOW (answered on
         the loop thread — works against a wedged or dead engine pump).

@@ -65,6 +65,9 @@ from paddle_tpu.obs import (MetricsRegistry, statset_collector,
 from paddle_tpu.obs.compile_watch import compile_collector, get_compile_watch
 from paddle_tpu.obs.flight import flight_collector, get_flight_recorder
 from paddle_tpu.obs.hbm import hbm_collector, hbm_snapshot
+from paddle_tpu.obs.slo import SloEvaluator, default_serving_slos
+from paddle_tpu.obs.timeseries import (HistorySampler, MetricHistory,
+                                       history_collector, history_reply)
 from paddle_tpu.obs.trace import trace_reply
 from paddle_tpu.serving import wire
 from paddle_tpu.serving.engine import Request, ServingEngine
@@ -165,7 +168,9 @@ class ServingServer:
                  port: int = 0, max_queue: int = 32,
                  postmortem_dir: Optional[str] = None,
                  wedge_threshold_s: float = 30.0, role: str = "both",
-                 kv_push_timeout_s: float = 10.0):
+                 kv_push_timeout_s: float = 10.0,
+                 history_resolution_s: float = 5.0,
+                 history_retention_s: float = 1800.0, slo_specs=None):
         assert role in ("prefill", "decode", "both"), role
         self.engine = engine
         self.host = host
@@ -224,6 +229,22 @@ class ServingServer:
         engine.on_token = self._on_token
         engine.on_finish = self._on_finish
         self._init_metrics()
+        # the health plane (docs/observability.md "Health plane"): a
+        # bounded time-series ring over the registry, fed by a background
+        # sampler thread, with SLO burn-rate alerting riding each
+        # sampling pass.  `slo_specs=None` takes the serving defaults;
+        # pass () to disable alerting while keeping history.
+        self.history = MetricHistory(self.metrics,
+                                     resolution_s=history_resolution_s,
+                                     retention_s=history_retention_s)
+        self.metrics.register_collector(history_collector(self.history))
+        self.slo = SloEvaluator(
+            self.history,
+            default_serving_slos() if slo_specs is None else slo_specs,
+            flight=self.flight, registry=self.metrics,
+            dump_fn=self._slo_dump)
+        self.history_sampler = HistorySampler(self.history,
+                                              on_sample=self.slo.evaluate)
 
     def _init_metrics(self) -> None:
         """The unified registry behind the `metrics` frame.  Rendered on
@@ -396,6 +417,10 @@ class ServingServer:
         # while the pump is stuck inside step()): past the threshold it
         # records a wedge event and freezes one postmortem bundle
         self._watch_task = self._loop.create_task(self._wedge_watchdog())
+        # the health plane's sampler is a daemon thread like the pump: it
+        # reads lock-guarded registry state, so it keeps the time-series
+        # (and SLO evaluation) running while the pump is wedged
+        self.history_sampler.start()
         if start_pump:
             self.start_pump()
         return self.host, self.port
@@ -454,6 +479,7 @@ class ServingServer:
         if self._watch_task is not None:
             self._watch_task.cancel()
             self._watch_task = None
+        self.history_sampler.stop()
         if self._pump_thread is not None and self._pump_thread.is_alive():
             self._cmds.put(("stop",))
             self._wake.set()
@@ -791,6 +817,14 @@ class ServingServer:
             "postmortem_dir": self.postmortem_dir,
         }
 
+    def _slo_dump(self, fired) -> None:
+        """The SLO evaluator's episode hook (sampler thread): freeze the
+        bundle with the offending series attached while the pump is
+        still ALIVE — the proactive counterpart of the wedge dump, same
+        stale-ok snapshot paths."""
+        names = ",".join(sorted({str(f.get("slo", "?")) for f in fired}))
+        self._write_bundle(f"slo:{names}", error=f"slo firing: {names}")
+
     def _write_bundle(self, reason: str,
                       error: Optional[str] = None) -> Optional[str]:
         """Freeze one postmortem bundle; returns its path, or None when no
@@ -805,6 +839,7 @@ class ServingServer:
                 engine=self._engine_snapshot(),
                 metrics=self.metrics.snapshot(),
                 config=self._config_snapshot(),
+                history=self.history.snapshot(),
                 error=error)
             print(f"postmortem bundle ({reason}): {path}", file=sys.stderr,
                   flush=True)
@@ -1129,6 +1164,14 @@ class ServingServer:
             # snapshot, so enable:false returns the spans it just froze.
             conn.send(trace_reply(self.tracer, msg, "replica",
                                   self.host, self.port))
+        elif t == "history":
+            # the health plane's time-series pull (loop thread, stale-ok
+            # like `metrics`/`trace`: the ring is fed by its own sampler
+            # thread and read here under its lock — no pump round trip,
+            # no shared lock with any other reply type — so it answers
+            # against a wedged pump; staleness shows as last_sample_unix)
+            conn.send(history_reply(self.history, msg, "replica",
+                                    self.host, self.port))
         elif t == "hello":
             # version/capabilities negotiation: answered on connect so a
             # peer (the fleet router, a ctl, a probing operator) can
@@ -1141,7 +1184,7 @@ class ServingServer:
                 server="paddle_tpu-serving",
                 capabilities=sorted(["hello", "generate", "cancel", "stats",
                                      "metrics", "dump", "ping", "trace",
-                                     "kv_xfer"]),
+                                     "history", "kv_xfer"]),
                 role_mode=self.role,
                 num_slots=len(self.engine.slots),
                 max_inflight=self.max_inflight,

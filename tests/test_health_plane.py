@@ -1,0 +1,442 @@
+"""Health-plane tests (ISSUE 20): the metric time-series ring, SLO
+burn-rate alerting, the stale-ok `history` RPC, and the router's
+fleet-aggregate view.
+
+The acceptance contract: an induced p99 blowup on a real TCP serving
+front end trips `slo_fire`, flips the labelled `obs_slo_firing` gauge,
+freezes EXACTLY one proactive postmortem bundle per episode (with the
+offending series in history.json), recovery emits `slo_clear` and
+re-arms, and a second episode dumps again; the `history` RPC answers
+against a deliberately wedged pump; a router's aggregate history labels
+each replica's series `replica="rN"`.
+
+Determinism: unit tests drive `MetricHistory.sample(now=..., samples=...)`
+with a synthetic clock; the e2e test stops the background sampler and
+ticks `sample()`/`evaluate()` by hand at synthetic times far past any
+real-time sample, so wall-clock jitter can neither fire nor mask an SLO.
+"""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.config.parser import parse_config
+from paddle_tpu.fleet import FleetRouter
+from paddle_tpu.obs.flight import load_bundle
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.obs.slo import SloEvaluator, SloSpec, default_serving_slos
+from paddle_tpu.obs.timeseries import (MetricHistory, history_collector,
+                                       merge_history, relabel_series_key)
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.client import ServingClient
+from paddle_tpu.serving.server import ServingServer
+from paddle_tpu.trainer.trainer import Trainer
+
+#: synthetic clock origin: far past any real wall-clock sample a server
+#: background thread could have slipped in before tests stopped it, so
+#: trailing-window reads never mix real and synthetic points
+T = 2_000_000_000.0
+
+
+@pytest.fixture(scope="module")
+def tiny_tr():
+    cfg = parse_config("demo/model_zoo/transformer_lm.py",
+                       "vocab=31,dim=16,layers=1,heads=2,batch_size=4")
+    return Trainer(cfg, seed=7)
+
+
+def _engine(tr, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_context", 64)
+    return ServingEngine(tr.executor, tr.params, **kw)
+
+
+def _bundles(d):
+    import glob
+    import os
+
+    return sorted(p for p in glob.glob(os.path.join(str(d), "postmortem-*"))
+                  if not p.endswith(".tmp"))
+
+
+# ---------------------------------------------------------------------------
+# MetricHistory: the downsampled ring
+# ---------------------------------------------------------------------------
+
+def test_gauge_ring_downsamples_last_wins_and_bounds_retention():
+    h = MetricHistory(resolution_s=5.0, retention_s=20.0)   # capacity 4
+    assert h.capacity == 4
+    # two samples in ONE 5s window collapse to one point, last value wins
+    h.sample(now=T, samples=[("g", "gauge", None, 1.0)])
+    h.sample(now=T + 1, samples=[("g", "gauge", None, 2.0)])
+    assert h.points("g") == [(T, 2.0)]
+    # six more windows: the ring keeps only the newest 4
+    for k in range(1, 7):
+        h.sample(now=T + 5 * k, samples=[("g", "gauge", None, float(k))])
+    pts = h.points("g")
+    assert [v for _, v in pts] == [3.0, 4.0, 5.0, 6.0]
+    # window starts align to the resolution grid
+    assert all(t % 5.0 == 0.0 for t, _ in pts)
+    # last_s trims to the trailing window (lo boundary inclusive)
+    assert [v for _, v in h.points("g", last_s=10.0, now=T + 30)] \
+        == [4.0, 5.0, 6.0]
+
+
+def test_counter_ring_stores_clamped_deltas():
+    h = MetricHistory(resolution_s=1.0, retention_s=10.0)
+    for k, raw in enumerate([5.0, 12.0, 3.0, 10.0]):
+        h.sample(now=T + k, samples=[("c_total", "counter", None, raw)])
+    # first reading IS the delta since process start; the 12->3 restart
+    # clamps to 0 instead of going negative
+    assert [v for _, v in h.points("c_total")] == [5.0, 7.0, 0.0, 7.0]
+    assert h.kind("c_total") == "counter"
+    # two samples landing in one window accumulate their deltas
+    h.sample(now=T + 4.1, samples=[("c_total", "counter", None, 11.0)])
+    h.sample(now=T + 4.9, samples=[("c_total", "counter", None, 14.0)])
+    assert h.points("c_total")[-1] == (T + 4.0, 4.0)
+
+
+def test_histogram_sum_count_ride_as_counters_buckets_skipped():
+    h = MetricHistory(resolution_s=1.0, retention_s=10.0)
+    h.sample(now=T, samples=[
+        ("lat_sum", "histogram", None, 4.0),
+        ("lat_count", "histogram", None, 2.0),
+        ("lat_bucket", "histogram", {"le": "1"}, 2.0),   # cardinality guard
+    ])
+    assert h.points("lat_sum") == [(T, 4.0)]
+    assert h.kind("lat_count") == "counter"
+    assert h.points('lat_bucket{le="1"}') == []
+    assert h.series_count() == 2
+
+
+def test_series_cap_degrades_to_accounting_not_memory():
+    h = MetricHistory(resolution_s=1.0, retention_s=5.0, max_series=2)
+    h.sample(now=T, samples=[("a", "gauge", None, 1.0),
+                             ("b", "gauge", None, 1.0),
+                             ("c", "gauge", None, 1.0)])
+    assert h.series_count() == 2 and h.dropped_series == 1
+    # the ring's own collector surfaces the drop
+    got = {name: v for name, _k, _l, v in history_collector(h)()}
+    assert got["obs_history_dropped_series_total"] == 1.0
+    assert got["obs_history_series"] == 2.0
+    assert got["obs_history_samples_total"] == 1.0
+
+
+def test_snapshot_filters_by_prefix_and_window():
+    h = MetricHistory(resolution_s=1.0, retention_s=30.0)
+    for k in range(5):
+        h.sample(now=T + k, samples=[
+            ("serving_num_slots", "gauge", None, 2.0),
+            ("fleet_inflight", "gauge", None, float(k))])
+    snap = h.snapshot(names=["serving_"], now=T + 4)
+    assert set(snap["series"]) == {"serving_num_slots"}
+    assert snap["samples_taken"] == 5
+    assert snap["first_sample_unix"] == T
+    assert snap["last_sample_unix"] == T + 4
+    snap = h.snapshot(last_s=2.0, now=T + 4)
+    assert [v for _, v in snap["series"]["fleet_inflight"]["points"]] \
+        == [2.0, 3.0, 4.0]
+
+
+def test_relabel_and_merge_tag_replica_series():
+    assert relabel_series_key('a{x="1"}', {"replica": "r0"}) \
+        == 'a{replica="r0",x="1"}'
+    assert relabel_series_key("plain", {"replica": "r1"}) \
+        == 'plain{replica="r1"}'
+    local = {"resolution_s": 5.0, "samples_taken": 3,
+             "series": {"fleet_inflight": {"kind": "gauge",
+                                           "points": [[T, 1.0]]}}}
+    rep = {"series": {"serving_num_slots": {"kind": "gauge",
+                                            "points": [[T, 2.0]]}}}
+    out = merge_history([(None, local), ("r0", rep)])
+    # the None part (the router's own) passes through unlabeled and
+    # supplies the ring accounting; replica series get the label
+    assert out["resolution_s"] == 5.0 and out["replicas"] == ["r0"]
+    assert "fleet_inflight" in out["series"]
+    assert out["series"]['serving_num_slots{replica="r0"}']["points"] \
+        == [[T, 2.0]]
+
+
+# ---------------------------------------------------------------------------
+# SloEvaluator: multi-window burn rate, warm-up gate, episode re-arm
+# ---------------------------------------------------------------------------
+
+def test_slo_warmup_gate_fire_clear_and_one_dump_per_episode():
+    h = MetricHistory(resolution_s=1.0, retention_s=60.0)
+    reg = MetricsRegistry()
+    dumps = []
+    spec = SloSpec(name="lat", series="g", objective=1.0, op=">",
+                   short_window_s=2.0, long_window_s=4.0)
+    ev = SloEvaluator(h, [spec], registry=reg, dump_fn=dumps.append)
+    # violating from the very first sample — but the warm-up gate holds
+    # until the ring has covered one long window (4s of evidence)
+    for k in range(4):
+        h.sample(now=T + k, samples=[("g", "gauge", None, 5.0)])
+        assert ev.evaluate(now=T + k) == []
+    h.sample(now=T + 4, samples=[("g", "gauge", None, 5.0)])
+    tr = ev.evaluate(now=T + 4)
+    assert [t["event"] for t in tr] == ["slo_fire"]
+    assert ev.firing() == ["lat"]
+    assert reg.snapshot()['obs_slo_firing{slo="lat"}'] == 1.0
+    assert reg.snapshot()['obs_slo_fired_total{slo="lat"}'] == 1.0
+    assert len(dumps) == 1 and dumps[0][0]["slo"] == "lat"
+    # a sustained violation is one episode: no new transition, no 2nd dump
+    h.sample(now=T + 5, samples=[("g", "gauge", None, 5.0)])
+    assert ev.evaluate(now=T + 5) == [] and len(dumps) == 1
+    # recovery: the short window fills with healthy points -> clear
+    for k in range(6, 10):
+        h.sample(now=T + k, samples=[("g", "gauge", None, 0.5)])
+    tr = ev.evaluate(now=T + 9)
+    assert [t["event"] for t in tr] == ["slo_clear"]
+    assert ev.firing() == []
+    assert reg.snapshot()['obs_slo_firing{slo="lat"}'] == 0.0
+    # a second episode re-fires AND dumps again (the dump re-armed when
+    # everything cleared)
+    for k in range(10, 15):
+        h.sample(now=T + k, samples=[("g", "gauge", None, 9.0)])
+        ev.evaluate(now=T + k)
+    assert ev.firing() == ["lat"] and len(dumps) == 2
+    assert reg.snapshot()['obs_slo_fired_total{slo="lat"}'] == 2.0
+
+
+def test_ratio_slo_skips_zero_denominator_windows():
+    h = MetricHistory(resolution_s=1.0, retention_s=60.0)
+    spec = SloSpec(name="shed", kind="ratio", series=("sheds",),
+                   den=("ok", "sheds"), objective=0.05, op=">",
+                   short_window_s=2.0, long_window_s=4.0)
+    ev = SloEvaluator(h, [spec])
+    # zero traffic: every window has denominator 0 -> skipped, never burns
+    for k in range(10):
+        h.sample(now=T + k, samples=[("sheds", "counter", None, 0.0),
+                                     ("ok", "counter", None, 0.0)])
+        assert ev.evaluate(now=T + k) == []
+    # traffic that sheds everything burns both windows and fires
+    tot = 0.0
+    for k in range(10, 16):
+        tot += 5.0
+        h.sample(now=T + k, samples=[("sheds", "counter", None, tot),
+                                     ("ok", "counter", None, 0.0)])
+        ev.evaluate(now=T + k)
+    assert ev.firing() == ["shed"]
+
+
+def test_default_serving_slos_match_the_catalog():
+    # the shipped defaults reference catalogued series only (guards the
+    # specs against a metrics rename)
+    names = {s.name for s in default_serving_slos()}
+    assert {"serving_ttft_p99", "serving_itl_p99",
+            "serving_shed_ratio"} <= names
+    for s in default_serving_slos():
+        assert s.long_window_s >= s.short_window_s
+
+
+# ---------------------------------------------------------------------------
+# e2e over TCP: history RPC stale-ok, SLO fire -> bundle -> clear -> re-arm
+# ---------------------------------------------------------------------------
+
+def test_history_rpc_answers_against_wedged_pump(tiny_tr):
+    """The stale-ok contract: the `history` frame is served on the loop
+    thread from the lock-guarded ring — it answers while the engine pump
+    is deliberately wedged, exactly when the trailing window matters."""
+    eng = _engine(tiny_tr)
+    orig_step = eng.step
+    wedged, release = threading.Event(), threading.Event()
+
+    def wedge_step():
+        if not release.is_set() and \
+                (eng.queue or any(s is not None for s in eng.slots)):
+            wedged.set()
+            release.wait(60)
+        return orig_step()
+
+    eng.step = wedge_step
+    srv = ServingServer(eng, max_queue=4)
+    host, port = srv.start_background()
+    try:
+        # manual sampling below: the background cadence is irrelevant here
+        srv.history_sampler.stop()
+        with ServingClient(host, port) as c:
+            assert "history" in (c.hello().get("capabilities") or [])
+            rid = c.submit([3, 4, 5], max_new=3)
+            assert wedged.wait(30), "pump never picked up the request"
+            srv.history.sample()          # the sampler-thread write path
+            reply = c.history()           # ...answered against the wedge
+            assert reply["type"] == "history"
+            assert reply["process"]["role"] == "replica"
+            assert reply["samples_taken"] >= 1
+            assert "serving_num_slots" in reply["series"]
+            kinds = {s["kind"] for s in reply["series"].values()}
+            assert kinds <= {"counter", "gauge"}
+            # prefix filter travels over the wire too
+            only = c.history(names=["obs_history_"])["series"]
+            assert only and all(k.startswith("obs_history_") for k in only)
+            release.set()
+            c.collect([rid])              # the pump recovers cleanly
+    finally:
+        release.set()
+        srv.stop_background(drain=True)
+
+
+def test_slo_episode_e2e_fire_bundle_clear_rearm(tiny_tr, tmp_path):
+    """ISSUE 20 acceptance: induced p99 blowup -> slo_fire flight event,
+    labelled gauge flips over the wire, EXACTLY one proactive bundle per
+    episode (with the offending series in history.json), recovery emits
+    slo_clear, and a second episode freezes a second bundle."""
+    q = 'serving_latency_seconds{quantile="p99",stat="first_token_latency"}'
+    spec = SloSpec(name="ttft_p99", series=q, objective=0.1, op=">",
+                   short_window_s=2.0, long_window_s=4.0)
+    eng = _engine(tiny_tr)
+    srv = ServingServer(eng, max_queue=4, postmortem_dir=str(tmp_path),
+                        history_resolution_s=1.0, history_retention_s=60.0,
+                        slo_specs=[spec])
+    host, port = srv.start_background()
+    try:
+        # deterministic clock: stop the background sampler and tick the
+        # ring by hand at synthetic times past any real-time sample it
+        # may have slipped in before the stop
+        srv.history_sampler.stop()
+        t0 = time.time() + 3600.0
+        st = srv.stats.get("first_token_latency")
+        for _ in range(8):
+            st.add(5.0)                   # the p99 blowup: 5s TTFT
+        for k in range(5):
+            srv.history.sample(now=t0 + k)
+            srv.slo.evaluate(now=t0 + k)
+        assert srv.slo.firing() == ["ttft_p99"]
+
+        found = _bundles(tmp_path)
+        assert len(found) == 1, "first fire must freeze exactly one bundle"
+        b = load_bundle(found[0])
+        assert b["meta"]["reason"] == "slo:ttft_p99"
+        assert "slo firing: ttft_p99" in b["meta"]["error"]
+        fire_evs = [e for e in b["events"] if e["kind"] == "slo_fire"]
+        assert fire_evs and fire_evs[-1]["data"]["slo"] == "ttft_p99"
+        assert fire_evs[-1]["data"]["series"] == q
+        # the bundle carries the offending series' trailing window —
+        # frozen BEFORE anything died
+        assert q in b["history"]["series"]
+        assert b["history"]["series"][q]["points"][-1][1] == 5.0
+
+        with ServingClient(host, port) as c:
+            assert 'obs_slo_firing{slo="ttft_p99"} 1' in c.metrics()
+            assert q in c.history()["series"]
+        # a sustained violation stays one episode, one bundle
+        srv.history.sample(now=t0 + 5)
+        srv.slo.evaluate(now=t0 + 5)
+        assert len(_bundles(tmp_path)) == 1
+
+        # recovery: the latency window drains and healthy samples land
+        st.reset()
+        for _ in range(8):
+            st.add(0.01)
+        cleared = []
+        for k in range(6, 10):
+            srv.history.sample(now=t0 + k)
+            cleared += srv.slo.evaluate(now=t0 + k)
+        assert [t["event"] for t in cleared] == ["slo_clear"]
+        assert srv.slo.firing() == []
+        with ServingClient(host, port) as c:
+            assert 'obs_slo_firing{slo="ttft_p99"} 0' in c.metrics()
+
+        # second episode: re-fires and freezes a SECOND bundle
+        for _ in range(8):
+            st.add(5.0)
+        for k in range(10, 16):
+            srv.history.sample(now=t0 + k)
+            srv.slo.evaluate(now=t0 + k)
+        assert srv.slo.firing() == ["ttft_p99"]
+        assert len(_bundles(tmp_path)) == 2, \
+            "a new episode after recovery must dump again"
+        # the renderer round-trips the health-plane section
+        from tools.postmortem import main as postmortem_main
+        assert postmortem_main([found[0]]) == 0
+    finally:
+        srv.stop_background(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# fleet: the router's aggregate history view + obs_top over it
+# ---------------------------------------------------------------------------
+
+def test_router_aggregate_history_labels_replicas(tiny_tr):
+    srvs = []
+    for _ in range(2):
+        eng = _engine(tiny_tr)
+        srv = ServingServer(eng, max_queue=16)
+        srv.start_background()
+        srvs.append(srv)
+    rt = FleetRouter(port=0,
+                     replicas=[(s.host, s.port) for s in srvs],
+                     poll_interval_s=0.1, heartbeat_misses=100)
+    host, port = rt.start_background()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(rt.table) == 2 and \
+                    all(r.backend is not None and not r.backend.dead
+                        for r in rt.table):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("replica backends never connected")
+        # deterministic rings: one manual sample each, background off
+        for srv in srvs:
+            srv.history_sampler.stop()
+            srv.history.sample()
+        rt.history_sampler.stop()
+        rt.history.sample()
+
+        rids = sorted(r.rid for r in rt.table)
+        with ServingClient(host, port) as c:
+            reply = c.history(aggregate=True)
+            c.metrics(aggregate=True)      # populate the metrics rpc lane
+        assert reply["aggregate"] is True
+        assert reply["replicas"] == rids
+        keys = reply["series"]
+        for rid in rids:
+            assert f'serving_num_slots{{replica="{rid}"}}' in keys
+        # the router's own series pass through unlabeled
+        assert "fleet_replicas_registered" in keys
+        assert "fleet_replicas_healthy" in keys
+
+        # the loop-thread RPC audit: each reply type fans out on its own
+        # lock-serialized lane — a slow history pull must never block the
+        # stats heartbeat
+        be = next(iter(rt.table)).backend
+        assert be._rpc_locks["history"] is not be._rpc_locks["metrics"]
+
+        # obs_top renders the same aggregate (no TTY: one-shot poll)
+        from tools.obs_top import poll_router, render
+        frame = poll_router(f"{host}:{port}", 300.0)
+        assert frame["mode"] == "router"
+        assert frame["replicas"] == rids
+        assert "router" in frame["rows"]
+        for rid in rids:
+            assert rid in frame["rows"]
+            assert frame["rows"][rid]["occupancy"] == 0.0
+        text = render(frame)
+        assert "tok/s" in text and "router" in text
+    finally:
+        rt.stop_background(drain=True)
+        for srv in srvs:
+            srv.stop_background(drain=True)
+
+
+def test_obs_top_key_parsing_and_bucketing():
+    from tools.obs_top import bucket_series, parse_key, sparkline
+
+    assert parse_key('a{replica="r0",x="1"}') \
+        == ("a", {"replica": "r0", "x": "1"})
+    assert parse_key("plain") == ("plain", {})
+    series = {
+        'serving_num_slots{replica="r0"}':
+            {"kind": "gauge", "points": [[T, 2.0]]},
+        "fleet_inflight": {"kind": "gauge", "points": [[T, 1.0]]},
+    }
+    buckets = bucket_series(series)
+    assert set(buckets) == {"", "r0"}
+    assert buckets["r0"].points("serving_num_slots") == [[T, 2.0]]
+    s = sparkline([0.0, 1.0, 2.0, 3.0], width=4)
+    assert len(s) == 4 and s[0] != s[-1]

@@ -22,6 +22,13 @@ Clients connect to the router exactly as to one replica — serving/client.py,
 Operate the fleet with `python -m paddle_tpu.fleet.ctl --router HOST:PORT
 join|leave|drain|undrain|list|wait-drained` (the rolling-restart runbook
 lives in docs/serving.md "Fleet").
+
+One-shot client ops (stats / fleet-aggregated metrics / health-plane
+history — `python tools/obs_top.py --router HOST:PORT` is the live view):
+
+  python tools/fleet_router.py --client 127.0.0.1:8440 --stats
+  python tools/fleet_router.py --client 127.0.0.1:8440 --metrics --aggregate
+  python tools/fleet_router.py --client 127.0.0.1:8440 --history --aggregate
 """
 
 from __future__ import annotations
@@ -39,6 +46,46 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def parse_addr(s: str) -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+def _render_history(reply: dict) -> str:
+    # compact one-line-per-series view for --watch (tools/serve.py has
+    # the same shape; tools/obs_top.py is the real dashboard)
+    lines = [f"samples={reply.get('samples_taken')} "
+             f"resolution={reply.get('resolution_s')}s "
+             f"replicas={reply.get('replicas')} "
+             f"series={len(reply.get('series') or {})}"]
+    for key, ser in sorted((reply.get("series") or {}).items()):
+        pts = ser.get("points") or []
+        last = pts[-1][1] if pts else "?"
+        lines.append(f"  {ser.get('kind', '?'):7s} {key}  "
+                     f"last={last} n={len(pts)}")
+    return "\n".join(lines)
+
+
+def run_client(args) -> int:
+    import time
+
+    from paddle_tpu.serving.client import ServingClient
+
+    host, port = parse_addr(args.client)
+    with ServingClient(host, port) as c:
+        if args.metrics:
+            print(c.metrics(aggregate=args.aggregate), end="")
+        elif args.history:
+            while True:
+                reply = c.history(last_s=args.last_s or None,
+                                  aggregate=args.aggregate)
+                if not args.watch:
+                    print(json.dumps(reply, indent=2))
+                    break
+                print("\x1b[H\x1b[J" + _render_history(reply), flush=True)
+                time.sleep(args.watch)
+        elif args.dump:
+            print(json.dumps(c.dump(), indent=2))
+        else:
+            print(json.dumps(c.stats(), indent=2))
+    return 0
 
 
 async def amain(args) -> int:
@@ -133,7 +180,34 @@ def main(argv=None) -> int:
                          "trace ids); spans written as JSONL here on "
                          "EVERY exit path — clean drain, crash, SIGTERM "
                          "— ready for tools/trace_dump.py --merge")
+    # client mode
+    ap.add_argument("--client", default="",
+                    help="HOST:PORT — run as a one-shot client instead")
+    ap.add_argument("--stats", action="store_true",
+                    help="with --client: print the fleet stats frame "
+                         "(the default op)")
+    ap.add_argument("--metrics", action="store_true",
+                    help="with --client: print the Prometheus text")
+    ap.add_argument("--history", action="store_true",
+                    help="with --client: print the health-plane "
+                         "time-series ring (the `history` RPC)")
+    ap.add_argument("--aggregate", action="store_true",
+                    help="with --metrics/--history: the FLEET view — "
+                         "router series plus every replica's under "
+                         "replica=\"rN\" labels")
+    ap.add_argument("--last-s", type=float, default=0.0,
+                    help="with --history: trailing window in seconds "
+                         "(0 = full retention)")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="with --history: re-poll every N seconds as a "
+                         "compact live view (tools/obs_top.py is the "
+                         "full dashboard)")
+    ap.add_argument("--dump", action="store_true",
+                    help="with --client: freeze a fleet postmortem "
+                         "bundle and print its path")
     args = ap.parse_args(argv)
+    if args.client:
+        return run_client(args)
     return asyncio.run(amain(args))
 
 

@@ -1,0 +1,258 @@
+"""obs_top: a top-style live terminal dashboard for the fleet health plane.
+
+Polls the `history` RPC (obs/timeseries.py — loop-thread, stale-ok, so
+rows keep updating while a replica's pump is wedged) plus the `stats`
+frame, and renders one row per process: role, token rate, slot/page
+occupancy, prefix hit rate, speculative accept rate, firing SLOs
+(obs/slo.py), and sparkline trends — the 2016 `watch nvidia-smi` habit,
+rebuilt for an engine-pump fleet.
+
+Against a fleet router the single aggregate `history` reply carries
+every replica's series under `replica="rN"` labels:
+
+  python tools/obs_top.py --router 127.0.0.1:8440
+
+Or poll an explicit host list (replicas, routers, pservers — any mix;
+each answers its own ring):
+
+  python tools/obs_top.py --hosts 127.0.0.1:8431,127.0.0.1:8432
+
+`--once` renders a single frame and exits; `--once --json` prints the
+computed rows as machine-readable JSON (what tests/CI consume).
+Stdlib-only, like every client-side tool: serving/client.py + wire.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.serving.client import ServingClient  # noqa: E402
+
+#: eight-level sparkline ramp (min..max over the series window)
+SPARK = "▁▂▃▄▅▆▇█"
+
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_key(key: str) -> tuple[str, dict]:
+    """Split a history series key into (metric name, labels dict)."""
+    name, _, inner = key.partition("{")
+    labels = {m.group(1): re.sub(r"\\(.)", r"\1", m.group(2))
+              for m in _LABEL_RE.finditer(inner)}
+    return name, labels
+
+
+def sparkline(values, width: int = 12) -> str:
+    """Newest-right sparkline over the last `width` values."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK[0] * len(vals)
+    return "".join(SPARK[min(len(SPARK) - 1,
+                             int((v - lo) / (hi - lo) * (len(SPARK) - 1)))]
+                   for v in vals)
+
+
+def _last(points) -> float:
+    return float(points[-1][1]) if points else 0.0
+
+
+def _sum(points) -> float:
+    return float(sum(p[1] for p in points))
+
+
+class _Bucket:
+    """One process's series, keyed by bare metric name."""
+
+    def __init__(self):
+        self.series: dict[str, dict] = {}    # name -> {"points", "kind"}
+
+    def add(self, name: str, ser: dict) -> None:
+        # a labeled family (e.g. latency quantiles) keeps its labels in
+        # the bucket key so specific quantiles stay addressable
+        self.series[name] = ser
+
+    def points(self, name: str):
+        ser = self.series.get(name)
+        return (ser or {}).get("points") or []
+
+
+def bucket_series(series: dict) -> dict[str, _Bucket]:
+    """Group an aggregate (or single-process) series dict by the
+    `replica` label; unlabeled series land under "" (the polled process
+    itself — the router's own rows in aggregate mode)."""
+    out: dict[str, _Bucket] = {}
+    for key, ser in (series or {}).items():
+        name, labels = parse_key(key)
+        rid = labels.pop("replica", "")
+        bkey = name if not labels else \
+            name + "{" + ",".join(f'{k}="{v}"'
+                                  for k, v in sorted(labels.items())) + "}"
+        out.setdefault(rid, _Bucket()).add(bkey, ser)
+    return out
+
+
+def row_from_bucket(b: _Bucket, resolution_s: float) -> dict:
+    """The computed per-process row: rates from counter deltas, ratios
+    over the visible window, firing SLOs from the obs_slo_firing series."""
+    res = max(1e-9, float(resolution_s))
+    tok = b.points("serving_tokens_generated_total")
+    hits = _sum(b.points("serving_prefix_hits_total"))
+    misses = _sum(b.points("serving_prefix_misses_total"))
+    drafted = _sum(b.points("serving_spec_drafted_total"))
+    accepted = _sum(b.points("serving_spec_accepted_total"))
+    slots = _last(b.points("serving_num_slots"))
+    row = {
+        "tok_s": round(_last(tok) / res, 2),
+        "tok_spark": sparkline([v for _t, v in tok]),
+        "occupancy": round(_last(b.points("serving_slots_in_use"))
+                           / slots, 3) if slots else None,
+        "hit_rate": round(hits / (hits + misses), 3)
+        if hits + misses else None,
+        "accept_rate": round(accepted / drafted, 3) if drafted else None,
+        "slos_firing": sorted(
+            parse_key(k)[1].get("slo", "?")
+            for k, ser in b.series.items()
+            if k.startswith("obs_slo_firing")
+            and _last(ser.get("points") or []) >= 1.0),
+    }
+    # non-serving processes (router/pserver) still get their trend column
+    if not tok:
+        for name in ("fleet_requests_accepted_total",
+                     "pserver_updates_total"):
+            pts = b.points(name)
+            if pts:
+                row["tok_s"] = None
+                row["rate_s"] = round(_last(pts) / res, 2)
+                row["tok_spark"] = sparkline([v for _t, v in pts])
+                break
+    return row
+
+
+def poll_router(addr: str, last_s: float) -> dict:
+    host, _, port = addr.rpartition(":")
+    with ServingClient(host or "127.0.0.1", int(port)) as c:
+        hist = c.history(last_s=last_s or None, aggregate=True)
+        stats = c.stats()
+    res = float(hist.get("resolution_s") or 5.0)
+    roles = {}
+    for r in stats.get("replicas") or []:
+        roles[r.get("replica")] = {"role": r.get("role"),
+                                   "state": r.get("state"),
+                                   "addr": r.get("addr")}
+    rows = {}
+    for rid, b in sorted(bucket_series(hist.get("series")).items()):
+        row = row_from_bucket(b, res)
+        if rid == "":
+            row.update(role="router", state="-", addr=addr)
+            rows["router"] = row
+        else:
+            row.update(roles.get(rid) or {"role": "?", "state": "?"})
+            rows[rid] = row
+    return {"mode": "router", "resolution_s": res,
+            "last_sample_unix": hist.get("last_sample_unix"),
+            "replicas": sorted(hist.get("replicas") or []), "rows": rows}
+
+
+def poll_hosts(addrs: list[str], last_s: float) -> dict:
+    rows = {}
+    res = 5.0
+    for addr in addrs:
+        host, _, port = addr.rpartition(":")
+        try:
+            with ServingClient(host or "127.0.0.1", int(port),
+                               connect_attempts=1) as c:
+                hello = c.hello()
+                hist = c.history(last_s=last_s or None)
+                stats = c.stats(stale_ok=hello.get("role") == "replica")
+        except (OSError, ConnectionError) as e:
+            rows[addr] = {"role": "?", "state": "unreachable",
+                          "error": f"{type(e).__name__}: {e}"}
+            continue
+        res = float(hist.get("resolution_s") or res)
+        b = bucket_series(hist.get("series")).get("") or _Bucket()
+        row = row_from_bucket(b, res)
+        row.update(role=stats.get("role") or hello.get("role") or "?",
+                   state="draining" if stats.get("draining") else "up",
+                   addr=addr)
+        rows[addr] = row
+    return {"mode": "hosts", "resolution_s": res, "rows": rows}
+
+
+def _fmt(v, pct: bool = False) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 100:.1f}%" if pct else f"{v:g}"
+
+
+def render(frame: dict) -> str:
+    head = (f"obs_top  {time.strftime('%H:%M:%S')}  "
+            f"resolution={frame.get('resolution_s')}s  "
+            f"rows={len(frame['rows'])}")
+    cols = (f"{'id':14s} {'role':8s} {'state':10s} {'tok/s':>8s} "
+            f"{'occ':>6s} {'hit':>6s} {'acc':>6s}  {'trend':12s} slo")
+    lines = [head, cols]
+    for rid, row in frame["rows"].items():
+        if row.get("state") == "unreachable":
+            lines.append(f"{rid:14s} {'?':8s} unreachable  "
+                         f"({row.get('error', '')})")
+            continue
+        rate = row.get("tok_s")
+        if rate is None:
+            rate = row.get("rate_s")
+        firing = ",".join(row.get("slos_firing") or []) or "-"
+        lines.append(
+            f"{rid:14.14s} {str(row.get('role') or '-'):8.8s} "
+            f"{str(row.get('state') or '-'):10.10s} "
+            f"{_fmt(rate):>8s} {_fmt(row.get('occupancy'), True):>6s} "
+            f"{_fmt(row.get('hit_rate'), True):>6s} "
+            f"{_fmt(row.get('accept_rate'), True):>6s}  "
+            f"{row.get('tok_spark', ''):12s} {firing}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--router", default="",
+                    help="HOST:PORT of a fleet router — one aggregate "
+                         "history pull covers every replica")
+    ap.add_argument("--hosts", default="",
+                    help="comma-separated HOST:PORT list to poll "
+                         "directly (replicas/routers/pservers, any mix)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period, seconds")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="trailing history window per pull, seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: print the computed rows as JSON "
+                         "(the tests/CI contract)")
+    args = ap.parse_args(argv)
+    if bool(args.router) == bool(args.hosts):
+        print("need exactly one of --router HOST:PORT or --hosts ...",
+              file=sys.stderr)
+        return 2
+    addrs = [a for a in args.hosts.split(",") if a.strip()]
+    while True:
+        frame = poll_router(args.router, args.window) if args.router \
+            else poll_hosts(addrs, args.window)
+        if args.once:
+            print(json.dumps(frame, indent=2) if args.json
+                  else render(frame))
+            return 0
+        print("\x1b[H\x1b[J" + render(frame), flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

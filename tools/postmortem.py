@@ -47,6 +47,55 @@ def _fmt_bytes(n) -> str:
     return f"{n:.1f}GiB"
 
 
+#: eight-level sparkline ramp (tools/obs_top.py's, newest-right)
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 24) -> str:
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    return "".join(_SPARK[min(len(_SPARK) - 1,
+                              int((v - lo) / (hi - lo)
+                                  * (len(_SPARK) - 1)))] for v in vals)
+
+
+def _render_history(bundle: dict) -> list:
+    """The health-plane section: ring accounting from history.json plus —
+    for an SLO-triggered bundle — each firing objective's offending
+    series as a sparkline (the slo_fire flight events name their series
+    keys, frozen BEFORE anything died)."""
+    hist = bundle.get("history") or {}
+    series = hist.get("series") or {}
+    if not series:
+        return []
+    out = [f"history: {len(series)} series, "
+           f"{hist.get('samples_taken')} samples at "
+           f"{hist.get('resolution_s')}s resolution — history.json"]
+    for ev in bundle.get("events") or []:
+        if ev.get("kind") != "slo_fire":
+            continue
+        d = ev.get("data") or {}
+        out.append(f"  SLO {d.get('slo', '?')}: value={d.get('value')} "
+                   f"{d.get('op', '?')} objective={d.get('objective')}  "
+                   f"burn short={d.get('short_burn')} "
+                   f"long={d.get('long_burn')}")
+        for key in str(d.get("series") or "").split(","):
+            ser = series.get(key)
+            pts = (ser or {}).get("points") or []
+            if not pts:
+                continue
+            vals = [v for _t, v in pts]
+            out.append(f"    {key}")
+            out.append(f"      {_sparkline(vals)}  "
+                       f"min={min(vals):g} max={max(vals):g} "
+                       f"last={vals[-1]:g} n={len(vals)}")
+    return out
+
+
 def _render_pserver(eng: dict) -> list:
     """The pserver half of render(): membership table, update-thread
     state, window/commit/snapshot counters — the engine.json a
@@ -169,6 +218,8 @@ def render(bundle: dict, n_events: int = 20) -> str:
             out.append("metrics: " + "  ".join(
                 f"{k}={metrics[k]:g}" for k in heads)
                 + f"  ({len(metrics)} total — metrics.json)")
+
+    out.extend(_render_history(bundle))
 
     events = bundle.get("events") or []
     out.append(f"events: {len(events)} retained "

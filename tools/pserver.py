@@ -69,6 +69,11 @@ def run_client(args) -> int:
                 print(reply["error"], file=sys.stderr)
                 return 1
             print(json.dumps(reply, indent=2))
+        elif args.history:
+            # the health-plane ring (loop thread, stale-ok — answers
+            # against a wedged update thread; docs/observability.md)
+            wire.write_frame_sync(sock, {"type": "history", "id": "cli"})
+            print(json.dumps(wire.read_frame_sync(sock), indent=2))
         else:
             wire.write_frame_sync(sock, {"type": "stats"})
             print(json.dumps(wire.read_frame_sync(sock), indent=2))
@@ -178,6 +183,9 @@ def main(argv=None) -> int:
                     help="with --client: print the commit log")
     ap.add_argument("--dump", action="store_true",
                     help="with --client: freeze a postmortem bundle")
+    ap.add_argument("--history", action="store_true",
+                    help="with --client: print the health-plane metric "
+                         "time-series ring (the `history` RPC)")
     args = ap.parse_args(argv)
     if args.client:
         return run_client(args)

@@ -43,8 +43,24 @@ import json
 import os
 import signal
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def render_history(reply: dict) -> str:
+    """Compact per-series text for --history --watch: one line per
+    series with the newest value (the full JSON stays available without
+    --watch; tools/obs_top.py is the real dashboard)."""
+    lines = [f"samples={reply.get('samples_taken')} "
+             f"resolution={reply.get('resolution_s')}s "
+             f"series={len(reply.get('series') or {})}"]
+    for key, ser in sorted((reply.get("series") or {}).items()):
+        pts = ser.get("points") or []
+        last = pts[-1][1] if pts else "?"
+        lines.append(f"  {ser.get('kind', '?'):7s} {key}  "
+                     f"last={last} n={len(pts)}")
+    return "\n".join(lines)
 
 
 def run_client(args) -> int:
@@ -55,6 +71,16 @@ def run_client(args) -> int:
         if args.metrics:
             print(c.metrics(aggregate=args.aggregate), end="")
             return 0
+        if args.history:
+            while True:
+                reply = c.history(last_s=args.last_s or None,
+                                  aggregate=args.aggregate)
+                if not args.watch:
+                    print(json.dumps(reply, indent=2))
+                    return 0
+                # \x1b[H\x1b[J = home + clear: a cheap live view
+                print("\x1b[H\x1b[J" + render_history(reply), flush=True)
+                time.sleep(args.watch)
         if args.dump:
             print(json.dumps(c.dump(), indent=2))
             return 0
@@ -322,6 +348,19 @@ def main(argv=None) -> int:
                     help="with --client: ask the server to freeze a "
                          "postmortem bundle and print its path (works "
                          "against a wedged engine)")
+    ap.add_argument("--history", action="store_true",
+                    help="with --client: print the metric time-series "
+                         "ring (the `history` RPC — loop thread, "
+                         "answers against a wedged engine); against a "
+                         "router --aggregate merges every replica's "
+                         "series under replica=\"rN\" labels")
+    ap.add_argument("--last-s", type=float, default=0.0,
+                    help="with --history: only the trailing window, in "
+                         "seconds (0 = full retention)")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="with --history: re-poll every N seconds and "
+                         "render a compact live view (0 = print JSON "
+                         "once); tools/obs_top.py is the full dashboard")
     # server-side tracing
     ap.add_argument("--trace-out", default="",
                     help="enable request-lifecycle tracing; write spans "
